@@ -1,0 +1,29 @@
+"""spark_rapids_tpu — a TPU-native columnar SQL execution engine.
+
+A from-scratch rebuild of the capabilities of the RAPIDS Accelerator for
+Apache Spark (reference: binmahone/spark-rapids), designed TPU-first:
+
+  * compute path: JAX/XLA programs + Pallas kernels over device-resident
+    Arrow-like columns (static capacity buckets, device row counts);
+  * scale-out: jax.sharding Mesh + shard_map with ICI collectives replacing
+    the reference's UCX/NVLink shuffle transport;
+  * memory: HBM budget manager with host/disk spill tiers and a
+    retry/split-retry discipline mirroring the reference's RMM-based
+    RmmRapidsRetryIterator contract;
+  * planning: declarative override rule tables (wrap -> tag -> convert)
+    mirroring GpuOverrides/RapidsMeta, operating on this engine's logical
+    plans.
+
+Spark-semantics fidelity (LongType/DoubleType/Decimal/hash parity) requires
+64-bit lanes, so x64 mode is enabled at import — TPUs emulate i64/f64; hot
+kernels deliberately stay in 32-bit lanes where Spark semantics allow.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from . import types  # noqa: E402
+from .columnar.column import Column, StringColumn, bucket_capacity  # noqa: E402
+from .columnar.batch import ColumnarBatch  # noqa: E402
+from .version import __version__  # noqa: E402

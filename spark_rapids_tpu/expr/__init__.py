@@ -1,0 +1,26 @@
+"""Expression layer — the engine's analog of the reference's ~218 Gpu
+expression implementations (GpuOverrides.scala:919 rule table)."""
+
+from .core import (
+    Alias, BoundReference, Expression, Literal, UnresolvedAttribute, col, lit,
+    output_name, resolve,
+)
+from .arithmetic import (
+    Abs, Add, Divide, Greatest, IntegralDivide, Least, Multiply, Pmod,
+    Remainder, Subtract, UnaryMinus,
+)
+from .predicates import (
+    And, EqualNullSafe, EqualTo, GreaterThan, GreaterThanOrEqual, In, IsNotNull,
+    IsNull, LessThan, LessThanOrEqual, Not, Or,
+)
+from .conditional import CaseWhen, Coalesce, If, IsNaN, NaNvl
+from .math import (
+    Acos, Asin, Atan, Atan2, BRound, Cbrt, Ceil, Cos, Cosh, Exp, Expm1, Floor,
+    Log, Log10, Log1p, Log2, Pow, Rint, Round, Signum, Sin, Sinh, Sqrt, Tan,
+    Tanh, ToDegrees, ToRadians,
+)
+from .cast import Cast
+from .stringexprs import (
+    Contains, EndsWith, Length, Lower, StartsWith, Substring, Upper,
+)
+from .hashexprs import Murmur3Hash, XxHash64

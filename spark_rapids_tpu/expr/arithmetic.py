@@ -1,0 +1,371 @@
+"""Arithmetic expressions with Spark semantics (non-ANSI mode).
+
+Mirrors reference sql-plugin org/apache/spark/sql/rapids/arithmetic.scala:
+  * integral overflow wraps (Java semantics; XLA integer ops wrap natively);
+  * Divide / IntegralDivide / Remainder / Pmod return NULL when the divisor
+    is 0 (Spark's non-ANSI behavior — unlike IEEE);
+  * binary op type coercion promotes to the wider numeric type
+    (Spark's BinaryArithmetic with implicit casts).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar.column import Column
+from ..types import (
+    DOUBLE, DataType, DecimalType, DoubleType, FloatType, FractionalType,
+    IntegralType, LONG, LongType, numeric_promote,
+)
+from .core import Expression
+
+
+def _promote(l: Column, r: Column, target: DataType):
+    ld = l.data.astype(target.jnp_dtype) if l.dtype != target else l.data
+    rd = r.data.astype(target.jnp_dtype) if r.dtype != target else r.data
+    return ld, rd
+
+
+def _trunc_div(a, b):
+    q = a // b
+    rem = a - q * b
+    # floor division rounds toward -inf; adjust when signs differ and rem != 0
+    adjust = (rem != 0) & ((a < 0) != (b < 0))
+    return q + adjust.astype(q.dtype)
+
+
+def _trunc_mod(a, b):
+    return a - _trunc_div(a, b) * b
+
+
+def _round_div_half_up(a, m):
+    """(a / m) rounded HALF_UP on int lanes (m positive int scalar)."""
+    half = m // 2
+    adj = jnp.where(a >= 0, a + half, a - half)
+    return _trunc_div(adj, m)
+
+
+def _round_div_half_up_signed(a, b):
+    """(a / b) rounded HALF_UP where b may be negative (lanes)."""
+    sign = jnp.where((a >= 0) == (b >= 0), jnp.int64(1), jnp.int64(-1))
+    mag = _round_div_half_up(jnp.abs(a), jnp.abs(b))
+    return sign * mag
+
+
+def _decimal_scale_of(dt: DataType) -> int:
+    if isinstance(dt, DecimalType):
+        return dt.scale
+    return 0  # integral coerced to decimal(p, 0)
+
+
+def _rescale_unscaled(data, from_scale: int, to_scale: int):
+    if to_scale == from_scale:
+        return data
+    if to_scale > from_scale:
+        return data * jnp.int64(10 ** (to_scale - from_scale))
+    return _round_div_half_up(data, jnp.int64(10 ** (from_scale - to_scale)))
+
+
+class BinaryArithmetic(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    @property
+    def data_type(self) -> DataType:
+        lt, rt = self.left.data_type, self.right.data_type
+        if isinstance(lt, DecimalType) or isinstance(rt, DecimalType):
+            return self._decimal_type(lt, rt)
+        if lt == rt:
+            return lt
+        return numeric_promote(lt, rt)
+
+    def _decimal_type(self, lt, rt) -> DataType:
+        from .decimal_rules import binary_result_type
+        return binary_result_type(type(self).__name__, lt, rt)
+
+    def columnar_eval(self, batch) -> Column:
+        l = self.left.columnar_eval(batch)
+        r = self.right.columnar_eval(batch)
+        out_t = self.data_type
+        if isinstance(out_t, DecimalType):
+            return self._decimal_eval(l, r, out_t)
+        ld, rd = _promote(l, r, out_t)
+        valid = l.validity & r.validity
+        data = self._op(ld, rd)
+        data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+        return Column(data, valid, out_t)
+
+    def _decimal_eval(self, l: Column, r: Column, out_t: DecimalType) -> Column:
+        """Decimal64 arithmetic on unscaled int64 lanes: rescale to a common
+        working scale, operate, rescale HALF_UP to the result scale (Spark's
+        Decimal math; overflow past 18 digits -> NULL, the reference's
+        decimal-64 fast-path contract)."""
+        s1 = _decimal_scale_of(l.dtype)
+        s2 = _decimal_scale_of(r.dtype)
+        valid = l.validity & r.validity
+        name = type(self).__name__
+        ld = l.data.astype(jnp.int64)
+        rd = r.data.astype(jnp.int64)
+        if name in ("Add", "Subtract"):
+            ws = max(s1, s2)
+            a = _rescale_unscaled(ld, s1, ws)
+            b = _rescale_unscaled(rd, s2, ws)
+            res = a + b if name == "Add" else a - b
+            res = _rescale_unscaled(res, ws, out_t.scale)
+        elif name == "Multiply":
+            res = _rescale_unscaled(ld * rd, s1 + s2, out_t.scale)
+        elif name == "Divide":
+            # l/r at result scale rs: unscaled = l*10^(rs - s1 + s2) / r
+            shift = out_t.scale - s1 + s2
+            num = ld * jnp.int64(10 ** max(shift, 0))
+            if shift < 0:
+                num = _round_div_half_up(num, jnp.int64(10 ** (-shift)))
+            div_ok = rd != 0
+            safe_r = jnp.where(div_ok, rd, jnp.int64(1))
+            res = _round_div_half_up_signed(num, safe_r)
+            valid = valid & div_ok
+        elif name in ("Remainder", "Pmod"):
+            ws = max(s1, s2)
+            a = _rescale_unscaled(ld, s1, ws)
+            b = _rescale_unscaled(rd, s2, ws)
+            div_ok = b != 0
+            safe_b = jnp.where(div_ok, b, jnp.int64(1))
+            res = _trunc_mod(a, safe_b)
+            if name == "Pmod":
+                res = jnp.where(res < 0, res + jnp.abs(safe_b), res)
+            res = _rescale_unscaled(res, ws, out_t.scale)
+            valid = valid & div_ok
+        else:
+            raise TypeError(f"no decimal eval for {name}")
+        bound = 10 ** min(out_t.precision, 18)
+        ok = (res < bound) & (res > -bound)
+        valid = valid & ok
+        return Column(jnp.where(valid, res, 0), valid, out_t)
+
+    def _op(self, l, r):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def _op(self, l, r):
+        return l + r
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def _op(self, l, r):
+        return l - r
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def _op(self, l, r):
+        return l * r
+
+
+class Divide(BinaryArithmetic):
+    """Spark `/`: fractional result; NULL on divide-by-zero."""
+    symbol = "/"
+
+    @property
+    def data_type(self):
+        lt, rt = self.left.data_type, self.right.data_type
+        if isinstance(lt, DecimalType) or isinstance(rt, DecimalType):
+            return self._decimal_type(lt, rt)
+        return DOUBLE
+
+    def columnar_eval(self, batch):
+        l = self.left.columnar_eval(batch)
+        r = self.right.columnar_eval(batch)
+        out_t = self.data_type
+        if isinstance(out_t, DecimalType):
+            return self._decimal_eval(l, r, out_t)
+        ld, rd = _promote(l, r, out_t)
+        zero = jnp.zeros((), rd.dtype)
+        div_ok = rd != zero
+        valid = l.validity & r.validity & div_ok
+        data = ld / jnp.where(div_ok, rd, jnp.ones((), rd.dtype))
+        data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+        return Column(data, valid, out_t)
+
+
+class IntegralDivide(BinaryArithmetic):
+    """Spark `div`: long result, truncated toward zero; NULL on zero divisor."""
+    symbol = "div"
+
+    @property
+    def data_type(self):
+        return LONG
+
+    def columnar_eval(self, batch):
+        l = self.left.columnar_eval(batch)
+        r = self.right.columnar_eval(batch)
+        s1 = _decimal_scale_of(l.dtype)
+        s2 = _decimal_scale_of(r.dtype)
+        ws = max(s1, s2)
+        ld = _rescale_unscaled(l.data.astype(jnp.int64), s1, ws)
+        rd = _rescale_unscaled(r.data.astype(jnp.int64), s2, ws)
+        div_ok = rd != 0
+        valid = l.validity & r.validity & div_ok
+        safe_r = jnp.where(div_ok, rd, jnp.int64(1))
+        q = _trunc_div(ld, safe_r)
+        q = jnp.where(valid, q, jnp.int64(0))
+        return Column(q, valid, LONG)
+
+
+class Remainder(BinaryArithmetic):
+    """Spark `%`: sign of dividend (Java); NULL on zero divisor."""
+    symbol = "%"
+
+    def columnar_eval(self, batch):
+        l = self.left.columnar_eval(batch)
+        r = self.right.columnar_eval(batch)
+        out_t = self.data_type
+        if isinstance(out_t, DecimalType):
+            return self._decimal_eval(l, r, out_t)
+        ld, rd = _promote(l, r, out_t)
+        if isinstance(out_t, FractionalType):
+            div_ok = rd != 0
+            safe_r = jnp.where(div_ok, rd, jnp.ones((), rd.dtype))
+            data = ld - jnp.trunc(ld / safe_r) * safe_r
+        else:
+            div_ok = rd != 0
+            safe_r = jnp.where(div_ok, rd, jnp.ones((), rd.dtype))
+            data = _trunc_mod(ld, safe_r)
+        valid = l.validity & r.validity & div_ok
+        data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+        return Column(data, valid, out_t)
+
+
+class Pmod(BinaryArithmetic):
+    """Spark pmod: always-positive modulus; NULL on zero divisor."""
+    symbol = "pmod"
+
+    def columnar_eval(self, batch):
+        l = self.left.columnar_eval(batch)
+        r = self.right.columnar_eval(batch)
+        out_t = self.data_type
+        if isinstance(out_t, DecimalType):
+            return self._decimal_eval(l, r, out_t)
+        ld, rd = _promote(l, r, out_t)
+        div_ok = rd != 0
+        safe_r = jnp.where(div_ok, rd, jnp.ones((), rd.dtype))
+        if isinstance(out_t, FractionalType):
+            m = ld - jnp.trunc(ld / safe_r) * safe_r
+        else:
+            m = _trunc_mod(ld, safe_r)
+        m = jnp.where(m < 0, m + jnp.abs(safe_r), m)
+        valid = l.validity & r.validity & div_ok
+        m = jnp.where(valid, m, jnp.zeros((), m.dtype))
+        return Column(m, valid, out_t)
+
+
+class UnaryMinus(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def with_children(self, children):
+        return UnaryMinus(children[0])
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        return Column(-c.data, c.validity, c.dtype)
+
+
+class Abs(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def with_children(self, children):
+        return Abs(children[0])
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        return Column(jnp.abs(c.data), c.validity, c.dtype)
+
+
+class Least(Expression):
+    """Spark least(): null-skipping minimum across children."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def with_children(self, children):
+        return Least(*children)
+
+    def columnar_eval(self, batch):
+        return _least_greatest(self, batch, want_smaller=True)
+
+
+class Greatest(Expression):
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def with_children(self, children):
+        return Greatest(*children)
+
+    def columnar_eval(self, batch):
+        return _least_greatest(self, batch, want_smaller=False)
+
+
+def _least_greatest(node, batch, want_smaller: bool):
+    """Null-skipping min/max across children with Java float ordering
+    (NaN greatest) — Spark least()/greatest()."""
+    from .predicates import _float_compare_sign
+    cols = [c.columnar_eval(batch) for c in node.children]
+    out_t = node.data_type
+    is_float = jnp.issubdtype(out_t.jnp_dtype, jnp.floating) \
+        if out_t.jnp_dtype is not None else False
+    data, valid = None, None
+    for c in cols:
+        d = c.data.astype(out_t.jnp_dtype)
+        if data is None:
+            data, valid = d, c.validity
+            continue
+        if is_float:
+            sign = _float_compare_sign(d, data)
+            better = (sign < 0) if want_smaller else (sign > 0)
+        else:
+            better = (d < data) if want_smaller else (d > data)
+        take_new = c.validity & (~valid | better)
+        data = jnp.where(take_new, d, data)
+        valid = valid | c.validity
+    return Column(jnp.where(valid, data, jnp.zeros((), data.dtype)),
+                  valid, out_t)
+

@@ -1,0 +1,138 @@
+"""Cast expression — Spark cast matrix (reference GpuCast.scala:1823 plus
+JNI CastStrings). This module starts with the numeric/temporal core; the
+string-cast long tail (string->number parsing with Spark's trim/overflow
+rules, number->string formatting) lives in ops/cast_strings.py and grows
+under phase 7.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+from ..types import (
+    BOOLEAN, BooleanType, ByteType, DataType, DateType, DecimalType,
+    DoubleType, FloatType, FractionalType, IntegerType, IntegralType,
+    LongType, ShortType, StringType, TimestampType,
+)
+from .core import Expression
+
+_INT_BOUNDS = {
+    ByteType: (-128, 127),
+    ShortType: (-32768, 32767),
+    IntegerType: (-(2**31), 2**31 - 1),
+    LongType: (-(2**63), 2**63 - 1),
+}
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, dtype: DataType, ansi: bool = False):
+        self.children = (child,)
+        self._dtype = dtype
+        self.ansi = ansi
+
+    def with_children(self, children):
+        return Cast(children[0], self._dtype, self.ansi)
+
+    def _semantic_args(self):
+        return (repr(self._dtype), self.ansi)
+
+    @property
+    def data_type(self):
+        return self._dtype
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        src, dst = c.dtype, self._dtype
+        if src == dst:
+            return c
+        if isinstance(dst, StringType):
+            from ..ops.cast_strings import cast_to_string
+            return cast_to_string(c)
+        if isinstance(src, StringType):
+            from ..ops.cast_strings import cast_string_to
+            return cast_string_to(c, dst)
+        if isinstance(dst, BooleanType):
+            data = c.data != jnp.zeros((), c.data.dtype)
+            return Column(data & c.validity, c.validity, dst)
+        if isinstance(src, BooleanType):
+            data = c.data.astype(dst.jnp_dtype)
+            return Column(data, c.validity, dst)
+        if isinstance(dst, IntegralType) and isinstance(src, FractionalType) \
+                and not isinstance(src, DecimalType):
+            # Spark float->int: truncate; NaN -> 0; out of range saturates
+            lo, hi = _INT_BOUNDS[type(dst)]
+            x = jnp.nan_to_num(c.data, nan=0.0, posinf=float(hi), neginf=float(lo))
+            x = jnp.clip(jnp.trunc(x), float(lo), float(hi))
+            # convert via int64 then clamp in the integer domain: XLA's
+            # float->int conversion clamping is not exact at the boundary
+            data = jnp.clip(x.astype(jnp.int64), lo, hi).astype(dst.jnp_dtype)
+            return Column(jnp.where(c.validity, data, 0), c.validity, dst)
+        if isinstance(dst, DecimalType):
+            return self._cast_to_decimal(c, src, dst)
+        if isinstance(src, DecimalType):
+            return self._cast_from_decimal(c, src, dst)
+        if isinstance(src, DateType) and isinstance(dst, TimestampType):
+            data = c.data.astype(jnp.int64) * 86_400_000_000
+            return Column(jnp.where(c.validity, data, 0), c.validity, dst)
+        if isinstance(src, TimestampType) and isinstance(dst, DateType):
+            days = jnp.floor_divide(c.data, 86_400_000_000).astype(jnp.int32)
+            return Column(jnp.where(c.validity, days, 0), c.validity, dst)
+        if isinstance(src, TimestampType) and isinstance(dst, LongType):
+            data = jnp.floor_divide(c.data, 1_000_000)
+            return Column(jnp.where(c.validity, data, 0), c.validity, dst)
+        if isinstance(src, (IntegralType,)) and isinstance(dst, TimestampType):
+            data = c.data.astype(jnp.int64) * 1_000_000
+            return Column(jnp.where(c.validity, data, 0), c.validity, dst)
+        # numeric widening/narrowing: Java-style wrap on narrowing
+        data = c.data.astype(dst.jnp_dtype)
+        data = jnp.where(c.validity, data, jnp.zeros((), data.dtype))
+        return Column(data, c.validity, dst)
+
+    def _cast_to_decimal(self, c, src, dst: DecimalType):
+        scale_m = 10 ** dst.scale
+        if isinstance(src, DecimalType):
+            shift = dst.scale - src.scale
+            if shift >= 0:
+                unscaled = c.data * (10 ** shift)
+            else:
+                unscaled = _round_div_half_up(c.data, 10 ** (-shift))
+        elif isinstance(src, IntegralType):
+            unscaled = c.data.astype(jnp.int64) * scale_m
+        else:  # float/double -> decimal, HALF_UP at target scale
+            x = c.data.astype(jnp.float64) * scale_m
+            unscaled = jnp.where(x >= 0, jnp.floor(x + 0.5),
+                                 jnp.ceil(x - 0.5)).astype(jnp.int64)
+        # overflow -> null (non-ANSI)
+        bound = 10 ** dst.precision
+        ok = (unscaled < bound) & (unscaled > -bound)
+        valid = c.validity & ok
+        return Column(jnp.where(valid, unscaled, 0), valid, dst)
+
+    def _cast_from_decimal(self, c, src: DecimalType, dst):
+        m = 10 ** src.scale
+        if isinstance(dst, FractionalType) and not isinstance(dst, DecimalType):
+            data = c.data.astype(jnp.float64) / m
+            data = data.astype(dst.jnp_dtype)
+            return Column(jnp.where(c.validity, data, jnp.zeros((), data.dtype)),
+                          c.validity, dst)
+        if isinstance(dst, IntegralType):
+            q = _trunc_div64(c.data, jnp.int64(m))
+            lo, hi = _INT_BOUNDS[type(dst)]
+            ok = (q >= lo) & (q <= hi)
+            valid = c.validity & ok
+            return Column(jnp.where(valid, q.astype(dst.jnp_dtype), 0), valid, dst)
+        raise TypeError(f"cast decimal -> {dst} unsupported")
+
+
+def _trunc_div64(a, b):
+    q = a // b
+    rem = a - q * b
+    adjust = (rem != 0) & ((a < 0) != (b < 0))
+    return q + adjust.astype(q.dtype)
+
+
+def _round_div_half_up(a, m: int):
+    half = m // 2
+    adj = jnp.where(a >= 0, a + half, a - half)
+    return _trunc_div64(adj, jnp.int64(m))

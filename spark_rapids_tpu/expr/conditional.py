@@ -1,0 +1,179 @@
+"""Conditional & null expressions (reference conditionalExpressions.scala,
+nullExpressions.scala): If, CaseWhen, Coalesce, IsNaN, NaNvl, Nvl-family.
+
+All are lazy in Spark only for side effects; columnar eval computes all
+branches and blends with jnp.where — the XLA-idiomatic form (no divergence
+cost on a vector machine; fusion collapses the blends).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+from ..types import BOOLEAN, DOUBLE, DataType, DoubleType, FloatType
+from .core import Expression
+
+
+def _blend(pred_data, pred_valid, t: Column, f: Column) -> Column:
+    """Select t where predicate is TRUE (valid & data), else f; a NULL
+    predicate selects the else branch value semantics-wise? No — Spark: NULL
+    predicate yields the else branch in CaseWhen chains and NULL-selects
+    `else` in If. Spark If: if(cond, a, b) with NULL cond -> b."""
+    take_t = pred_data & pred_valid
+    if isinstance(t, StringColumn) or isinstance(f, StringColumn):
+        return _blend_strings(take_t, t, f)
+    data = jnp.where(take_t, t.data, f.data)
+    valid = jnp.where(take_t, t.validity, f.validity)
+    return Column(jnp.where(valid, data, jnp.zeros((), data.dtype)),
+                  valid, t.dtype)
+
+
+def _blend_strings(take_t, t: StringColumn, f: StringColumn) -> StringColumn:
+    """Row-wise select between two string columns: rebuild offsets+bytes."""
+    from ..ops.strings import string_lengths, _rebuild_offsets
+    lt = string_lengths(t)
+    lf = string_lengths(f)
+    valid = jnp.where(take_t, t.validity, f.validity)
+    lengths = jnp.where(valid, jnp.where(take_t, lt, lf), 0)
+    new_offsets = _rebuild_offsets(lengths)
+    # worst case the selection keeps every byte of both inputs' used regions
+    byte_cap = t.byte_capacity + f.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, t.capacity - 1)
+    intra = pos - new_offsets[row]
+    from_t = take_t[row]
+    t_pos = jnp.clip(t.offsets[row] + intra, 0, t.byte_capacity - 1)
+    f_pos = jnp.clip(f.offsets[row] + intra, 0, f.byte_capacity - 1)
+    in_use = pos < new_offsets[-1]
+    data = jnp.where(in_use,
+                     jnp.where(from_t, t.data[t_pos], f.data[f_pos]),
+                     jnp.uint8(0))
+    return StringColumn(data, new_offsets, valid, t.dtype)
+
+
+class If(Expression):
+    def __init__(self, pred: Expression, t: Expression, f: Expression):
+        self.children = (pred, t, f)
+
+    def with_children(self, children):
+        return If(*children)
+
+    @property
+    def data_type(self):
+        return self.children[1].data_type
+
+    def columnar_eval(self, batch):
+        p = self.children[0].columnar_eval(batch)
+        t = self.children[1].columnar_eval(batch)
+        f = self.children[2].columnar_eval(batch)
+        return _blend(p.data, p.validity, t, f)
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 ... ELSE e END — right-fold of If blends."""
+
+    def __init__(self, branches, else_value: Expression | None = None):
+        flat = []
+        for c, v in branches:
+            flat += [c, v]
+        if else_value is not None:
+            flat.append(else_value)
+        self.children = tuple(flat)
+        self.n_branches = len(branches)
+        self.has_else = else_value is not None
+
+    def with_children(self, children):
+        n = self.n_branches
+        branches = [(children[2 * i], children[2 * i + 1]) for i in range(n)]
+        else_v = children[-1] if self.has_else else None
+        return CaseWhen(branches, else_v)
+
+    def _semantic_args(self):
+        return (self.n_branches, self.has_else)
+
+    @property
+    def data_type(self):
+        return self.children[1].data_type
+
+    def columnar_eval(self, batch):
+        n = self.n_branches
+        if self.has_else:
+            result = self.children[-1].columnar_eval(batch)
+        else:
+            from .core import Literal
+            result = Literal(None, self.data_type).columnar_eval(batch)
+        # fold from the last branch backwards so earlier branches win
+        for i in reversed(range(n)):
+            p = self.children[2 * i].columnar_eval(batch)
+            v = self.children[2 * i + 1].columnar_eval(batch)
+            result = _blend(p.data, p.validity, v, result)
+        return result
+
+
+class Coalesce(Expression):
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return Coalesce(*children)
+
+    @property
+    def data_type(self):
+        for c in self.children:
+            from ..types import NullType
+            if not isinstance(c.data_type, NullType):
+                return c.data_type
+        return self.children[0].data_type
+
+    def columnar_eval(self, batch):
+        cols = [c.columnar_eval(batch) for c in self.children]
+        result = cols[-1]
+        for c in reversed(cols[:-1]):
+            result = _blend(c.validity, jnp.ones_like(c.validity), c, result)
+        return result
+
+
+class IsNaN(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return IsNaN(children[0])
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        data = jnp.isnan(c.data) & c.validity
+        return Column(data, jnp.ones_like(c.validity), BOOLEAN)
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): a unless a is NaN, then b (nulls propagate from chosen)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def with_children(self, children):
+        return NaNvl(*children)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def columnar_eval(self, batch):
+        a = self.children[0].columnar_eval(batch)
+        b = self.children[1].columnar_eval(batch)
+        use_b = jnp.isnan(a.data) & a.validity
+        data = jnp.where(use_b, b.data.astype(a.data.dtype), a.data)
+        valid = jnp.where(use_b, b.validity, a.validity)
+        return Column(jnp.where(valid, data, jnp.zeros((), data.dtype)),
+                      valid, a.dtype)

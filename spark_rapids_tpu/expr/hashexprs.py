@@ -1,0 +1,61 @@
+"""Hash expressions: Murmur3Hash (Spark `hash`), XxHash64 (reference
+HashFunctions.scala over JNI Hash kernels)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar.column import Column
+from ..types import INT, LONG
+from .core import Expression
+from ..ops.hashing import murmur3_batch, xxhash64_batch
+
+
+class Murmur3Hash(Expression):
+    def __init__(self, *children: Expression, seed: int = 42):
+        self.children = tuple(children)
+        self.seed = seed
+
+    def with_children(self, children):
+        return Murmur3Hash(*children, seed=self.seed)
+
+    def _semantic_args(self):
+        return (self.seed,)
+
+    @property
+    def data_type(self):
+        return INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        cols = [c.columnar_eval(batch) for c in self.children]
+        h = murmur3_batch(cols, self.seed)
+        return Column(h, jnp.ones((h.shape[0],), jnp.bool_), INT)
+
+
+class XxHash64(Expression):
+    def __init__(self, *children: Expression, seed: int = 42):
+        self.children = tuple(children)
+        self.seed = seed
+
+    def with_children(self, children):
+        return XxHash64(*children, seed=self.seed)
+
+    def _semantic_args(self):
+        return (self.seed,)
+
+    @property
+    def data_type(self):
+        return LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        cols = [c.columnar_eval(batch) for c in self.children]
+        h = xxhash64_batch(cols, self.seed)
+        return Column(h, jnp.ones((h.shape[0],), jnp.bool_), LONG)

@@ -1,0 +1,226 @@
+"""Math expressions (reference mathExpressions.scala): unary transcendentals,
+rounding with Spark HALF_UP/HALF_EVEN semantics, log family with Spark's
+null-on-nonpositive behavior.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar.column import Column
+from ..types import DOUBLE, LONG, DataType, DoubleType, FractionalType, IntegralType
+from .core import Expression
+
+
+class UnaryMath(Expression):
+    """double -> double elementwise; input implicitly cast to double."""
+
+    fn = None
+    #: when True, non-positive inputs produce NULL (Spark log/sqrt family)
+    null_on_nonpositive = False
+    null_on_negative = False
+    #: lower bound (exclusive) below which the result is NULL (log1p: -1)
+    null_below = None
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    @property
+    def data_type(self) -> DataType:
+        return DOUBLE
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        x = c.data.astype(jnp.float64)
+        valid = c.validity
+        if self.null_on_nonpositive:
+            ok = x > 0
+            valid = valid & ok
+            x = jnp.where(ok, x, jnp.float64(1.0))
+        if self.null_on_negative:
+            ok = x >= 0
+            valid = valid & ok
+            x = jnp.where(ok, x, jnp.float64(0.0))
+        if self.null_below is not None:
+            ok = x > self.null_below
+            valid = valid & ok
+            x = jnp.where(ok, x, jnp.float64(0.0))
+        data = type(self).fn(x)
+        data = jnp.where(valid, data, jnp.float64(0.0))
+        return Column(data, valid, DOUBLE)
+
+
+def _mk(name, fn, **attrs):
+    cls = type(name, (UnaryMath,), {"fn": staticmethod(fn), **attrs})
+    return cls
+
+
+Sqrt = _mk("Sqrt", jnp.sqrt)  # Spark sqrt(-x) -> NaN (not null)
+Exp = _mk("Exp", jnp.exp)
+Expm1 = _mk("Expm1", jnp.expm1)
+Log = _mk("Log", jnp.log, null_on_nonpositive=True)
+Log2 = _mk("Log2", jnp.log2, null_on_nonpositive=True)
+Log10 = _mk("Log10", jnp.log10, null_on_nonpositive=True)
+Log1p = _mk("Log1p", jnp.log1p, null_below=-1.0)
+Sin = _mk("Sin", jnp.sin)
+Cos = _mk("Cos", jnp.cos)
+Tan = _mk("Tan", jnp.tan)
+Asin = _mk("Asin", jnp.arcsin)
+Acos = _mk("Acos", jnp.arccos)
+Atan = _mk("Atan", jnp.arctan)
+Sinh = _mk("Sinh", jnp.sinh)
+Cosh = _mk("Cosh", jnp.cosh)
+Tanh = _mk("Tanh", jnp.tanh)
+Asinh = _mk("Asinh", jnp.arcsinh)
+Acosh = _mk("Acosh", jnp.arccosh)
+Atanh = _mk("Atanh", jnp.arctanh)
+Cbrt = _mk("Cbrt", jnp.cbrt)
+ToDegrees = _mk("ToDegrees", jnp.degrees)
+ToRadians = _mk("ToRadians", jnp.radians)
+Signum = _mk("Signum", jnp.sign)
+Rint = _mk("Rint", jnp.rint)
+
+
+class Pow(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def with_children(self, children):
+        return Pow(*children)
+
+    @property
+    def data_type(self):
+        return DOUBLE
+
+    def columnar_eval(self, batch):
+        l = self.children[0].columnar_eval(batch)
+        r = self.children[1].columnar_eval(batch)
+        valid = l.validity & r.validity
+        data = jnp.power(l.data.astype(jnp.float64), r.data.astype(jnp.float64))
+        return Column(jnp.where(valid, data, 0.0), valid, DOUBLE)
+
+
+class Atan2(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def with_children(self, children):
+        return Atan2(*children)
+
+    @property
+    def data_type(self):
+        return DOUBLE
+
+    def columnar_eval(self, batch):
+        l = self.children[0].columnar_eval(batch)
+        r = self.children[1].columnar_eval(batch)
+        valid = l.validity & r.validity
+        data = jnp.arctan2(l.data.astype(jnp.float64), r.data.astype(jnp.float64))
+        return Column(jnp.where(valid, data, 0.0), valid, DOUBLE)
+
+
+class Floor(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return Floor(children[0])
+
+    @property
+    def data_type(self):
+        dt = self.children[0].data_type
+        return dt if isinstance(dt, IntegralType) else LONG
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        if isinstance(c.dtype, IntegralType):
+            return c
+        data = jnp.floor(c.data).astype(jnp.int64)
+        return Column(jnp.where(c.validity, data, 0), c.validity, LONG)
+
+
+class Ceil(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return Ceil(children[0])
+
+    @property
+    def data_type(self):
+        dt = self.children[0].data_type
+        return dt if isinstance(dt, IntegralType) else LONG
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        if isinstance(c.dtype, IntegralType):
+            return c
+        data = jnp.ceil(c.data).astype(jnp.int64)
+        return Column(jnp.where(c.validity, data, 0), c.validity, LONG)
+
+
+def _round_half_up(x, scale: int):
+    m = 10.0 ** scale
+    scaled = x * m
+    # HALF_UP: away from zero at .5 (Java BigDecimal ROUND_HALF_UP)
+    return jnp.where(scaled >= 0,
+                     jnp.floor(scaled + 0.5),
+                     jnp.ceil(scaled - 0.5)) / m
+
+
+def _round_half_even(x, scale: int):
+    m = 10.0 ** scale
+    return jnp.round(x * m) / m  # rint = banker's rounding
+
+
+class Round(Expression):
+    """Spark round(col, scale): HALF_UP."""
+
+    def __init__(self, child: Expression, scale: int = 0):
+        self.children = (child,)
+        self.scale = scale
+
+    def with_children(self, children):
+        return Round(children[0], self.scale)
+
+    def _semantic_args(self):
+        return (self.scale,)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        dt = c.dtype
+        if isinstance(dt, IntegralType):
+            if self.scale >= 0:
+                return c
+            from .arithmetic import _round_div_half_up
+            m = jnp.asarray(10 ** (-self.scale), c.data.dtype)
+            data = _round_div_half_up(c.data, m) * m
+            return Column(jnp.where(c.validity, data, 0), c.validity, dt)
+        data = _round_half_up(c.data.astype(jnp.float64), self.scale)
+        data = data.astype(dt.jnp_dtype)
+        return Column(jnp.where(c.validity, data, jnp.zeros((), data.dtype)),
+                      c.validity, dt)
+
+
+class BRound(Round):
+    """Spark bround: HALF_EVEN."""
+
+    def with_children(self, children):
+        return BRound(children[0], self.scale)
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        dt = c.dtype
+        if isinstance(dt, IntegralType) and self.scale >= 0:
+            return c
+        data = _round_half_even(c.data.astype(jnp.float64), self.scale)
+        data = data.astype(dt.jnp_dtype)
+        return Column(jnp.where(c.validity, data, jnp.zeros((), data.dtype)),
+                      c.validity, dt)

@@ -1,0 +1,302 @@
+"""Predicates & comparisons with Spark's 3-valued logic.
+
+Mirrors reference predicates (sql-plugin GpuOverrides rules for And/Or/Not,
+EqualTo, comparisons) — validity lanes implement SQL ternary logic directly:
+  AND: F && anything = F ;  T && NULL = NULL
+  OR : T || anything = T ;  F || NULL = NULL
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+from ..types import BOOLEAN, DataType, numeric_promote
+from .core import Expression, Literal
+from ..ops.strings import string_compare_cols, string_equal
+
+
+def _float_compare_sign(l, r):
+    """Spark/Java float ordering as a sign lane: NaN equals NaN and sorts
+    greater than any other value (Double.compare semantics)."""
+    ln = jnp.isnan(l)
+    rn = jnp.isnan(r)
+    lt = (~ln & rn) | (~ln & ~rn & (l < r))
+    gt = (ln & ~rn) | (~ln & ~rn & (l > r))
+    return jnp.where(lt, jnp.int32(-1), jnp.where(gt, jnp.int32(1), jnp.int32(0)))
+
+
+class BinaryComparison(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    @property
+    def data_type(self) -> DataType:
+        return BOOLEAN
+
+    def columnar_eval(self, batch) -> Column:
+        l = self.left.columnar_eval(batch)
+        r = self.right.columnar_eval(batch)
+        valid = l.validity & r.validity
+        if isinstance(l, StringColumn) or isinstance(r, StringColumn):
+            cmp = string_compare_cols(l, r)
+            data = self._cmp_from_sign(cmp)
+        else:
+            lt, rt = l.dtype, r.dtype
+            common = lt if lt == rt else numeric_promote(lt, rt)
+            ld = l.data.astype(common.jnp_dtype)
+            rd = r.data.astype(common.jnp_dtype)
+            if jnp.issubdtype(ld.dtype, jnp.floating):
+                # Spark's float total order: NaN == NaN, NaN > everything
+                data = self._cmp_from_sign(_float_compare_sign(ld, rd))
+            else:
+                data = self._op(ld, rd)
+        data = data & valid
+        return Column(data, valid, BOOLEAN)
+
+    def _op(self, l, r):
+        raise NotImplementedError
+
+    def _cmp_from_sign(self, cmp):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+    def _op(self, l, r):
+        return l == r
+
+    def _cmp_from_sign(self, cmp):
+        return cmp == 0
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+    def _op(self, l, r):
+        return l < r
+
+    def _cmp_from_sign(self, cmp):
+        return cmp < 0
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+    def _op(self, l, r):
+        return l <= r
+
+    def _cmp_from_sign(self, cmp):
+        return cmp <= 0
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+    def _op(self, l, r):
+        return l > r
+
+    def _cmp_from_sign(self, cmp):
+        return cmp > 0
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+    def _op(self, l, r):
+        return l >= r
+
+    def _cmp_from_sign(self, cmp):
+        return cmp >= 0
+
+
+class EqualNullSafe(BinaryComparison):
+    """<=> : null-safe equality, never returns null."""
+    symbol = "<=>"
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        l = self.left.columnar_eval(batch)
+        r = self.right.columnar_eval(batch)
+        if isinstance(l, StringColumn) or isinstance(r, StringColumn):
+            eq_vals = string_equal(l, r).data
+        elif jnp.issubdtype(l.data.dtype, jnp.floating) or \
+                jnp.issubdtype(r.data.dtype, jnp.floating):
+            eq_vals = _float_compare_sign(l.data.astype(jnp.float64),
+                                          r.data.astype(jnp.float64)) == 0
+        else:
+            eq_vals = l.data == r.data
+        both_valid = l.validity & r.validity
+        both_null = ~l.validity & ~r.validity
+        data = (both_valid & eq_vals) | both_null
+        cap = data.shape[0]
+        return Column(data, jnp.ones((cap,), jnp.bool_), BOOLEAN)
+
+
+class And(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def with_children(self, children):
+        return And(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+    def columnar_eval(self, batch):
+        l = self.children[0].columnar_eval(batch)
+        r = self.children[1].columnar_eval(batch)
+        lv, rv = l.validity, r.validity
+        ld = l.data & lv  # null treated as "unknown", data lane meaningless
+        rd = r.data & rv
+        false_l = lv & ~l.data
+        false_r = rv & ~r.data
+        data = ld & rd
+        valid = (lv & rv) | false_l | false_r
+        return Column(data & valid, valid, BOOLEAN)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} AND {self.children[1]!r})"
+
+
+class Or(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def with_children(self, children):
+        return Or(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+    def columnar_eval(self, batch):
+        l = self.children[0].columnar_eval(batch)
+        r = self.children[1].columnar_eval(batch)
+        lv, rv = l.validity, r.validity
+        true_l = lv & l.data
+        true_r = rv & r.data
+        data = true_l | true_r
+        valid = (lv & rv) | true_l | true_r
+        return Column(data & valid, valid, BOOLEAN)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} OR {self.children[1]!r})"
+
+
+class Not(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return Not(children[0])
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        return Column(~c.data & c.validity, c.validity, BOOLEAN)
+
+    def __repr__(self):
+        return f"NOT {self.children[0]!r}"
+
+
+class IsNull(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return IsNull(children[0])
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        cap = c.capacity
+        return Column(~c.validity, jnp.ones((cap,), jnp.bool_), BOOLEAN)
+
+
+class IsNotNull(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return IsNotNull(children[0])
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        cap = c.capacity
+        return Column(c.validity, jnp.ones((cap,), jnp.bool_), BOOLEAN)
+
+
+class In(Expression):
+    """Spark IN over a literal list: null list elements give NULL when no
+    positive match exists (3-valued membership)."""
+
+    def __init__(self, value: Expression, items):
+        self.children = (value,)
+        self.items = tuple(items)
+
+    def with_children(self, children):
+        return In(children[0], self.items)
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+    def _semantic_args(self):
+        return (self.items,)
+
+    def columnar_eval(self, batch):
+        from .core import lit
+        c = self.children[0]
+        has_null = any(i is None for i in self.items)
+        hit = None
+        for item in self.items:
+            if item is None:
+                continue
+            e = EqualTo(c, lit(item)).columnar_eval(batch)
+            hit = e.data if hit is None else (hit | e.data)
+        v = c.columnar_eval(batch)
+        cap = v.capacity
+        if hit is None:
+            hit = jnp.zeros((cap,), jnp.bool_)
+        valid = v.validity & (hit | ~jnp.asarray(has_null))
+        return Column(hit & valid, valid, BOOLEAN)

@@ -1,0 +1,101 @@
+"""String expressions (reference stringFunctions.scala subset, growing)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+from ..types import BOOLEAN, INT, STRING, DataType
+from .core import Expression, Literal
+from ..ops import strings as S
+
+
+class _UnaryString(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+
+class Length(_UnaryString):
+    @property
+    def data_type(self):
+        return INT
+
+    def columnar_eval(self, batch):
+        return S.str_length_chars(self.children[0].columnar_eval(batch))
+
+
+class Upper(_UnaryString):
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        return S.str_upper_ascii(self.children[0].columnar_eval(batch))
+
+
+class Lower(_UnaryString):
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        return S.str_lower_ascii(self.children[0].columnar_eval(batch))
+
+
+class Substring(Expression):
+    """Spark substring(str, pos, len): 1-based, negative pos from end."""
+
+    def __init__(self, child: Expression, pos: int, length: int | None = None):
+        self.children = (child,)
+        self.pos = pos
+        self.length = length
+
+    def with_children(self, children):
+        return Substring(children[0], self.pos, self.length)
+
+    def _semantic_args(self):
+        return (self.pos, self.length)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        return S.substring(self.children[0].columnar_eval(batch),
+                           self.pos, self.length)
+
+
+class _LiteralNeedle(Expression):
+    def __init__(self, child: Expression, needle):
+        self.children = (child,)
+        if isinstance(needle, Literal):
+            needle = needle.value
+        self.needle = needle.encode("utf-8") if isinstance(needle, str) else bytes(needle)
+
+    def with_children(self, children):
+        return type(self)(children[0], self.needle)
+
+    def _semantic_args(self):
+        return (self.needle,)
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+
+class StartsWith(_LiteralNeedle):
+    def columnar_eval(self, batch):
+        return S.str_starts_with(self.children[0].columnar_eval(batch), self.needle)
+
+
+class EndsWith(_LiteralNeedle):
+    def columnar_eval(self, batch):
+        return S.str_ends_with(self.children[0].columnar_eval(batch), self.needle)
+
+
+class Contains(_LiteralNeedle):
+    def columnar_eval(self, batch):
+        return S.str_contains(self.children[0].columnar_eval(batch), self.needle)

@@ -1,0 +1,7 @@
+"""Columnar kernel substrate — the engine's replacement for libcudf (L6)."""
+
+from .basic import (
+    active_mask, compact_columns, compaction_order, concat_columns,
+    gather_column, sanitize, slice_rows,
+)
+from .hashing import murmur3_batch, pmod, xxhash64_batch

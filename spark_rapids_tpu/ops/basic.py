@@ -1,0 +1,135 @@
+"""Core row-layout kernels: active masks, compaction, gather, concat, slice.
+
+These replace cuDF's gather/copy_if/concatenate primitives (reference L6,
+SURVEY §2.9) with static-shape XLA programs. The universal trick: row counts
+live in a device scalar (`num_rows`) while array shapes stay at the capacity
+bucket, so filters/joins don't recompile.
+
+Conventions:
+  * every kernel is shape-polymorphic only in the capacity bucket;
+  * rows with index >= num_rows are "inactive": validity False, data zero;
+  * kernels return (columns..., new_num_rows) and always re-normalize the
+    inactive region so downstream kernels can rely on it.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import ArrayColumn, Column, StringColumn, StructColumn
+from .strings import gather_string
+
+
+def active_mask(num_rows, capacity: int):
+    """Bool (capacity,): True for logical rows."""
+    return jnp.arange(capacity, dtype=jnp.int32) < num_rows
+
+
+def sanitize(col: Column, num_rows) -> Column:
+    """Force the inactive tail to (zero, invalid) so padded slots never leak."""
+    act = active_mask(num_rows, col.capacity)
+    validity = col.validity & act
+    if isinstance(col, StringColumn):
+        return StringColumn(col.data, col.offsets, validity, col.dtype)
+    if isinstance(col, StructColumn):
+        kids = tuple(sanitize(k, num_rows) for k in col.children)
+        return StructColumn(kids, validity, col.dtype)
+    if isinstance(col, ArrayColumn):
+        return ArrayColumn(col.child, col.offsets, validity, col.dtype)
+    data = jnp.where(act, col.data, jnp.zeros((), col.data.dtype))
+    return Column(data, validity, col.dtype)
+
+
+def gather_column(col: Column, indices, out_valid=None,
+                  out_byte_capacity: int | None = None) -> Column:
+    """Gather rows by int32 indices (the JoinGatherer primitive,
+    reference JoinGatherer.scala). indices shape defines output capacity.
+    `out_valid` masks output rows (False -> null+inactive slot).
+    Out-of-range indices produce invalid rows.
+    """
+    cap = col.capacity
+    in_range = (indices >= 0) & (indices < cap)
+    safe = jnp.where(in_range, indices, 0)
+    valid = col.validity[safe] & in_range
+    if out_valid is not None:
+        valid = valid & out_valid
+    if isinstance(col, StringColumn):
+        return gather_string(col, safe, valid, out_byte_capacity)
+    if isinstance(col, StructColumn):
+        kids = tuple(gather_column(k, indices, out_valid, out_byte_capacity)
+                     for k in col.children)
+        return StructColumn(kids, valid, col.dtype)
+    if isinstance(col, ArrayColumn):
+        raise NotImplementedError(
+            "ARRAY gather lands with the nested-types phase; the planner "
+            "must tag ARRAY columns unsupported for row-reordering ops")
+    data = jnp.where(valid, col.data[safe], jnp.zeros((), col.data.dtype))
+    return Column(data, valid, col.dtype)
+
+
+def compaction_order(keep, num_rows):
+    """Stable permutation moving kept active rows to the front.
+
+    Returns (perm, new_num_rows). This is the engine's copy_if: instead of a
+    stream-compaction scatter (dynamic output size), a stable argsort on the
+    inverted keep flag — O(n log n) but static-shape and XLA-native.
+    """
+    cap = keep.shape[0]
+    act = active_mask(num_rows, cap)
+    k = keep & act
+    perm = jnp.argsort(jnp.where(k, 0, 1).astype(jnp.int8), stable=True)
+    new_rows = jnp.sum(k, dtype=jnp.int32)
+    return perm.astype(jnp.int32), new_rows
+
+
+def compact_columns(columns: Sequence[Column], keep, num_rows
+                    ) -> Tuple[Tuple[Column, ...], jnp.ndarray]:
+    """Filter: keep rows where `keep` is True (null predicate rows dropped
+    by the caller having already AND-ed validity into keep)."""
+    perm, new_rows = compaction_order(keep, num_rows)
+    cap = keep.shape[0]
+    out_valid = active_mask(new_rows, cap)
+    out = tuple(gather_column(c, perm, out_valid) for c in columns)
+    return out, new_rows
+
+
+def concat_columns(a: Column, b: Column, a_rows, b_rows, out_capacity: int
+                   ) -> Column:
+    """Concatenate two columns' active rows (the coalesce primitive).
+
+    out_capacity must be >= a_rows+b_rows worst case (callers size it to the
+    bucket of a.capacity+b.capacity).
+    """
+    idx = jnp.arange(out_capacity, dtype=jnp.int32)
+    from_b = idx >= a_rows
+    b_idx = idx - a_rows
+    total = a_rows + b_rows
+    out_valid = idx < total
+    if isinstance(a, StringColumn):
+        from .strings import concat_string
+        return concat_string(a, b, a_rows, b_rows, out_capacity)
+    if isinstance(a, StructColumn):
+        kids = tuple(concat_columns(ka, kb, a_rows, b_rows, out_capacity)
+                     for ka, kb in zip(a.children, b.children))
+        valid = _concat_fixed(a.validity, b.validity, from_b, b_idx, idx) & out_valid
+        return StructColumn(kids, valid, a.dtype)
+    data = _concat_fixed(a.data, b.data, from_b, b_idx, idx)
+    valid = _concat_fixed(a.validity, b.validity, from_b, b_idx, idx) & out_valid
+    data = jnp.where(out_valid, data, jnp.zeros((), data.dtype))
+    return Column(data, valid, a.dtype)
+
+
+def _concat_fixed(a, b, from_b, b_idx, idx):
+    a_safe = jnp.where(idx < a.shape[0], idx, 0)
+    b_safe = jnp.clip(b_idx, 0, b.shape[0] - 1)
+    return jnp.where(from_b, b[b_safe], a[a_safe])
+
+
+def slice_rows(col: Column, start, length, out_capacity: int) -> Column:
+    """Rows [start, start+length) moved to the front of a fresh column."""
+    idx = jnp.arange(out_capacity, dtype=jnp.int32) + start
+    out_valid = jnp.arange(out_capacity, dtype=jnp.int32) < length
+    return gather_column(col, idx, out_valid)

@@ -1,0 +1,411 @@
+"""String <-> numeric cast kernels with Spark semantics.
+
+The reference delegates these to the spark-rapids-jni `CastStrings` CUDA
+kernels (imported by GpuCast.scala). Here they are dense XLA programs:
+
+  * int -> string: fixed 20-iteration digit extraction (max int64 digits),
+    right-aligned into a per-row 20-byte scratch then compacted.
+  * string -> int: device parse with Spark's whitespace trim, sign, overflow
+    -> NULL, trailing-garbage -> NULL (non-ANSI returns NULL, never throws).
+  * string -> float/double: mantissa/exponent parse; 'NaN'/'Infinity'
+    accepted like Spark.
+  * bool/date renderings match Spark's Cast.scala output formats.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+from ..types import (
+    BOOLEAN, BooleanType, ByteType, DataType, DateType, DoubleType, FloatType,
+    IntegerType, IntegralType, LongType, ShortType, STRING, TimestampType,
+)
+from .strings import _rebuild_offsets, string_lengths
+
+_INT_BOUNDS = {
+    ByteType: (-128, 127),
+    ShortType: (-32768, 32767),
+    IntegerType: (-(2**31), 2**31 - 1),
+    LongType: (-(2**63), 2**63 - 1),
+}
+
+
+def _digits_fixed(vals_i64):
+    """(n,) int64 -> (n, 20) uint8 right-aligned decimal digits + lengths.
+
+    20 = sign + max 19 digits of int64.
+    """
+    n = vals_i64.shape[0]
+    neg = vals_i64 < 0
+    # abs of int64 min overflows; go through uint64
+    mag = jnp.where(neg, (-(vals_i64.astype(jnp.int64))).astype(jnp.uint64),
+                    vals_i64.astype(jnp.uint64))
+    mag = jnp.where(vals_i64 == jnp.int64(-(2**63)),
+                    jnp.uint64(2**63), mag)
+    digits = []
+    x = mag
+    for _ in range(19):
+        digits.append((x % 10).astype(jnp.uint8))
+        x = x // 10
+    # digits[0] is least significant
+    digit_mat = jnp.stack(digits[::-1], axis=1)  # (n, 19) most-significant first
+    ndig = jnp.maximum(
+        19 - jnp.argmax(digit_mat != 0, axis=1), 1)
+    all_zero = jnp.all(digit_mat == 0, axis=1)
+    ndig = jnp.where(all_zero, 1, ndig)
+    return digit_mat, ndig, neg
+
+
+def int_to_string(col: Column) -> StringColumn:
+    vals = col.data.astype(jnp.int64)
+    digit_mat, ndig, neg = _digits_fixed(vals)
+    lengths = (ndig + neg.astype(jnp.int32)).astype(jnp.int32)
+    lengths = jnp.where(col.validity, lengths, 0)
+    offsets = _rebuild_offsets(lengths)
+    cap = col.capacity
+    byte_cap = 20 * cap
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, cap - 1)
+    intra = pos - offsets[row]
+    is_sign = neg[row] & (intra == 0)
+    digit_idx = intra - neg[row].astype(jnp.int32)  # 0-based into the number
+    # digit d of row r lives at digit_mat[r, 19 - ndig[r] + d]
+    mat_col = jnp.clip(19 - ndig[row] + digit_idx, 0, 18)
+    ch = digit_mat[row, mat_col] + jnp.uint8(ord("0"))
+    ch = jnp.where(is_sign, jnp.uint8(ord("-")), ch)
+    in_use = pos < offsets[-1]
+    data = jnp.where(in_use, ch, jnp.uint8(0))
+    return StringColumn(data, offsets, col.validity, STRING)
+
+
+def bool_to_string(col: Column) -> StringColumn:
+    lengths = jnp.where(col.data, 4, 5).astype(jnp.int32)
+    lengths = jnp.where(col.validity, lengths, 0)
+    offsets = _rebuild_offsets(lengths)
+    cap = col.capacity
+    byte_cap = 5 * cap
+    t = jnp.asarray(list(b"true\x00"), jnp.uint8)
+    f = jnp.asarray(list(b"false"), jnp.uint8)
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, cap - 1)
+    intra = jnp.clip(pos - offsets[row], 0, 4)
+    ch = jnp.where(col.data[row], t[intra], f[intra])
+    in_use = pos < offsets[-1]
+    return StringColumn(jnp.where(in_use, ch, jnp.uint8(0)), offsets,
+                        col.validity, STRING)
+
+
+def _civil_from_days(days):
+    """Proleptic Gregorian (y, m, d) from days since 1970-01-01.
+    Howard Hinnant's algorithm, branch-free."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def date_to_string(col: Column) -> StringColumn:
+    """DATE -> 'YYYY-MM-DD' (years padded to 4; negative years unsupported
+    on device — the planner tags pre-epoch-extreme dates for host fallback)."""
+    y, m, d = _civil_from_days(col.data)
+    cap = col.capacity
+    lengths = jnp.where(col.validity, 10, 0).astype(jnp.int32)
+    offsets = _rebuild_offsets(lengths)
+    byte_cap = 10 * cap
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, cap - 1)
+    intra = pos - offsets[row]
+    yr, mr, dr = y[row], m[row], d[row]
+    digits = jnp.stack([
+        yr // 1000 % 10, yr // 100 % 10, yr // 10 % 10, yr % 10,
+        jnp.full_like(yr, -3),  # '-'
+        mr // 10 % 10, mr % 10,
+        jnp.full_like(yr, -3),
+        dr // 10 % 10, dr % 10,
+    ], axis=1)
+    i = jnp.clip(intra, 0, 9)
+    val = digits[jnp.arange(byte_cap), i]
+    ch = jnp.where(val == -3, jnp.uint8(ord("-")),
+                   val.astype(jnp.uint8) + jnp.uint8(ord("0")))
+    in_use = pos < offsets[-1]
+    return StringColumn(jnp.where(in_use, ch, jnp.uint8(0)), offsets,
+                        col.validity, STRING)
+
+
+def cast_to_string(col: Column) -> StringColumn:
+    dt = col.dtype
+    if isinstance(dt, BooleanType):
+        return bool_to_string(col)
+    if isinstance(dt, IntegralType):
+        return int_to_string(col)
+    if isinstance(dt, DateType):
+        return date_to_string(col)
+    from ..types import DecimalType
+    if isinstance(dt, DecimalType):
+        return decimal_to_string(col)
+    raise TypeError(f"cast {dt} -> string not yet on device")
+
+
+def decimal_to_string(col: Column) -> StringColumn:
+    """decimal64 -> string with exactly `scale` fraction digits (Spark)."""
+    dt = col.dtype
+    if dt.scale == 0:
+        return int_to_string(Column(col.data, col.validity, LongType()))
+    # render unscaled padded, then splice the point — simplest correct form:
+    # integer part and fraction part rendered separately
+    m = 10 ** dt.scale
+    neg = col.data < 0
+    mag = jnp.abs(col.data)
+    int_part = mag // m
+    frac_part = mag % m
+    int_str = int_to_string(Column(jnp.where(neg, -int_part, int_part),
+                                   col.validity, LongType()))
+    # fraction digits, fixed width = scale
+    digits = []
+    x = frac_part
+    for _ in range(dt.scale):
+        digits.append((x % 10).astype(jnp.uint8))
+        x = x // 10
+    frac_mat = jnp.stack(digits[::-1], axis=1)  # (n, scale)
+    int_len = string_lengths(int_str)
+    # handle "-0.xx": int part of -0 renders "0"; need explicit minus
+    needs_minus = neg & (int_part == 0)
+    lengths = int_len + needs_minus.astype(jnp.int32) + 1 + dt.scale
+    lengths = jnp.where(col.validity, lengths, 0)
+    offsets = _rebuild_offsets(lengths)
+    cap = col.capacity
+    byte_cap = int(int_str.byte_capacity) + (dt.scale + 2) * cap
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, cap - 1)
+    intra = pos - offsets[row]
+    nm = needs_minus[row]
+    ilen = int_len[row] + nm.astype(jnp.int32)
+    is_minus = nm & (intra == 0)
+    in_int = (intra < ilen) & ~is_minus
+    is_dot = intra == ilen
+    int_pos = jnp.clip(int_str.offsets[row] + intra - nm.astype(jnp.int32),
+                       0, int_str.byte_capacity - 1)
+    frac_idx = jnp.clip(intra - ilen - 1, 0, dt.scale - 1)
+    ch = jnp.where(is_minus, jnp.uint8(ord("-")),
+          jnp.where(in_int, int_str.data[int_pos],
+           jnp.where(is_dot, jnp.uint8(ord(".")),
+                     frac_mat[row, frac_idx] + jnp.uint8(ord("0")))))
+    in_use = pos < offsets[-1]
+    return StringColumn(jnp.where(in_use, ch, jnp.uint8(0)), offsets,
+                        col.validity, STRING)
+
+
+# --- parsing --------------------------------------------------------------
+
+_SPACE = ord(" ")
+
+
+def _trimmed_span(col: StringColumn):
+    """Spark trims ASCII whitespace (<= 0x20) before parsing."""
+    starts = col.offsets[:-1]
+    ends = col.offsets[1:]
+    byte_cap = col.byte_capacity
+    data = col.data
+
+    def trim_front(carry):
+        s, e = carry
+        b = data[jnp.clip(s, 0, byte_cap - 1)]
+        can = (s < e) & (b <= 0x20)
+        return jnp.where(can, s + 1, s), e
+
+    def front_cond(carry):
+        s, e = carry
+        b = data[jnp.clip(s, 0, byte_cap - 1)]
+        return jnp.any((s < e) & (b <= 0x20))
+
+    s, e = jax.lax.while_loop(front_cond, trim_front, (starts, ends))
+
+    def trim_back(carry):
+        s2, e2 = carry
+        b = data[jnp.clip(e2 - 1, 0, byte_cap - 1)]
+        can = (s2 < e2) & (b <= 0x20)
+        return s2, jnp.where(can, e2 - 1, e2)
+
+    def back_cond(carry):
+        s2, e2 = carry
+        b = data[jnp.clip(e2 - 1, 0, byte_cap - 1)]
+        return jnp.any((s2 < e2) & (b <= 0x20))
+
+    s, e = jax.lax.while_loop(back_cond, trim_back, (s, e))
+    return s, e
+
+
+def string_to_integral(col: StringColumn, dst) -> Column:
+    """Spark string->int: optional sign, digits only, overflow/garbage->NULL."""
+    s, e = _trimmed_span(col)
+    data = col.data
+    byte_cap = col.byte_capacity
+    first = data[jnp.clip(s, 0, byte_cap - 1)]
+    neg = first == ord("-")
+    has_sign = neg | (first == ord("+"))
+    ds = s + has_sign.astype(jnp.int32)
+    n_digits = e - ds
+    max_t = jnp.max(jnp.maximum(n_digits, 0))
+
+    def body(carry):
+        t, acc, ok, ovf = carry
+        p = jnp.clip(ds + t, 0, byte_cap - 1)
+        b = data[p]
+        active = t < n_digits
+        is_digit = (b >= ord("0")) & (b <= ord("9"))
+        d = (b - ord("0")).astype(jnp.uint64)
+        # magnitude accumulates in uint64 so Long.MIN_VALUE (2^63) fits
+        new_ovf = ovf | (acc > (jnp.uint64(2**64 - 1) - d) // 10)
+        new_acc = acc * 10 + d
+        acc = jnp.where(active & is_digit, new_acc, acc)
+        ok = ok & (~active | is_digit)
+        ovf = jnp.where(active & is_digit, new_ovf, ovf)
+        return t + 1, acc, ok, ovf
+
+    acc0 = jnp.zeros(col.capacity, jnp.uint64)
+    ok0 = jnp.ones(col.capacity, jnp.bool_)
+    ovf0 = jnp.zeros(col.capacity, jnp.bool_)
+    _, acc, ok, ovf = jax.lax.while_loop(
+        lambda c: c[0] < max_t, body, (jnp.int32(0), acc0, ok0, ovf0))
+    ok = ok & (n_digits > 0) & ~ovf
+    max_mag = jnp.where(neg, jnp.uint64(2**63), jnp.uint64(2**63 - 1))
+    ok = ok & (acc <= max_mag)
+    val = jnp.where(neg, -(acc.astype(jnp.int64)), acc.astype(jnp.int64))
+    lo, hi = _INT_BOUNDS[type(dst)]
+    in_range = (val >= lo) & (val <= hi) | (neg & (acc == jnp.uint64(2**63))
+                                           & (lo == -(2**63)))
+    valid = col.validity & ok & in_range
+    out = jnp.where(valid, val, 0).astype(dst.jnp_dtype)
+    return Column(out, valid, dst)
+
+
+def string_to_fractional(col: StringColumn, dst) -> Column:
+    """string -> float/double: sign, digits, optional '.', optional e-exp,
+    plus 'NaN'/'[+-]Infinity' like Spark; malformed -> NULL."""
+    s, e = _trimmed_span(col)
+    data = col.data
+    byte_cap = col.byte_capacity
+    cap = col.capacity
+
+    def byte_at(p):
+        return data[jnp.clip(p, 0, byte_cap - 1)]
+
+    first = byte_at(s)
+    neg = first == ord("-")
+    has_sign = neg | (first == ord("+"))
+    p0 = s + has_sign.astype(jnp.int32)
+
+    # special literals
+    def match_lit(lit: bytes, start):
+        ok = (e - start) == len(lit)
+        for j, chx in enumerate(lit):
+            bl = byte_at(start + j)
+            # case-insensitive ascii match
+            ok = ok & ((bl == chx) | (bl == (chx ^ 0x20) if chr(chx).isalpha() else bl == chx))
+        return ok
+
+    is_nan = match_lit(b"NaN", p0) | match_lit(b"nan", p0)
+    is_inf = match_lit(b"Infinity", p0) | match_lit(b"Inf", p0) | \
+        match_lit(b"infinity", p0) | match_lit(b"inf", p0)
+
+    max_t = jnp.max(jnp.maximum(e - p0, 0))
+
+    def body(carry):
+        (t, mant, frac_digits, seen_dot, seen_digit, exp_val, exp_neg,
+         in_exp, seen_exp_digit, ok) = carry
+        p = p0 + t
+        b = byte_at(p)
+        active = p < e
+        is_digit = (b >= ord("0")) & (b <= ord("9"))
+        d = (b - ord("0")).astype(jnp.float64)
+        is_dot = b == ord(".")
+        is_e = (b == ord("e")) | (b == ord("E"))
+        is_exp_sign = ((b == ord("+")) | (b == ord("-"))) & in_exp & ~seen_exp_digit
+
+        mant_new = jnp.where(is_digit & ~in_exp, mant * 10 + d, mant)
+        frac_new = jnp.where(is_digit & ~in_exp & seen_dot,
+                             frac_digits + 1, frac_digits)
+        exp_new = jnp.where(is_digit & in_exp,
+                            exp_val * 10 + (b - ord("0")).astype(jnp.int32),
+                            exp_val)
+        bad = ~(is_digit | (is_dot & ~seen_dot & ~in_exp) |
+                (is_e & ~in_exp & seen_digit) | is_exp_sign)
+        ok = ok & (~active | ~bad)
+        seen_dot_n = seen_dot | (is_dot & active)
+        seen_digit_n = seen_digit | (is_digit & active & ~in_exp)
+        in_exp_n = in_exp | (is_e & active)
+        exp_neg_n = exp_neg | (is_exp_sign & (b == ord("-")) & active)
+        seen_exp_digit_n = seen_exp_digit | (is_digit & in_exp & active)
+        return (t + 1,
+                jnp.where(active, mant_new, mant),
+                jnp.where(active, frac_new, frac_digits),
+                seen_dot_n, seen_digit_n,
+                jnp.where(active, exp_new, exp_val),
+                exp_neg_n, in_exp_n, seen_exp_digit_n, ok)
+
+    z_f = jnp.zeros(cap, jnp.float64)
+    z_i = jnp.zeros(cap, jnp.int32)
+    z_b = jnp.zeros(cap, jnp.bool_)
+    o_b = jnp.ones(cap, jnp.bool_)
+    (_, mant, frac_digits, seen_dot, seen_digit, exp_val, exp_neg,
+     in_exp, seen_exp_digit, ok) = jax.lax.while_loop(
+        lambda c: c[0] < max_t, body,
+        (jnp.int32(0), z_f, z_i, z_b, z_b, z_i, z_b, z_b, z_b, o_b))
+
+    ok = ok & seen_digit & (~in_exp | seen_exp_digit)
+    exp = jnp.where(exp_neg, -exp_val, exp_val) - frac_digits
+    val = mant * jnp.power(10.0, exp.astype(jnp.float64))
+    val = jnp.where(neg, -val, val)
+    val = jnp.where(is_nan, jnp.float64(jnp.nan), val)
+    val = jnp.where(is_inf, jnp.where(neg, -jnp.inf, jnp.inf), val)
+    ok = ok | is_nan | is_inf
+    valid = col.validity & ok
+    out = jnp.where(valid, val, 0.0).astype(dst.jnp_dtype)
+    return Column(out, valid, dst)
+
+
+def string_to_boolean(col: StringColumn) -> Column:
+    """Spark accepts t/true/y/yes/1 and f/false/n/no/0 (case-insensitive)."""
+    from .strings import str_lower_ascii
+    low = str_lower_ascii(col)
+    s, e = _trimmed_span(low)
+    length = e - s
+    data = low.data
+    byte_cap = low.byte_capacity
+
+    def eq_lit(lit: bytes):
+        ok = length == len(lit)
+        for j, chx in enumerate(lit):
+            ok = ok & (data[jnp.clip(s + j, 0, byte_cap - 1)] == chx)
+        return ok
+
+    truthy = eq_lit(b"t") | eq_lit(b"true") | eq_lit(b"y") | eq_lit(b"yes") | eq_lit(b"1")
+    falsy = eq_lit(b"f") | eq_lit(b"false") | eq_lit(b"n") | eq_lit(b"no") | eq_lit(b"0")
+    valid = col.validity & (truthy | falsy)
+    return Column(truthy & valid, valid, BOOLEAN)
+
+
+def cast_string_to(col: StringColumn, dst: DataType) -> Column:
+    if isinstance(dst, BooleanType):
+        return string_to_boolean(col)
+    if isinstance(dst, IntegralType):
+        return string_to_integral(col, dst)
+    if isinstance(dst, (FloatType, DoubleType)):
+        return string_to_fractional(col, dst)
+    if isinstance(dst, DateType):
+        from .datetime_ops import string_to_date
+        return string_to_date(col)
+    raise TypeError(f"cast string -> {dst} not yet on device")

@@ -1,0 +1,203 @@
+"""Date/time kernels (reference datetimeExpressions.scala + JNI DateTimeRebase
+/ GpuTimeZoneDB). Dates are int32 days since epoch, timestamps int64 micros
+UTC; all in the proleptic Gregorian calendar (Spark >= 3.0 semantics, so no
+julian rebase needed except for legacy parquet, handled at the IO layer).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+from ..types import DATE, INT, LONG, TIMESTAMP
+
+_DAY_US = 86_400_000_000
+
+
+def days_from_civil(y, m, d):
+    """Howard Hinnant days_from_civil: (y,m,d) -> days since 1970-01-01."""
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def civil_from_days(days):
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def _is_leap(y):
+    return ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+
+
+_DAYS_IN_MONTH = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                             jnp.int32)
+
+
+def days_in_month(y, m):
+    base = _DAYS_IN_MONTH[jnp.clip(m - 1, 0, 11)]
+    return jnp.where((m == 2) & _is_leap(y), 29, base)
+
+
+def string_to_date(col: StringColumn) -> Column:
+    """Spark cast(string as date): accepts 'yyyy', 'yyyy-mm', 'yyyy-mm-dd'
+    (plus trailing 'T...' / time suffix ignored); invalid -> NULL."""
+    from .cast_strings import _trimmed_span
+    s, e = _trimmed_span(col)
+    data = col.data
+    byte_cap = col.byte_capacity
+    cap = col.capacity
+
+    def byte_at(p):
+        return data[jnp.clip(p, 0, byte_cap - 1)]
+
+    def digit_at(p, active):
+        b = byte_at(p)
+        is_d = (b >= ord("0")) & (b <= ord("9"))
+        return (b - ord("0")).astype(jnp.int32), is_d | ~active
+
+    # parse segments split by '-': year (1-6 digits incl sign? Spark: 4ish),
+    # month, day. Implement the common fixed layouts: y{1,6}[-m{1,2}[-d{1,2}]]
+    # via a vectorized scan over characters.
+    max_t = jnp.max(jnp.maximum(e - s, 0))
+
+    def body(carry):
+        (t, seg, vals0, vals1, vals2, seg_len, ok, done) = carry
+        p = s + t
+        b = byte_at(p)
+        active = (p < e) & ~done
+        is_digit = (b >= ord("0")) & (b <= ord("9"))
+        is_dash = b == ord("-")
+        is_t = (b == ord("T")) | (b == ord(" "))
+        d = (b - ord("0")).astype(jnp.int32)
+        v0 = jnp.where(active & is_digit & (seg == 0), vals0 * 10 + d, vals0)
+        v1 = jnp.where(active & is_digit & (seg == 1), vals1 * 10 + d, vals1)
+        v2 = jnp.where(active & is_digit & (seg == 2), vals2 * 10 + d, vals2)
+        seg_len_n = jnp.where(active & is_digit, seg_len + 1, seg_len)
+        advance = active & is_dash & (seg < 2) & (seg_len > 0)
+        seg_n = jnp.where(advance, seg + 1, seg)
+        seg_len_n = jnp.where(advance, 0, seg_len_n)
+        # 'T' or ' ' after day segment terminates parse (time part ignored
+        # only when a full y-m-d was seen, like Spark)
+        done_n = done | (active & is_t & (seg == 2) & (seg_len > 0))
+        bad = active & ~(is_digit | advance | (is_t & (seg == 2) & (seg_len > 0)))
+        ok = ok & ~bad
+        return (t + 1, seg_n, v0, v1, v2, seg_len_n, ok, done_n)
+
+    z = jnp.zeros(cap, jnp.int32)
+    ob = jnp.ones(cap, jnp.bool_)
+    zb = jnp.zeros(cap, jnp.bool_)
+    (_, seg, y, m, d, seg_len, ok, _done) = jax.lax.while_loop(
+        lambda c: c[0] < max_t, body, (jnp.int32(0), z, z, z, z, z, ob, zb))
+
+    m = jnp.where(seg >= 1, m, 1)
+    d = jnp.where(seg >= 2, d, 1)
+    ok = ok & (e > s)
+    ok = ok & (m >= 1) & (m <= 12) & (d >= 1) & (d <= days_in_month(y, m))
+    days = days_from_civil(y, m, d).astype(jnp.int32)
+    valid = col.validity & ok
+    return Column(jnp.where(valid, days, 0), valid, DATE)
+
+
+# --- field extraction -----------------------------------------------------
+
+def extract_year(days) -> jnp.ndarray:
+    y, _, _ = civil_from_days(days)
+    return y
+
+
+def extract_month(days) -> jnp.ndarray:
+    _, m, _ = civil_from_days(days)
+    return m
+
+
+def extract_day(days) -> jnp.ndarray:
+    _, _, d = civil_from_days(days)
+    return d
+
+
+def extract_dayofweek(days):
+    """Spark dayofweek: 1 = Sunday ... 7 = Saturday. 1970-01-01 = Thursday."""
+    return ((days.astype(jnp.int64) + 4) % 7 + 1).astype(jnp.int32)
+
+
+def extract_dayofyear(days):
+    y, _, _ = civil_from_days(days)
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return (days.astype(jnp.int64) - jan1 + 1).astype(jnp.int32)
+
+
+def extract_quarter(days):
+    _, m, _ = civil_from_days(days)
+    return (m - 1) // 3 + 1
+
+
+def timestamp_to_date_days(micros):
+    return jnp.floor_divide(micros, _DAY_US).astype(jnp.int32)
+
+
+def extract_hour(micros):
+    day_us = jnp.mod(micros, _DAY_US)
+    return (day_us // 3_600_000_000).astype(jnp.int32)
+
+
+def extract_minute(micros):
+    day_us = jnp.mod(micros, _DAY_US)
+    return ((day_us // 60_000_000) % 60).astype(jnp.int32)
+
+
+def extract_second(micros):
+    day_us = jnp.mod(micros, _DAY_US)
+    return ((day_us // 1_000_000) % 60).astype(jnp.int32)
+
+
+def date_add(days, n):
+    return (days.astype(jnp.int64) + n.astype(jnp.int64)).astype(jnp.int32)
+
+
+def date_diff(end, start):
+    return (end.astype(jnp.int64) - start.astype(jnp.int64)).astype(jnp.int32)
+
+
+def last_day(days):
+    y, m, _ = civil_from_days(days)
+    return days_from_civil(y, m, days_in_month(y, m)).astype(jnp.int32)
+
+
+def add_months(days, n):
+    y, m, d = civil_from_days(days)
+    total = y * 12 + (m - 1) + n
+    ny = jnp.floor_divide(total, 12)
+    nm = jnp.mod(total, 12) + 1
+    nd = jnp.minimum(d, days_in_month(ny, nm))
+    return days_from_civil(ny, nm, nd).astype(jnp.int32)
+
+
+def trunc_date(days, unit: str):
+    y, m, _d = civil_from_days(days)
+    if unit in ("year", "yyyy", "yy"):
+        return days_from_civil(y, jnp.ones_like(m), jnp.ones_like(m)).astype(jnp.int32)
+    if unit in ("quarter",):
+        qm = ((m - 1) // 3) * 3 + 1
+        return days_from_civil(y, qm, jnp.ones_like(m)).astype(jnp.int32)
+    if unit in ("month", "mon", "mm"):
+        return days_from_civil(y, m, jnp.ones_like(m)).astype(jnp.int32)
+    if unit in ("week",):
+        # Monday-aligned: 1970-01-01 is Thursday (dow 4 with Mon=1)
+        dow = jnp.mod(days.astype(jnp.int64) + 3, 7)  # 0 = Monday
+        return (days.astype(jnp.int64) - dow).astype(jnp.int32)
+    raise ValueError(f"unsupported trunc unit {unit}")

@@ -1,0 +1,254 @@
+"""Varlen (string/binary) kernels over the (offsets, bytes) twin-array layout.
+
+Replaces cuDF's strings column primitives (reference L6). XLA has no ragged
+tensors, so every kernel is expressed as dense gathers over the padded byte
+buffer. The workhorse is `row_of_byte`: for each output byte position, find
+which row it belongs to via searchsorted on the output offsets — this turns
+any row-gather of strings into two vectorized gathers (O(B log N) with B =
+byte capacity), fully static shapes, MXU-free pure VPU work.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+
+
+def string_lengths(col: StringColumn):
+    """int32 (capacity,): byte length per row (0 for null/inactive rows)."""
+    return col.offsets[1:] - col.offsets[:-1]
+
+
+def _rebuild_offsets(lengths):
+    """Exclusive-scan lengths into (capacity+1,) offsets."""
+    return jnp.concatenate([
+        jnp.zeros(1, jnp.int32),
+        jnp.cumsum(lengths, dtype=jnp.int32),
+    ])
+
+
+def gather_string(col: StringColumn, indices, out_valid,
+                  out_byte_capacity: int | None = None) -> StringColumn:
+    """Gather rows of a string column by pre-clamped int32 `indices`.
+
+    out_byte_capacity: static byte bucket of the result. Defaults to the
+    input's byte bucket (sufficient for any permutation/filter; joins that
+    duplicate long rows must pass a larger bucket).
+    """
+    byte_cap = out_byte_capacity or col.byte_capacity
+    lengths = string_lengths(col)[indices]
+    lengths = jnp.where(out_valid, lengths, 0)
+    new_offsets = _rebuild_offsets(lengths)
+    src_starts = col.offsets[indices]
+
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    # row owning each output byte: last row whose offset <= pos
+    row = jnp.searchsorted(new_offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, indices.shape[0] - 1)
+    intra = pos - new_offsets[row]
+    src_pos = src_starts[row] + intra
+    in_use = pos < new_offsets[-1]
+    src_pos = jnp.where(in_use, jnp.clip(src_pos, 0, col.byte_capacity - 1), 0)
+    data = jnp.where(in_use, col.data[src_pos], jnp.uint8(0))
+    return StringColumn(data, new_offsets, out_valid, col.dtype)
+
+
+def concat_string(a: StringColumn, b: StringColumn, a_rows, b_rows,
+                  out_capacity: int,
+                  out_byte_capacity: int | None = None) -> StringColumn:
+    """Concatenate active rows of two string columns."""
+    byte_cap = out_byte_capacity or (a.byte_capacity + b.byte_capacity)
+    idx = jnp.arange(out_capacity, dtype=jnp.int32)
+    from_b = idx >= a_rows
+    total = a_rows + b_rows
+    out_valid_slot = idx < total
+
+    a_len = string_lengths(a)
+    b_len = string_lengths(b)
+    a_idx = jnp.where(idx < a.capacity, idx, 0)
+    b_idx = jnp.clip(idx - a_rows, 0, b.capacity - 1)
+    lengths = jnp.where(from_b, b_len[b_idx], a_len[a_idx])
+    lengths = jnp.where(out_valid_slot, lengths, 0)
+    validity = jnp.where(from_b, b.validity[b_idx], a.validity[a_idx]) & out_valid_slot
+    new_offsets = _rebuild_offsets(lengths)
+    src_starts = jnp.where(from_b, b.offsets[b_idx], a.offsets[a_idx])
+
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, out_capacity - 1)
+    intra = pos - new_offsets[row]
+    src_pos = src_starts[row] + intra
+    row_from_b = from_b[row]
+    in_use = pos < new_offsets[-1]
+    a_bytes = a.data[jnp.where(in_use & ~row_from_b,
+                               jnp.clip(src_pos, 0, a.byte_capacity - 1), 0)]
+    b_bytes = b.data[jnp.where(in_use & row_from_b,
+                               jnp.clip(src_pos, 0, b.byte_capacity - 1), 0)]
+    data = jnp.where(in_use, jnp.where(row_from_b, b_bytes, a_bytes), jnp.uint8(0))
+    return StringColumn(data, new_offsets, validity, a.dtype)
+
+
+# --- elementwise string functions ----------------------------------------
+
+def str_length_bytes(col: StringColumn) -> Column:
+    from ..types import INT
+    return Column(string_lengths(col), col.validity, INT)
+
+
+def str_length_chars(col: StringColumn) -> Column:
+    """UTF-8 aware character count (Spark `length`): count non-continuation
+    bytes ((b & 0xC0) != 0x80) per row via a segmented sum."""
+    from ..types import INT
+    cap = col.capacity
+    is_start = ((col.data & 0xC0) != 0x80).astype(jnp.int32)
+    csum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                            jnp.cumsum(is_start, dtype=jnp.int32)])
+    counts = csum[col.offsets[1:]] - csum[col.offsets[:-1]]
+    return Column(counts, col.validity, INT)
+
+
+def str_upper_ascii(col: StringColumn) -> StringColumn:
+    lower = (col.data >= ord("a")) & (col.data <= ord("z"))
+    data = jnp.where(lower, col.data - 32, col.data)
+    return StringColumn(data, col.offsets, col.validity, col.dtype)
+
+
+def str_lower_ascii(col: StringColumn) -> StringColumn:
+    upper = (col.data >= ord("A")) & (col.data <= ord("Z"))
+    data = jnp.where(upper, col.data + 32, col.data)
+    return StringColumn(data, col.offsets, col.validity, col.dtype)
+
+
+def substring(col: StringColumn, start: int, length: int | None) -> StringColumn:
+    """Spark substring semantics: 1-based start, negative = from end."""
+    lens = string_lengths(col)
+    if start > 0:
+        begin = jnp.minimum(jnp.int32(start - 1), lens)
+    elif start == 0:
+        begin = jnp.zeros_like(lens)
+    else:
+        begin = jnp.maximum(lens + start, 0)
+    if length is None:
+        sub_len = lens - begin
+    else:
+        sub_len = jnp.clip(jnp.int32(length), 0, lens - begin)
+    starts = col.offsets[:-1] + begin
+    return _substring_gather(col, starts, sub_len)
+
+
+def _substring_gather(col: StringColumn, src_starts, lengths) -> StringColumn:
+    lengths = jnp.where(col.validity, lengths, 0)
+    new_offsets = _rebuild_offsets(lengths)
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, col.capacity - 1)
+    intra = pos - new_offsets[row]
+    src_pos = src_starts[row] + intra
+    in_use = pos < new_offsets[-1]
+    src_pos = jnp.where(in_use, jnp.clip(src_pos, 0, byte_cap - 1), 0)
+    data = jnp.where(in_use, col.data[src_pos], jnp.uint8(0))
+    return StringColumn(data, new_offsets, col.validity, col.dtype)
+
+
+def _match_at(col: StringColumn, needle: bytes, starts):
+    """Bool per row: needle matches at byte position `starts` (absolute)."""
+    ok = jnp.ones(col.capacity, dtype=jnp.bool_)
+    byte_cap = col.byte_capacity
+    for j, ch in enumerate(needle):
+        p = jnp.clip(starts + j, 0, byte_cap - 1)
+        ok = ok & (col.data[p] == jnp.uint8(ch))
+    return ok
+
+
+def str_starts_with(col: StringColumn, prefix: bytes) -> Column:
+    from ..types import BOOLEAN
+    lens = string_lengths(col)
+    ok = (lens >= len(prefix)) & _match_at(col, prefix, col.offsets[:-1])
+    return Column(ok, col.validity, BOOLEAN)
+
+
+def str_ends_with(col: StringColumn, suffix: bytes) -> Column:
+    from ..types import BOOLEAN
+    lens = string_lengths(col)
+    ok = (lens >= len(suffix)) & _match_at(col, suffix,
+                                           col.offsets[1:] - len(suffix))
+    return Column(ok, col.validity, BOOLEAN)
+
+
+def str_contains(col: StringColumn, needle: bytes) -> Column:
+    """Substring search: needle-length sliding window over the byte buffer,
+    segmented to row boundaries. O(bytes * |needle|) VPU work."""
+    from ..types import BOOLEAN
+    if not needle:
+        return Column(jnp.ones(col.capacity, jnp.bool_), col.validity, BOOLEAN)
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    hit = jnp.ones(byte_cap, dtype=jnp.bool_)
+    for j, ch in enumerate(needle):
+        p = jnp.clip(pos + j, 0, byte_cap - 1)
+        hit = hit & (col.data[p] == jnp.uint8(ch))
+    # a hit at byte p belongs to row r if p..p+len-1 inside row r's span
+    row = jnp.searchsorted(col.offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, col.capacity - 1)
+    inside = (pos + len(needle)) <= col.offsets[row + 1]
+    hit = hit & inside
+    # segment-max hit per row
+    csum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                            jnp.cumsum(hit.astype(jnp.int32))])
+    per_row = (csum[jnp.minimum(col.offsets[1:], byte_cap)] -
+               csum[jnp.minimum(col.offsets[:-1], byte_cap)]) > 0
+    return Column(per_row, col.validity, BOOLEAN)
+
+
+def string_compare_cols(a: StringColumn, b: StringColumn):
+    """Row-wise lexicographic byte compare -> int32 sign (-1/0/1).
+
+    Sequential fold per row expressed as a device while_loop over byte
+    positions, vectorized across rows; trip count is the max common prefix
+    length in the batch (device scalar — no recompile).
+    """
+    la = string_lengths(a)
+    lb = string_lengths(b)
+    min_len = jnp.minimum(la, lb)
+    max_t = jnp.max(min_len)
+    sa, sb = a.offsets[:-1], b.offsets[:-1]
+
+    def body(carry):
+        t, res = carry
+        pa = jnp.clip(sa + t, 0, a.byte_capacity - 1)
+        pb = jnp.clip(sb + t, 0, b.byte_capacity - 1)
+        ba = a.data[pa].astype(jnp.int32)
+        bb = b.data[pb].astype(jnp.int32)
+        active = (res == 0) & (t < min_len)
+        diff = jnp.sign(ba - bb)
+        return t + 1, jnp.where(active, diff, res)
+
+    res0 = jnp.zeros(a.capacity, jnp.int32)
+    _, res = jax.lax.while_loop(lambda c: c[0] < max_t, body,
+                                (jnp.int32(0), res0))
+    return jnp.where(res == 0, jnp.sign(la - lb), res)
+
+
+def string_equal(a: StringColumn, b: StringColumn) -> Column:
+    """Row-wise string equality via length check + prefix-sum byte compare."""
+    from ..types import BOOLEAN
+    la = string_lengths(a)
+    lb = string_lengths(b)
+    same_len = la == lb
+    # compare bytes positionally: for each byte of a's row, compare with b's
+    pos = jnp.arange(a.byte_capacity, dtype=jnp.int32)
+    row = jnp.searchsorted(a.offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, a.capacity - 1)
+    intra = pos - a.offsets[row]
+    b_pos = jnp.clip(b.offsets[row] + intra, 0, b.byte_capacity - 1)
+    in_use = pos < a.offsets[-1]
+    neq = in_use & (a.data != b.data[jnp.where(in_use, b_pos, 0)])
+    csum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                            jnp.cumsum(neq.astype(jnp.int32))])
+    any_neq = (csum[jnp.minimum(a.offsets[1:], a.byte_capacity)] -
+               csum[jnp.minimum(a.offsets[:-1], a.byte_capacity)]) > 0
+    eq = same_len & ~any_neq
+    return Column(eq, a.validity & b.validity, BOOLEAN)

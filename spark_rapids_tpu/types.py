@@ -1,0 +1,378 @@
+"""Spark-semantics data types for the TPU-native columnar engine.
+
+Mirrors the type universe the reference plugin supports (see reference
+sql-plugin TypeChecks.scala:168 TypeSig enum: BOOLEAN..DAYTIME, nested
+ARRAY/MAP/STRUCT), re-expressed for a JAX/XLA backend where every column is
+one or more dense device arrays.
+
+Physical encodings on TPU:
+  - fixed-width types -> a single device array of the listed jnp dtype
+  - BOOLEAN           -> bool_ array (validity is carried separately)
+  - STRING / BINARY   -> twin arrays: uint8 byte buffer + int32 offsets
+                         (Arrow-style; XLA has no ragged support so the byte
+                         buffer is padded to a byte-capacity bucket)
+  - DECIMAL(p<=18)    -> int64 unscaled values + (precision, scale) metadata
+  - DECIMAL(p>18)     -> two int64 limbs (hi, lo) -- decimal128
+  - DATE              -> int32 days since epoch  (Spark CatalystType DateType)
+  - TIMESTAMP         -> int64 microseconds since epoch UTC
+  - NULL              -> all-invalid validity, no data array
+  - ARRAY             -> child column + int32 offsets
+  - STRUCT            -> child columns side by side
+  - MAP               -> ARRAY<STRUCT<key,value>> encoding (like Arrow/cuDF)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType:
+    """Base of the engine's logical type lattice."""
+
+    #: logical default; overridden per type
+    nullable_physical = True
+
+    @property
+    def is_numeric(self) -> bool:
+        return isinstance(self, NumericType)
+
+    @property
+    def is_fixed_width(self) -> bool:
+        return self.jnp_dtype is not None and not isinstance(self, (StringType, BinaryType))
+
+    # jnp dtype of the primary data buffer; None for nested/varlen
+    jnp_dtype: Optional[np.dtype] = None
+
+    def simple_name(self) -> str:
+        return type(self).__name__.replace("Type", "").lower()
+
+    def __repr__(self) -> str:
+        return self.simple_name()
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other) and dataclasses.asdict(self) == dataclasses.asdict(other) \
+            if dataclasses.is_dataclass(self) else type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(repr(self))
+
+
+class NumericType(DataType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class BooleanType(DataType):
+    jnp_dtype = np.dtype(np.bool_)
+
+
+class ByteType(IntegralType):
+    jnp_dtype = np.dtype(np.int8)
+    byte_width = 1
+
+
+class ShortType(IntegralType):
+    jnp_dtype = np.dtype(np.int16)
+    byte_width = 2
+
+
+class IntegerType(IntegralType):
+    jnp_dtype = np.dtype(np.int32)
+    byte_width = 4
+
+    def simple_name(self) -> str:
+        return "int"
+
+
+class LongType(IntegralType):
+    jnp_dtype = np.dtype(np.int64)
+    byte_width = 8
+
+    def simple_name(self) -> str:
+        return "bigint"
+
+
+class FloatType(FractionalType):
+    jnp_dtype = np.dtype(np.float32)
+    byte_width = 4
+
+
+class DoubleType(FractionalType):
+    jnp_dtype = np.dtype(np.float64)
+    byte_width = 8
+
+
+class DateType(DataType):
+    """Days since unix epoch, proleptic Gregorian (int32)."""
+    jnp_dtype = np.dtype(np.int32)
+    byte_width = 4
+
+
+class TimestampType(DataType):
+    """Microseconds since unix epoch UTC (int64)."""
+    jnp_dtype = np.dtype(np.int64)
+    byte_width = 8
+
+
+class TimestampNTZType(DataType):
+    """Timestamp without timezone; micros since epoch in local wall clock."""
+    jnp_dtype = np.dtype(np.int64)
+    byte_width = 8
+
+
+class StringType(DataType):
+    """UTF-8 bytes + int32 offsets (Arrow layout, padded byte buffer)."""
+    jnp_dtype = None
+
+
+class BinaryType(DataType):
+    jnp_dtype = None
+
+
+class NullType(DataType):
+    jnp_dtype = None
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class DecimalType(FractionalType):
+    """Fixed-point decimal. p<=18 packs in one int64 of unscaled value
+    (Spark's Decimal64 fast path); p<=38 in two int64 limbs (decimal128)."""
+    precision: int = 10
+    scale: int = 0
+
+    MAX_INT_DIGITS = 9
+    MAX_LONG_DIGITS = 18
+    MAX_PRECISION = 38
+
+    def __post_init__(self):
+        assert 1 <= self.precision <= self.MAX_PRECISION, self.precision
+        assert 0 <= self.scale <= self.precision, (self.precision, self.scale)
+
+    @property
+    def jnp_dtype(self):  # type: ignore[override]
+        return np.dtype(np.int64)
+
+    @property
+    def is_decimal128(self) -> bool:
+        return self.precision > self.MAX_LONG_DIGITS
+
+    def simple_name(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    def __hash__(self) -> int:
+        return hash(("decimal", self.precision, self.scale))
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class ArrayType(DataType):
+    element_type: DataType = dataclasses.field(default_factory=IntegerType)
+    contains_null: bool = True
+    jnp_dtype = None
+
+    def simple_name(self) -> str:
+        return f"array<{self.element_type.simple_name()}>"
+
+    def __hash__(self) -> int:
+        return hash(("array", self.element_type))
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class StructField:
+    name: str
+    data_type: DataType
+    nullable: bool = True
+
+    def __hash__(self) -> int:
+        return hash((self.name, self.data_type, self.nullable))
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class StructType(DataType):
+    fields: Tuple[StructField, ...] = ()
+    jnp_dtype = None
+
+    def simple_name(self) -> str:
+        inner = ",".join(f"{f.name}:{f.data_type.simple_name()}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def field_index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __hash__(self) -> int:
+        return hash(("struct", self.fields))
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class MapType(DataType):
+    key_type: DataType = dataclasses.field(default_factory=StringType)
+    value_type: DataType = dataclasses.field(default_factory=StringType)
+    value_contains_null: bool = True
+    jnp_dtype = None
+
+    def simple_name(self) -> str:
+        return f"map<{self.key_type.simple_name()},{self.value_type.simple_name()}>"
+
+    def __hash__(self) -> int:
+        return hash(("map", self.key_type, self.value_type))
+
+
+# Canonical singletons (Spark-style)
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+STRING = StringType()
+BINARY = BinaryType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+TIMESTAMP_NTZ = TimestampNTZType()
+NULL = NullType()
+
+_NUMERIC_ORDER = [ByteType, ShortType, IntegerType, LongType, FloatType, DoubleType]
+
+
+def is_orderable(dt: DataType) -> bool:
+    return not isinstance(dt, (MapType, NullType))
+
+
+def numeric_promote(a: DataType, b: DataType) -> DataType:
+    """Spark's binary-arithmetic common type for non-decimal numerics."""
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        raise TypeError("decimal promotion handled by DecimalPrecision rules")
+    ia = _NUMERIC_ORDER.index(type(a))
+    ib = _NUMERIC_ORDER.index(type(b))
+    return (a, b)[ia < ib]
+
+
+def from_arrow(at) -> DataType:
+    """Map a pyarrow DataType to the engine type."""
+    import pyarrow as pa
+    if pa.types.is_boolean(at):
+        return BOOLEAN
+    if pa.types.is_int8(at):
+        return BYTE
+    if pa.types.is_int16(at):
+        return SHORT
+    if pa.types.is_int32(at):
+        return INT
+    if pa.types.is_int64(at):
+        return LONG
+    if pa.types.is_float32(at):
+        return FLOAT
+    if pa.types.is_float64(at):
+        return DOUBLE
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return STRING
+    if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+        return BINARY
+    if pa.types.is_date32(at):
+        return DATE
+    if pa.types.is_timestamp(at):
+        return TIMESTAMP if at.tz is not None else TIMESTAMP_NTZ
+    if pa.types.is_decimal(at):
+        return DecimalType(at.precision, at.scale)
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow(at.value_type))
+    if pa.types.is_struct(at):
+        return StructType(tuple(StructField(f.name, from_arrow(f.type)) for f in at))
+    if pa.types.is_map(at):
+        return MapType(from_arrow(at.key_type), from_arrow(at.item_type))
+    if pa.types.is_null(at):
+        return NULL
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def to_arrow(dt: DataType):
+    import pyarrow as pa
+    if isinstance(dt, BooleanType):
+        return pa.bool_()
+    if isinstance(dt, ByteType):
+        return pa.int8()
+    if isinstance(dt, ShortType):
+        return pa.int16()
+    if isinstance(dt, IntegerType):
+        return pa.int32()
+    if isinstance(dt, LongType):
+        return pa.int64()
+    if isinstance(dt, FloatType):
+        return pa.float32()
+    if isinstance(dt, DoubleType):
+        return pa.float64()
+    if isinstance(dt, StringType):
+        return pa.string()
+    if isinstance(dt, BinaryType):
+        return pa.binary()
+    if isinstance(dt, DateType):
+        return pa.date32()
+    if isinstance(dt, TimestampType):
+        return pa.timestamp("us", tz="UTC")
+    if isinstance(dt, TimestampNTZType):
+        return pa.timestamp("us")
+    if isinstance(dt, DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, ArrayType):
+        return pa.list_(to_arrow(dt.element_type))
+    if isinstance(dt, StructType):
+        return pa.struct([pa.field(f.name, to_arrow(f.data_type), f.nullable) for f in dt.fields])
+    if isinstance(dt, MapType):
+        return pa.map_(to_arrow(dt.key_type), to_arrow(dt.value_type))
+    if isinstance(dt, NullType):
+        return pa.null()
+    raise TypeError(f"unsupported type {dt}")
+
+
+def jnp_zero(dt: DataType):
+    """Neutral fill value used in padded (invalid) slots."""
+    if dt.jnp_dtype is None:
+        raise TypeError(f"{dt} has no single-buffer physical encoding")
+    return jnp.zeros((), dtype=dt.jnp_dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Schema:
+    """Ordered named columns; the engine's row-schema object."""
+    fields: Tuple[StructField, ...]
+
+    def __post_init__(self):
+        assert len({f.name for f in self.fields}) == len(self.fields), "duplicate column names"
+
+    @property
+    def names(self):
+        return [f.name for f in self.fields]
+
+    @property
+    def types(self):
+        return [f.data_type for f in self.fields]
+
+    def index_of(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(f"column {name!r} not in schema {self.names}")
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __getitem__(self, i):
+        return self.fields[i]
+
+    @staticmethod
+    def of(**name_types: DataType) -> "Schema":
+        return Schema(tuple(StructField(n, t) for n, t in name_types.items()))

@@ -1,0 +1,29 @@
+"""Test fixture: run the engine on a virtual 8-device CPU mesh so multi-chip
+sharding paths are exercised without TPU hardware (the reference's analog is
+the NUM_LOCAL_EXECS pseudo-cluster, run_pyspark_from_build.sh:138).
+
+The axon sitecustomize pins jax_platforms=axon (real TPU tunnel); tests
+override it back to CPU *after* jax import — env vars alone are not enough.
+CPU also gives correctly-rounded f64, the reference oracle for Spark
+semantics; TPU f64 is double-float emulated (documented divergence, like the
+reference's docs/compatibility.md floating-point section).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

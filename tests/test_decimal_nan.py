@@ -1,0 +1,123 @@
+"""Regression tests for decimal arithmetic rescaling and Spark NaN ordering
+(code-review findings on the initial kernel drop)."""
+
+import math
+
+import pytest
+
+from spark_rapids_tpu.types import DOUBLE, DecimalType, LONG, Schema, STRING
+from spark_rapids_tpu.columnar import ColumnarBatch, Column
+from spark_rapids_tpu.expr import (
+    Cast, Divide, EqualTo, EqualNullSafe, Greatest, GreaterThan,
+    IntegralDivide, Least, col, lit, resolve,
+)
+
+
+def ev(expr, batch):
+    bound = resolve(expr, batch.schema)
+    return bound.columnar_eval(batch).to_pylist(batch.num_rows_host)
+
+
+def dec_batch():
+    """a: decimal(10,2) = [1.00, 2.50, 12.34]; b: decimal(10,0) = [2, 3, 4]."""
+    import numpy as np
+    from spark_rapids_tpu.types import Schema, StructField
+    a = Column.from_numpy(np.array([100, 250, 1234], np.int64), DecimalType(10, 2))
+    b = Column.from_numpy(np.array([2, 3, 4], np.int64), DecimalType(10, 0))
+    schema = Schema((StructField("a", DecimalType(10, 2)),
+                     StructField("b", DecimalType(10, 0))))
+    return ColumnarBatch([a, b], 3, schema)
+
+
+def unscaled(expr, batch):
+    bound = resolve(expr, batch.schema)
+    c = bound.columnar_eval(batch)
+    return c.dtype, c.to_pylist(batch.num_rows_host)
+
+
+def test_decimal_add_rescales():
+    b = dec_batch()
+    dt, vals = unscaled(col("a") + col("b"), b)
+    # 1.00+2 = 3.00 ; 2.50+3 = 5.50 ; 12.34+4 = 16.34 at scale 2
+    assert dt.scale == 2
+    assert vals == [300, 550, 1634]
+
+
+def test_decimal_multiply():
+    b = dec_batch()
+    dt, vals = unscaled(col("a") * col("b"), b)
+    # scale s1+s2 = 2: 2.00, 7.50, 49.36
+    assert dt.scale == 2
+    assert vals == [200, 750, 4936]
+
+
+def test_decimal_divide():
+    b = dec_batch()
+    dt, vals = unscaled(col("a") / col("b"), b)
+    # Spark result scale: max(6, s1+p2+1) = 13 -> adjusted; 1.00/2 = 0.5
+    assert vals[0] == 5 * 10 ** (dt.scale - 1)
+    # 2.50/3 = 0.8333... round HALF_UP at result scale
+    expect = round((250 / 3) * 10 ** (dt.scale - 2))
+    assert abs(vals[1] - expect) <= 1
+
+
+def test_decimal_integral_divide():
+    b = dec_batch()
+    assert ev(IntegralDivide(col("a"), col("b")), b) == [0, 0, 3]
+
+
+def test_nan_equality():
+    b = ColumnarBatch.from_pydict(
+        {"x": [float("nan"), 1.0, float("nan")],
+         "y": [float("nan"), float("nan"), 2.0]},
+        Schema.of(x=DOUBLE, y=DOUBLE))
+    # Spark: NaN = NaN is TRUE; NaN > everything
+    assert ev(EqualTo(col("x"), col("y")), b) == [True, False, False]
+    assert ev(GreaterThan(col("x"), col("y")), b) == [False, False, True]
+    assert ev(GreaterThan(col("y"), col("x")), b) == [False, True, False]
+    assert ev(EqualNullSafe(col("x"), col("y")), b) == [True, False, False]
+
+
+def test_nan_least_greatest():
+    b = ColumnarBatch.from_pydict(
+        {"x": [float("nan"), 5.0], "y": [1.0, float("nan")]},
+        Schema.of(x=DOUBLE, y=DOUBLE))
+    assert ev(Least(col("x"), col("y")), b) == [1.0, 5.0]
+    out = ev(Greatest(col("x"), col("y")), b)
+    assert math.isnan(out[0]) and math.isnan(out[1])
+
+
+def test_round_negative_scale_ints():
+    from spark_rapids_tpu.expr import Round
+    from spark_rapids_tpu.types import INT
+    b = ColumnarBatch.from_pydict({"i": [-14, -15, 14, 15, -16]},
+                                  Schema.of(i=INT))
+    # Spark HALF_UP at -1: -14 -> -10, -15 -> -20 (away from zero), 15 -> 20
+    assert ev(Round(col("i"), -1), b) == [-10, -20, 10, 20, -20]
+
+
+def test_parse_long_min():
+    b = ColumnarBatch.from_pydict(
+        {"s": ["-9223372036854775808", "9223372036854775807",
+               "9223372036854775808", "-9223372036854775809"]},
+        Schema.of(s=STRING))
+    assert ev(Cast(col("s"), LONG), b) == [-(2**63), 2**63 - 1, None, None]
+
+
+def test_log1p_domain():
+    from spark_rapids_tpu.expr import Log1p
+    b = ColumnarBatch.from_pydict({"x": [-2.0, -1.0, 0.0]}, Schema.of(x=DOUBLE))
+    assert ev(Log1p(col("x")), b) == [None, None, 0.0]
+
+
+def test_if_strings_byte_budget():
+    """Row-wise string blend where the selection needs bytes from both sides."""
+    from spark_rapids_tpu.expr import If
+    n = 8
+    b = ColumnarBatch.from_pydict(
+        {"f": [True, False] * (n // 2),
+         "s": ["x" * 40] * n, "t": ["y" * 40] * n},
+        Schema.of(f=__import__("spark_rapids_tpu.types", fromlist=["BOOLEAN"]).BOOLEAN,
+                  s=STRING, t=STRING))
+    out = ev(If(col("f"), col("s"), col("t")), b)
+    assert out == ["x" * 40 if i % 2 == 0 else "y" * 40 for i in range(n)]

@@ -1,0 +1,227 @@
+"""Hash parity tests. Device murmur3/xxhash64 is cross-checked against an
+independent pure-python implementation of Spark's Murmur3_x86_32 /
+XXH64 (written from the xxHash spec + Spark's hashUnsafeBytes layout)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, STRING, Schema
+from spark_rapids_tpu.columnar import ColumnarBatch
+from spark_rapids_tpu.ops.hashing import murmur3_batch, pmod, xxhash64_batch
+
+M32 = 0xFFFFFFFF
+M64 = 0xFFFFFFFFFFFFFFFF
+
+
+# --- reference murmur3 (Spark Murmur3_x86_32) -----------------------------
+
+def rotl32(x, r):
+    return ((x << r) | (x >> (32 - r))) & M32
+
+
+def mix_k1(k1):
+    k1 = (k1 * 0xCC9E2D51) & M32
+    k1 = rotl32(k1, 15)
+    return (k1 * 0x1B873593) & M32
+
+
+def mix_h1(h1, k1):
+    h1 ^= k1
+    h1 = rotl32(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & M32
+
+
+def fmix(h1, length):
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & M32
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & M32
+    h1 ^= h1 >> 16
+    return h1
+
+
+def py_murmur3_int(v, seed):
+    return fmix(mix_h1(seed, mix_k1(v & M32)), 4)
+
+
+def py_murmur3_long(v, seed):
+    v &= M64
+    h1 = mix_h1(seed, mix_k1(v & M32))
+    h1 = mix_h1(h1, mix_k1(v >> 32))
+    return fmix(h1, 8)
+
+
+def py_murmur3_bytes(data: bytes, seed):
+    h1 = seed
+    n = len(data)
+    for i in range(0, n - n % 4, 4):
+        word = int.from_bytes(data[i : i + 4], "little")
+        h1 = mix_h1(h1, mix_k1(word))
+    for i in range(n - n % 4, n):
+        b = data[i]
+        if b >= 128:
+            b -= 256  # sign extension like Java's getByte
+        h1 = mix_h1(h1, mix_k1(b & M32))
+    return fmix(h1, n)
+
+
+def to_i32(x):
+    return x - 2**32 if x >= 2**31 else x
+
+
+# --- reference xxh64 ------------------------------------------------------
+
+P1, P2, P3, P4, P5 = (0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F,
+                      0x165667B19E3779F9, 0x85EBCA77C2B2AE63,
+                      0x27D4EB2F165667C5)
+
+
+def rotl64(x, r):
+    return ((x << r) | (x >> (64 - r))) & M64
+
+
+def xx_fmix(h):
+    h ^= h >> 33
+    h = (h * P2) & M64
+    h ^= h >> 29
+    h = (h * P3) & M64
+    h ^= h >> 32
+    return h
+
+
+def py_xx_long(v, seed):
+    h = (seed + P5 + 8) & M64
+    k = rotl64((v * P2) & M64, 31) * P1 & M64
+    h = (rotl64(h ^ k, 27) * P1 + P4) & M64
+    return xx_fmix(h)
+
+
+def py_xx_int(v, seed):
+    h = (seed + P5 + 4) & M64
+    h ^= ((v & M32) * P1) & M64
+    h = (rotl64(h, 23) * P2 + P3) & M64
+    return xx_fmix(h)
+
+
+def py_xx_bytes(data: bytes, seed):
+    n = len(data)
+    i = 0
+    if n >= 32:
+        v1 = (seed + P1 + P2) & M64
+        v2 = (seed + P2) & M64
+        v3 = seed & M64
+        v4 = (seed - P1) & M64
+        while i + 32 <= n:
+            for k, v in enumerate((v1, v2, v3, v4)):
+                w = int.from_bytes(data[i + 8 * k : i + 8 * k + 8], "little")
+                nv = (rotl64((v + w * P2) & M64, 31) * P1) & M64
+                if k == 0:
+                    v1 = nv
+                elif k == 1:
+                    v2 = nv
+                elif k == 2:
+                    v3 = nv
+                else:
+                    v4 = nv
+            i += 32
+        h = (rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18)) & M64
+        for v in (v1, v2, v3, v4):
+            h ^= (rotl64((v * P2) & M64, 31) * P1) & M64
+            h = (h * P1 + P4) & M64
+    else:
+        h = (seed + P5) & M64
+    h = (h + n) & M64
+    while i + 8 <= n:
+        w = int.from_bytes(data[i : i + 8], "little")
+        k = (rotl64((w * P2) & M64, 31) * P1) & M64
+        h = (rotl64(h ^ k, 27) * P1 + P4) & M64
+        i += 8
+    if i + 4 <= n:
+        w = int.from_bytes(data[i : i + 4], "little")
+        h = (rotl64(h ^ ((w * P1) & M64), 23) * P2 + P3) & M64
+        i += 4
+    while i < n:
+        h = (rotl64(h ^ ((data[i] * P5) & M64), 11) * P1) & M64
+        i += 1
+    return xx_fmix(h)
+
+
+def to_i64(x):
+    return x - 2**64 if x >= 2**63 else x
+
+
+# --- tests ----------------------------------------------------------------
+
+def test_murmur3_ints():
+    vals = [0, 1, -1, 42, 2**31 - 1, -(2**31), 123456789]
+    b = ColumnarBatch.from_pydict({"i": vals}, Schema.of(i=INT))
+    out = np.asarray(murmur3_batch(b.columns))[: len(vals)]
+    exp = [to_i32(py_murmur3_int(v, 42)) for v in vals]
+    assert out.tolist() == exp
+
+
+def test_murmur3_longs():
+    vals = [0, 1, -1, 42, 2**63 - 1, -(2**63), 987654321012345]
+    b = ColumnarBatch.from_pydict({"l": vals}, Schema.of(l=LONG))
+    out = np.asarray(murmur3_batch(b.columns))[: len(vals)]
+    exp = [to_i32(py_murmur3_long(v, 42)) for v in vals]
+    assert out.tolist() == exp
+
+
+def test_murmur3_multi_column_null_passthrough():
+    b = ColumnarBatch.from_pydict(
+        {"i": [1, None, 3], "l": [None, 5, 6]}, Schema.of(i=INT, l=LONG))
+    out = np.asarray(murmur3_batch(b.columns))[:3]
+    exp = [
+        to_i32(py_murmur3_int(1, 42)),             # null long leaves hash
+        to_i32(py_murmur3_long(5, 42)),            # null int leaves seed
+        to_i32(py_murmur3_long(6, py_murmur3_int(3, 42))),
+    ]
+    assert out.tolist() == exp
+
+
+def test_murmur3_strings():
+    vals = ["", "a", "ab", "abc", "abcd", "abcde", "Hello TPU world!", "日本語",
+            "0123456789abcdef0123456789abcdef!"]
+    b = ColumnarBatch.from_pydict({"s": vals}, Schema.of(s=STRING))
+    out = np.asarray(murmur3_batch(b.columns))[: len(vals)]
+    exp = [to_i32(py_murmur3_bytes(v.encode("utf-8"), 42)) for v in vals]
+    assert out.tolist() == exp
+
+
+def test_murmur3_double_negzero():
+    b = ColumnarBatch.from_pydict({"x": [-0.0, 0.0]}, Schema.of(x=DOUBLE))
+    out = np.asarray(murmur3_batch(b.columns))[:2]
+    assert out[0] == out[1]  # -0.0 normalized
+
+
+def test_xxhash64_fixed():
+    vals = [0, 1, -1, 42, 2**63 - 1, -(2**63)]
+    b = ColumnarBatch.from_pydict({"l": vals}, Schema.of(l=LONG))
+    out = np.asarray(xxhash64_batch(b.columns))[: len(vals)]
+    exp = [to_i64(py_xx_long(v & M64, 42)) for v in vals]
+    assert out.tolist() == exp
+
+    ivals = [0, 5, -5, 2**31 - 1]
+    bi = ColumnarBatch.from_pydict({"i": ivals}, Schema.of(i=INT))
+    outi = np.asarray(xxhash64_batch(bi.columns))[: len(ivals)]
+    expi = [to_i64(py_xx_int(v, 42)) for v in ivals]
+    assert outi.tolist() == expi
+
+
+def test_xxhash64_strings():
+    vals = ["", "a", "abcd", "abcdefgh", "0123456789abcdef",
+            "0123456789abcdef0123456789abcdef",  # exactly 32
+            "0123456789abcdef0123456789abcdefXYZ",  # 32 + tail
+            "x" * 100]
+    b = ColumnarBatch.from_pydict({"s": vals}, Schema.of(s=STRING))
+    out = np.asarray(xxhash64_batch(b.columns))[: len(vals)]
+    exp = [to_i64(py_xx_bytes(v.encode(), 42)) for v in vals]
+    assert out.tolist() == exp
+
+
+def test_pmod():
+    import jax.numpy as jnp
+    h = jnp.asarray([-5, 5, -1, 0], jnp.int32)
+    assert np.asarray(pmod(h, 4)).tolist() == [3, 1, 3, 0]
