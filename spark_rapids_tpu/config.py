@@ -1,0 +1,257 @@
+"""Typed config registry — the engine's RapidsConf (reference
+RapidsConf.scala: ConfEntry :121, TypedConfBuilder :201, registry :319-333,
+212 spark.rapids.* entries, docs generated via help() :149).
+
+Keys keep the `spark.rapids.*` UX (BASELINE.json requires config
+compatibility) with TPU-specific entries under `spark.rapids.tpu.*`.
+`generate_docs()` renders docs/configs.md the same way the reference does.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ConfEntry:
+    def __init__(self, key: str, default, doc: str, conv: Callable[[str], Any],
+                 internal: bool = False, startup_only: bool = False,
+                 commonly_used: bool = False):
+        self.key = key
+        self.default = default
+        self.doc = doc
+        self.conv = conv
+        self.internal = internal
+        self.startup_only = startup_only
+        self.commonly_used = commonly_used
+
+    def get(self, conf: "RapidsConf"):
+        raw = conf._settings.get(self.key)
+        if raw is None:
+            return self.default
+        if isinstance(raw, str):
+            return self.conv(raw)
+        return raw
+
+
+_REGISTRY: Dict[str, ConfEntry] = {}
+
+
+def _register(entry: ConfEntry) -> ConfEntry:
+    assert entry.key not in _REGISTRY, f"duplicate conf {entry.key}"
+    _REGISTRY[entry.key] = entry
+    return entry
+
+
+def _bool(s: str) -> bool:
+    return s.strip().lower() in ("true", "1", "yes")
+
+
+def _bytes(s: str) -> int:
+    s = s.strip().lower()
+    mult = 1
+    for suffix, m in (("k", 1 << 10), ("m", 1 << 20), ("g", 1 << 30),
+                      ("t", 1 << 40)):
+        if s.endswith(suffix + "b"):
+            s, mult = s[:-2], m
+            break
+        if s.endswith(suffix):
+            s, mult = s[:-1], m
+            break
+    return int(float(s) * mult)
+
+
+def conf_bool(key, default, doc, **kw):
+    return _register(ConfEntry(key, default, doc, _bool, **kw))
+
+
+def conf_int(key, default, doc, **kw):
+    return _register(ConfEntry(key, default, doc, int, **kw))
+
+
+def conf_float(key, default, doc, **kw):
+    return _register(ConfEntry(key, default, doc, float, **kw))
+
+
+def conf_str(key, default, doc, **kw):
+    return _register(ConfEntry(key, default, doc, str, **kw))
+
+
+def conf_bytes(key, default, doc, **kw):
+    return _register(ConfEntry(key, default, doc, _bytes, **kw))
+
+
+# --- core entries (mirroring the reference's most load-bearing keys) ------
+
+SQL_ENABLED = conf_bool(
+    "spark.rapids.sql.enabled", True,
+    "Master toggle: when false every operator stays on the CPU path "
+    "(reference RapidsConf.scala SQL_ENABLED).", commonly_used=True)
+
+EXPLAIN = conf_str(
+    "spark.rapids.sql.explain", "NOT_ON_GPU",
+    "Explain mode: NONE, NOT_ON_GPU (log why operators fell back), ALL "
+    "(reference sql.explain).", commonly_used=True)
+
+BATCH_SIZE_BYTES = conf_bytes(
+    "spark.rapids.sql.batchSizeBytes", 1 << 30,
+    "Target output batch size; on TPU this is the target *padded capacity "
+    "bucket* footprint (reference RapidsConf.scala:559).", commonly_used=True)
+
+MAX_READER_BATCH_SIZE_ROWS = conf_int(
+    "spark.rapids.sql.reader.batchSizeRows", 1 << 20,
+    "Soft cap on rows per scan batch (reference reader.batchSizeRows).")
+
+CONCURRENT_TPU_TASKS = conf_int(
+    "spark.rapids.sql.concurrentGpuTasks", 2,
+    "Admission-semaphore width: concurrent tasks allowed to issue device "
+    "work (reference RapidsConf.scala:544 concurrentGpuTasks; on TPU this "
+    "gates enqueue into the per-chip executor).", commonly_used=True)
+
+HBM_POOL_FRACTION = conf_float(
+    "spark.rapids.memory.tpu.allocFraction", 0.9,
+    "Fraction of device HBM the engine budget manager may use (reference "
+    "rmm allocFraction).", startup_only=True)
+
+HBM_BUDGET_BYTES = conf_bytes(
+    "spark.rapids.memory.tpu.budgetBytes", 0,
+    "Absolute HBM budget override; 0 = derive from allocFraction and "
+    "detected device memory.", startup_only=True)
+
+HOST_SPILL_LIMIT = conf_bytes(
+    "spark.rapids.memory.host.spillStorageSize", 4 << 30,
+    "Bytes of host memory for spilled buffers before overflowing to disk "
+    "(reference host.spillStorageSize).")
+
+SPILL_DIR = conf_str(
+    "spark.rapids.memory.spillDirectory", "",
+    "Directory for disk-tier spill files; empty = system temp.")
+
+RETRY_MAX_ATTEMPTS = conf_int(
+    "spark.rapids.sql.retry.maxAttempts", 10,
+    "Upper bound on OOM-retry attempts before surfacing the failure "
+    "(guards the withRetry loop, reference RmmRapidsRetryIterator).")
+
+SHUFFLE_MODE = conf_str(
+    "spark.rapids.shuffle.mode", "MULTITHREADED",
+    "Shuffle mode: MULTITHREADED (host, works everywhere), ICI (resident "
+    "mesh all-to-all over interconnect), CACHE_ONLY (reference "
+    "RapidsShuffleManagerMode).", commonly_used=True)
+
+SHUFFLE_WRITER_THREADS = conf_int(
+    "spark.rapids.shuffle.multiThreaded.writer.threads", 8,
+    "Writer-side serialization threads (reference "
+    "RapidsShuffleInternalManagerBase.scala:238).")
+
+SHUFFLE_READER_THREADS = conf_int(
+    "spark.rapids.shuffle.multiThreaded.reader.threads", 8,
+    "Reader-side fetch/decode threads (reference :569).")
+
+MULTITHREADED_READ_NUM_THREADS = conf_int(
+    "spark.rapids.sql.multiThreadedRead.numThreads", 8,
+    "Threads for the cloud multi-file readers (reference "
+    "GpuMultiFileReader.scala:345).")
+
+METRICS_LEVEL = conf_str(
+    "spark.rapids.sql.metrics.level", "MODERATE",
+    "ESSENTIAL | MODERATE | DEBUG (reference GpuExec.scala:36-47).")
+
+STABLE_SORT = conf_bool(
+    "spark.rapids.sql.stableSort.enabled", False,
+    "Force fully stable sorts (reference stableSort.enabled).")
+
+IMPROVED_FLOAT_OPS = conf_bool(
+    "spark.rapids.sql.improvedFloatOps.enabled", True,
+    "Allow float results that differ from Spark in last-ulp ways — on TPU "
+    "f64 is double-float emulated so this also gates f64-heavy plans "
+    "(reference improvedFloatOps).")
+
+TEST_RETRY_OOM_INJECTION_MODE = conf_str(
+    "spark.rapids.sql.test.injectRetryOOM", "",
+    "Fault injection: 'retry:N' / 'split:N' throws TpuRetryOOM / "
+    "TpuSplitAndRetryOOM on the Nth guarded device call of each task "
+    "(reference RmmSpark fault injection, RmmSparkRetrySuiteBase).",
+    internal=True)
+
+CPU_FALLBACK_ENABLED = conf_bool(
+    "spark.rapids.sql.cpuFallback.enabled", True,
+    "Allow per-operator fallback to the host (arrow/numpy) engine when an "
+    "operator or type is not supported on TPU (reference semantics: "
+    "untagged operators stay on Spark's CPU path).")
+
+DECIMAL_ENABLED = conf_bool(
+    "spark.rapids.sql.decimalType.enabled", True,
+    "Enable decimal offload (decimal128 columns stay on CPU until the "
+    "two-limb kernels land; reference decimalType.enabled).")
+
+
+class RapidsConf:
+    """Immutable snapshot of settings; construct from a dict of
+    spark-style key->string/typed values."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings = dict(settings or {})
+        for k in self._settings:
+            if k.startswith("spark.rapids.") and k not in _REGISTRY:
+                raise KeyError(f"unknown config {k!r}; see docs/configs.md")
+
+    def get(self, entry: ConfEntry):
+        return entry.get(self)
+
+    def with_overrides(self, **kv) -> "RapidsConf":
+        s = dict(self._settings)
+        s.update(kv)
+        return RapidsConf(s)
+
+    # convenience properties for hot entries
+    @property
+    def sql_enabled(self):
+        return self.get(SQL_ENABLED)
+
+    @property
+    def batch_size_bytes(self):
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def concurrent_tpu_tasks(self):
+        return self.get(CONCURRENT_TPU_TASKS)
+
+    @property
+    def retry_max_attempts(self):
+        return self.get(RETRY_MAX_ATTEMPTS)
+
+
+_active = threading.local()
+
+
+def active_conf() -> RapidsConf:
+    conf = getattr(_active, "conf", None)
+    if conf is None:
+        conf = RapidsConf()
+        _active.conf = conf
+    return conf
+
+
+def set_active_conf(conf: RapidsConf):
+    _active.conf = conf
+
+
+def generate_docs() -> str:
+    """Render docs/configs.md from the registry (reference RapidsConf.help)."""
+    lines = [
+        "# spark_rapids_tpu configuration",
+        "",
+        "Generated from the config registry (`spark_rapids_tpu/config.py`), "
+        "mirroring the reference's RapidsConf-generated docs/configs.md.",
+        "",
+        "| Key | Default | Meaning |",
+        "|---|---|---|",
+    ]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.internal:
+            continue
+        lines.append(f"| `{e.key}` | `{e.default}` | {e.doc} |")
+    lines.append("")
+    return "\n".join(lines)

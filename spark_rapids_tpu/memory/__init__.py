@@ -1,0 +1,18 @@
+"""Memory & OOM-retry runtime (reference SURVEY §2.4 — the heart of
+robustness): HBM budget, 3-tier spill catalog, spillable handles,
+retry/split-retry discipline with fault injection, admission semaphore."""
+
+from .budget import MemoryBudget, memory_budget, reset_memory_budget
+from .catalog import (
+    ACTIVE_BATCHING_PRIORITY, ACTIVE_ON_DECK_PRIORITY, BufferCatalog,
+    StorageTier, buffer_catalog, reset_buffer_catalog,
+)
+from .retry import (
+    CpuRetryOOM, TpuOOMError, TpuRetryOOM, TpuSplitAndRetryOOM,
+    force_retry_oom, force_split_and_retry_oom, oom_guard, register_task,
+    split_in_half_by_rows, task_retry_counts, unregister_task, with_retry,
+    with_retry_no_split,
+)
+from .semaphore import TpuSemaphore, reset_tpu_semaphore, tpu_semaphore
+from .spillable import SpillableBatch
+from .device_manager import DeviceManager, device_manager

@@ -1,0 +1,102 @@
+"""HBM budget manager — the RMM-pool analog (reference
+GpuDeviceManager.scala:275 initializeRmm + DeviceMemoryEventHandler.scala).
+
+XLA owns the physical HBM allocator; this layer does *accounting*: operators
+reserve their padded worst-case footprint before launching device programs.
+When a reservation would exceed the budget, registered spillables are
+synchronously spilled (largest-priority first) until it fits — the
+DeviceMemoryEventHandler loop (:58-90) — else TpuRetryOOM is raised for the
+retry framework to handle.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..config import HBM_BUDGET_BYTES, HBM_POOL_FRACTION, active_conf
+from .retry import TpuRetryOOM
+
+_DEFAULT_HBM = 16 << 30  # v5e/v5p chips have 16 GiB HBM per core
+
+
+class MemoryBudget:
+    def __init__(self, limit_bytes: Optional[int] = None):
+        if limit_bytes is None:
+            conf = active_conf()
+            override = conf.get(HBM_BUDGET_BYTES)
+            if override:
+                limit_bytes = override
+            else:
+                limit_bytes = int(_detect_hbm() * conf.get(HBM_POOL_FRACTION))
+        self.limit = limit_bytes
+        self.used = 0
+        self._lock = threading.Condition()
+        self.peak = 0
+        self.spill_requests = 0
+
+    def reserve(self, nbytes: int):
+        """Reserve accounting space; spill-then-raise on pressure."""
+        with self._lock:
+            if self.used + nbytes <= self.limit:
+                self.used += nbytes
+                self.peak = max(self.peak, self.used)
+                return
+        # out of budget: try to make room by spilling catalog buffers
+        from .catalog import buffer_catalog
+        needed = nbytes - (self.limit - self.used)
+        freed = buffer_catalog().synchronous_spill(needed)
+        with self._lock:
+            self.spill_requests += 1
+            if self.used + nbytes <= self.limit:
+                self.used += nbytes
+                self.peak = max(self.peak, self.used)
+                return
+        raise TpuRetryOOM(
+            f"HBM budget exhausted: need {nbytes}, used {self.used} of "
+            f"{self.limit} (freed {freed} by spill)")
+
+    def release(self, nbytes: int):
+        with self._lock:
+            self.used = max(0, self.used - nbytes)
+            self._lock.notify_all()
+
+
+def _detect_hbm() -> int:
+    try:
+        import jax
+        dev = jax.devices()[0]
+        stats = dev.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return _DEFAULT_HBM
+
+
+_budget: Optional[MemoryBudget] = None
+_budget_lock = threading.Lock()
+
+
+def memory_budget() -> MemoryBudget:
+    global _budget
+    with _budget_lock:
+        if _budget is None:
+            _budget = MemoryBudget()
+        return _budget
+
+
+def reset_memory_budget(limit_bytes: Optional[int] = None):
+    """Test hook: install a fresh (possibly tiny) budget — the analog of the
+    reference's 512MiB test RMM pool (RmmSparkRetrySuiteBase.scala:35)."""
+    global _budget
+    with _budget_lock:
+        _budget = MemoryBudget(limit_bytes)
+    return _budget
+
+
+def spill_for_retry():
+    """Between OOM retries, aggressively push device buffers down a tier
+    (reference: synchronous spill in DeviceMemoryEventHandler)."""
+    from .catalog import buffer_catalog
+    buffer_catalog().synchronous_spill(None)

@@ -1,0 +1,221 @@
+"""3-tier spill store: DEVICE -> HOST -> DISK.
+
+Port of the *contract* of the reference's RapidsBufferCatalog.scala:62-795 +
+RapidsDeviceMemoryStore / RapidsHostMemoryStore / RapidsDiskStore — not the
+code: tiers here hold jax device pytrees, numpy host pytrees, and .npz spill
+files. The catalog is the single registry; SpillableBatch handles point into
+it. Spill policy: spillable (not in-use) entries, lowest priority first,
+moved one tier down until the requested bytes are freed
+(SpillPriorities.scala semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import uuid
+from enum import IntEnum
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..config import HOST_SPILL_LIMIT, SPILL_DIR, active_conf
+
+
+class StorageTier(IntEnum):
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+# reference SpillPriorities.scala
+ACTIVE_ON_DECK_PRIORITY = 100
+ACTIVE_BATCHING_PRIORITY = 50
+OUTPUT_FOR_SHUFFLE_PRIORITY = 0
+HOST_MEMORY_BUFFER_PRIORITY = -100
+
+
+def _leaf_nbytes(tree) -> int:
+    return sum(x.nbytes for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "nbytes"))
+
+
+class _Entry:
+    __slots__ = ("handle_id", "tier", "device_tree", "host_leaves", "treedef",
+                 "disk_path", "nbytes", "priority", "in_use", "closed")
+
+    def __init__(self, handle_id, tree, priority):
+        self.handle_id = handle_id
+        self.tier = StorageTier.DEVICE
+        self.device_tree = tree
+        self.host_leaves = None
+        leaves, self.treedef = jax.tree_util.tree_flatten(tree)
+        self.nbytes = _leaf_nbytes(tree)
+        self.disk_path = None
+        self.priority = priority
+        self.in_use = 0
+        self.closed = False
+
+
+class BufferCatalog:
+    def __init__(self):
+        self._entries: Dict[str, _Entry] = {}
+        self._lock = threading.RLock()
+        self.spilled_device_bytes = 0
+        self.spilled_host_bytes = 0
+        self._spill_dir: Optional[str] = None
+
+    # -- registration ------------------------------------------------------
+    def add(self, tree, priority: int = ACTIVE_BATCHING_PRIORITY) -> str:
+        """Register a device pytree; returns a handle id. Accounts its
+        footprint against the HBM budget."""
+        from .budget import memory_budget
+        handle = uuid.uuid4().hex
+        entry = _Entry(handle, tree, priority)
+        memory_budget().reserve(entry.nbytes)
+        with self._lock:
+            self._entries[handle] = entry
+        return handle
+
+    def acquire(self, handle: str):
+        """Return the device pytree, promoting back up tiers if spilled.
+        Marks the entry in-use (unspillable) until `release`."""
+        from .budget import memory_budget
+        with self._lock:
+            entry = self._entries[handle]
+            assert not entry.closed, "acquire after close"
+            if entry.tier != StorageTier.DEVICE:
+                self._unspill_locked(entry)
+            entry.in_use += 1
+            return entry.device_tree
+
+    def release(self, handle: str):
+        with self._lock:
+            entry = self._entries.get(handle)
+            if entry is not None:
+                entry.in_use = max(0, entry.in_use - 1)
+
+    def remove(self, handle: str):
+        from .budget import memory_budget
+        with self._lock:
+            entry = self._entries.pop(handle, None)
+        if entry is None or entry.closed:
+            return
+        entry.closed = True
+        if entry.tier == StorageTier.DEVICE:
+            memory_budget().release(entry.nbytes)
+        if entry.disk_path and os.path.exists(entry.disk_path):
+            os.unlink(entry.disk_path)
+
+    def tier_of(self, handle: str) -> StorageTier:
+        with self._lock:
+            return self._entries[handle].tier
+
+    def size_of(self, handle: str) -> int:
+        with self._lock:
+            return self._entries[handle].nbytes
+
+    # -- spilling ----------------------------------------------------------
+    def synchronous_spill(self, target_bytes: Optional[int]) -> int:
+        """Move spillable DEVICE entries to HOST (lowest priority first)
+        until target_bytes are freed (None = spill everything spillable).
+        Overflows HOST to DISK past the host limit. Returns bytes freed from
+        device (reference DeviceMemoryEventHandler.scala:58-90 loop)."""
+        from .budget import memory_budget
+        freed = 0
+        while target_bytes is None or freed < target_bytes:
+            with self._lock:
+                candidates = [e for e in self._entries.values()
+                              if e.tier == StorageTier.DEVICE and
+                              e.in_use == 0 and not e.closed]
+                if not candidates:
+                    break
+                victim = min(candidates, key=lambda e: e.priority)
+                self._spill_to_host_locked(victim)
+                freed += victim.nbytes
+            memory_budget().release(victim.nbytes)
+        self._enforce_host_limit()
+        return freed
+
+    def _spill_to_host_locked(self, entry: _Entry):
+        leaves = jax.tree_util.tree_leaves(entry.device_tree)
+        entry.host_leaves = [np.asarray(jax.device_get(x)) for x in leaves]
+        entry.device_tree = None
+        entry.tier = StorageTier.HOST
+        self.spilled_device_bytes += entry.nbytes
+
+    def _enforce_host_limit(self):
+        limit = active_conf().get(HOST_SPILL_LIMIT)
+        with self._lock:
+            host_entries = [e for e in self._entries.values()
+                            if e.tier == StorageTier.HOST and not e.closed]
+            host_total = sum(e.nbytes for e in host_entries)
+            for e in sorted(host_entries, key=lambda x: x.priority):
+                if host_total <= limit:
+                    break
+                self._spill_to_disk_locked(e)
+                host_total -= e.nbytes
+
+    def _spill_to_disk_locked(self, entry: _Entry):
+        path = os.path.join(self._spill_dir_path(),
+                            f"spill-{entry.handle_id}.npz")
+        np.savez(path, **{str(i): a for i, a in enumerate(entry.host_leaves)})
+        entry.host_leaves = None
+        entry.disk_path = path
+        entry.tier = StorageTier.DISK
+        self.spilled_host_bytes += entry.nbytes
+
+    def _unspill_locked(self, entry: _Entry):
+        from .budget import memory_budget
+        import jax.numpy as jnp
+        if entry.tier == StorageTier.DISK:
+            with np.load(entry.disk_path) as z:
+                entry.host_leaves = [z[str(i)] for i in range(len(z.files))]
+            os.unlink(entry.disk_path)
+            entry.disk_path = None
+            entry.tier = StorageTier.HOST
+        if entry.tier == StorageTier.HOST:
+            memory_budget().reserve(entry.nbytes)
+            leaves = [jnp.asarray(a) for a in entry.host_leaves]
+            entry.device_tree = jax.tree_util.tree_unflatten(
+                entry.treedef, leaves)
+            entry.host_leaves = None
+            entry.tier = StorageTier.DEVICE
+
+    def _spill_dir_path(self) -> str:
+        if self._spill_dir is None:
+            conf_dir = active_conf().get(SPILL_DIR)
+            self._spill_dir = conf_dir or tempfile.mkdtemp(prefix="srtpu-spill-")
+            os.makedirs(self._spill_dir, exist_ok=True)
+        return self._spill_dir
+
+    # -- introspection (test surface) -------------------------------------
+    def device_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values()
+                       if e.tier == StorageTier.DEVICE and not e.closed)
+
+    def num_entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+_catalog: Optional[BufferCatalog] = None
+_catalog_lock = threading.Lock()
+
+
+def buffer_catalog() -> BufferCatalog:
+    global _catalog
+    with _catalog_lock:
+        if _catalog is None:
+            _catalog = BufferCatalog()
+        return _catalog
+
+
+def reset_buffer_catalog() -> BufferCatalog:
+    global _catalog
+    with _catalog_lock:
+        _catalog = BufferCatalog()
+        return _catalog

@@ -1,0 +1,181 @@
+"""OOM-retry framework — the contract of the reference's
+RmmRapidsRetryIterator.scala:33,62-100 + JNI RmmSpark per-thread OOM state
+machine, rebuilt for TPU.
+
+On GPUs the reference gets an async callback from RMM when an allocation
+fails, spills synchronously, and retries the kernel. XLA on TPU gives no
+such callback mid-program, so the discipline is *proactive budgeting*: every
+operator reserves its worst-case padded footprint against an accounted HBM
+budget BEFORE launching device work. Reservation failure raises TpuRetryOOM
+(spill then retry) or, if the batch is the problem, the retry loop escalates
+to TpuSplitAndRetryOOM semantics by splitting the input and re-running —
+identical control flow to the reference, different trigger.
+
+Fault injection (`spark.rapids.sql.test.injectRetryOOM` = 'retry:N' or
+'split:N') throws on the Nth guarded section of a task — the reference's
+RmmSpark.forceRetryOOM test pattern (RmmSparkRetrySuiteBase.scala:35-80),
+and the backbone of the chaos-test suites in tests/test_retry.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence, TypeVar
+
+from ..config import RETRY_MAX_ATTEMPTS, TEST_RETRY_OOM_INJECTION_MODE, active_conf
+
+
+class TpuOOMError(MemoryError):
+    pass
+
+
+class TpuRetryOOM(TpuOOMError):
+    """Transient: spill/wait should free memory; re-run the SAME input."""
+
+
+class TpuSplitAndRetryOOM(TpuOOMError):
+    """The input itself is too big: split it and run the halves."""
+
+
+class CpuRetryOOM(TpuOOMError):
+    """Host-memory pressure analog (reference CpuRetryOOM)."""
+
+
+class _TaskState(threading.local):
+    def __init__(self):
+        self.task_id: Optional[int] = None
+        self.guarded_calls = 0
+        self.inject_mode: Optional[str] = None
+        self.inject_at = 0
+        self.injected = False
+        self.retry_count = 0
+        self.split_retry_count = 0
+
+
+_state = _TaskState()
+
+
+def register_task(task_id: int):
+    """Associate this thread with a task (reference RmmSpark task/thread
+    registration). Resets injection + metrics counters."""
+    _state.task_id = task_id
+    _state.guarded_calls = 0
+    _state.injected = False
+    _state.retry_count = 0
+    _state.split_retry_count = 0
+    inj = active_conf().get(TEST_RETRY_OOM_INJECTION_MODE)
+    if inj:
+        mode, _, n = inj.partition(":")
+        _state.inject_mode = mode
+        _state.inject_at = int(n or 1)
+    else:
+        _state.inject_mode = None
+
+
+def unregister_task():
+    _state.task_id = None
+    _state.inject_mode = None
+
+
+def force_retry_oom(num_ooms: int = 1):
+    """Directly arm injection on this thread (test API, reference
+    RmmSpark.forceRetryOOM)."""
+    _state.inject_mode = "retry"
+    _state.inject_at = _state.guarded_calls + 1
+    _state.injected = False
+
+
+def force_split_and_retry_oom():
+    _state.inject_mode = "split"
+    _state.inject_at = _state.guarded_calls + 1
+    _state.injected = False
+
+
+def oom_guard():
+    """Called at the top of every guarded device section; applies injection."""
+    _state.guarded_calls += 1
+    if (_state.inject_mode and not _state.injected
+            and _state.guarded_calls >= _state.inject_at):
+        _state.injected = True
+        if _state.inject_mode == "retry":
+            raise TpuRetryOOM("injected retry OOM")
+        if _state.inject_mode == "split":
+            raise TpuSplitAndRetryOOM("injected split-and-retry OOM")
+
+
+def task_retry_counts():
+    return _state.retry_count, _state.split_retry_count
+
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def split_in_half_by_rows(item):
+    """Default split policy: halve a (Spillable)ColumnarBatch by rows
+    (reference splitSpillableInHalfByRows)."""
+    from .spillable import SpillableBatch
+    if isinstance(item, SpillableBatch):
+        batch = item.get_batch()
+        item.close()
+        a, b = _split_batch(batch)
+        return [SpillableBatch.from_batch(a), SpillableBatch.from_batch(b)]
+    return list(_split_batch(item))
+
+
+def _split_batch(batch):
+    from ..columnar.batch import ColumnarBatch
+    from ..ops.basic import slice_rows
+    n = batch.num_rows_host
+    if n < 2:
+        raise TpuSplitAndRetryOOM("cannot split a batch with < 2 rows")
+    half = n // 2
+    cap = batch.capacity
+    import jax.numpy as jnp
+    left = ColumnarBatch(
+        [slice_rows(c, jnp.int32(0), jnp.int32(half), cap)
+         for c in batch.columns], half, batch.schema)
+    right = ColumnarBatch(
+        [slice_rows(c, jnp.int32(half), jnp.int32(n - half), cap)
+         for c in batch.columns], n - half, batch.schema)
+    return left, right
+
+
+def with_retry(input_item: T, fn: Callable[[T], R],
+               split_policy: Optional[Callable[[T], List[T]]] = None,
+               ) -> Iterator[R]:
+    """Run fn over input_item with OOM retry/split-retry semantics
+    (reference withRetry). Yields one result per (sub-)input. fn MUST be
+    idempotent; inputs should be spillable while waiting.
+    """
+    from .budget import spill_for_retry
+    max_attempts = active_conf().retry_max_attempts
+    queue: List[T] = [input_item]
+    while queue:
+        item = queue.pop(0)
+        attempts = 0
+        while True:
+            attempts += 1
+            try:
+                oom_guard()
+                yield fn(item)
+                break
+            except TpuRetryOOM:
+                _state.retry_count += 1
+                if attempts >= max_attempts:
+                    raise
+                spill_for_retry()
+            except TpuSplitAndRetryOOM:
+                _state.split_retry_count += 1
+                if split_policy is None:
+                    raise
+                halves = split_policy(item)
+                queue = halves + queue
+                break
+
+
+def with_retry_no_split(input_item: T, fn: Callable[[T], R]) -> R:
+    """withRetryNoSplit: retry on TpuRetryOOM only; split escalates."""
+    for result in with_retry(input_item, fn, split_policy=None):
+        return result
+    raise RuntimeError("with_retry produced no result")

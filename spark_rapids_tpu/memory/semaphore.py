@@ -1,0 +1,72 @@
+"""TpuSemaphore — device admission control (reference GpuSemaphore.scala:51).
+
+TPU programs serialize per core, so this is an admission queue into the
+per-chip executor: at most `spark.rapids.sql.concurrentGpuTasks` tasks may
+hold the device; others block (and their operator state, held as
+SpillableBatch, remains stealable). Wait time is tracked for task metrics
+(reference GpuTaskMetrics semWaitTime)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..config import CONCURRENT_TPU_TASKS, active_conf
+
+
+class TpuSemaphore:
+    def __init__(self, permits: Optional[int] = None):
+        self._permits = permits or active_conf().get(CONCURRENT_TPU_TASKS)
+        self._sem = threading.Semaphore(self._permits)
+        self._holders: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self.total_wait_ns = 0
+
+    def acquire_if_necessary(self, task_id: int):
+        """Idempotent per task (reference acquireIfNecessary
+        GpuSemaphore.scala:100): first call blocks for a permit, reentrant
+        calls are free."""
+        with self._lock:
+            if self._holders.get(task_id, 0) > 0:
+                self._holders[task_id] += 1
+                return
+        t0 = time.monotonic_ns()
+        self._sem.acquire()
+        self.total_wait_ns += time.monotonic_ns() - t0
+        with self._lock:
+            self._holders[task_id] = self._holders.get(task_id, 0) + 1
+
+    def release_if_necessary(self, task_id: int):
+        with self._lock:
+            count = self._holders.pop(task_id, 0)
+        if count > 0:
+            self._sem.release()
+
+    def held_by(self, task_id: int) -> bool:
+        with self._lock:
+            return self._holders.get(task_id, 0) > 0
+
+    @property
+    def available(self) -> int:
+        # not exact under contention; test/debug surface only
+        return self._sem._value  # noqa: SLF001
+
+
+_semaphore: Optional[TpuSemaphore] = None
+_sem_lock = threading.Lock()
+
+
+def tpu_semaphore() -> TpuSemaphore:
+    global _semaphore
+    with _sem_lock:
+        if _semaphore is None:
+            _semaphore = TpuSemaphore()
+        return _semaphore
+
+
+def reset_tpu_semaphore(permits: Optional[int] = None) -> TpuSemaphore:
+    global _semaphore
+    with _sem_lock:
+        _semaphore = TpuSemaphore(permits)
+        return _semaphore

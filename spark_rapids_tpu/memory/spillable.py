@@ -1,0 +1,62 @@
+"""SpillableBatch — handle wrapper letting operator state spill while not
+actively in use (reference SpillableColumnarBatch.scala). Operators hold
+these between kernel launches instead of raw device batches so the catalog
+can steal their memory under pressure."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..columnar.batch import ColumnarBatch
+from .catalog import ACTIVE_BATCHING_PRIORITY, buffer_catalog
+
+
+class SpillableBatch:
+    def __init__(self, handle: str, num_rows: int, schema):
+        self._handle = handle
+        self._num_rows = num_rows
+        self._schema = schema
+        self._closed = False
+
+    @staticmethod
+    def from_batch(batch: ColumnarBatch,
+                   priority: int = ACTIVE_BATCHING_PRIORITY) -> "SpillableBatch":
+        handle = buffer_catalog().add(batch, priority)
+        return SpillableBatch(handle, batch.num_rows_host, batch.schema)
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def size_bytes(self) -> int:
+        return buffer_catalog().size_of(self._handle)
+
+    def get_batch(self) -> ColumnarBatch:
+        """Bring the batch to the device and pin it (unspillable) until
+        `release()` / `close()`."""
+        assert not self._closed, "use after close"
+        return buffer_catalog().acquire(self._handle)
+
+    def release(self):
+        buffer_catalog().release(self._handle)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            buffer_catalog().remove(self._handle)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

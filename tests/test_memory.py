@@ -1,0 +1,209 @@
+"""Memory-runtime suites — the engine's analog of the reference's
+OOM-injection chaos tests (RmmSparkRetrySuiteBase.scala + WithRetrySuite /
+RapidsBufferCatalogSuite / Rapids*StoreSuite, SURVEY §4 tier 1): a tiny
+budget, spill stores installed, then forced TpuRetryOOM / split-retry."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.types import INT, LONG, Schema
+from spark_rapids_tpu.columnar import ColumnarBatch
+from spark_rapids_tpu.memory import (
+    SpillableBatch, StorageTier, TpuRetryOOM, TpuSplitAndRetryOOM,
+    buffer_catalog, force_retry_oom, force_split_and_retry_oom,
+    memory_budget, register_task, reset_buffer_catalog, reset_memory_budget,
+    reset_tpu_semaphore, split_in_half_by_rows, task_retry_counts,
+    tpu_semaphore, with_retry, with_retry_no_split,
+)
+
+
+@pytest.fixture(autouse=True)
+def small_pool():
+    """512 KiB budget + fresh catalog per test (the reference's tiny RMM)."""
+    reset_buffer_catalog()
+    reset_memory_budget(512 * 1024)
+    register_task(1)
+    yield
+    reset_buffer_catalog()
+    reset_memory_budget()
+
+
+def batch_of(n, start=0):
+    return ColumnarBatch.from_pydict(
+        {"a": list(range(start, start + n)),
+         "b": [i * 10 for i in range(start, start + n)]},
+        Schema.of(a=LONG, b=LONG))
+
+
+def test_spillable_roundtrip():
+    sb = SpillableBatch.from_batch(batch_of(100))
+    got = sb.get_batch()
+    assert got.to_pydict()["a"][:3] == [0, 1, 2]
+    sb.release()
+    sb.close()
+    assert buffer_catalog().num_entries() == 0
+
+
+def test_spill_to_host_and_back():
+    sb = SpillableBatch.from_batch(batch_of(64))
+    cat = buffer_catalog()
+    freed = cat.synchronous_spill(None)
+    assert freed > 0
+    assert cat.tier_of(sb._handle) == StorageTier.HOST
+    # acquire unspills transparently
+    got = sb.get_batch()
+    assert got.to_pydict()["b"][3] == 30
+    assert cat.tier_of(sb._handle) == StorageTier.DEVICE
+    sb.release()
+    sb.close()
+
+
+def test_spill_to_disk(tmp_path):
+    from spark_rapids_tpu import config as C
+    C.set_active_conf(C.RapidsConf({
+        "spark.rapids.memory.host.spillStorageSize": "1k",
+        "spark.rapids.memory.spillDirectory": str(tmp_path),
+    }))
+    try:
+        reset_buffer_catalog()
+        sb = SpillableBatch.from_batch(batch_of(64))
+        cat = buffer_catalog()
+        cat.synchronous_spill(None)  # device -> host -> (limit 1k) -> disk
+        assert cat.tier_of(sb._handle) == StorageTier.DISK
+        assert list(tmp_path.glob("spill-*.npz"))
+        got = sb.get_batch()
+        assert got.to_pydict()["a"][5] == 5
+        sb.release()
+        sb.close()
+    finally:
+        C.set_active_conf(C.RapidsConf())
+
+
+def test_in_use_entries_are_not_spilled():
+    sb = SpillableBatch.from_batch(batch_of(32))
+    sb.get_batch()  # pinned
+    cat = buffer_catalog()
+    cat.synchronous_spill(None)
+    assert cat.tier_of(sb._handle) == StorageTier.DEVICE
+    sb.release()
+    cat.synchronous_spill(None)
+    assert cat.tier_of(sb._handle) == StorageTier.HOST
+    sb.close()
+
+
+def test_budget_pressure_triggers_spill():
+    """Reserving past the limit spills idle spillables instead of failing."""
+    budget = memory_budget()
+    sb = SpillableBatch.from_batch(batch_of(1000))  # big-ish resident batch
+    used_before = budget.used
+    assert used_before > 0
+    budget.reserve(budget.limit - budget.used + 1)  # forces a spill
+    assert buffer_catalog().tier_of(sb._handle) == StorageTier.HOST
+    sb.close()
+
+
+def test_budget_oom_when_nothing_spillable():
+    budget = memory_budget()
+    with pytest.raises(TpuRetryOOM):
+        budget.reserve(budget.limit + 1)
+
+
+def test_with_retry_recovers_from_injected_oom():
+    """Reference WithRetrySuite: first attempt throws, retry succeeds."""
+    attempts = []
+
+    def body(b):
+        attempts.append(1)
+        return b.num_rows_host
+
+    force_retry_oom()
+    sb = batch_of(10)
+    out = list(with_retry(sb, body))
+    assert out == [10]
+    retries, splits = task_retry_counts()
+    assert retries == 1 and splits == 0
+
+
+def test_with_retry_split_halves_batch():
+    """Reference split-retry: the batch is halved and both halves run."""
+    force_split_and_retry_oom()
+    out = list(with_retry(batch_of(10), lambda b: b.num_rows_host,
+                          split_policy=split_in_half_by_rows))
+    assert out == [5, 5]
+    retries, splits = task_retry_counts()
+    assert splits == 1
+
+
+def test_with_retry_split_preserves_rows():
+    force_split_and_retry_oom()
+    seen = []
+    for b in with_retry(batch_of(9), lambda b: b.to_pydict()["a"],
+                        split_policy=split_in_half_by_rows):
+        seen.extend(b)
+    assert seen == list(range(9))
+
+
+def test_with_retry_no_split_escalates():
+    force_split_and_retry_oom()
+    with pytest.raises(TpuSplitAndRetryOOM):
+        with_retry_no_split(batch_of(4), lambda b: b)
+
+
+def test_retry_gives_up_after_max_attempts():
+    from spark_rapids_tpu import config as C
+    C.set_active_conf(C.RapidsConf({
+        "spark.rapids.sql.retry.maxAttempts": "3"}))
+    try:
+        register_task(2)
+
+        def always_oom(b):
+            raise TpuRetryOOM("persistent")
+
+        with pytest.raises(TpuRetryOOM):
+            list(with_retry(batch_of(4), always_oom))
+    finally:
+        C.set_active_conf(C.RapidsConf())
+
+
+def test_semaphore_admission():
+    sem = reset_tpu_semaphore(2)
+    sem.acquire_if_necessary(1)
+    sem.acquire_if_necessary(1)  # reentrant, no deadlock
+    sem.acquire_if_necessary(2)
+    assert sem.available == 0
+    sem.release_if_necessary(1)
+    assert sem.available == 1
+    sem.release_if_necessary(2)
+    assert sem.available == 2
+
+
+def test_semaphore_blocks_third_task():
+    import threading
+    sem = reset_tpu_semaphore(1)
+    sem.acquire_if_necessary(1)
+    acquired = threading.Event()
+
+    def worker():
+        sem.acquire_if_necessary(2)
+        acquired.set()
+        sem.release_if_necessary(2)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    assert not acquired.wait(0.1)
+    sem.release_if_necessary(1)
+    assert acquired.wait(2.0)
+    t.join()
+
+
+def test_config_docs_generation():
+    from spark_rapids_tpu.config import generate_docs
+    docs = generate_docs()
+    assert "spark.rapids.sql.batchSizeBytes" in docs
+    assert "spark.rapids.memory.tpu.allocFraction" in docs
+
+
+def test_unknown_config_rejected():
+    from spark_rapids_tpu import config as C
+    with pytest.raises(KeyError):
+        C.RapidsConf({"spark.rapids.sql.typoKey": "1"})
