@@ -1,0 +1,4 @@
+"""Columnar execution operators (reference layer L3, SURVEY §2.3): TPU
+plan nodes producing/consuming ColumnarBatch, the analog of GpuExec trees."""
+
+from .base import TpuExec, TpuMetric  # noqa: F401

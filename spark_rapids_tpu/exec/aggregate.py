@@ -1,0 +1,270 @@
+"""HashAggregateExec — reference GpuHashAggregateExec
+(GpuAggregateExec.scala:1711) + GpuMergeAggregateIterator:711 rebuilt around
+the sort-based segment-reduce kernel (ops/aggregate.py).
+
+Flow (complete mode):
+  1. per input batch: pre-project [group keys..., agg inputs...]
+  2. update group-by -> batch of [keys..., buffer cols...] (first-pass agg)
+  3. aggregated batches accumulate as SpillableBatch
+  4. merge: concat + re-aggregate with merge ops (reference
+     tryMergeAggregatedBatches:803; our kernel IS the sort fallback :909,
+     so the two reference paths collapse into one here)
+  5. evaluate buffers -> output projection
+
+`partial` mode stops after 4 and emits keys+buffers (feeds a shuffle);
+`final` consumes keys+buffers batches and runs 4-5. This mirrors Spark's
+partial/final split so distributed aggregation reuses the same exec.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, StringColumn
+from ..expr.aggexprs import AggregateFunction
+from ..expr.core import Expression, output_name, resolve
+from ..memory.retry import (
+    TpuSplitAndRetryOOM, split_in_half_by_rows, with_retry,
+)
+from ..memory.spillable import SpillableBatch
+from ..ops.aggregate import groupby_aggregate, reduce_no_keys
+from ..ops.basic import active_mask, sanitize
+from ..ops.sort import string_words_for
+from ..types import DataType, LongType, Schema, StructField
+from .base import AGG_TIME, CONCAT_TIME, NUM_INPUT_BATCHES, NUM_INPUT_ROWS, TpuExec
+from .basic import bind_projection, eval_projection
+from .coalesce import concat_batches
+
+
+class AggregateExec(TpuExec):
+    def __init__(self, group_exprs: Sequence[Expression],
+                 aggregates: Sequence[Tuple[AggregateFunction, str]],
+                 child: TpuExec, mode: str = "complete"):
+        super().__init__(child)
+        assert mode in ("complete", "partial", "final")
+        self.mode = mode
+        self.group_exprs = list(group_exprs)
+        self.aggregates = list(aggregates)
+        in_schema = child.output_schema
+
+        if mode == "final":
+            # input is keys+buffers produced by a partial instance
+            self._key_count = len(group_exprs)
+            self._input_types = None
+            self._buffer_schema = in_schema
+        else:
+            # pre-projection: keys then the union of agg inputs
+            self._pre_exprs = list(self.group_exprs)
+            self._input_slots: List[List[int]] = []
+            for fn, _ in self.aggregates:
+                slots = []
+                for e in fn.inputs:
+                    slot = len(self._pre_exprs)
+                    self._pre_exprs.append(e.alias(f"_aggin{slot}"))
+                    slots.append(slot)
+                self._input_slots.append(slots)
+            self._pre_bound = bind_projection(self._pre_exprs, in_schema)
+            from .basic import projection_schema
+            self._pre_schema = projection_schema(self._pre_exprs, in_schema)
+            self._key_count = len(group_exprs)
+            self._input_types = [
+                [self._pre_schema.fields[s].data_type for s in slots]
+                for slots in self._input_slots]
+            self._buffer_schema = self._make_buffer_schema()
+
+    # -- schemas -----------------------------------------------------------
+    def _make_buffer_schema(self) -> Schema:
+        fields = list(self._pre_schema.fields[: self._key_count])
+        for i, (fn, name) in enumerate(self.aggregates):
+            for j, bt in enumerate(fn.buffer_types(self._input_types[i])):
+                fields.append(StructField(f"{name}#buf{j}", bt, True))
+        return Schema(tuple(fields))
+
+    @property
+    def output_schema(self) -> Schema:
+        if self.mode == "partial":
+            return self._buffer_schema
+        key_fields = list(self._buffer_schema.fields[: self._key_count])
+        agg_fields = []
+        bufs = self._buffer_schema.fields[self._key_count:]
+        # result types: derive from buffer types for final mode
+        pos = 0
+        for i, (fn, name) in enumerate(self.aggregates):
+            n_buf = len(fn.merge_ops())
+            input_types = self._input_types[i] if self._input_types else \
+                [bufs[pos].data_type]
+            agg_fields.append(StructField(name, fn.result_type(input_types)))
+            pos += n_buf
+        return Schema(tuple(key_fields + agg_fields))
+
+    def additional_metrics(self):
+        return (AGG_TIME, CONCAT_TIME, NUM_INPUT_ROWS, NUM_INPUT_BATCHES)
+
+    # -- kernels -----------------------------------------------------------
+    def _update_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """First-pass aggregation of one pre-projected batch."""
+        keys = list(batch.columns[: self._key_count])
+        agg_inputs = []
+        for i, (fn, _) in enumerate(self.aggregates):
+            for (op, slot) in fn.update_ops():
+                col = batch.columns[self._input_slots[i][slot]] \
+                    if slot is not None else None
+                agg_inputs.append((op, col))
+        return self._run_groupby(keys, agg_inputs, batch,
+                                 self._buffer_schema)
+
+    def _merge_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Re-aggregate a keys+buffers batch with merge ops."""
+        keys = list(batch.columns[: self._key_count])
+        agg_inputs = []
+        pos = self._key_count
+        for fn, _ in self.aggregates:
+            for op in fn.merge_ops():
+                agg_inputs.append((op, batch.columns[pos]))
+                pos += 1
+        return self._run_groupby(keys, agg_inputs, batch,
+                                 self._buffer_schema)
+
+    def _run_groupby(self, keys, agg_inputs, batch, out_schema
+                     ) -> ColumnarBatch:
+        cap = batch.capacity
+        if not keys:
+            # a count(*)-only aggregate has no input columns at all; give the
+            # one-row output a real capacity bucket
+            cap = max(cap, 128)
+            results = reduce_no_keys(agg_inputs, batch.num_rows, cap)
+            cols = []
+            fields = out_schema.fields
+            for (data, valid), f in zip(results, fields):
+                act1 = active_mask(jnp.int32(1), cap)
+                cols.append(Column(
+                    jnp.where(act1, data.astype(f.data_type.jnp_dtype), 0),
+                    valid & act1, f.data_type))
+            return ColumnarBatch(cols, 1, out_schema)
+        words = string_words_for(keys, range(len(keys)))
+        out_keys, results, num_groups = groupby_aggregate(
+            keys, agg_inputs, batch.num_rows, cap, words)
+        cols = list(out_keys)
+        buf_fields = out_schema.fields[self._key_count:]
+        for r, f in zip(results, buf_fields):
+            if r[0] == "col":
+                cols.append(r[1])
+            else:
+                data, valid = r[1]
+                cols.append(Column(data.astype(f.data_type.jnp_dtype),
+                                   valid, f.data_type))
+        return ColumnarBatch(cols, num_groups, out_schema)
+
+    def _evaluate(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Final projection buffers -> results."""
+        out_schema = self.output_schema
+        cols = list(batch.columns[: self._key_count])
+        pos = self._key_count
+        for i, (fn, _) in enumerate(self.aggregates):
+            n_buf = len(fn.merge_ops())
+            bufs = list(batch.columns[pos: pos + n_buf])
+            input_types = self._input_types[i] if self._input_types else \
+                [b.dtype for b in bufs]
+            col = fn.evaluate(bufs, input_types)
+            cols.append(sanitize(col, batch.num_rows))
+            pos += n_buf
+        return ColumnarBatch(cols, batch.num_rows, out_schema,
+                             batch._host_rows)
+
+    # -- drive -------------------------------------------------------------
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        agg_time = self.metrics[AGG_TIME]
+        in_rows = self.metrics[NUM_INPUT_ROWS]
+        in_batches = self.metrics[NUM_INPUT_BATCHES]
+        aggregated: List[SpillableBatch] = []
+
+        with agg_time.ns_timer():
+            first_pass = self._merge_batch if self.mode == "final" \
+                else self._update_and_aggregate
+            for batch in self.child.execute():
+                in_batches.add(1)
+                in_rows.add(batch.num_rows_host)
+                spillable = SpillableBatch.from_batch(batch)
+                try:
+                    for out in with_retry(spillable,
+                                          self._spill_wrap(first_pass),
+                                          split_policy=split_in_half_by_rows):
+                        aggregated.append(SpillableBatch.from_batch(out))
+                finally:
+                    spillable.close()
+
+            if not aggregated:
+                if not self.group_exprs and self.mode != "partial":
+                    # grand aggregate over empty input: one row (count=0 ...)
+                    from .basic import InMemoryScanExec
+                    from ..columnar.batch import empty_batch
+                    empty = empty_batch(self._pre_schema
+                                        if self.mode != "final"
+                                        else self._buffer_schema)
+                    merged = self._update_batch(empty) \
+                        if self.mode != "final" else self._merge_batch(empty)
+                    yield self._evaluate(merged)
+                return
+
+            merged = self._merge_all(aggregated)
+            if self.mode == "partial":
+                yield merged
+            else:
+                yield self._evaluate(merged)
+
+    def _update_and_aggregate(self, batch: ColumnarBatch) -> ColumnarBatch:
+        pre = eval_projection(self._pre_bound, batch, self._pre_schema)
+        return self._update_batch(pre)
+
+    def _spill_wrap(self, fn):
+        def run(s: SpillableBatch):
+            b = s.get_batch()
+            try:
+                return fn(b)
+            finally:
+                s.release()
+        return run
+
+    def _merge_all(self, aggregated: List[SpillableBatch]) -> ColumnarBatch:
+        """Concat + re-aggregate; under OOM the retry framework splits the
+        set of partial batches and re-merges the halves (always correct:
+        merge ops are associative & commutative)."""
+        extra_owned: List[SpillableBatch] = []
+
+        def split_set(items: List[SpillableBatch]):
+            if len(items) < 2:
+                halves = split_in_half_by_rows(items[0])
+                extra_owned.extend(halves)
+                return [[h] for h in halves]
+            half = len(items) // 2
+            return [items[:half], items[half:]]
+
+        def do(items: List[SpillableBatch]) -> ColumnarBatch:
+            batches = [s.get_batch() for s in items]
+            try:
+                merged = concat_batches(batches, self._buffer_schema)
+                return self._merge_batch(merged)
+            finally:
+                for s in items:
+                    s.release()
+
+        try:
+            outs = list(with_retry(aggregated, do, split_policy=split_set))
+        finally:
+            for s in aggregated + extra_owned:
+                s.close()
+        if len(outs) == 1:
+            return outs[0]
+        # split path produced several partials: re-merge them
+        spill = [SpillableBatch.from_batch(b) for b in outs]
+        return self._merge_all(spill)
+
+    def node_description(self):
+        aggs = ", ".join(f"{fn!r} AS {name}" for fn, name in self.aggregates)
+        return (f"AggregateExec[{self.mode}, keys={self.group_exprs!r}, "
+                f"aggs=[{aggs}]]")

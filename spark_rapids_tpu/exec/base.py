@@ -1,0 +1,133 @@
+"""TpuExec — base of the columnar operator tree (reference GpuExec,
+sql-plugin/.../GpuExec.scala:365 `doExecuteColumnar`; metric registry at
+GpuExec.scala:49-116 with ESSENTIAL/MODERATE/DEBUG levels).
+
+Operators form a tree; `execute()` returns an iterator of ColumnarBatch.
+Each operator's device work is jax-traced per batch *shape bucket*, so a
+pipeline of execs compiles into a small set of XLA programs reused across
+batches. Host-side control (iteration, spill, retry, coalesce decisions)
+stays in Python exactly where the reference keeps it in Scala.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from ..columnar.batch import ColumnarBatch
+from ..types import Schema
+
+ESSENTIAL = 0
+MODERATE = 1
+DEBUG = 2
+
+
+class TpuMetric:
+    """Accumulating operator metric (reference GpuMetric)."""
+
+    __slots__ = ("name", "level", "value")
+
+    def __init__(self, name: str, level: int = MODERATE):
+        self.name = name
+        self.level = level
+        self.value = 0
+
+    def add(self, v):
+        self.value += v
+
+    def ns_timer(self):
+        return _NsTimer(self)
+
+
+class _NsTimer:
+    def __init__(self, metric: TpuMetric):
+        self.metric = metric
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.metric.add(time.perf_counter_ns() - self._t0)
+
+
+# canonical metric names (reference GpuMetric companion, GpuExec.scala:49-96)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+OP_TIME = "opTime"
+SORT_TIME = "sortTime"
+AGG_TIME = "computeAggTime"
+CONCAT_TIME = "concatTime"
+JOIN_TIME = "joinTime"
+BUILD_TIME = "buildTime"
+PEAK_DEVICE_MEMORY = "peakDevMemory"
+NUM_TASKS_FALL_BACKED = "numTasksFallBacked"
+SPILL_TIME = "spillTime"
+
+
+class TpuExec:
+    """Base columnar operator."""
+
+    def __init__(self, *children: "TpuExec"):
+        self.children: List[TpuExec] = list(children)
+        self.metrics: Dict[str, TpuMetric] = {}
+        for name in (NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES):
+            self.metrics[name] = TpuMetric(name, ESSENTIAL)
+        self.metrics[OP_TIME] = TpuMetric(OP_TIME, MODERATE)
+        for name in self.additional_metrics():
+            self.metrics[name] = TpuMetric(name, MODERATE)
+
+    # -- subclass surface --------------------------------------------------
+    @property
+    def output_schema(self) -> Schema:
+        raise NotImplementedError(type(self).__name__)
+
+    def additional_metrics(self) -> Sequence[str]:
+        return ()
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError(type(self).__name__)
+
+    # -- public ------------------------------------------------------------
+    def execute(self) -> Iterator[ColumnarBatch]:
+        """Final wrapper (reference GpuExec.doExecuteColumnar:365): counts
+        output rows/batches around the operator's own iterator."""
+        rows = self.metrics[NUM_OUTPUT_ROWS]
+        batches = self.metrics[NUM_OUTPUT_BATCHES]
+        for batch in self.internal_execute():
+            batches.add(1)
+            rows.add(batch.num_rows_host)
+            yield batch
+
+    @property
+    def child(self) -> "TpuExec":
+        assert len(self.children) == 1, type(self).__name__
+        return self.children[0]
+
+    def collect(self) -> List[tuple]:
+        out: List[tuple] = []
+        for batch in self.execute():
+            out.extend(batch.to_pylist())
+        return out
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.node_description()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def node_description(self) -> str:
+        return type(self).__name__
+
+    def all_metrics(self) -> Dict[str, int]:
+        out = {}
+        def walk(node, path):
+            label = f"{type(node).__name__}"
+            for name, m in node.metrics.items():
+                out[f"{path}{label}.{name}"] = m.value
+            for i, c in enumerate(node.children):
+                walk(c, f"{path}{label}/")
+        walk(self, "")
+        return out

@@ -1,0 +1,294 @@
+"""Basic execs: scan, project (tiered/CSE), filter, range, expand, union,
+limits — reference basicPhysicalOperators.scala (GpuProjectExec:350,
+GpuTieredProject:507, GpuFilterExec:783, GpuRangeExec:1116), limit.scala,
+GpuExpandExec.scala.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, bucket_capacity
+from ..expr.core import Alias, BoundReference, Expression, output_name, resolve
+from ..memory.retry import split_in_half_by_rows, with_retry
+from ..memory.spillable import SpillableBatch
+from ..ops.basic import compact_columns, sanitize, slice_rows
+from ..types import LongType, Schema, StructField
+from .base import NUM_INPUT_BATCHES, NUM_INPUT_ROWS, OP_TIME, TpuExec
+
+
+class InMemoryScanExec(TpuExec):
+    """Leaf feeding pre-built device batches (tests, broadcast relations,
+    shuffle reads). File-format scans live in the io/ package."""
+
+    def __init__(self, batches: Sequence[ColumnarBatch], schema: Schema):
+        super().__init__()
+        self._batches = list(batches)
+        self._schema = schema
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        yield from self._batches
+
+
+def bind_projection(exprs: Sequence[Expression], schema: Schema
+                    ) -> List[Expression]:
+    return [resolve(e, schema) for e in exprs]
+
+
+def projection_schema(exprs: Sequence[Expression], schema: Schema) -> Schema:
+    bound = bind_projection(exprs, schema)
+    fields = []
+    for i, e in enumerate(bound):
+        fields.append(StructField(output_name(exprs[i], f"col{i}"),
+                                  e.data_type, e.nullable))
+    return Schema(tuple(fields))
+
+
+class _CSECache:
+    """Common-subexpression cache shared across one projection evaluation —
+    the effect of the reference's GpuTieredProject
+    (basicPhysicalOperators.scala:507) without explicit tiers: XLA fusion
+    already dedupes device work; this dedupes *tracing* work."""
+
+    def __init__(self):
+        self._cache: Dict[tuple, Column] = {}
+
+    def eval(self, expr: Expression, batch: ColumnarBatch) -> Column:
+        key = expr.semantic_key()
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        col = expr.columnar_eval(batch)
+        self._cache[key] = col
+        return col
+
+
+def eval_projection(bound: Sequence[Expression], batch: ColumnarBatch,
+                    schema: Schema) -> ColumnarBatch:
+    cse = _CSECache()
+    cols = [sanitize(cse.eval(e, batch), batch.num_rows) for e in bound]
+    return batch.with_columns(cols, schema)
+
+
+class ProjectExec(TpuExec):
+    def __init__(self, exprs: Sequence[Expression], child: TpuExec):
+        super().__init__(child)
+        self.exprs = list(exprs)
+        self._schema = projection_schema(self.exprs, child.output_schema)
+        self._bound = bind_projection(self.exprs, child.output_schema)
+        self._jit = jax.jit(
+            lambda b: eval_projection(self._bound, b, self._schema))
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        op_time = self.metrics[OP_TIME]
+        for batch in self.child.execute():
+            spillable = SpillableBatch.from_batch(batch)
+            try:
+                with op_time.ns_timer():
+                    yield from with_retry(
+                        spillable,
+                        lambda s: self._project_spillable(s),
+                        split_policy=split_in_half_by_rows)
+            finally:
+                spillable.close()
+
+    def _project_spillable(self, s: SpillableBatch) -> ColumnarBatch:
+        batch = s.get_batch()
+        try:
+            return self._jit(batch)
+        finally:
+            s.release()
+
+    def node_description(self):
+        return f"ProjectExec[{', '.join(map(repr, self.exprs))}]"
+
+
+class FilterExec(TpuExec):
+    def __init__(self, condition: Expression, child: TpuExec):
+        super().__init__(child)
+        self.condition = condition
+        self._bound = resolve(condition, child.output_schema)
+        self._jit = jax.jit(self._kernel)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def _kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
+        pred = self._bound.columnar_eval(batch)
+        # Spark: null predicate rows are dropped
+        keep = pred.data & pred.validity
+        cols, n = compact_columns(batch.columns, keep, batch.num_rows)
+        return ColumnarBatch(cols, n, batch.schema)
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        op_time = self.metrics[OP_TIME]
+        for batch in self.child.execute():
+            spillable = SpillableBatch.from_batch(batch)
+            try:
+                with op_time.ns_timer():
+                    yield from with_retry(
+                        spillable,
+                        lambda s: self._filter_spillable(s),
+                        split_policy=split_in_half_by_rows)
+            finally:
+                spillable.close()
+
+    def _filter_spillable(self, s: SpillableBatch) -> ColumnarBatch:
+        batch = s.get_batch()
+        try:
+            return self._jit(batch)
+        finally:
+            s.release()
+
+    def node_description(self):
+        return f"FilterExec[{self.condition!r}]"
+
+
+class RangeExec(TpuExec):
+    """GpuRangeExec (basicPhysicalOperators.scala:1116): generates id ranges
+    directly on device in target-sized batches."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 batch_rows: int = 1 << 20, name: str = "id"):
+        super().__init__()
+        assert step != 0
+        self.start, self.end, self.step = start, end, step
+        self.batch_rows = batch_rows
+        self._schema = Schema((StructField(name, LongType(), False),))
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        total = max(0, -(-(self.end - self.start) // self.step))
+        emitted = 0
+        while emitted < total:
+            n = min(self.batch_rows, total - emitted)
+            cap = bucket_capacity(n)
+            base = self.start + emitted * self.step
+            data = base + jnp.arange(cap, dtype=jnp.int64) * self.step
+            act = jnp.arange(cap, dtype=jnp.int32) < n
+            col = Column(jnp.where(act, data, 0), act, LongType())
+            yield ColumnarBatch([col], n, self._schema)
+            emitted += n
+
+
+class UnionExec(TpuExec):
+    """GpuUnionExec: concatenation of children outputs (schemas align)."""
+
+    def __init__(self, *children: TpuExec):
+        super().__init__(*children)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        for c in self.children:
+            for batch in c.execute():
+                yield ColumnarBatch(batch.columns, batch.num_rows,
+                                    self.output_schema,
+                                    batch._host_rows)
+
+
+class LocalLimitExec(TpuExec):
+    """GpuLocalLimitExec (limit.scala:168): per-partition row cap."""
+
+    def __init__(self, limit: int, child: TpuExec):
+        super().__init__(child)
+        self.limit = limit
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        remaining = self.limit
+        for batch in self.child.execute():
+            if remaining <= 0:
+                break
+            n = batch.num_rows_host
+            if n <= remaining:
+                remaining -= n
+                yield batch
+            else:
+                cols = [slice_rows(c, jnp.int32(0), jnp.int32(remaining),
+                                   batch.capacity)
+                        for c in batch.columns]
+                yield ColumnarBatch(cols, remaining, batch.schema)
+                remaining = 0
+
+
+class GlobalLimitExec(LocalLimitExec):
+    """Single-partition engine: same row cap with optional offset."""
+
+    def __init__(self, limit: int, child: TpuExec, offset: int = 0):
+        super().__init__(limit, child)
+        self.offset = offset
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        to_skip = self.offset
+        inner = super().internal_execute() if self.offset == 0 else \
+            self.child.execute()
+        if self.offset == 0:
+            yield from inner
+            return
+        remaining = self.limit
+        for batch in inner:
+            n = batch.num_rows_host
+            if to_skip >= n:
+                to_skip -= n
+                continue
+            start = to_skip
+            to_skip = 0
+            take = min(n - start, remaining)
+            if take <= 0:
+                break
+            cols = [slice_rows(c, jnp.int32(start), jnp.int32(take),
+                               batch.capacity) for c in batch.columns]
+            yield ColumnarBatch(cols, take, batch.schema)
+            remaining -= take
+            if remaining <= 0:
+                break
+
+
+class ExpandExec(TpuExec):
+    """GpuExpandExec: N projections per input batch (GROUPING SETS/rollup).
+
+    Emits one batch per projection rather than interleaving rows — same
+    multiset of rows, better shapes for XLA."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 child: TpuExec):
+        super().__init__(child)
+        self.projections = [list(p) for p in projections]
+        self._schema = projection_schema(self.projections[0],
+                                         child.output_schema)
+        self._bound = [bind_projection(p, child.output_schema)
+                       for p in self.projections]
+        self._jits = [
+            jax.jit(lambda b, bp=bp: eval_projection(bp, b, self._schema))
+            for bp in self._bound]
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        for batch in self.child.execute():
+            for jitfn in self._jits:
+                yield jitfn(batch)

@@ -1,0 +1,97 @@
+"""Batch coalescing — reference GpuCoalesceBatches.scala:875 /
+AbstractGpuCoalesceIterator:250. Concatenates small batches up to the target
+batch size (spark.rapids.sql.batchSizeBytes) so downstream kernels run at
+MXU-friendly sizes. Pending input is held as SpillableBatch so the coalesce
+window never pins more HBM than the catalog allows."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import bucket_capacity
+from ..config import active_conf
+from ..memory.retry import with_retry_no_split
+from ..memory.spillable import SpillableBatch
+from ..ops.basic import concat_columns, sanitize
+from ..types import Schema
+from .base import CONCAT_TIME, NUM_INPUT_BATCHES, NUM_INPUT_ROWS, TpuExec
+
+
+def concat_batches(batches: List[ColumnarBatch], schema: Schema
+                   ) -> ColumnarBatch:
+    """Concatenate active rows of all batches into one batch whose capacity
+    is the bucket of the total. Tree-shaped pairwise reduction: each row is
+    copied O(log k) times instead of the O(k) of a left fold, and each
+    round reuses one compiled concat program per capacity pair."""
+    assert batches
+    level = batches
+    while len(level) > 1:
+        nxt_level = []
+        for i in range(0, len(level) - 1, 2):
+            a, b = level[i], level[i + 1]
+            cap = bucket_capacity(a.num_rows_host + b.num_rows_host)
+            cols = [concat_columns(ca, cb, a.num_rows, b.num_rows, cap)
+                    for ca, cb in zip(a.columns, b.columns)]
+            nxt_level.append(ColumnarBatch(
+                cols, a.num_rows_host + b.num_rows_host, schema))
+        if len(level) % 2:
+            nxt_level.append(level[-1])
+        level = nxt_level
+    return level[0]
+
+
+class CoalesceBatchesExec(TpuExec):
+    def __init__(self, child: TpuExec, target_bytes: Optional[int] = None):
+        super().__init__(child)
+        self.target_bytes = target_bytes or active_conf().batch_size_bytes
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def additional_metrics(self):
+        return (CONCAT_TIME, NUM_INPUT_ROWS, NUM_INPUT_BATCHES)
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        in_rows = self.metrics[NUM_INPUT_ROWS]
+        in_batches = self.metrics[NUM_INPUT_BATCHES]
+        concat_time = self.metrics[CONCAT_TIME]
+        pending: List[SpillableBatch] = []
+        pending_bytes = 0
+
+        def flush() -> Optional[ColumnarBatch]:
+            nonlocal pending, pending_bytes
+            if not pending:
+                return None
+            with concat_time.ns_timer():
+                spillables, pending = pending, []
+                pending_bytes = 0
+                def do(items):
+                    batches = [s.get_batch() for s in items]
+                    try:
+                        return concat_batches(batches, self.output_schema)
+                    finally:
+                        for s in items:
+                            s.release()
+                out = with_retry_no_split(spillables, do)
+                for s in spillables:
+                    s.close()
+                return out
+
+        for batch in self.child.execute():
+            in_batches.add(1)
+            in_rows.add(batch.num_rows_host)
+            size = batch.device_size_bytes()
+            if pending and pending_bytes + size > self.target_bytes:
+                yield flush()
+            pending.append(SpillableBatch.from_batch(batch))
+            pending_bytes += size
+            if pending_bytes >= self.target_bytes:
+                yield flush()
+        tail = flush()
+        if tail is not None:
+            yield tail

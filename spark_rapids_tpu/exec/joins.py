@@ -1,0 +1,341 @@
+"""Join execs — reference GpuHashJoin
+(org/apache/spark/sql/rapids/execution/GpuHashJoin.scala:994, doJoin:1103),
+GpuShuffledHashJoinExec, GpuBroadcastHashJoinExecBase,
+GpuBroadcastNestedLoopJoinExecBase, ExistenceJoin.
+
+One HashJoinExec covers broadcast & shuffled hash joins: in this engine a
+"broadcast" build side is simply an already-materialized child (the
+broadcast exchange keeps it device-resident), so both reference execs share
+this operator, parameterized by build side. The probe pipeline is the
+gather-map kernel stack in ops/join.py; per stream batch there is exactly
+one host sync (candidate count -> capacity bucket), everything else stays
+in compiled XLA.
+
+Join-type support: inner, left/right/full outer, left semi, left anti,
+cross (via NestedLoopJoinExec), existence. Extra non-equi conditions
+evaluate over candidate pairs and AND into the verified mask — the analog
+of the reference's AST-compiled join conditions (AstUtil.scala).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, bucket_capacity
+from ..expr.core import Expression, resolve
+from ..memory.spillable import SpillableBatch
+from ..ops.basic import active_mask, compaction_order, gather_column
+from ..ops.join import (
+    BuildTable, cross_pairs, expand_candidates, gather_column_indices,
+    inner_gather_maps, matched_flags, outer_extend_maps, probe_counts,
+    unmatched_indices, verify_pairs,
+)
+from ..types import BooleanType, Schema, StructField
+from .base import BUILD_TIME, JOIN_TIME, NUM_INPUT_BATCHES, TpuExec
+from .basic import bind_projection, eval_projection, projection_schema
+from .coalesce import concat_batches
+
+INNER, LEFT_OUTER, RIGHT_OUTER, FULL_OUTER = "inner", "left_outer", \
+    "right_outer", "full_outer"
+LEFT_SEMI, LEFT_ANTI, EXISTENCE, CROSS = "left_semi", "left_anti", \
+    "existence", "cross"
+
+
+def _gather_batch(columns: Sequence[Column], idx, n) -> List[Column]:
+    cap = idx.shape[0]
+    act = active_mask(n, cap)
+    return [gather_column(c, jnp.where(act, idx, -1)) for c in columns]
+
+
+class HashJoinExec(TpuExec):
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 join_type: str = INNER,
+                 build_side: str = "right",
+                 condition: Optional[Expression] = None,
+                 exists_name: str = "exists"):
+        super().__init__(left, right)
+        assert build_side in ("left", "right")
+        self.join_type = join_type
+        self.build_side = build_side
+        self.condition = condition
+        self.exists_name = exists_name
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        # semi/anti/existence joins that preserve the stream side require
+        # build == non-preserved side; the planner guarantees this.
+        if join_type in (LEFT_SEMI, LEFT_ANTI, EXISTENCE):
+            assert build_side == "right"
+
+    # -- schema ------------------------------------------------------------
+    @property
+    def left_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    @property
+    def right_schema(self) -> Schema:
+        return self.children[1].output_schema
+
+    @property
+    def output_schema(self) -> Schema:
+        if self.join_type in (LEFT_SEMI, LEFT_ANTI):
+            return self.left_schema
+        if self.join_type == EXISTENCE:
+            return Schema(tuple(self.left_schema.fields) +
+                          (StructField(self.exists_name, BooleanType(), False),))
+        lf = [StructField(f.name, f.data_type,
+                          f.nullable or self.join_type in (RIGHT_OUTER, FULL_OUTER))
+              for f in self.left_schema.fields]
+        rf = [StructField(f.name, f.data_type,
+                          f.nullable or self.join_type in (LEFT_OUTER, FULL_OUTER))
+              for f in self.right_schema.fields]
+        return Schema(tuple(lf + rf))
+
+    def additional_metrics(self):
+        return (BUILD_TIME, JOIN_TIME, NUM_INPUT_BATCHES)
+
+    # -- build -------------------------------------------------------------
+    def _build(self) -> Tuple[BuildTable, ColumnarBatch]:
+        build_child = self.children[1] if self.build_side == "right" \
+            else self.children[0]
+        keys = self.right_keys if self.build_side == "right" else self.left_keys
+        with self.metrics[BUILD_TIME].ns_timer():
+            batches = list(build_child.execute())
+            if batches:
+                batch = concat_batches(batches, build_child.output_schema)
+            else:
+                from ..columnar.batch import empty_batch
+                batch = empty_batch(build_child.output_schema)
+            bound = bind_projection(keys, build_child.output_schema)
+            key_cols = [e.columnar_eval(batch) for e in bound]
+            table = BuildTable(key_cols, list(batch.columns),
+                               batch.num_rows, batch.capacity)
+            return table, batch
+
+    # -- probe -------------------------------------------------------------
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        build, build_batch = self._build()
+        stream_child = self.children[0] if self.build_side == "right" \
+            else self.children[1]
+        stream_keys = self.left_keys if self.build_side == "right" \
+            else self.right_keys
+        bound_keys = bind_projection(stream_keys, stream_child.output_schema)
+        build_matched = jnp.zeros((build.capacity,), jnp.bool_)
+        need_build_flags = (
+            (self.join_type in (RIGHT_OUTER, FULL_OUTER) and self.build_side == "right")
+            or (self.join_type in (LEFT_OUTER, FULL_OUTER) and self.build_side == "left"))
+
+        join_time = self.metrics[JOIN_TIME]
+        for stream_batch in stream_child.execute():
+            with join_time.ns_timer():
+                out, build_matched = self._probe_one(
+                    build, build_batch, stream_batch, bound_keys,
+                    build_matched, need_build_flags)
+            if out is not None:
+                yield out
+
+        if need_build_flags:
+            with join_time.ns_timer():
+                yield self._emit_build_unmatched(build, build_batch,
+                                                 build_matched)
+
+    def _probe_one(self, build: BuildTable, build_batch: ColumnarBatch,
+                   stream_batch: ColumnarBatch, bound_keys,
+                   build_matched, need_build_flags):
+        scap = stream_batch.capacity
+        skey_cols = [e.columnar_eval(stream_batch) for e in bound_keys]
+        lo, counts, _valid = probe_counts(build, skey_cols,
+                                          stream_batch.num_rows, scap)
+        total = int(jnp.sum(counts))  # host sync: size the candidate bucket
+        cand_cap = bucket_capacity(max(total, 1))
+        s_idx, b_pos, total_dev = expand_candidates(lo, counts, cand_cap)
+        verified, b_row = verify_pairs(build, skey_cols, s_idx, b_pos,
+                                       s_idx >= 0)
+        if self.condition is not None:
+            verified = verified & self._eval_condition(
+                stream_batch, build_batch, s_idx, b_row, cand_cap)
+
+        jt, bs = self.join_type, self.build_side
+        stream_preserved = (jt == LEFT_OUTER and bs == "right") or \
+            (jt == RIGHT_OUTER and bs == "left") or jt == FULL_OUTER
+
+        if need_build_flags:
+            build_matched = build_matched | matched_flags(
+                verified, b_row, build.capacity)
+
+        if jt in (LEFT_SEMI, LEFT_ANTI, EXISTENCE):
+            smatched = matched_flags(verified, s_idx, scap)
+            if jt == EXISTENCE:
+                flag = Column(smatched, jnp.ones((scap,), jnp.bool_),
+                              BooleanType())
+                cols = list(stream_batch.columns) + [flag]
+                return ColumnarBatch(cols, stream_batch.num_rows,
+                                     self.output_schema), build_matched
+            keep = smatched if jt == LEFT_SEMI else ~smatched
+            perm, n = compaction_order(keep, stream_batch.num_rows)
+            cols = [gather_column(c, jnp.where(active_mask(n, scap), perm, -1))
+                    for c in stream_batch.columns]
+            return ColumnarBatch(cols, n, self.output_schema), build_matched
+
+        s_map, b_map, n_pairs = inner_gather_maps(verified, s_idx, b_row,
+                                                  total_dev)
+        if stream_preserved:
+            smatched = matched_flags(verified, s_idx, scap)
+            un_idx, n_un = unmatched_indices(smatched, stream_batch.num_rows,
+                                             scap)
+            out_cap = bucket_capacity(max(total + stream_batch.num_rows_host, 1))
+            s_map, b_map, n_out = outer_extend_maps(
+                s_map, b_map, n_pairs, un_idx, n_un, "build", out_cap)
+        else:
+            n_out = n_pairs
+
+        scols = _gather_batch(stream_batch.columns, s_map, n_out)
+        bcols = _gather_batch(build.payload, b_map, n_out)
+        left_cols = scols if self.build_side == "right" else bcols
+        right_cols = bcols if self.build_side == "right" else scols
+        return (ColumnarBatch(left_cols + right_cols, n_out,
+                              self.output_schema), build_matched)
+
+    def _emit_build_unmatched(self, build: BuildTable,
+                              build_batch: ColumnarBatch, build_matched):
+        un_idx, n_un = unmatched_indices(build_matched, build.num_rows,
+                                         build.capacity)
+        bcols = _gather_batch(build.payload, un_idx, n_un)
+        stream_schema = self.left_schema if self.build_side == "right" \
+            else self.right_schema
+        null_map = jnp.full((build.capacity,), -1, jnp.int32)
+        stream_child = self.children[0] if self.build_side == "right" \
+            else self.children[1]
+        from ..columnar.batch import empty_batch
+        nulls = empty_batch(stream_schema, capacity=build.capacity)
+        scols = [gather_column(c, null_map) for c in nulls.columns]
+        left_cols = scols if self.build_side == "right" else bcols
+        right_cols = bcols if self.build_side == "right" else scols
+        return ColumnarBatch(left_cols + right_cols, n_un, self.output_schema)
+
+    def _eval_condition(self, stream_batch, build_batch, s_idx, b_row,
+                        cand_cap: int):
+        """Evaluate the residual condition over candidate pairs: build a
+        pair batch of gathered left+right columns in output order."""
+        scols = [gather_column(c, s_idx) for c in stream_batch.columns]
+        bcols = [gather_column(c, b_row) for c in build_batch.columns]
+        left_cols = scols if self.build_side == "right" else bcols
+        right_cols = bcols if self.build_side == "right" else scols
+        lf = list(self.left_schema.fields)
+        rf = list(self.right_schema.fields)
+        pair_schema = Schema(tuple(lf + rf))
+        pair = ColumnarBatch(left_cols + right_cols,
+                             jnp.int32(cand_cap), pair_schema)
+        bound = resolve(self.condition, pair_schema)
+        pred = bound.columnar_eval(pair)
+        return pred.data & pred.validity
+
+    def node_description(self):
+        return (f"HashJoinExec[{self.join_type}, build={self.build_side}, "
+                f"lkeys={self.left_keys!r}, rkeys={self.right_keys!r}]")
+
+
+class NestedLoopJoinExec(TpuExec):
+    """Broadcast nested-loop / cartesian product join (reference
+    GpuBroadcastNestedLoopJoinExecBase, GpuCartesianProductExec): all pairs
+    in chunks, residual condition filters. Supports inner/cross and
+    stream-preserved outer/semi/anti with build == right."""
+
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 join_type: str = CROSS,
+                 condition: Optional[Expression] = None,
+                 chunk_rows: int = 1 << 16):
+        super().__init__(left, right)
+        self.join_type = join_type
+        self.condition = condition
+        self.chunk_rows = chunk_rows
+        assert join_type in (INNER, CROSS, LEFT_OUTER, LEFT_SEMI, LEFT_ANTI,
+                             EXISTENCE)
+
+    @property
+    def output_schema(self) -> Schema:
+        if self.join_type in (LEFT_SEMI, LEFT_ANTI):
+            return self.children[0].output_schema
+        if self.join_type == EXISTENCE:
+            return Schema(tuple(self.children[0].output_schema.fields) +
+                          (StructField("exists", BooleanType(), False),))
+        lf = list(self.children[0].output_schema.fields)
+        rf = [StructField(f.name, f.data_type,
+                          f.nullable or self.join_type == LEFT_OUTER)
+              for f in self.children[1].output_schema.fields]
+        return Schema(tuple(lf + rf))
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        right_batches = list(self.children[1].execute())
+        if right_batches:
+            build = concat_batches(right_batches,
+                                   self.children[1].output_schema)
+        else:
+            from ..columnar.batch import empty_batch
+            build = empty_batch(self.children[1].output_schema)
+        b_rows = build.num_rows_host
+
+        for stream in self.children[0].execute():
+            s_rows = stream.num_rows_host
+            total = s_rows * b_rows
+            jt = self.join_type
+            smatched = jnp.zeros((stream.capacity,), jnp.bool_)
+            start = 0
+            while start < total:
+                chunk = min(self.chunk_rows, total - start)
+                cap = bucket_capacity(max(chunk, 1))
+                # the capacity bucket may exceed the nominal chunk; emit a
+                # full bucket's worth and advance by what was emitted
+                chunk = min(total - start, cap)
+                s_idx, b_idx, n = cross_pairs(
+                    jnp.int32(s_rows), jnp.int32(b_rows), jnp.int32(start), cap)
+                verified = (s_idx >= 0)
+                if self.condition is not None:
+                    verified = verified & self._condition_mask(
+                        stream, build, s_idx, b_idx, cap)
+                if jt in (LEFT_SEMI, LEFT_ANTI, EXISTENCE, LEFT_OUTER):
+                    smatched = smatched | matched_flags(
+                        verified, s_idx, stream.capacity)
+                if jt in (INNER, CROSS, LEFT_OUTER):
+                    s_map, b_map, n_pairs = inner_gather_maps(
+                        verified, s_idx, b_idx, n)
+                    scols = _gather_batch(stream.columns, s_map, n_pairs)
+                    bcols = _gather_batch(build.columns, b_map, n_pairs)
+                    yield ColumnarBatch(scols + bcols, n_pairs,
+                                        self.output_schema)
+                start += chunk
+            # stream-preserved tails
+            if jt == LEFT_OUTER:
+                un_idx, n_un = unmatched_indices(smatched, stream.num_rows,
+                                                 stream.capacity)
+                scols = _gather_batch(stream.columns, un_idx, n_un)
+                null_map = jnp.full((stream.capacity,), -1, jnp.int32)
+                bcols = [gather_column(c, null_map) for c in build.columns]
+                yield ColumnarBatch(scols + bcols, n_un, self.output_schema)
+            elif jt in (LEFT_SEMI, LEFT_ANTI):
+                keep = smatched if jt == LEFT_SEMI else ~smatched
+                perm, n_keep = compaction_order(keep, stream.num_rows)
+                cols = [gather_column(
+                    c, jnp.where(active_mask(n_keep, stream.capacity), perm, -1))
+                    for c in stream.columns]
+                yield ColumnarBatch(cols, n_keep, self.output_schema)
+            elif jt == EXISTENCE:
+                flag = Column(smatched, jnp.ones((stream.capacity,), jnp.bool_),
+                              BooleanType())
+                yield ColumnarBatch(list(stream.columns) + [flag],
+                                    stream.num_rows, self.output_schema)
+
+    def _condition_mask(self, stream, build, s_idx, b_idx, cap: int):
+        scols = [gather_column(c, s_idx) for c in stream.columns]
+        bcols = [gather_column(c, b_idx) for c in build.columns]
+        pair_schema = Schema(tuple(self.children[0].output_schema.fields) +
+                             tuple(self.children[1].output_schema.fields))
+        pair = ColumnarBatch(scols + bcols, jnp.int32(cap), pair_schema)
+        bound = resolve(self.condition, pair_schema)
+        pred = bound.columnar_eval(pair)
+        return pred.data & pred.validity

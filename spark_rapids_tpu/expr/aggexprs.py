@@ -1,0 +1,253 @@
+"""Declarative aggregate functions — the engine's analog of the reference's
+GpuAggregateFunction hierarchy (org/apache/spark/sql/rapids/aggregate/
+aggregateFunctions.scala): each function declares its input expressions,
+update/merge buffer ops (executed by the sort-based group-by kernel,
+ops/aggregate.py) and a final `evaluate` over merged buffers.
+
+Spark semantics:
+  * sum(int*) -> long, sum(float|double) -> double; all-null group -> null
+  * count(x) counts non-null, count(*) counts rows; never null
+  * avg -> double; null when count == 0
+  * min/max ignore nulls; null for all-null groups
+  * stddev/variance via (n, sum, sum_sq) buffers; sample variants NaN at n=1
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+from ..types import (
+    BooleanType, DataType, DecimalType, DoubleType, FloatType, IntegralType,
+    LongType, StringType,
+)
+from .core import Expression
+
+
+class AggregateFunction:
+    """Base: subclasses define inputs, buffer ops and final evaluation."""
+
+    #: expressions evaluated against the input batch (pre-projection)
+    inputs: Tuple[Expression, ...] = ()
+    name = "agg"
+
+    def __init__(self, *inputs: Expression):
+        self.inputs = tuple(inputs)
+
+    @property
+    def child(self) -> Expression:
+        return self.inputs[0]
+
+    # -- contract ----------------------------------------------------------
+    def update_ops(self) -> List[Tuple[str, Optional[int]]]:
+        """[(kernel op, input index or None for count_star)] — one per buffer."""
+        raise NotImplementedError
+
+    def merge_ops(self) -> List[str]:
+        """Kernel op per buffer when re-aggregating partial buffers."""
+        raise NotImplementedError
+
+    def buffer_types(self, input_types: Sequence[DataType]) -> List[DataType]:
+        raise NotImplementedError
+
+    def result_type(self, input_types: Sequence[DataType]) -> DataType:
+        raise NotImplementedError
+
+    def evaluate(self, buffers: List[Column],
+                 input_types: Sequence[DataType]) -> Column:
+        """Final projection from merged buffer columns to the result."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.inputs))})"
+
+
+def _sum_buffer_type(dt: DataType) -> DataType:
+    if isinstance(dt, (DoubleType, FloatType)):
+        return DoubleType()
+    if isinstance(dt, DecimalType):
+        return DecimalType(min(dt.precision + 10, 38), dt.scale)
+    return LongType()
+
+
+class Sum(AggregateFunction):
+    name = "sum"
+
+    def update_ops(self):
+        return [("sum", 0)]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def buffer_types(self, input_types):
+        return [_sum_buffer_type(input_types[0])]
+
+    def result_type(self, input_types):
+        return _sum_buffer_type(input_types[0])
+
+    def evaluate(self, buffers, input_types):
+        return buffers[0]
+
+
+class Count(AggregateFunction):
+    """count(expr); Count() with no input is count(*)."""
+    name = "count"
+
+    def update_ops(self):
+        return [("count", 0) if self.inputs else ("count_star", None)]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def buffer_types(self, input_types):
+        return [LongType()]
+
+    def result_type(self, input_types):
+        return LongType()
+
+    def evaluate(self, buffers, input_types):
+        b = buffers[0]
+        # count is never null: all-null/empty groups are 0
+        data = jnp.where(b.validity, b.data, 0)
+        return Column(data, jnp.ones_like(b.validity) | b.validity, LongType())
+
+
+class Min(AggregateFunction):
+    name = "min"
+
+    def update_ops(self):
+        return [("min", 0)]
+
+    def merge_ops(self):
+        return ["min"]
+
+    def buffer_types(self, input_types):
+        return [input_types[0]]
+
+    def result_type(self, input_types):
+        return input_types[0]
+
+    def evaluate(self, buffers, input_types):
+        return buffers[0]
+
+
+class Max(Min):
+    name = "max"
+
+    def update_ops(self):
+        return [("max", 0)]
+
+    def merge_ops(self):
+        return ["max"]
+
+
+class First(AggregateFunction):
+    """first_value(expr) with ignoreNulls (deterministic only after sort)."""
+    name = "first"
+
+    def update_ops(self):
+        return [("first", 0)]
+
+    def merge_ops(self):
+        return ["first"]
+
+    def buffer_types(self, input_types):
+        return [input_types[0]]
+
+    def result_type(self, input_types):
+        return input_types[0]
+
+    def evaluate(self, buffers, input_types):
+        return buffers[0]
+
+
+class Last(First):
+    name = "last"
+
+    def update_ops(self):
+        return [("last", 0)]
+
+    def merge_ops(self):
+        return ["last"]
+
+
+class Average(AggregateFunction):
+    name = "avg"
+
+    def update_ops(self):
+        return [("sum", 0), ("count", 0)]
+
+    def merge_ops(self):
+        return ["sum", "sum"]
+
+    def buffer_types(self, input_types):
+        return [DoubleType(), LongType()]
+
+    def result_type(self, input_types):
+        return DoubleType()
+
+    def evaluate(self, buffers, input_types):
+        s, c = buffers
+        cnt = jnp.where(c.validity, c.data, 0)
+        ok = (cnt > 0) & s.validity
+        denom = jnp.where(cnt > 0, cnt, 1).astype(jnp.float64)
+        data = s.data.astype(jnp.float64) / denom
+        return Column(jnp.where(ok, data, 0.0), ok, DoubleType())
+
+
+class _CentralMoment(AggregateFunction):
+    """Shared (count, sum, sum_sq) machinery for variance/stddev."""
+
+    sample = True
+    sqrt = False
+
+    def update_ops(self):
+        return [("count", 0), ("sum", 0), ("sum_sq", 0)]
+
+    def merge_ops(self):
+        return ["sum", "sum", "sum"]
+
+    def buffer_types(self, input_types):
+        return [LongType(), DoubleType(), DoubleType()]
+
+    def result_type(self, input_types):
+        return DoubleType()
+
+    def evaluate(self, buffers, input_types):
+        c, s, sq = buffers
+        n = jnp.where(c.validity, c.data, 0).astype(jnp.float64)
+        has = n > 0
+        safe_n = jnp.where(has, n, 1.0)
+        mean = s.data.astype(jnp.float64) / safe_n
+        m2 = sq.data.astype(jnp.float64) - n * mean * mean
+        m2 = jnp.maximum(m2, 0.0)  # clamp catastrophic cancellation
+        if self.sample:
+            denom = n - 1.0
+            var = jnp.where(denom > 0, m2 / jnp.where(denom > 0, denom, 1.0),
+                            jnp.nan)  # n==1 -> NaN (Spark)
+        else:
+            var = m2 / safe_n
+        out = jnp.sqrt(var) if self.sqrt else var
+        return Column(jnp.where(has, out, 0.0), has, DoubleType())
+
+
+class VarianceSamp(_CentralMoment):
+    name = "var_samp"
+    sample = True
+
+
+class VariancePop(_CentralMoment):
+    name = "var_pop"
+    sample = False
+
+
+class StddevSamp(_CentralMoment):
+    name = "stddev_samp"
+    sample, sqrt = True, True
+
+
+class StddevPop(_CentralMoment):
+    name = "stddev_pop"
+    sample, sqrt = False, True

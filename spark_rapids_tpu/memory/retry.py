@@ -47,6 +47,7 @@ class _TaskState(threading.local):
         self.guarded_calls = 0
         self.inject_mode: Optional[str] = None
         self.inject_at = 0
+        self.inject_remaining = 0
         self.injected = False
         self.retry_count = 0
         self.split_retry_count = 0
@@ -68,8 +69,10 @@ def register_task(task_id: int):
         mode, _, n = inj.partition(":")
         _state.inject_mode = mode
         _state.inject_at = int(n or 1)
+        _state.inject_remaining = 1
     else:
         _state.inject_mode = None
+        _state.inject_remaining = 0
 
 
 def unregister_task():
@@ -78,25 +81,28 @@ def unregister_task():
 
 
 def force_retry_oom(num_ooms: int = 1):
-    """Directly arm injection on this thread (test API, reference
-    RmmSpark.forceRetryOOM)."""
+    """Arm injection on this thread for the next `num_ooms` guarded
+    sections (test API, reference RmmSpark.forceRetryOOM)."""
     _state.inject_mode = "retry"
     _state.inject_at = _state.guarded_calls + 1
+    _state.inject_remaining = num_ooms
     _state.injected = False
 
 
-def force_split_and_retry_oom():
+def force_split_and_retry_oom(num_ooms: int = 1):
     _state.inject_mode = "split"
     _state.inject_at = _state.guarded_calls + 1
+    _state.inject_remaining = num_ooms
     _state.injected = False
 
 
 def oom_guard():
     """Called at the top of every guarded device section; applies injection."""
     _state.guarded_calls += 1
-    if (_state.inject_mode and not _state.injected
+    if (_state.inject_mode and _state.inject_remaining > 0
             and _state.guarded_calls >= _state.inject_at):
-        _state.injected = True
+        _state.inject_remaining -= 1
+        _state.injected = _state.inject_remaining <= 0
         if _state.inject_mode == "retry":
             raise TpuRetryOOM("injected retry OOM")
         if _state.inject_mode == "split":
@@ -113,13 +119,21 @@ R = TypeVar("R")
 
 def split_in_half_by_rows(item):
     """Default split policy: halve a (Spillable)ColumnarBatch by rows
-    (reference splitSpillableInHalfByRows)."""
+    (reference splitSpillableInHalfByRows). The halves are registered
+    BEFORE the source's budget is released so the accounting never
+    undercounts live device memory mid-split; with_retry owns (and closes)
+    the returned halves."""
     from .spillable import SpillableBatch
     if isinstance(item, SpillableBatch):
         batch = item.get_batch()
+        try:
+            a, b = _split_batch(batch)
+            halves = [SpillableBatch.from_batch(a),
+                      SpillableBatch.from_batch(b)]
+        finally:
+            item.release()
         item.close()
-        a, b = _split_batch(batch)
-        return [SpillableBatch.from_batch(a), SpillableBatch.from_batch(b)]
+        return halves
     return list(_split_batch(item))
 
 
@@ -149,29 +163,46 @@ def with_retry(input_item: T, fn: Callable[[T], R],
     idempotent; inputs should be spillable while waiting.
     """
     from .budget import spill_for_retry
+    from .spillable import SpillableBatch
     max_attempts = active_conf().retry_max_attempts
     queue: List[T] = [input_item]
-    while queue:
-        item = queue.pop(0)
-        attempts = 0
-        while True:
-            attempts += 1
-            try:
-                oom_guard()
-                yield fn(item)
-                break
-            except TpuRetryOOM:
-                _state.retry_count += 1
-                if attempts >= max_attempts:
-                    raise
-                spill_for_retry()
-            except TpuSplitAndRetryOOM:
-                _state.split_retry_count += 1
-                if split_policy is None:
-                    raise
-                halves = split_policy(item)
-                queue = halves + queue
-                break
+    owned: set = set()  # split products with_retry must close itself
+
+    def _close_owned(item):
+        if id(item) in owned and isinstance(item, SpillableBatch):
+            owned.discard(id(item))
+            item.close()
+
+    try:
+        while queue:
+            item = queue.pop(0)
+            attempts = 0
+            while True:
+                attempts += 1
+                try:
+                    oom_guard()
+                    result = fn(item)
+                    _close_owned(item)
+                    yield result
+                    break
+                except TpuRetryOOM:
+                    _state.retry_count += 1
+                    if attempts >= max_attempts:
+                        raise
+                    spill_for_retry()
+                except TpuSplitAndRetryOOM:
+                    _state.split_retry_count += 1
+                    if split_policy is None:
+                        raise
+                    halves = split_policy(item)
+                    owned.discard(id(item))
+                    owned.update(id(h) for h in halves)
+                    queue = halves + queue
+                    break
+    except BaseException:
+        for item in queue:
+            _close_owned(item)
+        raise
 
 
 def with_retry_no_split(input_item: T, fn: Callable[[T], R]) -> R:

@@ -1,0 +1,210 @@
+"""Group-by aggregation kernels — device core of GpuHashAggregateExec
+(reference GpuAggregateExec.scala:1711 over cuDF groupby).
+
+TPU-first: no device hash table. XLA's native sort is fast and static-shaped,
+so group-by is sort-based end to end: order-key lanes (ops/sort.py) -> stable
+sort -> segment boundaries -> `jax.ops.segment_*` reductions. This is the
+same shape the reference falls back to when hash-merge can't fit
+(buildSortFallbackIterator, GpuAggregateExec.scala:909) — on TPU it is the
+primary path because segment reductions vectorize perfectly and never
+collide. num_groups rides as a device scalar; the output keeps the input
+capacity bucket (num_groups <= num_rows), so merge passes re-run the SAME
+compiled kernel.
+
+Null semantics follow Spark: nulls are excluded from sum/min/max/avg/count
+(sum of an all-null group is null); count(*) counts rows; GROUP BY treats
+nulls as equal.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+from ..types import DataType, DoubleType, LongType
+from .basic import active_mask, gather_column, sanitize
+from .sort import (
+    SortOrder, group_segment_ids, sort_permutation, string_words_for,
+)
+
+#: aggregate op names understood by the kernel
+AGG_OPS = ("sum", "count", "count_star", "min", "max", "first", "last",
+           "any_value", "sum_sq")
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    """One physical aggregate: op over an input ordinal (-1 for count_star)."""
+    op: str
+    ordinal: int = -1
+
+    def __post_init__(self):
+        assert self.op in AGG_OPS, self.op
+
+
+def _segment_reduce(op: str, values, validity, seg, capacity: int, positions):
+    """One aggregate over presorted segments. Returns (data, validity)."""
+    num_segments = capacity
+    valid_i = validity.astype(jnp.int32)
+    counts = jax.ops.segment_sum(valid_i, seg, num_segments=num_segments)
+    has_any = counts > 0
+    if op == "count":
+        return counts.astype(jnp.int64), jnp.ones((capacity,), jnp.bool_)
+    if op == "count_star":
+        ones = jnp.ones_like(seg, jnp.int32)
+        c = jax.ops.segment_sum(ones, seg, num_segments=num_segments)
+        return c.astype(jnp.int64), jnp.ones((capacity,), jnp.bool_)
+    if op in ("sum", "sum_sq"):
+        v = values.astype(jnp.float64) if jnp.issubdtype(values.dtype, jnp.floating) \
+            else values.astype(jnp.int64)
+        if op == "sum_sq":
+            v = v * v
+        v = jnp.where(validity, v, jnp.zeros((), v.dtype))
+        s = jax.ops.segment_sum(v, seg, num_segments=num_segments)
+        return s, has_any
+    if op in ("min", "max"):
+        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            sub = jnp.inf if op == "min" else -jnp.inf
+            neutral = jnp.full((), sub, values.dtype)
+        elif values.dtype == jnp.bool_:
+            values = values.astype(jnp.int8)
+            neutral = jnp.int8(1 if op == "min" else 0)
+        else:
+            info = jnp.iinfo(values.dtype)
+            neutral = jnp.full((), info.max if op == "min" else info.min,
+                               values.dtype)
+        v = jnp.where(validity, values, neutral)
+        r = fn(v, seg, num_segments=num_segments)
+        return r, has_any
+    if op in ("first", "last", "any_value"):
+        # first/any_value: value at the smallest position with a valid row;
+        # last: largest. (Spark first/last default ignoreNulls=False: first
+        # row regardless of null — model that with validity=active.)
+        big = jnp.int32(capacity)
+        if op == "last":
+            p = jnp.where(validity, positions, -1)
+            pick = jax.ops.segment_max(p, seg, num_segments=num_segments)
+        else:
+            p = jnp.where(validity, positions, big)
+            pick = jax.ops.segment_min(p, seg, num_segments=num_segments)
+        ok = (pick >= 0) & (pick < capacity)
+        safe = jnp.clip(pick, 0, capacity - 1)
+        return values[safe], ok & has_any
+    raise AssertionError(op)
+
+
+def groupby_aggregate(key_columns: Sequence[Column],
+                      agg_inputs: Sequence[Tuple[str, Optional[Column]]],
+                      num_rows, capacity: int,
+                      string_words: int,
+                      ) -> Tuple[List[Column], List[Tuple[jnp.ndarray, jnp.ndarray]], jnp.ndarray]:
+    """Sort-based group-by over one batch.
+
+    agg_inputs: list of (op, input Column or None for count_star).
+    Returns (grouped key columns, [(agg data, agg validity)], num_groups).
+    All outputs have the input capacity; rows >= num_groups are inactive.
+    """
+    orders = [SortOrder(i) for i in range(len(key_columns))]
+    perm = sort_permutation(key_columns, orders, num_rows, capacity,
+                            string_words)
+    sorted_keys = [gather_column(c, perm) for c in key_columns]
+    seg, num_groups = group_segment_ids(sorted_keys, num_rows, capacity,
+                                        string_words)
+    act = active_mask(num_rows, capacity)
+    positions = jnp.arange(capacity, dtype=jnp.int32)
+    group_act = active_mask(num_groups, capacity)
+
+    results = []
+    for op, col in agg_inputs:
+        if col is None:
+            data, valid = _segment_reduce("count_star", positions,
+                                          act, seg, capacity, positions)
+        else:
+            g = gather_column(col, perm)
+            if isinstance(g, StringColumn):
+                if op in ("min", "max", "first", "last", "any_value"):
+                    # order strings via their sort lanes; pick the row index
+                    # then gather the string (exact given string_words).
+                    from .sort import string_prefix_lanes
+                    lanes = string_prefix_lanes(g, string_words)
+                    valid = g.validity
+                    pickpos = _pick_string_pos(op, lanes, valid, seg,
+                                               capacity, positions)
+                    ok = (pickpos >= 0) & (pickpos < capacity)
+                    safe = jnp.clip(pickpos, 0, capacity - 1)
+                    out = gather_column(g, safe, out_valid=ok & group_act)
+                    results.append(("col", out))
+                    continue
+                raise NotImplementedError(f"string agg {op}")
+            data, valid = _segment_reduce(op, g.data, g.validity, seg,
+                                          capacity, positions)
+        valid = valid & group_act
+        data = jnp.where(group_act, data, jnp.zeros((), data.dtype))
+        results.append(("raw", (data, valid)))
+
+    # representative key per group: first row of each segment
+    first_pos = jax.ops.segment_min(positions, seg, num_segments=capacity)
+    ok = group_act
+    safe = jnp.clip(first_pos, 0, capacity - 1)
+    out_keys = [gather_column(c, safe, out_valid=c.validity[safe] & ok)
+                for c in sorted_keys]
+    return out_keys, results, num_groups
+
+
+def _pick_string_pos(op, lanes, valid, seg, capacity, positions):
+    """Position of the min/max/first/last string per segment using its
+    uint64 prefix lanes + position as the final tiebreaker."""
+    if op in ("first", "any_value"):
+        p = jnp.where(valid, positions, capacity)
+        return jax.ops.segment_min(p, seg, num_segments=capacity)
+    if op == "last":
+        p = jnp.where(valid, positions, -1)
+        return jax.ops.segment_max(p, seg, num_segments=capacity)
+    # min/max over lexicographic lanes: sort rows by (seg, lanes) and take
+    # the first/last row of each segment — reuse lax.sort for exactness.
+    key_lanes = [seg.astype(jnp.uint32)]
+    for lane in lanes:
+        lane = jnp.where(valid, lane, jnp.zeros((), lane.dtype))
+        if op == "max":
+            lane = ~lane
+        # invalid rows must lose: push them after all valid rows
+        key_lanes.append(lane)
+    # nulls excluded: make invalid rows sort last inside the segment
+    key_lanes.insert(1, (~valid).astype(jnp.uint32))
+    out = jax.lax.sort(tuple(key_lanes) + (positions,),
+                       num_keys=len(key_lanes))
+    sorted_pos = out[-1]
+    sorted_seg = seg[sorted_pos]
+    # index (in this ordering) of each segment's first VALID row, then map
+    # back to the original row position; capacity => "no valid row".
+    first_idx = jax.ops.segment_min(
+        jnp.where(valid[sorted_pos],
+                  jnp.arange(capacity, dtype=jnp.int32),
+                  jnp.int32(capacity)),
+        sorted_seg, num_segments=capacity)
+    ok = first_idx < capacity
+    safe = jnp.clip(first_idx, 0, capacity - 1)
+    return jnp.where(ok, sorted_pos[safe], jnp.int32(capacity))
+
+
+def reduce_no_keys(agg_inputs: Sequence[Tuple[str, Optional[Column]]],
+                   num_rows, capacity: int):
+    """Grand aggregate (no GROUP BY): one output row, still static shapes."""
+    act = active_mask(num_rows, capacity)
+    seg = jnp.where(act, 0, capacity)
+    positions = jnp.arange(capacity, dtype=jnp.int32)
+    out = []
+    for op, col in agg_inputs:
+        if col is None:
+            data, valid = _segment_reduce("count_star", positions, act, seg,
+                                          capacity, positions)
+        else:
+            data, valid = _segment_reduce(op, col.data, col.validity & act,
+                                          seg, capacity, positions)
+        out.append((data, valid))
+    return out

@@ -1,0 +1,186 @@
+"""Equi-join gather-map kernels — device core of GpuHashJoin / JoinGatherer
+(reference org/apache/spark/sql/rapids/execution/GpuHashJoin.scala:994,
+JoinGatherer.scala).
+
+TPU-first: no device hash table with collision chains. The build side is
+sorted by a 64-bit key hash (xxhash64, already Spark-exact in ops/hashing);
+each stream row finds its hash-equal candidate range with two searchsorteds;
+candidates expand into (stream, build) index pairs; a vectorized *verify*
+pass compares the real key columns (so hash collisions cost a false
+candidate, never a wrong row); compaction drops mismatches. All steps are
+static-shape XLA; the only host sync is choosing the candidate-capacity
+bucket from the total match count — the analog of the reference sizing its
+gather maps from cuDF's join row count.
+
+Join-type semantics (Spark):
+  * equi-keys never match null keys (IS NOT DISTINCT FROM is handled by the
+    planner rewriting to a null-safe wrapper before reaching here);
+  * left outer emits unmatched stream rows with build side null (build_idx
+    == -1 -> gather_column yields invalid rows);
+  * semi/anti/existence reduce to the per-stream-row matched flag.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn, bucket_capacity
+from .basic import active_mask, compaction_order, gather_column
+from .hashing import xxhash64_batch
+from .strings import string_equal
+
+JOIN_HASH_SEED = 0x5370_6172  # arbitrary fixed seed, 'Spar'
+
+
+def _keys_valid(key_cols: Sequence[Column], num_rows, capacity: int):
+    v = active_mask(num_rows, capacity)
+    for c in key_cols:
+        v = v & c.validity
+    return v
+
+
+class BuildTable:
+    """Hash-sorted build side: the TPU analog of the cuDF hash table the
+    reference builds once and probes per stream batch."""
+
+    def __init__(self, key_cols: Sequence[Column], payload: Sequence[Column],
+                 num_rows, capacity: int):
+        self.capacity = capacity
+        self.num_rows = num_rows
+        valid = _keys_valid(key_cols, num_rows, capacity)
+        h = xxhash64_batch(list(key_cols), seed=JOIN_HASH_SEED)
+        # invalid/inactive rows: push to the end with the max hash AND keep
+        # them out of every candidate range via the valid-count boundary.
+        big = jnp.uint64(0xFFFF_FFFF_FFFF_FFFF)
+        h_u = jax.lax.bitcast_convert_type(h, jnp.uint64)
+        sort_h = jnp.where(valid, h_u, big)
+        iota = jnp.arange(capacity, dtype=jnp.int32)
+        sorted_h, sorted_valid, perm = jax.lax.sort(
+            (sort_h, (~valid).astype(jnp.int8), iota), num_keys=2)
+        self.sorted_hash = sorted_h
+        self.perm = perm  # sorted position -> original build row
+        self.valid_count = jnp.sum(valid, dtype=jnp.int32)
+        self.key_cols = list(key_cols)
+        self.payload = list(payload)
+
+
+def probe_counts(build: BuildTable, stream_keys: Sequence[Column],
+                 stream_rows, stream_cap: int):
+    """Per-stream-row candidate range (lo, hi) in the sorted build table."""
+    valid = _keys_valid(stream_keys, stream_rows, stream_cap)
+    h = xxhash64_batch(list(stream_keys), seed=JOIN_HASH_SEED)
+    h_u = jax.lax.bitcast_convert_type(h, jnp.uint64)
+    lo = jnp.searchsorted(build.sorted_hash, h_u, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(build.sorted_hash, h_u, side="right").astype(jnp.int32)
+    hi = jnp.minimum(hi, build.valid_count)
+    lo = jnp.minimum(lo, hi)
+    counts = jnp.where(valid, hi - lo, 0)
+    return lo, counts, valid
+
+
+def expand_candidates(lo, counts, out_capacity: int):
+    """Flatten candidate ranges into (stream_idx, build_pos) pairs.
+
+    out_capacity >= total candidates (host-chosen bucket). Pair i belongs to
+    the stream row whose cumulative count interval contains i.
+    """
+    cum = jnp.cumsum(counts)  # inclusive
+    total = cum[-1] if counts.shape[0] else jnp.int32(0)
+    i = jnp.arange(out_capacity, dtype=jnp.int32)
+    stream_idx = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+    in_range = i < total
+    safe_stream = jnp.clip(stream_idx, 0, counts.shape[0] - 1)
+    before = cum[safe_stream] - counts[safe_stream]
+    build_pos = lo[safe_stream] + (i - before)
+    return jnp.where(in_range, safe_stream, -1), build_pos, total
+
+
+def verify_pairs(build: BuildTable, stream_keys: Sequence[Column],
+                 stream_idx, build_pos, pair_valid):
+    """Exact key equality per candidate pair (null-safe: nulls never match,
+    but null STREAM rows never produce candidates, so only hash collisions
+    are filtered here)."""
+    build_row = gather_column_indices(build.perm, build_pos)
+    ok = pair_valid
+    for bk, sk in zip(build.key_cols, stream_keys):
+        b = gather_column(bk, build_row)
+        s = gather_column(sk, stream_idx)
+        if isinstance(bk, StringColumn):
+            eq = string_equal(b, s)
+            ok = ok & eq.data & eq.validity
+        else:
+            ok = ok & (b.data == s.data) & b.validity & s.validity
+    return ok, build_row
+
+
+def gather_column_indices(arr, idx):
+    safe = jnp.clip(idx, 0, arr.shape[0] - 1)
+    return jnp.where((idx >= 0) & (idx < arr.shape[0]), arr[safe], -1)
+
+
+def inner_gather_maps(verified, stream_idx, build_row, total):
+    """Compact verified pairs to the front: (stream_map, build_map, rows)."""
+    cap = verified.shape[0]
+    perm, n = compaction_order(verified, total)
+    s = jnp.where(active_mask(n, cap), stream_idx[perm], -1)
+    b = jnp.where(active_mask(n, cap), build_row[perm], -1)
+    return s, b, n
+
+
+def matched_flags(verified, idx, capacity: int):
+    """Per-row matched flag via scatter-or (idx may repeat)."""
+    flags = jnp.zeros((capacity,), jnp.int32)
+    safe = jnp.clip(idx, 0, capacity - 1)
+    contrib = (verified & (idx >= 0)).astype(jnp.int32)
+    return flags.at[safe].max(contrib) > 0
+
+
+def outer_extend_maps(s_map, b_map, n_pairs, unmatched_idx, n_unmatched,
+                      null_on: str, out_capacity: int):
+    """Append unmatched rows (other side -1 => null) after the matched pairs.
+
+    null_on: which side of the appended rows is null ('build' for left outer,
+    'stream' for right outer).
+    """
+    i = jnp.arange(out_capacity, dtype=jnp.int32)
+    total = n_pairs + n_unmatched
+    from_un = (i >= n_pairs) & (i < total)
+    un_i = jnp.clip(i - n_pairs, 0, unmatched_idx.shape[0] - 1)
+    pair_i = jnp.clip(i, 0, s_map.shape[0] - 1)
+    if null_on == "build":
+        s = jnp.where(from_un, unmatched_idx[un_i], jnp.where(i < n_pairs, s_map[pair_i], -1))
+        b = jnp.where(from_un, -1, jnp.where(i < n_pairs, b_map[pair_i], -1))
+    else:
+        s = jnp.where(from_un, -1, jnp.where(i < n_pairs, s_map[pair_i], -1))
+        b = jnp.where(from_un, unmatched_idx[un_i], jnp.where(i < n_pairs, b_map[pair_i], -1))
+    return s, b, total
+
+
+def unmatched_indices(matched, num_rows, capacity: int):
+    """Indices of active rows whose matched flag is False, compacted."""
+    act = active_mask(num_rows, capacity)
+    keep = act & (~matched)
+    perm, n = compaction_order(keep, num_rows)
+    idx = jnp.where(active_mask(n, capacity), perm, -1)
+    return idx, n
+
+
+def cross_pairs(stream_rows, build_rows, chunk_start, out_capacity: int):
+    """Nested-loop candidates: all (stream, build) pairs with flat pair index
+    in [chunk_start, chunk_start+out_capacity). The exec layer loops chunks
+    (reference GpuBroadcastNestedLoopJoinExecBase / GpuCartesianProductExec).
+
+    Pair indices are int64: stream_rows*build_rows overflows int32 well
+    inside practical cartesian-product sizes."""
+    i = jnp.arange(out_capacity, dtype=jnp.int64) + jnp.int64(chunk_start)
+    total = jnp.int64(stream_rows) * jnp.int64(build_rows)
+    ok = i < total
+    safe_build = jnp.maximum(jnp.int64(build_rows), 1)
+    s = jnp.where(ok, i // safe_build, -1).astype(jnp.int32)
+    b = jnp.where(ok, i % safe_build, -1).astype(jnp.int32)
+    remaining = jnp.maximum(total - jnp.int64(chunk_start), 0)
+    n = jnp.minimum(remaining, out_capacity).astype(jnp.int32)
+    return s, b, n

@@ -1,0 +1,189 @@
+"""Join exec tests against a python oracle covering all join types, null
+keys, duplicates, hash-collision safety and residual conditions."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.basic import InMemoryScanExec
+from spark_rapids_tpu.exec.joins import (
+    HashJoinExec, NestedLoopJoinExec,
+)
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.types import INT, LONG, STRING, Schema, StructField
+
+L_SCHEMA = Schema((StructField("lk", INT), StructField("lv", STRING)))
+R_SCHEMA = Schema((StructField("rk", INT), StructField("rv", STRING)))
+
+L_DATA = {"lk": [1, 2, 2, None, 5, 7], "lv": ["a", "b", "c", "d", "e", "f"]}
+R_DATA = {"rk": [2, 2, 3, None, 5, 5], "rv": ["x", "y", "z", "n", "p", "q"]}
+
+
+def scan(data, schema, split=0):
+    n = len(next(iter(data.values())))
+    if split:
+        batches = [ColumnarBatch.from_pydict(
+            {k: v[s:s + split] for k, v in data.items()}, schema)
+            for s in range(0, n, split)]
+    else:
+        batches = [ColumnarBatch.from_pydict(data, schema)]
+    return InMemoryScanExec(batches, schema)
+
+
+def oracle_join(join_type):
+    lrows = list(zip(L_DATA["lk"], L_DATA["lv"]))
+    rrows = list(zip(R_DATA["rk"], R_DATA["rv"]))
+    out = []
+    matched_r = set()
+    for lk, lv in lrows:
+        matches = [(rk, rv) for rk, rv in rrows
+                   if lk is not None and rk == lk]
+        for i, (rk, rv) in enumerate(rrows):
+            if lk is not None and rk == lk:
+                matched_r.add(i)
+        if matches:
+            if join_type in ("inner", "left_outer", "full_outer"):
+                out.extend([(lk, lv, rk, rv) for rk, rv in matches])
+            elif join_type == "left_semi":
+                out.append((lk, lv))
+        else:
+            if join_type in ("left_outer", "full_outer"):
+                out.append((lk, lv, None, None))
+            elif join_type == "left_anti":
+                out.append((lk, lv))
+    if join_type in ("right_outer", "full_outer"):
+        for i, (rk, rv) in enumerate(rrows):
+            if i not in matched_r:
+                out.append((None, None, rk, rv))
+    if join_type == "right_outer":
+        inner = oracle_join("inner")
+        out = inner + out
+    return out
+
+
+@pytest.mark.parametrize("split", [0, 2])
+@pytest.mark.parametrize("jt", ["inner", "left_outer", "right_outer",
+                                "full_outer", "left_semi", "left_anti"])
+def test_hash_join_types(jt, split):
+    plan = HashJoinExec(scan(L_DATA, L_SCHEMA, split),
+                        scan(R_DATA, R_SCHEMA),
+                        [col("lk")], [col("rk")], join_type=jt)
+    got = sorted(plan.collect(), key=repr)
+    want = sorted(oracle_join(jt), key=repr)
+    assert got == want, f"{jt}: {got} != {want}"
+
+
+def test_hash_join_build_left():
+    plan = HashJoinExec(scan(L_DATA, L_SCHEMA), scan(R_DATA, R_SCHEMA),
+                        [col("lk")], [col("rk")], join_type="inner",
+                        build_side="left")
+    got = sorted(plan.collect(), key=repr)
+    assert got == sorted(oracle_join("inner"), key=repr)
+
+
+def test_left_outer_build_left():
+    plan = HashJoinExec(scan(L_DATA, L_SCHEMA), scan(R_DATA, R_SCHEMA),
+                        [col("lk")], [col("rk")], join_type="left_outer",
+                        build_side="left")
+    got = sorted(plan.collect(), key=repr)
+    assert got == sorted(oracle_join("left_outer"), key=repr)
+
+
+def test_join_with_condition():
+    # inner join with residual: rv > lv is replaced by int condition
+    ldata = {"lk": [1, 1, 2], "lv": ["a", "b", "c"]}
+    rdata = {"rk": [1, 1, 2], "rv": ["p", "q", "r"]}
+    plan = HashJoinExec(
+        scan(ldata, L_SCHEMA), scan(rdata, R_SCHEMA),
+        [col("lk")], [col("rk")], join_type="inner",
+        condition=(col("lv") == lit("a")))
+    got = sorted(plan.collect(), key=repr)
+    assert got == [(1, "a", 1, "p"), (1, "a", 1, "q")]
+
+
+def test_left_outer_condition_unmatched():
+    ldata = {"lk": [1, 2], "lv": ["a", "b"]}
+    rdata = {"rk": [1, 2], "rv": ["p", "q"]}
+    plan = HashJoinExec(
+        scan(ldata, L_SCHEMA), scan(rdata, R_SCHEMA),
+        [col("lk")], [col("rk")], join_type="left_outer",
+        condition=(col("lv") == lit("a")))
+    got = sorted(plan.collect(), key=repr)
+    assert got == [(1, "a", 1, "p"), (2, "b", None, None)]
+
+
+def test_string_keys_join():
+    lschema = Schema((StructField("lk", STRING), StructField("lv", INT)))
+    rschema = Schema((StructField("rk", STRING), StructField("rv", INT)))
+    ldata = {"lk": ["aa", "bb", None, "cc"], "lv": [1, 2, 3, 4]}
+    rdata = {"rk": ["bb", "cc", "cc", None], "rv": [10, 20, 30, 40]}
+    plan = HashJoinExec(scan(ldata, lschema), scan(rdata, rschema),
+                        [col("lk")], [col("rk")], join_type="inner")
+    got = sorted(plan.collect())
+    assert got == [("bb", 2, "bb", 10), ("cc", 4, "cc", 20),
+                   ("cc", 4, "cc", 30)]
+
+
+def test_multi_key_join():
+    lschema = Schema((StructField("k1", INT), StructField("k2", STRING),
+                      StructField("lv", INT)))
+    rschema = Schema((StructField("j1", INT), StructField("j2", STRING),
+                      StructField("rv", INT)))
+    ldata = {"k1": [1, 1, 2], "k2": ["a", "b", "a"], "lv": [1, 2, 3]}
+    rdata = {"j1": [1, 1, 2], "j2": ["a", "a", "b"], "rv": [10, 20, 30]}
+    plan = HashJoinExec(scan(ldata, lschema), scan(rdata, rschema),
+                        [col("k1"), col("k2")], [col("j1"), col("j2")],
+                        join_type="inner")
+    got = sorted(plan.collect())
+    assert got == [(1, "a", 1, 1, "a", 10), (1, "a", 1, 1, "a", 20)]
+
+
+def test_existence_join():
+    plan = HashJoinExec(scan(L_DATA, L_SCHEMA), scan(R_DATA, R_SCHEMA),
+                        [col("lk")], [col("rk")], join_type="existence")
+    got = {r[0:2]: r[2] for r in plan.collect()}
+    assert got[(2, "b")] is True
+    assert got[(1, "a")] is False
+    assert got[(None, "d")] is False
+    assert got[(5, "e")] is True
+
+
+def test_empty_build_side():
+    empty = InMemoryScanExec([], R_SCHEMA)
+    plan = HashJoinExec(scan(L_DATA, L_SCHEMA), empty,
+                        [col("lk")], [col("rk")], join_type="left_outer")
+    got = sorted(plan.collect(), key=repr)
+    assert len(got) == 6
+    assert all(r[2] is None and r[3] is None for r in got)
+
+
+def test_cross_join():
+    plan = NestedLoopJoinExec(scan(L_DATA, L_SCHEMA), scan(R_DATA, R_SCHEMA),
+                              join_type="cross", chunk_rows=8)
+    assert len(plan.collect()) == 36
+
+
+def test_nested_loop_inner_condition():
+    plan = NestedLoopJoinExec(
+        scan(L_DATA, L_SCHEMA), scan(R_DATA, R_SCHEMA),
+        join_type="inner",
+        condition=(col("lk") > col("rk")), chunk_rows=8)
+    got = plan.collect()
+    want = [(lk, lv, rk, rv)
+            for lk, lv in zip(L_DATA["lk"], L_DATA["lv"])
+            for rk, rv in zip(R_DATA["rk"], R_DATA["rv"])
+            if lk is not None and rk is not None and lk > rk]
+    assert sorted(got) == sorted(want)
+
+
+def test_nested_loop_left_outer():
+    plan = NestedLoopJoinExec(
+        scan(L_DATA, L_SCHEMA), scan(R_DATA, R_SCHEMA),
+        join_type="left_outer",
+        condition=(col("lk") > col("rk")), chunk_rows=4)
+    got = plan.collect()
+    matched = {lk for lk, _ in zip(L_DATA["lk"], L_DATA["lv"])
+               if lk is not None and any(rk is not None and lk > rk
+                                         for rk in R_DATA["rk"])}
+    unmatched_rows = [r for r in got if r[2] is None and r[3] is None]
+    assert {r[0] for r in unmatched_rows} == {1, 2, None}
