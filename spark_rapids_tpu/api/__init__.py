@@ -1,0 +1,5 @@
+"""User-facing API: session + DataFrame over the logical planner (the
+engine's equivalent of the PySpark surface the reference accelerates)."""
+
+from .session import TpuSession  # noqa: F401
+from . import functions  # noqa: F401

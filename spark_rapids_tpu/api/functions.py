@@ -1,0 +1,107 @@
+"""Column functions — the pyspark.sql.functions analog, resolving to the
+engine's expression and aggregate classes."""
+
+from __future__ import annotations
+
+from ..expr import arithmetic, conditional, hashexprs, stringexprs
+from ..expr.aggexprs import (
+    Average, Count, First, Last, Max, Min, StddevPop, StddevSamp, Sum,
+    VariancePop, VarianceSamp,
+)
+from ..expr.core import Expression, col, lit  # noqa: F401
+
+
+def _e(x) -> Expression:
+    return x if isinstance(x, Expression) else (col(x) if isinstance(x, str)
+                                                else lit(x))
+
+
+# aggregates ---------------------------------------------------------------
+def sum(x):  # noqa: A001
+    return Sum(_e(x))
+
+
+def count(x=None):
+    return Count(_e(x)) if x is not None else Count()
+
+
+def avg(x):
+    return Average(_e(x))
+
+
+mean = avg
+
+
+def min(x):  # noqa: A001
+    return Min(_e(x))
+
+
+def max(x):  # noqa: A001
+    return Max(_e(x))
+
+
+def first(x):
+    return First(_e(x))
+
+
+def last(x):
+    return Last(_e(x))
+
+
+def stddev(x):
+    return StddevSamp(_e(x))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(x):
+    return StddevPop(_e(x))
+
+
+def variance(x):
+    return VarianceSamp(_e(x))
+
+
+var_samp = variance
+
+
+def var_pop(x):
+    return VariancePop(_e(x))
+
+
+# scalar functions ---------------------------------------------------------
+def coalesce(*xs):
+    return conditional.Coalesce(*[_e(x) for x in xs])
+
+
+def when(cond, value):
+    return conditional.CaseWhen([( _e(cond), _e(value))], None)
+
+
+def abs(x):  # noqa: A001
+    return arithmetic.Abs(_e(x))
+
+
+def length(x):
+    return stringexprs.Length(_e(x))
+
+
+def upper(x):
+    return stringexprs.Upper(_e(x))
+
+
+def lower(x):
+    return stringexprs.Lower(_e(x))
+
+
+def substring(x, pos, length_):
+    return stringexprs.Substring(_e(x), pos, length_)
+
+
+def hash(*xs):  # noqa: A001
+    return hashexprs.Murmur3Hash(*[_e(x) for x in xs])
+
+
+def xxhash64(*xs):
+    return hashexprs.XxHash64(*[_e(x) for x in xs])

@@ -1,0 +1,224 @@
+"""TpuSession + DataFrame — the engine's user surface. The reference keeps
+PySpark's API and swaps the physical plan underneath (SQLPlugin +
+GpuOverrides); standalone, this session IS the query entry, but the flow
+is identical: build a logical plan, run it through TpuOverrides
+(wrap -> tag -> convert), execute the TpuExec tree."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..columnar.batch import ColumnarBatch
+from ..config import RapidsConf, set_active_conf
+from ..expr.aggexprs import AggregateFunction
+from ..expr.core import Expression, col, lit, output_name
+from ..plan import logical as L
+from ..plan.overrides import TpuOverrides
+from ..types import Schema
+
+
+class _InMemorySource:
+    def __init__(self, batches: List[ColumnarBatch], schema: Schema):
+        self._batches = batches
+        self.schema = schema
+
+    def batches(self):
+        return list(self._batches)
+
+
+class TpuSession:
+    def __init__(self, conf: Optional[Dict] = None):
+        self.conf = RapidsConf(conf or {})
+        set_active_conf(self.conf)
+
+    # -- ingestion ---------------------------------------------------------
+    def from_pydict(self, data: Dict, schema: Schema,
+                    batch_rows: Optional[int] = None) -> "DataFrame":
+        n = len(next(iter(data.values()))) if data else 0
+        rows = batch_rows or max(n, 1)
+        batches = []
+        for s in range(0, max(n, 1), rows):
+            chunk = {k: v[s:s + rows] for k, v in data.items()}
+            batches.append(ColumnarBatch.from_pydict(chunk, schema))
+        return self._df(L.LogicalScan(_InMemorySource(batches, schema)))
+
+    def from_arrow(self, table) -> "DataFrame":
+        batch = ColumnarBatch.from_arrow(table)
+        return self._df(L.LogicalScan(
+            _InMemorySource([batch], batch.schema)))
+
+    def from_batches(self, batches: Sequence[ColumnarBatch],
+                     schema: Schema) -> "DataFrame":
+        return self._df(L.LogicalScan(_InMemorySource(list(batches), schema)))
+
+    def range(self, start: int, end: Optional[int] = None,
+              step: int = 1) -> "DataFrame":
+        if end is None:
+            start, end = 0, start
+        return self._df(L.LogicalRange(start, end, step))
+
+    def read_parquet(self, path) -> "DataFrame":
+        from ..io.parquet import ParquetSource
+        return self._df(L.LogicalScan(ParquetSource(path, self.conf)))
+
+    def read_csv(self, path, schema: Optional[Schema] = None,
+                 header: bool = True) -> "DataFrame":
+        from ..io.csv import CsvSource
+        return self._df(L.LogicalScan(CsvSource(path, self.conf,
+                                                schema=schema,
+                                                header=header)))
+
+    def read_json(self, path, schema: Optional[Schema] = None) -> "DataFrame":
+        from ..io.json import JsonSource
+        return self._df(L.LogicalScan(JsonSource(path, self.conf,
+                                                 schema=schema)))
+
+    def _df(self, plan: L.LogicalPlan) -> "DataFrame":
+        return DataFrame(plan, self)
+
+
+def _to_expr(x) -> Expression:
+    if isinstance(x, Expression):
+        return x
+    if isinstance(x, str):
+        return col(x)
+    return lit(x)
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan, session: TpuSession):
+        self._plan = plan
+        self.session = session
+
+    @property
+    def schema(self) -> Schema:
+        return self._plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self.schema.names)
+
+    # -- transformations ---------------------------------------------------
+    def select(self, *exprs) -> "DataFrame":
+        return self._with(L.LogicalProject([_to_expr(e) for e in exprs],
+                                           self._plan))
+
+    def with_column(self, name: str, expr) -> "DataFrame":
+        exprs = [col(n) for n in self.columns if n != name]
+        exprs.append(_to_expr(expr).alias(name))
+        return self._with(L.LogicalProject(exprs, self._plan))
+
+    def filter(self, condition) -> "DataFrame":
+        return self._with(L.LogicalFilter(_to_expr(condition), self._plan))
+
+    where = filter
+
+    def group_by(self, *keys) -> "GroupedData":
+        return GroupedData([_to_expr(k) for k in keys], self)
+
+    groupBy = group_by
+
+    def agg(self, *aggs: Tuple[AggregateFunction, str]) -> "DataFrame":
+        return GroupedData([], self).agg(*aggs)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             left_on=None, right_on=None, condition=None) -> "DataFrame":
+        if on is not None:
+            names = [on] if isinstance(on, str) else list(on)
+            lkeys = [col(n) for n in names]
+            rkeys = [col(n) for n in names]
+        elif left_on is not None:
+            lk = [left_on] if not isinstance(left_on, (list, tuple)) else left_on
+            rk = [right_on] if not isinstance(right_on, (list, tuple)) else right_on
+            lkeys = [_to_expr(k) for k in lk]
+            rkeys = [_to_expr(k) for k in rk]
+        else:
+            lkeys, rkeys = [], []
+        return self._with(L.LogicalJoin(self._plan, other._plan, lkeys,
+                                        rkeys, how, condition))
+
+    def sort(self, *orders) -> "DataFrame":
+        norm = []
+        for o in orders:
+            if isinstance(o, tuple):
+                e = _to_expr(o[0])
+                norm.append((e,) + tuple(o[1:]))
+            else:
+                norm.append((_to_expr(o), True))
+        return self._with(L.LogicalSort(norm, self._plan))
+
+    order_by = sort
+    orderBy = sort
+
+    def limit(self, n: int, offset: int = 0) -> "DataFrame":
+        if isinstance(self._plan, L.LogicalSort) and self._plan.limit is None:
+            # sort+limit collapses to TopN (reference GpuTopN, limit.scala:351)
+            return self._with(L.LogicalSort(self._plan.orders,
+                                            self._plan.children[0],
+                                            limit=n, offset=offset))
+        return self._with(L.LogicalLimit(n, self._plan, offset))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return self._with(L.LogicalUnion(self._plan, other._plan))
+
+    def distinct(self) -> "DataFrame":
+        return self._with(L.LogicalAggregate(
+            [col(n) for n in self.columns], [], self._plan))
+
+    # -- actions -----------------------------------------------------------
+    def _exec(self):
+        return TpuOverrides(self.session.conf).apply(self._plan)
+
+    def collect(self) -> List[tuple]:
+        return self._exec().collect()
+
+    def to_arrow(self):
+        import pyarrow as pa
+        tables = [b.to_arrow() for b in self._exec().execute()]
+        if not tables:
+            from ..types import to_arrow as t2a
+            return pa.table({f.name: pa.array([], t2a(f.data_type))
+                             for f in self.schema.fields})
+        return pa.concat_tables(tables)
+
+    def to_pydict(self) -> Dict:
+        t = self.to_arrow()
+        return {name: t.column(name).to_pylist() for name in t.column_names}
+
+    def count(self) -> int:
+        from ..expr.aggexprs import Count
+        rows = self._with(L.LogicalAggregate([], [(Count(), "count")],
+                                             self._plan)).collect()
+        return rows[0][0]
+
+    def explain(self) -> str:
+        return TpuOverrides(self.session.conf).explain(self._plan)
+
+    def logical_plan(self) -> L.LogicalPlan:
+        return self._plan
+
+    def write_parquet(self, path, partition_by: Optional[Sequence[str]] = None):
+        from ..io.parquet import write_parquet
+        write_parquet(self, path, partition_by=partition_by)
+
+    def _with(self, plan: L.LogicalPlan) -> "DataFrame":
+        return DataFrame(plan, self.session)
+
+
+class GroupedData:
+    def __init__(self, keys: List[Expression], df: DataFrame):
+        self.keys = keys
+        self.df = df
+
+    def agg(self, *aggs) -> DataFrame:
+        named: List[Tuple[AggregateFunction, str]] = []
+        for i, a in enumerate(aggs):
+            if isinstance(a, tuple):
+                named.append(a)
+            else:
+                assert isinstance(a, AggregateFunction), a
+                default = f"{a.name}({', '.join(map(repr, a.inputs))})" \
+                    if a.inputs else f"{a.name}(*)"
+                named.append((a, default))
+        return self.df._with(L.LogicalAggregate(self.keys, named,
+                                                self.df._plan))

@@ -167,6 +167,14 @@ class StringColumn(Column):
         validity = jnp.pad(self.validity, [(0, extra)])
         return StringColumn(self.data, offsets, validity, self.dtype)
 
+    def with_byte_capacity(self, byte_capacity: int) -> "StringColumn":
+        """Grow (never shrink) the byte-buffer bucket."""
+        if byte_capacity == self.byte_capacity:
+            return self
+        assert byte_capacity > self.byte_capacity
+        data = jnp.pad(self.data, [(0, byte_capacity - self.byte_capacity)])
+        return StringColumn(data, self.offsets, self.validity, self.dtype)
+
     def to_pylist(self, num_rows: int) -> List[Optional[str]]:
         data = np.asarray(self.data)
         offsets = np.asarray(self.offsets)

@@ -190,10 +190,18 @@ class RapidsConf:
     """Immutable snapshot of settings; construct from a dict of
     spark-style key->string/typed values."""
 
+    #: dynamic per-operator keys (reference registers one conf per rule:
+    #: spark.rapids.sql.exec.<Exec> / .expression.<Expr> etc.)
+    _DYNAMIC_PREFIXES = ("spark.rapids.sql.exec.",
+                         "spark.rapids.sql.expression.",
+                         "spark.rapids.sql.input.",
+                         "spark.rapids.sql.format.")
+
     def __init__(self, settings: Optional[Dict[str, Any]] = None):
         self._settings = dict(settings or {})
         for k in self._settings:
-            if k.startswith("spark.rapids.") and k not in _REGISTRY:
+            if (k.startswith("spark.rapids.") and k not in _REGISTRY
+                    and not k.startswith(self._DYNAMIC_PREFIXES)):
                 raise KeyError(f"unknown config {k!r}; see docs/configs.md")
 
     def get(self, entry: ConfEntry):

@@ -41,6 +41,17 @@ from .basic import bind_projection, eval_projection
 from .coalesce import concat_batches
 
 
+@partial(jax.jit, static_argnums=(1,))
+def _shrink_batch(batch: ColumnarBatch, cap: int) -> ColumnarBatch:
+    """Move the active prefix into a smaller capacity bucket: aggregated
+    partials carry few groups in huge input-sized buckets; merging at input
+    size would sort mostly-padding (the dominant waste in a groupby)."""
+    from ..ops.basic import slice_rows
+    cols = [slice_rows(c, jnp.int32(0), batch.num_rows, cap)
+            for c in batch.columns]
+    return ColumnarBatch(cols, batch.num_rows, batch.schema)
+
+
 class AggregateExec(TpuExec):
     def __init__(self, group_exprs: Sequence[Expression],
                  aggregates: Sequence[Tuple[AggregateFunction, str]],
@@ -51,6 +62,11 @@ class AggregateExec(TpuExec):
         self.group_exprs = list(group_exprs)
         self.aggregates = list(aggregates)
         in_schema = child.output_schema
+
+        # compiled kernels (cache keyed by capacity bucket + string words)
+        self._jit_update = jax.jit(self._update_batch, static_argnums=(1,))
+        self._jit_merge = jax.jit(self._merge_batch, static_argnums=(1,))
+        self._jit_pre = jax.jit(self._pre_project)
 
         if mode == "final":
             # input is keys+buffers produced by a partial instance
@@ -106,7 +122,11 @@ class AggregateExec(TpuExec):
         return (AGG_TIME, CONCAT_TIME, NUM_INPUT_ROWS, NUM_INPUT_BATCHES)
 
     # -- kernels -----------------------------------------------------------
-    def _update_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+    def _pre_project(self, batch: ColumnarBatch) -> ColumnarBatch:
+        return eval_projection(self._pre_bound, batch, self._pre_schema)
+
+    def _update_batch(self, batch: ColumnarBatch, words: int = 4
+                      ) -> ColumnarBatch:
         """First-pass aggregation of one pre-projected batch."""
         keys = list(batch.columns[: self._key_count])
         agg_inputs = []
@@ -116,9 +136,10 @@ class AggregateExec(TpuExec):
                     if slot is not None else None
                 agg_inputs.append((op, col))
         return self._run_groupby(keys, agg_inputs, batch,
-                                 self._buffer_schema)
+                                 self._buffer_schema, words)
 
-    def _merge_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+    def _merge_batch(self, batch: ColumnarBatch, words: int = 4
+                     ) -> ColumnarBatch:
         """Re-aggregate a keys+buffers batch with merge ops."""
         keys = list(batch.columns[: self._key_count])
         agg_inputs = []
@@ -128,9 +149,9 @@ class AggregateExec(TpuExec):
                 agg_inputs.append((op, batch.columns[pos]))
                 pos += 1
         return self._run_groupby(keys, agg_inputs, batch,
-                                 self._buffer_schema)
+                                 self._buffer_schema, words)
 
-    def _run_groupby(self, keys, agg_inputs, batch, out_schema
+    def _run_groupby(self, keys, agg_inputs, batch, out_schema, words: int
                      ) -> ColumnarBatch:
         cap = batch.capacity
         if not keys:
@@ -146,7 +167,6 @@ class AggregateExec(TpuExec):
                     jnp.where(act1, data.astype(f.data_type.jnp_dtype), 0),
                     valid & act1, f.data_type))
             return ColumnarBatch(cols, 1, out_schema)
-        words = string_words_for(keys, range(len(keys)))
         out_keys, results, num_groups = groupby_aggregate(
             keys, agg_inputs, batch.num_rows, cap, words)
         cols = list(out_keys)
@@ -184,7 +204,7 @@ class AggregateExec(TpuExec):
         aggregated: List[SpillableBatch] = []
 
         with agg_time.ns_timer():
-            first_pass = self._merge_batch if self.mode == "final" \
+            first_pass = self._merge_jitted if self.mode == "final" \
                 else self._update_and_aggregate
             for batch in self.child.execute():
                 in_batches.add(1)
@@ -194,6 +214,13 @@ class AggregateExec(TpuExec):
                     for out in with_retry(spillable,
                                           self._spill_wrap(first_pass),
                                           split_policy=split_in_half_by_rows):
+                        from ..columnar.column import bucket_capacity
+                        rows = out.num_rows_host
+                        small_cap = bucket_capacity(max(rows, 1))
+                        if small_cap < out.capacity:
+                            shrunk = _shrink_batch(out, small_cap)
+                            out = ColumnarBatch(shrunk.columns, rows,
+                                                out.schema)
                         aggregated.append(SpillableBatch.from_batch(out))
                 finally:
                     spillable.close()
@@ -211,15 +238,29 @@ class AggregateExec(TpuExec):
                     yield self._evaluate(merged)
                 return
 
-            merged = self._merge_all(aggregated)
+            if len(aggregated) == 1:
+                # a single partial already has unique keys: no merge needed
+                only = aggregated[0]
+                merged = only.get_batch()
+                only.release()
+                only.close()
+            else:
+                merged = self._merge_all(aggregated)
             if self.mode == "partial":
                 yield merged
             else:
                 yield self._evaluate(merged)
 
+    def _key_words(self, batch: ColumnarBatch) -> int:
+        """String-lane width for exact key ordering (host sync, pre-jit)."""
+        return string_words_for(batch.columns, range(self._key_count))
+
     def _update_and_aggregate(self, batch: ColumnarBatch) -> ColumnarBatch:
-        pre = eval_projection(self._pre_bound, batch, self._pre_schema)
-        return self._update_batch(pre)
+        pre = self._jit_pre(batch)
+        return self._jit_update(pre, self._key_words(pre))
+
+    def _merge_jitted(self, batch: ColumnarBatch) -> ColumnarBatch:
+        return self._jit_merge(batch, self._key_words(batch))
 
     def _spill_wrap(self, fn):
         def run(s: SpillableBatch):
@@ -248,7 +289,7 @@ class AggregateExec(TpuExec):
             batches = [s.get_batch() for s in items]
             try:
                 merged = concat_batches(batches, self._buffer_schema)
-                return self._merge_batch(merged)
+                return self._merge_jitted(merged)
             finally:
                 for s in items:
                     s.release()

@@ -21,23 +21,34 @@ from ..types import Schema
 from .base import CONCAT_TIME, NUM_INPUT_BATCHES, NUM_INPUT_ROWS, TpuExec
 
 
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _concat_pair(a: ColumnarBatch, b: ColumnarBatch, cap: int
+                 ) -> ColumnarBatch:
+    cols = [concat_columns(ca, cb, a.num_rows, b.num_rows, cap)
+            for ca, cb in zip(a.columns, b.columns)]
+    return ColumnarBatch(cols, a.num_rows + b.num_rows, a.schema)
+
+
 def concat_batches(batches: List[ColumnarBatch], schema: Schema
                    ) -> ColumnarBatch:
     """Concatenate active rows of all batches into one batch whose capacity
     is the bucket of the total. Tree-shaped pairwise reduction: each row is
     copied O(log k) times instead of the O(k) of a left fold, and each
-    round reuses one compiled concat program per capacity pair."""
+    round runs ONE compiled concat program per capacity-shape pair (jit
+    cache keyed on shapes + static out capacity)."""
     assert batches
     level = batches
     while len(level) > 1:
         nxt_level = []
         for i in range(0, len(level) - 1, 2):
             a, b = level[i], level[i + 1]
-            cap = bucket_capacity(a.num_rows_host + b.num_rows_host)
-            cols = [concat_columns(ca, cb, a.num_rows, b.num_rows, cap)
-                    for ca, cb in zip(a.columns, b.columns)]
-            nxt_level.append(ColumnarBatch(
-                cols, a.num_rows_host + b.num_rows_host, schema))
+            rows = a.num_rows_host + b.num_rows_host
+            cap = bucket_capacity(rows)
+            out = _concat_pair(a, b, cap)
+            nxt_level.append(ColumnarBatch(out.columns, rows, schema))
         if len(level) % 2:
             nxt_level.append(level[-1])
         level = nxt_level

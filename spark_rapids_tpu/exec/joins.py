@@ -71,6 +71,11 @@ class HashJoinExec(TpuExec):
         # build == non-preserved side; the planner guarantees this.
         if join_type in (LEFT_SEMI, LEFT_ANTI, EXISTENCE):
             assert build_side == "right"
+        # compiled phases: counts (sized by stream bucket) and the probe
+        # body (sized by stream + candidate buckets, static per shape)
+        self._jit_build = jax.jit(self._build_kernel)
+        self._jit_counts = jax.jit(self._counts_kernel)
+        self._jit_probe = jax.jit(self._probe_kernel, static_argnums=(5,))
 
     # -- schema ------------------------------------------------------------
     @property
@@ -100,10 +105,18 @@ class HashJoinExec(TpuExec):
         return (BUILD_TIME, JOIN_TIME, NUM_INPUT_BATCHES)
 
     # -- build -------------------------------------------------------------
-    def _build(self) -> Tuple[BuildTable, ColumnarBatch]:
+    def _build_kernel(self, batch: ColumnarBatch) -> BuildTable:
         build_child = self.children[1] if self.build_side == "right" \
             else self.children[0]
         keys = self.right_keys if self.build_side == "right" else self.left_keys
+        bound = bind_projection(keys, build_child.output_schema)
+        key_cols = [e.columnar_eval(batch) for e in bound]
+        return BuildTable.build(key_cols, list(batch.columns),
+                                batch.num_rows, batch.capacity)
+
+    def _build(self) -> Tuple[BuildTable, ColumnarBatch]:
+        build_child = self.children[1] if self.build_side == "right" \
+            else self.children[0]
         with self.metrics[BUILD_TIME].ns_timer():
             batches = list(build_child.execute())
             if batches:
@@ -111,48 +124,51 @@ class HashJoinExec(TpuExec):
             else:
                 from ..columnar.batch import empty_batch
                 batch = empty_batch(build_child.output_schema)
-            bound = bind_projection(keys, build_child.output_schema)
-            key_cols = [e.columnar_eval(batch) for e in bound]
-            table = BuildTable(key_cols, list(batch.columns),
-                               batch.num_rows, batch.capacity)
-            return table, batch
+            return self._jit_build(batch), batch
+
+    @property
+    def _need_build_flags(self) -> bool:
+        jt, bs = self.join_type, self.build_side
+        return ((jt in (RIGHT_OUTER, FULL_OUTER) and bs == "right")
+                or (jt in (LEFT_OUTER, FULL_OUTER) and bs == "left"))
 
     # -- probe -------------------------------------------------------------
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         build, build_batch = self._build()
         stream_child = self.children[0] if self.build_side == "right" \
             else self.children[1]
-        stream_keys = self.left_keys if self.build_side == "right" \
-            else self.right_keys
-        bound_keys = bind_projection(stream_keys, stream_child.output_schema)
         build_matched = jnp.zeros((build.capacity,), jnp.bool_)
-        need_build_flags = (
-            (self.join_type in (RIGHT_OUTER, FULL_OUTER) and self.build_side == "right")
-            or (self.join_type in (LEFT_OUTER, FULL_OUTER) and self.build_side == "left"))
 
         join_time = self.metrics[JOIN_TIME]
         for stream_batch in stream_child.execute():
             with join_time.ns_timer():
                 out, build_matched = self._probe_one(
-                    build, build_batch, stream_batch, bound_keys,
-                    build_matched, need_build_flags)
+                    build, build_batch, stream_batch, build_matched)
             if out is not None:
                 yield out
 
-        if need_build_flags:
+        if self._need_build_flags:
             with join_time.ns_timer():
                 yield self._emit_build_unmatched(build, build_batch,
                                                  build_matched)
 
-    def _probe_one(self, build: BuildTable, build_batch: ColumnarBatch,
-                   stream_batch: ColumnarBatch, bound_keys,
-                   build_matched, need_build_flags):
+    def _counts_kernel(self, build: BuildTable, stream_batch: ColumnarBatch):
+        stream_child = self.children[0] if self.build_side == "right" \
+            else self.children[1]
+        stream_keys = self.left_keys if self.build_side == "right" \
+            else self.right_keys
+        bound = bind_projection(stream_keys, stream_child.output_schema)
+        skey_cols = [e.columnar_eval(stream_batch) for e in bound]
+        lo, counts, _ = probe_counts(build, skey_cols,
+                                     stream_batch.num_rows,
+                                     stream_batch.capacity)
+        return lo, counts, skey_cols
+
+    def _probe_kernel(self, build: BuildTable, build_batch: ColumnarBatch,
+                      stream_batch: ColumnarBatch, lo_counts, build_matched,
+                      cand_cap: int):
+        lo, counts, skey_cols = lo_counts
         scap = stream_batch.capacity
-        skey_cols = [e.columnar_eval(stream_batch) for e in bound_keys]
-        lo, counts, _valid = probe_counts(build, skey_cols,
-                                          stream_batch.num_rows, scap)
-        total = int(jnp.sum(counts))  # host sync: size the candidate bucket
-        cand_cap = bucket_capacity(max(total, 1))
         s_idx, b_pos, total_dev = expand_candidates(lo, counts, cand_cap)
         verified, b_row = verify_pairs(build, skey_cols, s_idx, b_pos,
                                        s_idx >= 0)
@@ -164,7 +180,7 @@ class HashJoinExec(TpuExec):
         stream_preserved = (jt == LEFT_OUTER and bs == "right") or \
             (jt == RIGHT_OUTER and bs == "left") or jt == FULL_OUTER
 
-        if need_build_flags:
+        if self._need_build_flags:
             build_matched = build_matched | matched_flags(
                 verified, b_row, build.capacity)
 
@@ -174,8 +190,8 @@ class HashJoinExec(TpuExec):
                 flag = Column(smatched, jnp.ones((scap,), jnp.bool_),
                               BooleanType())
                 cols = list(stream_batch.columns) + [flag]
-                return ColumnarBatch(cols, stream_batch.num_rows,
-                                     self.output_schema), build_matched
+                return (ColumnarBatch(cols, stream_batch.num_rows,
+                                      self.output_schema), build_matched)
             keep = smatched if jt == LEFT_SEMI else ~smatched
             perm, n = compaction_order(keep, stream_batch.num_rows)
             cols = [gather_column(c, jnp.where(active_mask(n, scap), perm, -1))
@@ -188,7 +204,7 @@ class HashJoinExec(TpuExec):
             smatched = matched_flags(verified, s_idx, scap)
             un_idx, n_un = unmatched_indices(smatched, stream_batch.num_rows,
                                              scap)
-            out_cap = bucket_capacity(max(total + stream_batch.num_rows_host, 1))
+            out_cap = bucket_capacity(cand_cap + scap)
             s_map, b_map, n_out = outer_extend_maps(
                 s_map, b_map, n_pairs, un_idx, n_un, "build", out_cap)
         else:
@@ -200,6 +216,15 @@ class HashJoinExec(TpuExec):
         right_cols = bcols if self.build_side == "right" else scols
         return (ColumnarBatch(left_cols + right_cols, n_out,
                               self.output_schema), build_matched)
+
+    def _probe_one(self, build: BuildTable, build_batch: ColumnarBatch,
+                   stream_batch: ColumnarBatch, build_matched):
+        lo, counts, skey_cols = self._jit_counts(build, stream_batch)
+        total = int(jnp.sum(counts))  # host sync: size the candidate bucket
+        cand_cap = bucket_capacity(max(total, 1))
+        return self._jit_probe(build, build_batch, stream_batch,
+                               (lo, counts, skey_cols), build_matched,
+                               cand_cap)
 
     def _emit_build_unmatched(self, build: BuildTable,
                               build_batch: ColumnarBatch, build_matched):

@@ -48,6 +48,8 @@ class SortExec(TpuExec):
         super().__init__(child)
         self.orders = resolve_sort_orders(orders, child.output_schema)
         self.limit = limit
+        # one compiled sort program per (capacity bucket, string words)
+        self._jit_sort = jax.jit(self._sort_kernel, static_argnums=(1,))
 
     @property
     def output_schema(self) -> Schema:
@@ -60,11 +62,15 @@ class SortExec(TpuExec):
         return string_words_for(batch.columns,
                                 [o.ordinal for o in self.orders])
 
-    def _sort_one(self, batch: ColumnarBatch) -> ColumnarBatch:
-        words = self._string_words(batch)
+    def _sort_kernel(self, batch: ColumnarBatch, words: int) -> ColumnarBatch:
         cols, _ = sort_batch_columns(batch.columns, self.orders,
                                      batch.num_rows, batch.capacity, words)
-        out = ColumnarBatch(cols, batch.num_rows, batch.schema,
+        return ColumnarBatch(cols, batch.num_rows, batch.schema)
+
+    def _sort_one(self, batch: ColumnarBatch) -> ColumnarBatch:
+        words = self._string_words(batch)
+        out = self._jit_sort(batch, words)
+        out = ColumnarBatch(out.columns, batch.num_rows, batch.schema,
                             batch._host_rows)
         if self.limit is not None and batch.num_rows_host > self.limit:
             cols = [slice_rows(c, jnp.int32(0), jnp.int32(self.limit),

@@ -177,28 +177,32 @@ def with_retry(input_item: T, fn: Callable[[T], R],
         while queue:
             item = queue.pop(0)
             attempts = 0
-            while True:
-                attempts += 1
-                try:
-                    oom_guard()
-                    result = fn(item)
-                    _close_owned(item)
-                    yield result
-                    break
-                except TpuRetryOOM:
-                    _state.retry_count += 1
-                    if attempts >= max_attempts:
-                        raise
-                    spill_for_retry()
-                except TpuSplitAndRetryOOM:
-                    _state.split_retry_count += 1
-                    if split_policy is None:
-                        raise
-                    halves = split_policy(item)
-                    owned.discard(id(item))
-                    owned.update(id(h) for h in halves)
-                    queue = halves + queue
-                    break
+            try:
+                while True:
+                    attempts += 1
+                    try:
+                        oom_guard()
+                        result = fn(item)
+                        _close_owned(item)
+                        yield result
+                        break
+                    except TpuRetryOOM:
+                        _state.retry_count += 1
+                        if attempts >= max_attempts:
+                            raise
+                        spill_for_retry()
+                    except TpuSplitAndRetryOOM:
+                        _state.split_retry_count += 1
+                        if split_policy is None:
+                            raise
+                        halves = split_policy(item)
+                        owned.discard(id(item))
+                        owned.update(id(h) for h in halves)
+                        queue = halves + queue
+                        break
+            except BaseException:
+                _close_owned(item)  # the in-flight item, if owned
+                raise
     except BaseException:
         for item in queue:
             _close_owned(item)

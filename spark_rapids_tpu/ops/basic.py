@@ -73,16 +73,20 @@ def gather_column(col: Column, indices, out_valid=None,
 def compaction_order(keep, num_rows):
     """Stable permutation moving kept active rows to the front.
 
-    Returns (perm, new_num_rows). This is the engine's copy_if: instead of a
-    stream-compaction scatter (dynamic output size), a stable argsort on the
-    inverted keep flag — O(n log n) but static-shape and XLA-native.
+    Returns (perm, new_num_rows). This is the engine's copy_if: an O(n)
+    cumsum + scatter (prefix-sum stream compaction, the classic parallel
+    formulation) — kept row i lands at position (#kept before i); dropped
+    slots point out of range, which gather_column turns into invalid rows.
     """
     cap = keep.shape[0]
     act = active_mask(num_rows, cap)
     k = keep & act
-    perm = jnp.argsort(jnp.where(k, 0, 1).astype(jnp.int8), stable=True)
+    pos = jnp.cumsum(k.astype(jnp.int32)) - 1
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    perm = jnp.full((cap,), cap, jnp.int32)
+    perm = perm.at[jnp.where(k, pos, cap)].set(iota, mode="drop")
     new_rows = jnp.sum(k, dtype=jnp.int32)
-    return perm.astype(jnp.int32), new_rows
+    return perm, new_rows
 
 
 def compact_columns(columns: Sequence[Column], keep, num_rows

@@ -44,12 +44,24 @@ def _keys_valid(key_cols: Sequence[Column], num_rows, capacity: int):
 
 class BuildTable:
     """Hash-sorted build side: the TPU analog of the cuDF hash table the
-    reference builds once and probes per stream batch."""
+    reference builds once and probes per stream batch. A registered pytree
+    so the whole build phase jits and the probe phase takes it as a traced
+    argument."""
 
-    def __init__(self, key_cols: Sequence[Column], payload: Sequence[Column],
-                 num_rows, capacity: int):
-        self.capacity = capacity
+    def __init__(self, sorted_hash, perm, valid_count, num_rows,
+                 key_cols: Sequence[Column], payload: Sequence[Column],
+                 capacity: int):
+        self.sorted_hash = sorted_hash
+        self.perm = perm  # sorted position -> original build row
+        self.valid_count = valid_count
         self.num_rows = num_rows
+        self.key_cols = list(key_cols)
+        self.payload = list(payload)
+        self.capacity = capacity
+
+    @staticmethod
+    def build(key_cols: Sequence[Column], payload: Sequence[Column],
+              num_rows, capacity: int) -> "BuildTable":
         valid = _keys_valid(key_cols, num_rows, capacity)
         h = xxhash64_batch(list(key_cols), seed=JOIN_HASH_SEED)
         # invalid/inactive rows: push to the end with the max hash AND keep
@@ -58,13 +70,24 @@ class BuildTable:
         h_u = jax.lax.bitcast_convert_type(h, jnp.uint64)
         sort_h = jnp.where(valid, h_u, big)
         iota = jnp.arange(capacity, dtype=jnp.int32)
-        sorted_h, sorted_valid, perm = jax.lax.sort(
+        sorted_h, _, perm = jax.lax.sort(
             (sort_h, (~valid).astype(jnp.int8), iota), num_keys=2)
-        self.sorted_hash = sorted_h
-        self.perm = perm  # sorted position -> original build row
-        self.valid_count = jnp.sum(valid, dtype=jnp.int32)
-        self.key_cols = list(key_cols)
-        self.payload = list(payload)
+        return BuildTable(sorted_h, perm, jnp.sum(valid, dtype=jnp.int32),
+                          num_rows, key_cols, payload, capacity)
+
+
+def _bt_flatten(bt: BuildTable):
+    return ((bt.sorted_hash, bt.perm, bt.valid_count, bt.num_rows,
+             tuple(bt.key_cols), tuple(bt.payload)), bt.capacity)
+
+
+def _bt_unflatten(capacity, children):
+    sorted_hash, perm, valid_count, num_rows, key_cols, payload = children
+    return BuildTable(sorted_hash, perm, valid_count, num_rows,
+                      list(key_cols), list(payload), capacity)
+
+
+jax.tree_util.register_pytree_node(BuildTable, _bt_flatten, _bt_unflatten)
 
 
 def probe_counts(build: BuildTable, stream_keys: Sequence[Column],

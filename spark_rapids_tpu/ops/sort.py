@@ -185,7 +185,6 @@ def group_segment_ids(key_columns: Sequence[Column], num_rows, capacity: int,
     boundary = boundary.at[0].set(True)
     boundary = boundary & act
     seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    num_groups = jnp.maximum(seg[-1] + 1, 0) if capacity else jnp.int32(0)
     num_groups = jnp.where(num_rows > 0, jnp.max(jnp.where(act, seg, -1)) + 1, 0)
     seg = jnp.where(act, seg, capacity)
     return seg, num_groups.astype(jnp.int32)

@@ -252,3 +252,43 @@ def string_equal(a: StringColumn, b: StringColumn) -> Column:
                csum[jnp.minimum(a.offsets[:-1], a.byte_capacity)]) > 0
     eq = same_len & ~any_neq
     return Column(eq, a.validity & b.validity, BOOLEAN)
+
+
+def string_to_padded(col: StringColumn, width: int):
+    """(lengths (cap,), bytes (cap, width)): fixed-width row-major encoding
+    for collective exchange (ICI all-to-all needs rectangular tensors; this
+    is the TPU analog of JCudfSerialization's framed host buffers).
+    Truncates rows longer than `width` — callers size width from host-known
+    max length."""
+    cap = col.capacity
+    lengths = jnp.minimum(string_lengths(col), width)
+    starts = col.offsets[:cap]
+    j = jnp.arange(width, dtype=jnp.int32)
+    pos = starts[:, None] + j[None, :]
+    in_str = j[None, :] < lengths[:, None]
+    safe = jnp.where(in_str, jnp.clip(pos, 0, col.byte_capacity - 1), 0)
+    padded = jnp.where(in_str, col.data[safe], jnp.uint8(0))
+    return lengths, padded
+
+
+def string_from_padded(lengths, padded, validity,
+                       dtype=None) -> StringColumn:
+    """Inverse of string_to_padded: rebuild (offsets, bytes) columns.
+
+    Byte capacity is the static worst case cap*width (callers keep width
+    small); unused tail stays zero.
+    """
+    from ..columnar.column import bucket_capacity
+    from ..types import StringType
+    cap, width = padded.shape
+    lengths = jnp.where(validity, lengths, 0)
+    offsets = _rebuild_offsets(lengths)
+    byte_cap = bucket_capacity(max(cap * width, 1))
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, cap - 1)
+    intra = pos - offsets[row]
+    in_use = pos < offsets[-1]
+    safe_intra = jnp.clip(intra, 0, width - 1)
+    data = jnp.where(in_use, padded[row, safe_intra], jnp.uint8(0))
+    return StringColumn(data, offsets, validity, dtype or StringType())

@@ -1,0 +1,6 @@
+"""Distributed execution over a TPU device mesh (reference layer L5 +
+§2.10: shuffle transport + data parallelism). The ICI collective plane
+replaces the reference's UCX RDMA path; host-staged exchange replaces the
+MULTITHREADED file shuffle."""
+
+from .mesh import device_mesh, mesh_axis_size  # noqa: F401

@@ -1,0 +1,139 @@
+"""Distributed query steps: SPMD pipelines compiled once over the whole
+mesh (reference analog: the UCX shuffle + partial/final aggregate pattern,
+GpuShuffleExchangeExecBase.scala:277 + GpuAggregateExec partial/final).
+
+The biggest architectural departure from the reference (SURVEY §7 risk
+register): instead of independent tasks pulling batches through a
+transport, a distributed step is ONE resident XLA program over the mesh —
+local partial aggregate, ICI all-to-all exchange by key hash, local final
+merge — with XLA scheduling compute/communication overlap. Spark tasks
+enqueue batches into this program instead of talking to a shuffle service.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, StringColumn
+from ..ops.aggregate import groupby_aggregate
+from ..types import DataType, Schema
+from .exchange import exchange_columns
+from .mesh import DATA_AXIS
+
+
+def stack_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
+    """Stack n same-capacity batches along a new leading device axis; the
+    result's leaves have shape (n, ...) ready for shard_map over 'data'.
+    Row and string-byte buckets are aligned across batches first."""
+    cap = max(b.capacity for b in batches)
+    batches = [b.sized_to(cap) for b in batches]
+    aligned = []
+    byte_caps = {}
+    for b in batches:
+        for i, c in enumerate(b.columns):
+            if isinstance(c, StringColumn):
+                byte_caps[i] = max(byte_caps.get(i, 0), c.byte_capacity)
+    for b in batches:
+        cols = [c.with_byte_capacity(byte_caps[i])
+                if isinstance(c, StringColumn) else c
+                for i, c in enumerate(b.columns)]
+        aligned.append(b.with_columns(cols, b.schema))
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *aligned)
+
+
+def _squeeze0(tree):
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+
+def _expand0(tree):
+    return jax.tree_util.tree_map(lambda x: x[None], tree)
+
+
+def required_string_width(batches: Sequence[ColumnarBatch]) -> int:
+    """Exact fixed-width byte size for exchanging these batches' string
+    columns (host-side, pre-jit): the max string length rounded to 8.
+    Pass to make_distributed_groupby/exchange_columns — the fixed-width
+    codec TRUNCATES beyond this width."""
+    width = 8
+    for b in batches:
+        for c in b.columns:
+            if isinstance(c, StringColumn):
+                lengths = c.offsets[1:] - c.offsets[:-1]
+                max_len = int(jnp.max(lengths)) if c.capacity else 0
+                width = max(width, (max_len + 7) // 8 * 8)
+    return width
+
+
+def make_distributed_groupby(mesh: Mesh, key_count: int,
+                             update_inputs: Sequence[Tuple[str, int]],
+                             merge_ops: Sequence[str],
+                             buffer_types: Sequence[DataType],
+                             out_schema: Schema,
+                             string_words: int = 4,
+                             string_width: int = 64,
+                             axis_name: str = DATA_AXIS):
+    """Build the jitted SPMD group-by step.
+
+    update_inputs: [(op, input ordinal into the local batch)] per buffer
+    (ordinal -1 => count_star). merge_ops/buffer_types: one per buffer.
+    string_width: fixed-width byte size for exchanged string columns —
+    size it with required_string_width(batches) or longer keys TRUNCATE.
+    Input: stacked batch with leaves (n, ...); output: stacked aggregated
+    batch, one shard per device holding that device's hash partitions.
+    """
+    n_parts = mesh.shape[axis_name]
+    # sort-lane width must cover the exchanged width for exact key grouping
+    string_words = max(string_words, string_width // 8)
+
+    def spmd(stacked: ColumnarBatch) -> ColumnarBatch:
+        local = _squeeze0(stacked)
+        cap = local.capacity
+        keys = list(local.columns[:key_count])
+        agg_inputs = [(op, local.columns[ordinal] if ordinal >= 0 else None)
+                      for op, ordinal in update_inputs]
+        # phase 1: local partial aggregate
+        pkeys, presults, pgroups = groupby_aggregate(
+            keys, agg_inputs, local.num_rows, cap, string_words)
+        partial_cols = list(pkeys)
+        for r, bt in zip(presults, buffer_types):
+            if r[0] == "col":
+                partial_cols.append(r[1])
+            else:
+                data, valid = r[1]
+                partial_cols.append(Column(data.astype(bt.jnp_dtype),
+                                           valid, bt))
+        # phase 2: all-to-all exchange so equal keys colocate
+        recv_cols, n_recv = exchange_columns(
+            partial_cols, list(range(key_count)), pgroups, cap,
+            axis_name, n_parts, string_width=string_width)
+        # phase 3: final merge aggregate on the received partition
+        rkeys = recv_cols[:key_count]
+        rbufs = recv_cols[key_count:]
+        m_inputs = [(op, c) for op, c in zip(merge_ops, rbufs)]
+        fkeys, fresults, fgroups = groupby_aggregate(
+            rkeys, m_inputs, n_recv, recv_cols[0].capacity, string_words)
+        out_cols = list(fkeys)
+        for r, bt in zip(fresults, buffer_types):
+            if r[0] == "col":
+                out_cols.append(r[1])
+            else:
+                data, valid = r[1]
+                out_cols.append(Column(data.astype(bt.jnp_dtype), valid, bt))
+        out = ColumnarBatch(out_cols, fgroups, out_schema)
+        return _expand0(out)
+
+    mapped = jax.shard_map(spmd, mesh=mesh,
+                           in_specs=P(axis_name),
+                           out_specs=P(axis_name),
+                           check_vma=False)
+    return jax.jit(mapped)
+
+
+def unstack_batches(stacked: ColumnarBatch, n: int) -> List[ColumnarBatch]:
+    return [jax.tree_util.tree_map(lambda x: x[i], stacked)
+            for i in range(n)]

@@ -1,0 +1,123 @@
+"""ICI all-to-all shuffle exchange — the accelerated data plane (reference
+UCX shuffle, SURVEY §2.5: GpuShuffleExchangeExecBase.scala:277 device split
++ shuffle-plugin UCX transport). On TPU the transport IS the compiler:
+rows are hash-partitioned on device, packed into fixed (n_parts, slot_cap)
+blocks, and exchanged with `jax.lax.all_to_all` over the mesh axis — XLA
+lowers that to ICI neighbor exchanges with no host involvement, replacing
+the reference's bounce-buffer + RDMA state machines entirely.
+
+Static-shape contract: every device sends exactly `slot_cap` row slots to
+every peer (invalid slots carry validity False). slot_cap defaults to the
+full local capacity — the true worst case (all local rows hash to one
+partition) — so the exchange can never drop rows; callers with knowledge of
+key distribution can pass a smaller cap and trade memory for speed.
+
+Strings ride as (lengths, fixed-width padded byte matrix) pairs
+(ops/strings.py string_to_padded) — the TPU answer to cuDF's varlen
+device serialization in JCudfSerialization.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+from ..ops.basic import active_mask, compaction_order, gather_column
+from ..ops.hashing import murmur3_batch, pmod
+
+#: hash seed for shuffle partitioning (Spark uses 42 for HashPartitioning)
+SHUFFLE_SEED = 42
+
+
+def partition_ids(key_cols: Sequence[Column], num_rows, capacity: int,
+                  n_parts: int):
+    """Spark HashPartitioning: pmod(murmur3(keys), n). Inactive rows get
+    id n_parts so they never land in a real partition."""
+    h = murmur3_batch(list(key_cols), seed=SHUFFLE_SEED)
+    pid = pmod(h, n_parts)
+    act = active_mask(num_rows, capacity)
+    return jnp.where(act, pid, n_parts)
+
+
+def partition_slots(pid, num_rows, capacity: int, n_parts: int,
+                    slot_cap: int):
+    """Map each active row to a slot in the (n_parts, slot_cap) send grid.
+
+    Returns send_idx (n_parts*slot_cap,) int32: source row for each slot,
+    -1 for empty slots. Rows beyond slot_cap per partition are dropped —
+    callers must size slot_cap to the worst case (default: capacity).
+    """
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    # stable sort rows by pid: groups become contiguous
+    sorted_pid, perm = jax.lax.sort((pid.astype(jnp.int32), iota), num_keys=1)
+    # position within group = index - first index of that pid
+    first_of = jax.ops.segment_min(iota, sorted_pid,
+                                   num_segments=n_parts + 1)
+    pos = iota - first_of[jnp.clip(sorted_pid, 0, n_parts)]
+    ok = (sorted_pid < n_parts) & (pos < slot_cap)
+    # sentinel slot is out of bounds -> mode="drop" discards those updates
+    slot = jnp.where(ok, sorted_pid * slot_cap + pos, n_parts * slot_cap)
+    send_idx = jnp.full((n_parts * slot_cap,), -1, jnp.int32)
+    return send_idx.at[slot].set(perm, mode="drop")
+
+
+def _fixed_to_blocks(col: Column, send_idx, n_parts: int, slot_cap: int):
+    g = gather_column(col, send_idx)
+    return (g.data.reshape((n_parts, slot_cap)),
+            g.validity.reshape((n_parts, slot_cap)))
+
+
+def exchange_columns(columns: Sequence[Column], key_ordinals: Sequence[int],
+                     num_rows, capacity: int, axis_name: str, n_parts: int,
+                     slot_cap: int | None = None, string_width: int = 64,
+                     ) -> Tuple[List[Column], jnp.ndarray]:
+    """SPMD body (call inside shard_map): hash-partition local rows and
+    all-to-all them so partition p's rows land on device p.
+
+    Returns (received columns, received row count); received capacity is
+    n_parts*slot_cap with active rows compacted to the front.
+    """
+    from ..ops.strings import string_from_padded, string_to_padded
+
+    slot_cap = slot_cap or capacity
+    key_cols = [columns[i] for i in key_ordinals]
+    pid = partition_ids(key_cols, num_rows, capacity, n_parts)
+    send_idx = partition_slots(pid, num_rows, capacity, n_parts, slot_cap)
+
+    out_cols: List[Column] = []
+    recv_cap = n_parts * slot_cap
+    for col in columns:
+        if isinstance(col, StringColumn):
+            g = gather_column(col, send_idx)
+            lengths, padded = string_to_padded(g, string_width)
+            r_len = jax.lax.all_to_all(
+                lengths.reshape((n_parts, slot_cap)), axis_name, 0, 0,
+                tiled=False).reshape((recv_cap,))
+            r_pad = jax.lax.all_to_all(
+                padded.reshape((n_parts, slot_cap, string_width)),
+                axis_name, 0, 0, tiled=False).reshape((recv_cap, string_width))
+            r_val = jax.lax.all_to_all(
+                g.validity.reshape((n_parts, slot_cap)), axis_name, 0, 0,
+                tiled=False).reshape((recv_cap,))
+            out_cols.append(string_from_padded(r_len, r_pad, r_val,
+                                               col.dtype))
+        else:
+            data, valid = _fixed_to_blocks(col, send_idx, n_parts, slot_cap)
+            r_data = jax.lax.all_to_all(data, axis_name, 0, 0,
+                                        tiled=False).reshape((recv_cap,))
+            r_val = jax.lax.all_to_all(valid, axis_name, 0, 0,
+                                       tiled=False).reshape((recv_cap,))
+            out_cols.append(Column(r_data, r_val, col.dtype))
+
+    # occupancy: a slot is occupied iff its send side had a row; validity of
+    # a real-but-null row is False, so track occupancy separately
+    occ = jax.lax.all_to_all(
+        (send_idx >= 0).reshape((n_parts, slot_cap)), axis_name, 0, 0,
+        tiled=False).reshape((recv_cap,))
+    perm, n_recv = compaction_order(occ, jnp.int32(recv_cap))
+    act = active_mask(n_recv, recv_cap)
+    out_cols = [gather_column(c, jnp.where(act, perm, -1)) for c in out_cols]
+    return out_cols, n_recv

@@ -1,0 +1,9 @@
+"""Planning engine (reference layer L2, SURVEY §2.2): logical plans, the
+meta wrap->tag->convert framework, TypeSig checks and the override rule
+tables that decide what runs on TPU."""
+
+from .logical import (  # noqa: F401
+    LogicalAggregate, LogicalFilter, LogicalJoin, LogicalLimit, LogicalPlan,
+    LogicalProject, LogicalRange, LogicalScan, LogicalSort, LogicalUnion,
+)
+from .overrides import TpuOverrides, PlanNotSupported  # noqa: F401

@@ -1,0 +1,251 @@
+"""Override rule tables + plan conversion — the reference's
+GpuOverrides.scala (rule tables :919/:3838, wrapAndTagPlan :4421,
+doConvertPlan :4427) and GpuTransitionOverrides (coalesce insertion :322).
+
+Standalone difference: the reference falls back to Spark's CPU operators
+node-by-node; this engine has no host engine underneath, so an
+unsupported node raises PlanNotSupported carrying the full explain report
+(the same text the reference logs as "will not run on GPU because ...").
+A host-fallback operator tier can slot in here later without touching the
+tagging machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Type
+
+from ..config import RapidsConf, active_conf
+from ..exec.aggregate import AggregateExec
+from ..exec.base import TpuExec
+from ..exec.basic import (
+    ExpandExec, FilterExec, GlobalLimitExec, InMemoryScanExec, ProjectExec,
+    RangeExec, UnionExec,
+)
+from ..exec.coalesce import CoalesceBatchesExec
+from ..exec.joins import HashJoinExec, NestedLoopJoinExec
+from ..exec.sort import SortExec, TopNExec
+from ..expr import arithmetic, cast, conditional, hashexprs, math as emath, \
+    predicates, stringexprs
+from ..expr.core import (
+    Alias, BoundReference, Expression, Literal, UnresolvedAttribute, resolve,
+)
+from . import logical as L
+from .meta import BaseMeta, ExprMeta, ExprRule
+from .typesig import (
+    BOOLEAN, TypeSig, comparable, commonly_supported, fp, integral,
+    numeric, numeric_and_decimal, orderable, stringlike,
+)
+
+
+class PlanNotSupported(Exception):
+    def __init__(self, report: str):
+        super().__init__(
+            "plan cannot run on TPU:\n" + report)
+        self.report = report
+
+
+# ---------------------------------------------------------------------------
+# expression rule table (reference: 218 expr[...] rules; grows with kernels)
+# ---------------------------------------------------------------------------
+
+_EXPR_RULES: Optional[Dict[Type[Expression], ExprRule]] = None
+
+
+def _r(rules, cls, desc, input_sig=commonly_supported,
+       output_sig=commonly_supported, tag_fn=None):
+    rules[cls] = ExprRule(cls, desc, input_sig, output_sig, tag_fn)
+
+
+def expression_rules() -> Dict[Type[Expression], ExprRule]:
+    global _EXPR_RULES
+    if _EXPR_RULES is not None:
+        return _EXPR_RULES
+    rules: Dict[Type[Expression], ExprRule] = {}
+    num = numeric_and_decimal
+    # leaves
+    _r(rules, Literal, "literal value")
+    _r(rules, BoundReference, "column reference")
+    _r(rules, UnresolvedAttribute, "column reference")
+    _r(rules, Alias, "named expression")
+    # arithmetic
+    for c in (arithmetic.Add, arithmetic.Subtract, arithmetic.Multiply):
+        _r(rules, c, f"{c.__name__.lower()}", num, num)
+    _r(rules, arithmetic.Divide, "division", num, fp + TypeSig.of("DECIMAL"))
+    _r(rules, arithmetic.IntegralDivide, "integral division", num, integral)
+    _r(rules, arithmetic.Remainder, "remainder", num, num)
+    _r(rules, arithmetic.Pmod, "positive modulo", num, num)
+    _r(rules, arithmetic.UnaryMinus, "negation", num, num)
+    _r(rules, arithmetic.Abs, "absolute value", num, num)
+    _r(rules, arithmetic.Least, "least of arguments", orderable, orderable)
+    _r(rules, arithmetic.Greatest, "greatest of arguments", orderable, orderable)
+    # predicates
+    for c in (predicates.EqualTo, predicates.EqualNullSafe,
+              predicates.LessThan, predicates.LessThanOrEqual,
+              predicates.GreaterThan, predicates.GreaterThanOrEqual):
+        _r(rules, c, "comparison", comparable, BOOLEAN)
+    for c in (predicates.And, predicates.Or, predicates.Not):
+        _r(rules, c, "boolean logic", BOOLEAN, BOOLEAN)
+    _r(rules, predicates.IsNull, "null check", commonly_supported, BOOLEAN)
+    _r(rules, predicates.IsNotNull, "non-null check", commonly_supported, BOOLEAN)
+    _r(rules, predicates.In, "IN list", comparable, BOOLEAN)
+    # conditional
+    _r(rules, conditional.If, "if/else", commonly_supported)
+    _r(rules, conditional.CaseWhen, "case/when", commonly_supported)
+    _r(rules, conditional.Coalesce, "first non-null", commonly_supported)
+    _r(rules, conditional.IsNaN, "NaN check", fp, BOOLEAN)
+    _r(rules, conditional.NaNvl, "NaN replacement", fp, fp)
+    # cast
+    _r(rules, cast.Cast, "type cast")
+    # math
+    for c in (emath.UnaryMath, emath.Pow, emath.Atan, emath.Floor,
+              emath.Ceil, emath.Round, emath.BRound):
+        _r(rules, c, "math function", num, num)
+    # hash
+    _r(rules, hashexprs.Murmur3Hash, "murmur3 hash", commonly_supported, integral)
+    _r(rules, hashexprs.XxHash64, "xxhash64", commonly_supported, integral)
+    # strings
+    _r(rules, stringexprs.Length, "string length", stringlike, integral)
+    _r(rules, stringexprs.Upper, "uppercase (ASCII)", stringlike, stringlike)
+    _r(rules, stringexprs.Lower, "lowercase (ASCII)", stringlike, stringlike)
+    _r(rules, stringexprs.Substring, "substring", stringlike, stringlike)
+    _r(rules, stringexprs.StartsWith, "prefix match", stringlike, BOOLEAN)
+    _r(rules, stringexprs.EndsWith, "suffix match", stringlike, BOOLEAN)
+    _r(rules, stringexprs.Contains, "substring match", stringlike, BOOLEAN)
+    _EXPR_RULES = rules
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# plan metas
+# ---------------------------------------------------------------------------
+
+class PlanMeta(BaseMeta):
+    def __init__(self, plan: L.LogicalPlan, conf: RapidsConf):
+        super().__init__()
+        self.plan = plan
+        self.conf = conf
+        self.children = [PlanMeta(c, conf) for c in plan.children]
+        self.expr_metas: List[ExprMeta] = [
+            ExprMeta.wrap(e, conf, None) for e in self._expressions()]
+
+    def _expressions(self) -> List[Expression]:
+        p = self.plan
+        if isinstance(p, L.LogicalProject):
+            return list(p.exprs)
+        if isinstance(p, L.LogicalFilter):
+            return [p.condition]
+        if isinstance(p, L.LogicalAggregate):
+            out = list(p.group_exprs)
+            for fn, _ in p.aggregates:
+                out.extend(fn.inputs)
+            return out
+        if isinstance(p, L.LogicalJoin):
+            out = list(p.left_keys) + list(p.right_keys)
+            if p.condition is not None:
+                out.append(p.condition)
+            return out
+        if isinstance(p, L.LogicalExpand):
+            return [e for proj in p.projections for e in proj]
+        if isinstance(p, L.LogicalSort):
+            out = []
+            for o in p.orders:
+                out.append(o[0] if isinstance(o, tuple) else o)
+            return [e for e in out if isinstance(e, Expression)]
+        return []
+
+    def tag_for_tpu(self):
+        """Bottom-up tagging (reference RapidsMeta.tagForGpu:291)."""
+        for c in self.children:
+            c.tag_for_tpu()
+            if not c.can_run_on_tpu:
+                self.will_not_work_on_tpu("child plan cannot run on TPU")
+        for em in self.expr_metas:
+            em.tag_for_tpu()
+            if not em.can_run_on_tpu:
+                self.will_not_work_on_tpu(
+                    f"expression {type(em.expr).__name__} cannot run on TPU")
+        name = self.plan.node_name()
+        key = f"spark.rapids.sql.exec.{name}"
+        if str(self.conf._settings.get(key, "true")).lower() == "false":
+            self.will_not_work_on_tpu(f"operator {name} disabled by {key}")
+        if not self.conf.sql_enabled:
+            self.will_not_work_on_tpu(
+                "spark.rapids.sql.enabled is false")
+
+    def explain(self, indent: int = 0, lines: Optional[List[str]] = None
+                ) -> str:
+        """The reference's explain output (GpuOverrides.scala:4764)."""
+        lines = [] if lines is None else lines
+        mark = "*" if self.can_run_on_tpu else "!"
+        lines.append("  " * indent + f"{mark} {self.plan.describe()}")
+        for r in self._reasons:
+            lines.append("  " * indent + f"    @ {r}")
+        expr_reasons: List[str] = []
+        for em in self.expr_metas:
+            em.collect_reasons(expr_reasons)
+        for r in expr_reasons:
+            lines.append("  " * indent + f"    ! {r}")
+        for c in self.children:
+            c.explain(indent + 1, lines)
+        return "\n".join(lines)
+
+    # -- conversion --------------------------------------------------------
+    def convert(self) -> TpuExec:
+        p = self.plan
+        kids = [c.convert() for c in self.children]
+        if isinstance(p, L.LogicalScan):
+            batches = list(p.source.batches())
+            exec_node: TpuExec = InMemoryScanExec(batches, p.schema)
+            return CoalesceBatchesExec(exec_node)
+        if isinstance(p, L.LogicalRange):
+            return RangeExec(p.start, p.end, p.step, name=p.name)
+        if isinstance(p, L.LogicalProject):
+            return ProjectExec(p.exprs, kids[0])
+        if isinstance(p, L.LogicalFilter):
+            return FilterExec(p.condition, kids[0])
+        if isinstance(p, L.LogicalAggregate):
+            return AggregateExec(p.group_exprs, p.aggregates, kids[0])
+        if isinstance(p, L.LogicalSort):
+            if p.limit is None:
+                return SortExec(p.orders, kids[0])
+            return TopNExec(p.limit, p.orders, kids[0], offset=p.offset)
+        if isinstance(p, L.LogicalLimit):
+            return GlobalLimitExec(p.limit, kids[0], offset=p.offset)
+        if isinstance(p, L.LogicalUnion):
+            return UnionExec(*kids)
+        if isinstance(p, L.LogicalExpand):
+            return ExpandExec(p.projections, kids[0])
+        if isinstance(p, L.LogicalJoin):
+            if not p.left_keys:
+                return NestedLoopJoinExec(kids[0], kids[1], p.join_type,
+                                          p.condition)
+            return HashJoinExec(kids[0], kids[1], p.left_keys, p.right_keys,
+                                p.join_type, condition=p.condition)
+        raise PlanNotSupported(f"no conversion for {type(p).__name__}")
+
+
+class TpuOverrides:
+    """Entry point (reference `case class GpuOverrides` apply :4624)."""
+
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        self.conf = conf or active_conf()
+
+    def wrap_and_tag(self, plan: L.LogicalPlan) -> PlanMeta:
+        meta = PlanMeta(plan, self.conf)
+        meta.tag_for_tpu()
+        return meta
+
+    def apply(self, plan: L.LogicalPlan) -> TpuExec:
+        meta = self.wrap_and_tag(plan)
+        if not self._all_ok(meta):
+            raise PlanNotSupported(meta.explain())
+        return meta.convert()
+
+    def explain(self, plan: L.LogicalPlan) -> str:
+        return self.wrap_and_tag(plan).explain()
+
+    @staticmethod
+    def _all_ok(meta: PlanMeta) -> bool:
+        if not meta.can_run_on_tpu:
+            return False
+        return all(TpuOverrides._all_ok(c) for c in meta.children)

@@ -1,0 +1,158 @@
+"""Planning engine + DataFrame API tests: tagging, explain reporting,
+conf-driven disables (reference GpuOverrides explain/tag semantics) and
+end-to-end query execution through the session surface."""
+
+import math
+
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.expr.core import Expression, col, lit
+from spark_rapids_tpu.plan.overrides import PlanNotSupported, TpuOverrides
+from spark_rapids_tpu.types import (
+    DOUBLE, INT, LONG, STRING, Schema, StructField,
+)
+
+SCHEMA = Schema((StructField("k", STRING), StructField("v", INT),
+                 StructField("d", DOUBLE)))
+DATA = {
+    "k": ["b", "a", None, "b", "a", "c"],
+    "v": [3, 1, 7, None, 5, 2],
+    "d": [1.5, 2.5, 0.5, 3.5, None, 4.5],
+}
+
+
+def session(**conf):
+    return TpuSession(conf)
+
+
+def df(sess=None, batch_rows=None):
+    sess = sess or session()
+    return sess.from_pydict(DATA, SCHEMA, batch_rows=batch_rows)
+
+
+def test_select_filter_collect():
+    got = (df().filter(col("v") > 1)
+               .select(col("k"), (col("v") * 2).alias("v2"))
+               .collect())
+    assert sorted(got, key=repr) == sorted(
+        [("b", 6), (None, 14), ("a", 10), ("c", 4)], key=repr)
+
+
+def test_with_column_and_count():
+    d = df().with_column("vv", col("v") + col("v"))
+    assert d.columns == ["k", "d", "vv"] or "vv" in d.columns
+    assert df().count() == 6
+
+
+def test_groupby_agg_api():
+    got = (df(batch_rows=2).group_by("k")
+           .agg((F.sum("v"), "s"), (F.count(), "c"))
+           .sort("k").collect())
+    assert got == [(None, 7, 1), ("a", 6, 2), ("b", 3, 2), ("c", 2, 1)]
+
+
+def test_join_api():
+    s = session()
+    other = s.from_pydict({"k2": ["a", "b"], "w": [10, 20]},
+                          Schema((StructField("k2", STRING),
+                                  StructField("w", INT))))
+    got = (df(s).join(other, left_on=col("k"), right_on=col("k2"))
+           .select("k", "v", "w").sort("k", "v").collect())
+    assert got == [("a", 1, 10), ("a", 5, 10), ("b", None, 20),
+                   ("b", 3, 20)]
+
+
+def test_sort_limit_pushdown_topn():
+    d = df().sort(("v", False)).limit(2)
+    got = d.collect()
+    assert [r[1] for r in got] == [7, 5]
+
+
+def test_distinct():
+    s = session()
+    d = s.from_pydict({"x": [1, 2, 1, 3, 2]},
+                      Schema((StructField("x", INT),)))
+    assert sorted(r[0] for r in d.distinct().collect()) == [1, 2, 3]
+
+
+def test_union_api():
+    assert df().union(df()).count() == 12
+
+
+def test_range():
+    got = session().range(10).collect()
+    assert [r[0] for r in got] == list(range(10))
+
+
+def test_explain_marks_supported():
+    report = df().filter(col("v") > 1).select(col("v") + 1).explain()
+    assert "* Project" in report
+    assert "* Filter" in report
+    assert "* Scan" in report
+    assert "!" not in report.replace("!=", "")
+
+
+def test_explain_reports_unsupported_expression():
+    class FancyExpr(Expression):
+        def __init__(self, child):
+            self.children = (child,)
+        @property
+        def data_type(self):
+            return self.children[0].data_type
+        def with_children(self, cs):
+            return FancyExpr(cs[0])
+
+    d = df().select(FancyExpr(col("v")))
+    report = d.explain()
+    assert "no TPU implementation for expression FancyExpr" in report
+    with pytest.raises(PlanNotSupported) as exc:
+        d.collect()
+    assert "FancyExpr" in str(exc.value)
+
+
+def test_conf_disable_expression():
+    s = session(**{"spark.rapids.sql.expression.Add": "false"})
+    d = s.from_pydict(DATA, SCHEMA).select(col("v") + 1)
+    report = d.explain()
+    assert "disabled by spark.rapids.sql.expression.Add" in report
+    with pytest.raises(PlanNotSupported):
+        d.collect()
+
+
+def test_conf_disable_exec():
+    s = session(**{"spark.rapids.sql.exec.Sort": "false"})
+    d = s.from_pydict(DATA, SCHEMA).sort("v")
+    with pytest.raises(PlanNotSupported):
+        d.collect()
+
+
+def test_sql_enabled_off():
+    s = session(**{"spark.rapids.sql.enabled": "false"})
+    with pytest.raises(PlanNotSupported):
+        s.from_pydict(DATA, SCHEMA).collect()
+
+
+def test_to_arrow_roundtrip():
+    t = df().filter(col("v") > 1).to_arrow()
+    assert t.num_rows == 4
+    assert set(t.column_names) == {"k", "v", "d"}
+
+
+def test_string_functions_api():
+    got = (df().filter(F.col("k").is_not_null() if hasattr(F.col("k"), "is_not_null")
+                       else ~F.col("k").__eq__(lit(None)))
+           if False else
+           df().select(F.upper(F.col("k")).alias("u"),
+                       F.length(F.col("k")).alias("l"))).collect()
+    assert ("B", 1) in got and (None, None) in got
+
+
+def test_sorted_limit_with_offset():
+    # review regression: offset must survive the sort+limit TopN collapse
+    s = session()
+    d = s.from_pydict({"a": [5, 3, 1, 4, 2]},
+                      Schema((StructField("a", INT),)))
+    got = d.sort("a").limit(2, offset=1).collect()
+    assert got == [(2,), (3,)]
