@@ -105,3 +105,141 @@ def hash(*xs):  # noqa: A001
 
 def xxhash64(*xs):
     return hashexprs.XxHash64(*[_e(x) for x in xs])
+
+
+# window functions -----------------------------------------------------------
+def row_number():
+    from ..expr.windowexprs import RowNumber
+    return RowNumber()
+
+
+def rank():
+    from ..expr.windowexprs import Rank
+    return Rank()
+
+
+def dense_rank():
+    from ..expr.windowexprs import DenseRank
+    return DenseRank()
+
+
+def lag(x, offset=1, default=None):
+    from ..expr.windowexprs import Lag
+    return Lag(_e(x), offset, default)
+
+
+def lead(x, offset=1, default=None):
+    from ..expr.windowexprs import Lead
+    return Lead(_e(x), offset, default)
+
+
+def window_sum(x):
+    from ..expr.windowexprs import WindowAgg
+    return WindowAgg("sum", _e(x))
+
+
+def window_min(x):
+    from ..expr.windowexprs import WindowAgg
+    return WindowAgg("min", _e(x))
+
+
+def window_max(x):
+    from ..expr.windowexprs import WindowAgg
+    return WindowAgg("max", _e(x))
+
+
+def window_count(x=None):
+    from ..expr.windowexprs import WindowAgg
+    return WindowAgg("count", _e(x) if x is not None else None)
+
+
+def window_avg(x):
+    from ..expr.windowexprs import WindowAgg
+    return WindowAgg("avg", _e(x))
+
+
+def first_value(x):
+    from ..expr.windowexprs import FirstValue
+    return FirstValue(_e(x))
+
+
+def last_value(x):
+    from ..expr.windowexprs import LastValue
+    return LastValue(_e(x))
+
+
+# datetime functions ---------------------------------------------------------
+def year(x):
+    from ..expr.datetimeexprs import Year
+    return Year(_e(x))
+
+
+def month(x):
+    from ..expr.datetimeexprs import Month
+    return Month(_e(x))
+
+
+def dayofmonth(x):
+    from ..expr.datetimeexprs import DayOfMonth
+    return DayOfMonth(_e(x))
+
+
+def dayofweek(x):
+    from ..expr.datetimeexprs import DayOfWeek
+    return DayOfWeek(_e(x))
+
+
+def dayofyear(x):
+    from ..expr.datetimeexprs import DayOfYear
+    return DayOfYear(_e(x))
+
+
+def quarter(x):
+    from ..expr.datetimeexprs import Quarter
+    return Quarter(_e(x))
+
+
+def hour(x):
+    from ..expr.datetimeexprs import Hour
+    return Hour(_e(x))
+
+
+def minute(x):
+    from ..expr.datetimeexprs import Minute
+    return Minute(_e(x))
+
+
+def second(x):
+    from ..expr.datetimeexprs import Second
+    return Second(_e(x))
+
+
+def date_add(x, n):
+    from ..expr.datetimeexprs import DateAdd
+    return DateAdd(_e(x), _e(n))
+
+
+def date_sub(x, n):
+    from ..expr.datetimeexprs import DateAdd
+    return DateAdd(_e(x), _e(n), negate=True)
+
+
+def datediff(end, start):
+    from ..expr.datetimeexprs import DateDiff
+    return DateDiff(_e(end), _e(start))
+
+
+def add_months(x, n):
+    from ..expr.datetimeexprs import AddMonths
+    return AddMonths(_e(x), _e(n))
+
+
+def last_day(x):
+    from ..expr.datetimeexprs import LastDay
+    return LastDay(_e(x))
+
+
+def trunc(x, unit):
+    from ..expr.datetimeexprs import TruncDate
+    return TruncDate(_e(x), unit)
+

@@ -158,6 +158,17 @@ class DataFrame:
                                             limit=n, offset=offset))
         return self._with(L.LogicalLimit(n, self._plan, offset))
 
+    def with_windows(self, *window_exprs) -> "DataFrame":
+        """Append window-function columns: (WindowExpression, name) pairs
+        (the pyspark F.xxx().over(w) surface)."""
+        named = []
+        for i, we in enumerate(window_exprs):
+            if isinstance(we, tuple):
+                named.append(we)
+            else:
+                named.append((we, f"{we.fn.name}_{i}"))
+        return self._with(L.LogicalWindow(named, self._plan))
+
     def union(self, other: "DataFrame") -> "DataFrame":
         return self._with(L.LogicalUnion(self._plan, other._plan))
 

@@ -32,7 +32,9 @@ from ..memory.retry import (
     TpuSplitAndRetryOOM, split_in_half_by_rows, with_retry,
 )
 from ..memory.spillable import SpillableBatch
-from ..ops.aggregate import groupby_aggregate, reduce_no_keys
+from ..ops.aggregate import (
+    groupby_aggregate, groupby_aggregate_hash, reduce_no_keys,
+)
 from ..ops.basic import active_mask, sanitize
 from ..ops.sort import string_words_for
 from ..types import DataType, LongType, Schema, StructField
@@ -66,6 +68,14 @@ class AggregateExec(TpuExec):
         # compiled kernels (cache keyed by capacity bucket + string words)
         self._jit_update = jax.jit(self._update_batch, static_argnums=(1,))
         self._jit_merge = jax.jit(self._merge_batch, static_argnums=(1,))
+        # hash-path tiers: cheap 2-round first, 6-round escalation for
+        # mid-cardinality, exact sort as the last resort
+        self._jit_update_hash = {
+            r: jax.jit(partial(self._update_batch, hash_path=True,
+                               hash_rounds=r)) for r in (2, 6)}
+        self._jit_merge_hash = {
+            r: jax.jit(partial(self._merge_batch, hash_path=True,
+                               hash_rounds=r)) for r in (2, 6)}
         self._jit_pre = jax.jit(self._pre_project)
 
         if mode == "final":
@@ -125,8 +135,8 @@ class AggregateExec(TpuExec):
     def _pre_project(self, batch: ColumnarBatch) -> ColumnarBatch:
         return eval_projection(self._pre_bound, batch, self._pre_schema)
 
-    def _update_batch(self, batch: ColumnarBatch, words: int = 4
-                      ) -> ColumnarBatch:
+    def _update_batch(self, batch: ColumnarBatch, words: int = 4,
+                      hash_path: bool = False, hash_rounds: int = 2):
         """First-pass aggregation of one pre-projected batch."""
         keys = list(batch.columns[: self._key_count])
         agg_inputs = []
@@ -136,10 +146,11 @@ class AggregateExec(TpuExec):
                     if slot is not None else None
                 agg_inputs.append((op, col))
         return self._run_groupby(keys, agg_inputs, batch,
-                                 self._buffer_schema, words)
+                                 self._buffer_schema, words, hash_path,
+                                 hash_rounds)
 
-    def _merge_batch(self, batch: ColumnarBatch, words: int = 4
-                     ) -> ColumnarBatch:
+    def _merge_batch(self, batch: ColumnarBatch, words: int = 4,
+                     hash_path: bool = False, hash_rounds: int = 2):
         """Re-aggregate a keys+buffers batch with merge ops."""
         keys = list(batch.columns[: self._key_count])
         agg_inputs = []
@@ -149,10 +160,11 @@ class AggregateExec(TpuExec):
                 agg_inputs.append((op, batch.columns[pos]))
                 pos += 1
         return self._run_groupby(keys, agg_inputs, batch,
-                                 self._buffer_schema, words)
+                                 self._buffer_schema, words, hash_path,
+                                 hash_rounds)
 
-    def _run_groupby(self, keys, agg_inputs, batch, out_schema, words: int
-                     ) -> ColumnarBatch:
+    def _run_groupby(self, keys, agg_inputs, batch, out_schema, words: int,
+                     hash_path: bool = False, hash_rounds: int = 2):
         cap = batch.capacity
         if not keys:
             # a count(*)-only aggregate has no input columns at all; give the
@@ -166,9 +178,15 @@ class AggregateExec(TpuExec):
                 cols.append(Column(
                     jnp.where(act1, data.astype(f.data_type.jnp_dtype), 0),
                     valid & act1, f.data_type))
-            return ColumnarBatch(cols, 1, out_schema)
-        out_keys, results, num_groups = groupby_aggregate(
-            keys, agg_inputs, batch.num_rows, cap, words)
+            out = ColumnarBatch(cols, 1, out_schema)
+            return (out, jnp.asarray(False)) if hash_path else out
+        leftover = None
+        if hash_path:
+            out_keys, results, num_groups, leftover = groupby_aggregate_hash(
+                keys, agg_inputs, batch.num_rows, cap, rounds=hash_rounds)
+        else:
+            out_keys, results, num_groups = groupby_aggregate(
+                keys, agg_inputs, batch.num_rows, cap, words)
         cols = list(out_keys)
         buf_fields = out_schema.fields[self._key_count:]
         for r, f in zip(results, buf_fields):
@@ -178,7 +196,8 @@ class AggregateExec(TpuExec):
                 data, valid = r[1]
                 cols.append(Column(data.astype(f.data_type.jnp_dtype),
                                    valid, f.data_type))
-        return ColumnarBatch(cols, num_groups, out_schema)
+        out = ColumnarBatch(cols, num_groups, out_schema)
+        return (out, leftover) if hash_path else out
 
     def _evaluate(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Final projection buffers -> results."""
@@ -255,11 +274,40 @@ class AggregateExec(TpuExec):
         """String-lane width for exact key ordering (host sync, pre-jit)."""
         return string_words_for(batch.columns, range(self._key_count))
 
+    @property
+    def _hash_path_ok(self) -> bool:
+        """Hash group-by handles everything except ordering aggs (min/max)
+        over strings — those need sort lanes. Both update and merge passes
+        see them as min/max over a string buffer, so checking the buffer
+        schema covers every mode."""
+        from ..types import BinaryType, StringType
+        pos = self._key_count
+        for fn, _ in self.aggregates:
+            for op in fn.merge_ops():
+                bt = self._buffer_schema.fields[pos].data_type
+                if op in ("min", "max") and isinstance(
+                        bt, (StringType, BinaryType)):
+                    return False
+                pos += 1
+        return True
+
     def _update_and_aggregate(self, batch: ColumnarBatch) -> ColumnarBatch:
         pre = self._jit_pre(batch)
+        if self._hash_path_ok:
+            for rounds in (2, 6):
+                out, leftover = self._jit_update_hash[rounds](pre)
+                if not bool(leftover):
+                    return out
+            # unresolved hash collisions: exact sort fallback (reference
+            # duality: hash primary, sort fallback)
         return self._jit_update(pre, self._key_words(pre))
 
     def _merge_jitted(self, batch: ColumnarBatch) -> ColumnarBatch:
+        if self._hash_path_ok:
+            for rounds in (2, 6):
+                out, leftover = self._jit_merge_hash[rounds](batch)
+                if not bool(leftover):
+                    return out
         return self._jit_merge(batch, self._key_words(batch))
 
     def _spill_wrap(self, fn):

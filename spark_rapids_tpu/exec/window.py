@@ -1,0 +1,244 @@
+"""WindowExec — reference GpuWindowExec.scala:146 and its specializations
+(running, double-pass, bounded, unbounded-to-unbounded). One exec here:
+every frame shape lowers to segmented scans over partition-sorted rows
+(ops/window.py), so the reference's four execution strategies collapse
+into one compiled program per window-expression set.
+
+v1 scope: whole input is windowed as one concatenated batch (the
+reference's batched/carry-over machinery is the out-of-core follow-up);
+RANGE frames support the default (UNBOUNDED PRECEDING..CURRENT ROW with
+ties) shape; bounded min/max frames route to unsupported (planner tags).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column
+from ..expr.core import Expression
+from ..expr.windowexprs import (
+    DenseRank, FirstValue, Lag, LastValue, Rank, RowNumber, WindowAgg,
+    WindowExpression, WindowFrame,
+)
+from ..ops.basic import active_mask, gather_column, sanitize
+from ..ops.sort import (
+    SortOrder, group_segment_ids, order_key_lanes, sort_permutation,
+    string_words_for,
+)
+from ..ops.window import (
+    lag_lead, rank_dense_rank, row_number, running_min_max, segment_ends,
+    segment_starts, whole_partition_broadcast, windowed_sum_count,
+)
+from ..types import DoubleType, IntegerType, LongType, Schema, StructField
+from .base import OP_TIME, TpuExec
+from .basic import bind_projection, eval_projection, projection_schema
+from .coalesce import concat_batches
+from .sort import resolve_sort_orders
+
+
+class WindowExec(TpuExec):
+    def __init__(self, window_exprs: Sequence[Tuple[WindowExpression, str]],
+                 child: TpuExec):
+        super().__init__(child)
+        self.window_exprs = list(window_exprs)
+        in_schema = child.output_schema
+        # all specs must share partition/order in one exec (the planner
+        # splits differing specs into stacked WindowExecs, like Spark)
+        spec0 = self.window_exprs[0][0].spec
+        for we, _ in self.window_exprs:
+            assert we.spec.partition_by == spec0.partition_by
+            assert we.spec.order_by == spec0.order_by
+        self.spec = spec0
+
+        # pre-projection: child cols + partition keys + order keys + inputs
+        from ..expr.core import col
+        self._pre_exprs: List[Expression] = [col(n) for n in in_schema.names]
+        self._n_child = len(in_schema.fields)
+        self._part_slots = []
+        for e in self.spec.partition_by:
+            self._part_slots.append(len(self._pre_exprs))
+            self._pre_exprs.append(e.alias(f"_wpart{len(self._part_slots)}"))
+        self._order_slots = []
+        self._order_dirs = []
+        for o in self.spec.order_by:
+            e, asc = o[0], o[1] if len(o) > 1 else True
+            nf = o[2] if len(o) > 2 else None
+            self._order_slots.append(len(self._pre_exprs))
+            self._order_dirs.append((asc, nf))
+            self._pre_exprs.append(e.alias(f"_word{len(self._order_slots)}"))
+        self._input_slots: List[List[int]] = []
+        for we, _ in self.window_exprs:
+            slots = []
+            for e in we.fn.inputs:
+                slots.append(len(self._pre_exprs))
+                self._pre_exprs.append(e.alias(f"_win{len(self._pre_exprs)}"))
+            self._input_slots.append(slots)
+        self._pre_bound = bind_projection(self._pre_exprs, in_schema)
+        self._pre_schema = projection_schema(self._pre_exprs, in_schema)
+        self._jit_window = jax.jit(self._window_kernel, static_argnums=(1,))
+        self._jit_pre = jax.jit(lambda b: eval_projection(
+            self._pre_bound, b, self._pre_schema))
+
+    @property
+    def output_schema(self) -> Schema:
+        fields = list(self.child.output_schema.fields)
+        for i, (we, name) in enumerate(self.window_exprs):
+            in_types = [self._pre_schema.fields[s].data_type
+                        for s in self._input_slots[i]]
+            fields.append(StructField(name, we.fn.result_type(in_types)))
+        return Schema(tuple(fields))
+
+    # -- kernel ------------------------------------------------------------
+    def _window_kernel(self, batch: ColumnarBatch, words: int
+                       ) -> ColumnarBatch:
+        cap = batch.capacity
+        n = batch.num_rows
+        part_cols = [batch.columns[s] for s in self._part_slots]
+        order_cols = [batch.columns[s] for s in self._order_slots]
+
+        orders = [SortOrder(s) for s in self._part_slots] + [
+            SortOrder(s, asc, nf) for s, (asc, nf)
+            in zip(self._order_slots, self._order_dirs)]
+        perm = sort_permutation(batch.columns, orders, n, cap, words)
+        sorted_cols = [gather_column(c, perm) for c in batch.columns]
+        sorted_parts = [sorted_cols[s] for s in self._part_slots]
+        sorted_orders = [sorted_cols[s] for s in self._order_slots]
+
+        if self._part_slots:
+            seg, _ = group_segment_ids(sorted_parts, n, cap, words)
+        else:
+            act = active_mask(n, cap)
+            seg = jnp.where(act, 0, cap)
+
+        # order-key boundary mask (first row of each distinct order key)
+        if self._order_slots:
+            lanes = order_key_lanes(
+                sorted_orders, [SortOrder(i) for i in range(len(sorted_orders))],
+                n, cap, words)[1:]
+            ob = jnp.zeros((cap,), jnp.bool_)
+            for lane in lanes:
+                ob = ob | (lane != jnp.roll(lane, 1))
+            ob = ob.at[0].set(True)
+            # per-row last index of its order group (for RANGE-with-ties)
+            gid = jnp.cumsum((ob | jnp.concatenate(
+                [jnp.ones(1, jnp.bool_), seg[1:] != seg[:-1]])).astype(jnp.int32)) - 1
+            gid = jnp.where(active_mask(n, cap), gid, cap)
+            positions = jnp.arange(cap, dtype=jnp.int32)
+            glast = jax.ops.segment_max(positions, gid, num_segments=cap)
+            group_last = jnp.clip(glast[jnp.clip(gid, 0, cap - 1)], 0, cap - 1)
+        else:
+            ob = None
+            group_last = None
+
+        out_cols = list(sorted_cols[: self._n_child])
+        out_schema = self.output_schema
+        for i, (we, name) in enumerate(self.window_exprs):
+            fn = we.fn
+            res_type = out_schema.fields[self._n_child + i].data_type
+            ins = [sorted_cols[s] for s in self._input_slots[i]]
+            col = self._eval_fn(fn, we.spec.frame, ins, seg, ob, group_last,
+                                n, cap, res_type)
+            out_cols.append(sanitize(col, n))
+        return ColumnarBatch(out_cols, n, out_schema)
+
+    def _eval_fn(self, fn, frame, ins, seg, order_boundary, group_last,
+                 n, cap, res_type) -> Column:
+        ones = jnp.ones((cap,), jnp.bool_)
+        if isinstance(fn, RowNumber):
+            return Column(row_number(seg, n, cap), ones, res_type)
+        if isinstance(fn, DenseRank):
+            _, dense = rank_dense_rank(order_boundary, seg, n, cap)
+            return Column(dense, ones, res_type)
+        if isinstance(fn, Rank):
+            rank, _ = rank_dense_rank(order_boundary, seg, n, cap)
+            return Column(rank, ones, res_type)
+        if isinstance(fn, Lag):  # covers Lead (negated offset)
+            out = lag_lead(ins[0], seg, n, cap, fn.offset)
+            if fn.default is not None:
+                fill = jnp.full((cap,), fn.default, out.data.dtype)
+                data = jnp.where(out.validity, out.data, fill)
+                return Column(data, ones, res_type)
+            return out
+        if isinstance(fn, LastValue):
+            idx = group_last if group_last is not None \
+                else segment_ends(seg, cap)
+            return gather_column(ins[0], idx)
+        if isinstance(fn, FirstValue):
+            return gather_column(ins[0], segment_starts(seg, cap))
+        assert isinstance(fn, WindowAgg), fn
+        # frame resolution: default = RANGE UNBOUNDED..CURRENT (with ties)
+        # when ordered, whole partition otherwise
+        range_ties = frame.kind == "default" and self._order_slots
+        if frame.kind == "default":
+            preceding, following = (None, 0) if self._order_slots \
+                else (None, None)
+        else:
+            preceding, following = frame.preceding, frame.following
+
+        values = ins[0] if ins else None
+        if fn.op in ("sum", "count", "avg"):
+            if values is None:
+                data = jnp.ones((cap,), jnp.int64)
+                valid = active_mask(n, cap)
+            else:
+                data, valid = values.data, values.validity
+            s, c = windowed_sum_count(data, valid, seg, n, cap,
+                                      preceding, following)
+            if range_ties and group_last is not None:
+                s = s[group_last]
+                c = c[group_last]
+            if fn.op == "count":
+                return Column(c.astype(jnp.int64), ones, res_type)
+            if fn.op == "avg":
+                ok = c > 0
+                d = s.astype(jnp.float64) / jnp.where(ok, c, 1)
+                return Column(jnp.where(ok, d, 0.0), ok, res_type)
+            return Column(s.astype(res_type.jnp_dtype), c > 0, res_type)
+        # min/max
+        if preceding is None and following is None:
+            neutral_is_max = fn.op == "max"
+            # whole partition: segment reduce + broadcast
+            from .aggregate import groupby_aggregate  # reuse reduce machinery
+            red_fn = jax.ops.segment_max if fn.op == "max" \
+                else jax.ops.segment_min
+            vals = values.data
+            if jnp.issubdtype(vals.dtype, jnp.floating):
+                neutral = jnp.full((), -jnp.inf if fn.op == "max" else jnp.inf,
+                                   vals.dtype)
+            else:
+                info = jnp.iinfo(vals.dtype)
+                neutral = jnp.full((), info.min if fn.op == "max"
+                                   else info.max, vals.dtype)
+            act = active_mask(n, cap)
+            v = jnp.where(values.validity & act, vals, neutral)
+            red = red_fn(v, seg, num_segments=cap)
+            cnt = jax.ops.segment_sum((values.validity & act).astype(jnp.int32),
+                                      seg, num_segments=cap)
+            data = whole_partition_broadcast(red, seg, cap)
+            valid = whole_partition_broadcast(cnt, seg, cap) > 0
+            return Column(data, valid, res_type)
+        if preceding is None and following == 0:
+            data, valid = running_min_max(values.data, values.validity, seg,
+                                          n, cap, fn.op == "max")
+            if range_ties and group_last is not None:
+                data = data[group_last]
+                valid = valid[group_last]
+            return Column(data.astype(values.data.dtype), valid, res_type)
+        raise NotImplementedError(
+            f"bounded {fn.op} frames need the sliding min/max kernel; "
+            "planner must tag unsupported")
+
+    # -- drive -------------------------------------------------------------
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        with self.metrics[OP_TIME].ns_timer():
+            batches = [self._jit_pre(b) for b in self.child.execute()]
+            if not batches:
+                return
+            merged = concat_batches(batches, self._pre_schema)
+            words = string_words_for(
+                merged.columns, self._part_slots + self._order_slots)
+            yield self._jit_window(merged, words)

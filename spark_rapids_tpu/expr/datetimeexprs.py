@@ -1,0 +1,185 @@
+"""Datetime expressions (reference datetimeExpressions.scala; kernels in
+ops/datetime_ops.py use the Howard Hinnant civil-calendar algorithms the
+reference gets from cuDF). Dates are int32 days since epoch; timestamps
+int64 microseconds UTC (Spark's physical encodings)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column
+from ..ops import datetime_ops as dt
+from ..types import DateType, IntegerType, TimestampType
+from .core import Expression, lit
+
+
+class _UnaryDatetime(Expression):
+    out_type = IntegerType()
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return self.out_type
+
+    def with_children(self, cs):
+        return type(self)(cs[0])
+
+    def _days(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        if isinstance(c.dtype, TimestampType):
+            return dt.timestamp_to_date_days(c.data), c.validity
+        return c.data, c.validity
+
+    def columnar_eval(self, batch: ColumnarBatch) -> Column:
+        days, valid = self._days(batch)
+        return Column(self.kernel(days).astype(jnp.int32), valid,
+                      self.out_type)
+
+    kernel = None
+
+
+class Year(_UnaryDatetime):
+    kernel = staticmethod(dt.extract_year)
+
+
+class Month(_UnaryDatetime):
+    kernel = staticmethod(dt.extract_month)
+
+
+class DayOfMonth(_UnaryDatetime):
+    kernel = staticmethod(dt.extract_day)
+
+
+class DayOfWeek(_UnaryDatetime):
+    kernel = staticmethod(dt.extract_dayofweek)
+
+
+class DayOfYear(_UnaryDatetime):
+    kernel = staticmethod(dt.extract_dayofyear)
+
+
+class Quarter(_UnaryDatetime):
+    kernel = staticmethod(dt.extract_quarter)
+
+
+class _TimePart(Expression):
+    """hour/minute/second need the raw microseconds, not days."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return IntegerType()
+
+    def with_children(self, cs):
+        return type(self)(cs[0])
+
+    def columnar_eval(self, batch):
+        c = self.children[0].columnar_eval(batch)
+        return Column(self.kernel(c.data).astype(jnp.int32), c.validity,
+                      IntegerType())
+
+    kernel = None
+
+
+class Hour(_TimePart):
+    kernel = staticmethod(dt.extract_hour)
+
+
+class Minute(_TimePart):
+    kernel = staticmethod(dt.extract_minute)
+
+
+class Second(_TimePart):
+    kernel = staticmethod(dt.extract_second)
+
+
+class LastDay(_UnaryDatetime):
+    out_type = DateType()
+    kernel = staticmethod(dt.last_day)
+
+
+class DateAdd(Expression):
+    """date_add(date, n) / date_sub via negated n."""
+
+    def __init__(self, date: Expression, n: Expression, negate: bool = False):
+        self.children = (date, n)
+        self.negate = negate
+
+    @property
+    def data_type(self):
+        return DateType()
+
+    def with_children(self, cs):
+        return DateAdd(cs[0], cs[1], self.negate)
+
+    def columnar_eval(self, batch):
+        d = self.children[0].columnar_eval(batch)
+        n = self.children[1].columnar_eval(batch)
+        delta = -n.data if self.negate else n.data
+        return Column(dt.date_add(d.data, delta).astype(jnp.int32),
+                      d.validity & n.validity, DateType())
+
+    def _semantic_args(self):
+        return (self.negate,)
+
+
+class DateDiff(Expression):
+    def __init__(self, end: Expression, start: Expression):
+        self.children = (end, start)
+
+    @property
+    def data_type(self):
+        return IntegerType()
+
+    def with_children(self, cs):
+        return DateDiff(cs[0], cs[1])
+
+    def columnar_eval(self, batch):
+        e = self.children[0].columnar_eval(batch)
+        s = self.children[1].columnar_eval(batch)
+        return Column(dt.date_diff(e.data, s.data).astype(jnp.int32),
+                      e.validity & s.validity, IntegerType())
+
+
+class AddMonths(Expression):
+    def __init__(self, date: Expression, n: Expression):
+        self.children = (date, n)
+
+    @property
+    def data_type(self):
+        return DateType()
+
+    def with_children(self, cs):
+        return AddMonths(cs[0], cs[1])
+
+    def columnar_eval(self, batch):
+        d = self.children[0].columnar_eval(batch)
+        n = self.children[1].columnar_eval(batch)
+        return Column(dt.add_months(d.data, n.data).astype(jnp.int32),
+                      d.validity & n.validity, DateType())
+
+
+class TruncDate(Expression):
+    def __init__(self, date: Expression, unit: str):
+        self.children = (date,)
+        self.unit = unit.lower()
+
+    @property
+    def data_type(self):
+        return DateType()
+
+    def with_children(self, cs):
+        return TruncDate(cs[0], self.unit)
+
+    def columnar_eval(self, batch):
+        d = self.children[0].columnar_eval(batch)
+        return Column(dt.trunc_date(d.data, self.unit).astype(jnp.int32),
+                      d.validity, DateType())
+
+    def _semantic_args(self):
+        return (self.unit,)

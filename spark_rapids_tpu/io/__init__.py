@@ -1,0 +1,14 @@
+"""IO layer (reference §2.6): multi-file readers and writers.
+
+Architecture note vs the reference: cuDF decodes parquet/ORC bytes ON the
+GPU (Table.readParquet, GpuParquetScan.scala:2619). TPUs expose no byte-
+level device decode path, so file formats decode on the HOST (pyarrow's
+vectorized C++ readers) into pinned buffers and upload as device columns —
+while keeping the reference's performance-critical structure: the
+MULTITHREADED cloud-reader pattern (parallel fetch+decode ahead of the
+device pipeline, GpuMultiFileReader.scala:345) and row-group-granular
+slicing so batches hit the target size."""
+
+from .parquet import ParquetSource, write_parquet  # noqa: F401
+from .csv import CsvSource  # noqa: F401
+from .json import JsonSource  # noqa: F401

@@ -1,0 +1,60 @@
+"""CSV scan (reference GpuCSVScan.scala / GpuTextBasedPartitionReader.scala:
+host line framing + device parse; here pyarrow's C++ CSV reader does the
+framing+parse on the prefetch pool, producing device columns)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from ..columnar.batch import ColumnarBatch
+from ..config import RapidsConf
+from ..types import Schema, StructField, from_arrow, to_arrow
+from .multifile import arrow_to_batches, expand_paths, threaded_chunks
+from .parquet import DEFAULT_BATCH_ROWS, DEFAULT_NUM_THREADS
+
+
+class CsvSource:
+    def __init__(self, path, conf: Optional[RapidsConf] = None,
+                 schema: Optional[Schema] = None, header: bool = True,
+                 delimiter: str = ",",
+                 num_threads: int = DEFAULT_NUM_THREADS,
+                 batch_rows: int = DEFAULT_BATCH_ROWS):
+        self.paths = expand_paths(path)
+        assert self.paths, f"no csv files at {path!r}"
+        self.header = header
+        self.delimiter = delimiter
+        self.num_threads = num_threads
+        self.batch_rows = batch_rows
+        self._user_schema = schema
+        if schema is not None:
+            self.schema = schema
+        else:
+            table = self._read_one(self.paths[0])
+            self.schema = Schema(tuple(
+                StructField(f.name, from_arrow(f.type), f.nullable)
+                for f in table.schema))
+
+    def _read_one(self, path):
+        import pyarrow.csv as pacsv
+        read_opts = pacsv.ReadOptions(
+            autogenerate_column_names=not self.header,
+            column_names=None if self.header else
+            (list(self._user_schema.names) if self._user_schema else None))
+        parse_opts = pacsv.ParseOptions(delimiter=self.delimiter)
+        # Spark CSV semantics: empty field -> null (also for strings)
+        convert = pacsv.ConvertOptions(strings_can_be_null=True)
+        if self._user_schema is not None:
+            convert = pacsv.ConvertOptions(
+                strings_can_be_null=True,
+                column_types={f.name: to_arrow(f.data_type)
+                              for f in self._user_schema.fields})
+        return pacsv.read_csv(path, read_options=read_opts,
+                              parse_options=parse_opts,
+                              convert_options=convert)
+
+    def batches(self) -> Iterator[ColumnarBatch]:
+        tasks = [lambda p=p: self._read_one(p) for p in self.paths]
+        for table in threaded_chunks(tasks, self.num_threads):
+            if self._user_schema is not None:
+                table = table.select(list(self._user_schema.names))
+            yield from arrow_to_batches(table, self.batch_rows)

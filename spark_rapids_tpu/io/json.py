@@ -1,0 +1,49 @@
+"""JSON-lines scan (reference GpuJsonReadCommon.scala / JSON scan in L3:
+host line framing + device parse via JSONUtils JNI; here pyarrow's C++
+JSON reader on the prefetch pool)."""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from ..columnar.batch import ColumnarBatch
+from ..config import RapidsConf
+from ..types import Schema, StructField, from_arrow, to_arrow
+from .multifile import arrow_to_batches, expand_paths, threaded_chunks
+from .parquet import DEFAULT_BATCH_ROWS, DEFAULT_NUM_THREADS
+
+
+class JsonSource:
+    def __init__(self, path, conf: Optional[RapidsConf] = None,
+                 schema: Optional[Schema] = None,
+                 num_threads: int = DEFAULT_NUM_THREADS,
+                 batch_rows: int = DEFAULT_BATCH_ROWS):
+        self.paths = expand_paths(path)
+        assert self.paths, f"no json files at {path!r}"
+        self.num_threads = num_threads
+        self.batch_rows = batch_rows
+        self._user_schema = schema
+        if schema is not None:
+            self.schema = schema
+        else:
+            table = self._read_one(self.paths[0])
+            self.schema = Schema(tuple(
+                StructField(f.name, from_arrow(f.type), f.nullable)
+                for f in table.schema))
+
+    def _read_one(self, path):
+        import pyarrow.json as pajson
+        parse = None
+        if self._user_schema is not None:
+            import pyarrow as pa
+            parse = pajson.ParseOptions(explicit_schema=pa.schema(
+                [(f.name, to_arrow(f.data_type))
+                 for f in self._user_schema.fields]))
+        return pajson.read_json(path, parse_options=parse)
+
+    def batches(self) -> Iterator[ColumnarBatch]:
+        tasks = [lambda p=p: self._read_one(p) for p in self.paths]
+        for table in threaded_chunks(tasks, self.num_threads):
+            if self._user_schema is not None:
+                table = table.select(list(self._user_schema.names))
+            yield from arrow_to_batches(table, self.batch_rows)

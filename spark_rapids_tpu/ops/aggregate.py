@@ -192,6 +192,66 @@ def _pick_string_pos(op, lanes, valid, seg, capacity, positions):
     return jnp.where(ok, sorted_pos[safe], jnp.int32(capacity))
 
 
+def groupby_aggregate_hash(key_columns: Sequence[Column],
+                           agg_inputs: Sequence[Tuple[str, Optional[Column]]],
+                           num_rows, capacity: int, rounds: int = 2,
+                           ):
+    """Hash-path group-by (ops/hashagg.py): no sort; returns the same
+    (keys, results, num_groups) plus a `leftover` device flag the exec
+    must host-check — True means unresolved collisions and the caller
+    must re-run the exact sort-based kernel instead.
+
+    Not supported here: min/max over string inputs (they need ordering
+    lanes; the exec routes those plans to the sort path statically).
+    """
+    from .hashagg import dense_group_ids, hash_group_assignment
+
+    seg_slots, rep_row, leftover = hash_group_assignment(
+        key_columns, num_rows, capacity, rounds)
+    seg, group_rep, num_groups = dense_group_ids(seg_slots, rep_row,
+                                                 capacity, rounds)
+    act = active_mask(num_rows, capacity)
+    positions = jnp.arange(capacity, dtype=jnp.int32)
+    group_act = active_mask(num_groups, capacity)
+
+    results = []
+    for op, col in agg_inputs:
+        if col is None:
+            data, valid = _segment_reduce("count_star", positions, act, seg,
+                                          capacity, positions)
+        else:
+            if isinstance(col, StringColumn):
+                if op in ("first", "last", "any_value"):
+                    valid = col.validity
+                    if op == "last":
+                        p = jnp.where(valid, positions, -1)
+                        pick = jax.ops.segment_max(p, seg,
+                                                   num_segments=capacity)
+                    else:
+                        p = jnp.where(valid, positions, capacity)
+                        pick = jax.ops.segment_min(p, seg,
+                                                   num_segments=capacity)
+                    ok = (pick >= 0) & (pick < capacity)
+                    safe = jnp.clip(pick, 0, capacity - 1)
+                    out = gather_column(col, safe, out_valid=ok & group_act)
+                    results.append(("col", out))
+                    continue
+                raise NotImplementedError(
+                    f"string agg {op} requires the sort path")
+            data, valid = _segment_reduce(op, col.data, col.validity & act,
+                                          seg, capacity, positions)
+        valid = valid & group_act
+        data = jnp.where(group_act, data, jnp.zeros((), data.dtype))
+        results.append(("raw", (data, valid)))
+
+    out_keys = [gather_column(c, jnp.clip(group_rep, 0, capacity - 1),
+                              out_valid=(group_rep < capacity)
+                              & c.validity[jnp.clip(group_rep, 0,
+                                                    capacity - 1)])
+                for c in key_columns]
+    return out_keys, results, num_groups, leftover
+
+
 def reduce_no_keys(agg_inputs: Sequence[Tuple[str, Optional[Column]]],
                    num_rows, capacity: int):
     """Grand aggregate (no GROUP BY): one output row, still static shapes."""

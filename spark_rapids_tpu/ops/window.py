@@ -1,0 +1,165 @@
+"""Window kernels — device core of the reference's window stack
+(window/GpuWindowExec.scala:146, GpuRunningWindowExec.scala:220,
+GpuUnboundedToUnboundedAggWindowExec.scala, BasicWindowCalc.scala).
+
+TPU-first: all frames lower to *segmented scans and prefix differences*
+over partition-sorted data:
+  * running frames (UNBOUNDED PRECEDING..CURRENT ROW) -> segmented
+    cumulative ops (cumsum / associative_scan with a segment-reset carry);
+  * whole-partition frames -> segment reduce + gather-back;
+  * ROWS bounded frames (sum/count/avg) -> prefix[i+b] - prefix[i-a-1];
+  * rank family -> positions relative to segment starts and order-key
+    boundaries;
+  * lag/lead -> shifted gather guarded by segment membership.
+The reference implements these as four separate exec strategies over cuDF
+window kernels; on TPU one segmented-prefix formulation covers them all
+and XLA fuses the scans with the surrounding arithmetic.
+
+All kernels assume rows are already sorted by (partition, order) with
+segment ids precomputed (ops/sort.py group_segment_ids) and inactive rows
+at the tail.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column
+from .basic import active_mask
+
+
+def segment_starts(seg, capacity: int):
+    """first row index of each row's segment: gather of segment-min pos."""
+    positions = jnp.arange(capacity, dtype=jnp.int32)
+    first = jax.ops.segment_min(positions, seg, num_segments=capacity)
+    safe = jnp.clip(seg, 0, capacity - 1)
+    return jnp.clip(first[safe], 0, capacity - 1)
+
+
+def segment_ends(seg, capacity: int):
+    """last row index of each row's segment."""
+    positions = jnp.arange(capacity, dtype=jnp.int32)
+    last = jax.ops.segment_max(positions, seg, num_segments=capacity)
+    safe = jnp.clip(seg, 0, capacity - 1)
+    return jnp.clip(last[safe], 0, capacity - 1)
+
+
+def _prefix_sum_exclusive(values):
+    """exclusive prefix sum along the row axis."""
+    return jnp.concatenate([jnp.zeros((1,), values.dtype),
+                            jnp.cumsum(values)[:-1]])
+
+
+def windowed_sum_count(values, validity, seg, num_rows, capacity: int,
+                       preceding: Optional[int], following: Optional[int]):
+    """sum+count over a ROWS frame [i-preceding, i+following] clipped to the
+    segment; None means unbounded on that side. Returns (sum f64/i64,
+    count i32) per row. This one kernel backs sum/count/avg for every
+    frame shape via prefix differences."""
+    act = active_mask(num_rows, capacity)
+    v = jnp.where(validity & act, values, jnp.zeros((), values.dtype))
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        v = v.astype(jnp.float64)
+    else:
+        v = v.astype(jnp.int64)
+    c = (validity & act).astype(jnp.int32)
+    # pv_full has capacity+1 entries: pv_full[i] = sum of rows < i
+    pv_full = jnp.concatenate([jnp.zeros((1,), v.dtype), jnp.cumsum(v)])
+    pc_full = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(c, dtype=jnp.int32)])
+    i = jnp.arange(capacity, dtype=jnp.int32)
+    start_seg = segment_starts(seg, capacity)
+    end_seg = segment_ends(seg, capacity)
+    lo = start_seg if preceding is None else jnp.maximum(
+        start_seg, i - preceding)
+    hi = end_seg if following is None else jnp.minimum(
+        end_seg, i + following)
+    hi = jnp.maximum(hi, lo - 1)
+    # inclusive window [lo, hi]: prefix at hi+1 minus prefix at lo
+    s = pv_full[jnp.clip(hi + 1, 0, capacity)] - \
+        pv_full[jnp.clip(lo, 0, capacity)]
+    n = pc_full[jnp.clip(hi + 1, 0, capacity)] - \
+        pc_full[jnp.clip(lo, 0, capacity)]
+    return s, n.astype(jnp.int32)
+
+
+def running_min_max(values, validity, seg, num_rows, capacity: int,
+                    is_max: bool):
+    """segmented running min/max (UNBOUNDED PRECEDING..CURRENT ROW) via
+    associative_scan with a segment-reset combine."""
+    act = active_mask(num_rows, capacity)
+    valid = validity & act
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        neutral = jnp.full((), -jnp.inf if is_max else jnp.inf, values.dtype)
+    elif values.dtype == jnp.bool_:
+        values = values.astype(jnp.int8)
+        neutral = jnp.int8(0 if is_max else 1)
+    else:
+        info = jnp.iinfo(values.dtype)
+        neutral = jnp.full((), info.min if is_max else info.max, values.dtype)
+    v = jnp.where(valid, values, neutral)
+    is_start = jnp.concatenate([jnp.ones(1, jnp.bool_),
+                                seg[1:] != seg[:-1]])
+
+    def combine(a, b):
+        av, aflag, acnt = a
+        bv, bflag, bcnt = b
+        op = jnp.maximum if is_max else jnp.minimum
+        nv = jnp.where(bflag, bv, op(av, bv))
+        ncnt = jnp.where(bflag, bcnt, acnt + bcnt)
+        return nv, aflag | bflag, ncnt
+    cnt = valid.astype(jnp.int32)
+    out_v, _, out_c = jax.lax.associative_scan(
+        combine, (v, is_start, cnt))
+    return out_v, out_c > 0
+
+
+def row_number(seg, num_rows, capacity: int):
+    i = jnp.arange(capacity, dtype=jnp.int32)
+    return i - segment_starts(seg, capacity) + 1
+
+
+def rank_dense_rank(order_boundary, seg, num_rows, capacity: int):
+    """(rank, dense_rank) from the order-key boundary mask (True at the
+    first row of each distinct order key within its segment, which the
+    caller builds from sort lanes)."""
+    i = jnp.arange(capacity, dtype=jnp.int32)
+    start = segment_starts(seg, capacity)
+    # rank: index (within segment) of the first row of my order group + 1
+    seg_start_flag = jnp.concatenate([jnp.ones(1, jnp.bool_),
+                                      seg[1:] != seg[:-1]])
+    boundary = order_boundary | seg_start_flag
+
+    def combine(a, b):
+        apos, aflag = a
+        bpos, bflag = b
+        return jnp.where(bflag, bpos, apos), aflag | bflag
+    group_first, _ = jax.lax.associative_scan(
+        combine, (i, boundary))
+    rank = group_first - start + 1
+    # dense rank: boundaries in my segment up to & including me
+    pb = jnp.cumsum(boundary.astype(jnp.int32))  # inclusive
+    dense = pb - (pb[start] - boundary[start].astype(jnp.int32))
+    return rank, dense
+
+
+def lag_lead(col: Column, seg, num_rows, capacity: int, offset: int,
+             default_value=None):
+    """lag (offset>0 looks back) / lead (offset<0) within the segment."""
+    i = jnp.arange(capacity, dtype=jnp.int32)
+    src = i - offset
+    in_range = (src >= 0) & (src < capacity)
+    safe = jnp.clip(src, 0, capacity - 1)
+    same_seg = in_range & (seg[safe] == seg)
+    from .basic import gather_column
+    out = gather_column(col, jnp.where(same_seg, safe, -1))
+    return out
+
+
+def whole_partition_broadcast(reduced, seg, capacity: int):
+    """gather a per-segment reduction back to every row of the segment."""
+    safe = jnp.clip(seg, 0, capacity - 1)
+    return reduced[safe]

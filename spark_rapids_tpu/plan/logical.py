@@ -189,3 +189,21 @@ class LogicalExpand(LogicalPlan):
         from ..exec.basic import projection_schema
         return projection_schema(self.projections[0],
                                  self.children[0].schema)
+
+
+class LogicalWindow(LogicalPlan):
+    def __init__(self, window_exprs, child: LogicalPlan):
+        self.window_exprs = list(window_exprs)
+        self.children = (child,)
+
+    @property
+    def schema(self) -> Schema:
+        from ..exec.basic import InMemoryScanExec
+        from ..exec.window import WindowExec
+        probe = WindowExec(self.window_exprs,
+                           InMemoryScanExec([], self.children[0].schema))
+        return probe.output_schema
+
+    def describe(self):
+        return "Window [" + ", ".join(
+            f"{we!r} AS {n}" for we, n in self.window_exprs) + "]"

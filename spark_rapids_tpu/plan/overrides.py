@@ -24,8 +24,9 @@ from ..exec.basic import (
 from ..exec.coalesce import CoalesceBatchesExec
 from ..exec.joins import HashJoinExec, NestedLoopJoinExec
 from ..exec.sort import SortExec, TopNExec
-from ..expr import arithmetic, cast, conditional, hashexprs, math as emath, \
-    predicates, stringexprs
+from ..exec.window import WindowExec
+from ..expr import arithmetic, cast, conditional, datetimeexprs, \
+    hashexprs, math as emath, predicates, stringexprs
 from ..expr.core import (
     Alias, BoundReference, Expression, Literal, UnresolvedAttribute, resolve,
 )
@@ -96,6 +97,22 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
     _r(rules, conditional.NaNvl, "NaN replacement", fp, fp)
     # cast
     _r(rules, cast.Cast, "type cast")
+    # datetime
+    dtsig = TypeSig.of("DATE", "TIMESTAMP", "TIMESTAMP_NTZ")
+    for c in (datetimeexprs.Year, datetimeexprs.Month,
+              datetimeexprs.DayOfMonth, datetimeexprs.DayOfWeek,
+              datetimeexprs.DayOfYear, datetimeexprs.Quarter):
+        _r(rules, c, "date part extraction", dtsig, integral)
+    for c in (datetimeexprs.Hour, datetimeexprs.Minute,
+              datetimeexprs.Second):
+        _r(rules, c, "time part extraction",
+           TypeSig.of("TIMESTAMP", "TIMESTAMP_NTZ"), integral)
+    _r(rules, datetimeexprs.DateAdd, "date_add/date_sub",
+       dtsig + integral, dtsig)
+    _r(rules, datetimeexprs.DateDiff, "datediff", dtsig, integral)
+    _r(rules, datetimeexprs.AddMonths, "add_months", dtsig + integral, dtsig)
+    _r(rules, datetimeexprs.LastDay, "last_day", dtsig, dtsig)
+    _r(rules, datetimeexprs.TruncDate, "trunc", dtsig, dtsig)
     # math
     for c in (emath.UnaryMath, emath.Pow, emath.Atan, emath.Floor,
               emath.Ceil, emath.Round, emath.BRound):
@@ -151,6 +168,14 @@ class PlanMeta(BaseMeta):
             for o in p.orders:
                 out.append(o[0] if isinstance(o, tuple) else o)
             return [e for e in out if isinstance(e, Expression)]
+        if isinstance(p, L.LogicalWindow):
+            out = []
+            for we, _ in p.window_exprs:
+                out.extend(we.fn.inputs)
+                out.extend(we.spec.partition_by)
+                for o in we.spec.order_by:
+                    out.append(o[0])
+            return out
         return []
 
     def tag_for_tpu(self):
@@ -215,6 +240,8 @@ class PlanMeta(BaseMeta):
             return UnionExec(*kids)
         if isinstance(p, L.LogicalExpand):
             return ExpandExec(p.projections, kids[0])
+        if isinstance(p, L.LogicalWindow):
+            return WindowExec(p.window_exprs, kids[0])
         if isinstance(p, L.LogicalJoin):
             if not p.left_keys:
                 return NestedLoopJoinExec(kids[0], kids[1], p.join_type,

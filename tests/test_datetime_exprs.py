@@ -1,0 +1,84 @@
+"""Datetime expression tests vs Python's datetime oracle."""
+
+import datetime as pydt
+
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.types import DATE, INT, Schema, StructField
+
+DATES = [pydt.date(2024, 2, 29), pydt.date(1999, 12, 31),
+         pydt.date(1970, 1, 1), None, pydt.date(2025, 7, 4),
+         pydt.date(1969, 3, 15)]
+
+
+@pytest.fixture
+def df():
+    s = TpuSession()
+    return s.from_pydict(
+        {"d": [None if d is None else (d - pydt.date(1970, 1, 1)).days
+               for d in DATES],
+         "n": [1, 2, 3, 4, 5, 6]},
+        Schema((StructField("d", DATE), StructField("n", INT))))
+
+
+def test_extract_parts(df):
+    got = df.select(F.year("d"), F.month("d"), F.dayofmonth("d"),
+                    F.quarter("d"), F.dayofyear("d")).collect()
+    for row, d in zip(got, DATES):
+        if d is None:
+            assert row == (None,) * 5
+        else:
+            assert row == (d.year, d.month, d.day, (d.month - 1) // 3 + 1,
+                           d.timetuple().tm_yday)
+
+
+def test_dayofweek_spark_semantics(df):
+    # Spark dayofweek: 1=Sunday..7=Saturday
+    got = [r[0] for r in df.select(F.dayofweek("d")).collect()]
+    for g, d in zip(got, DATES):
+        if d is None:
+            assert g is None
+        else:
+            assert g == (d.isoweekday() % 7) + 1
+
+
+def test_date_add_sub_diff(df):
+    got = df.select(F.date_add("d", 10), F.date_sub("d", 10),
+                    F.datediff("d", F.lit(0).cast(DATE))).collect()
+    for row, d in zip(got, DATES):
+        if d is None:
+            assert row == (None, None, None)
+        else:
+            epoch = pydt.date(1970, 1, 1)
+            assert row[0] == (d - epoch).days + 10
+            assert row[1] == (d - epoch).days - 10
+            assert row[2] == (d - epoch).days
+
+
+def test_add_months_and_last_day(df):
+    got = df.select(F.add_months("d", 1), F.last_day("d")).collect()
+    epoch = pydt.date(1970, 1, 1)
+    for row, d in zip(got, DATES):
+        if d is None:
+            assert row == (None, None)
+            continue
+        y, m = (d.year, d.month + 1) if d.month < 12 else (d.year + 1, 1)
+        import calendar
+        day = min(d.day, calendar.monthrange(y, m)[1])
+        assert row[0] == (pydt.date(y, m, day) - epoch).days
+        last = pydt.date(d.year, d.month,
+                         calendar.monthrange(d.year, d.month)[1])
+        assert row[1] == (last - epoch).days
+
+
+def test_trunc(df):
+    got = df.select(F.trunc("d", "year"), F.trunc("d", "month")).collect()
+    epoch = pydt.date(1970, 1, 1)
+    for row, d in zip(got, DATES):
+        if d is None:
+            assert row == (None, None)
+            continue
+        assert row[0] == (pydt.date(d.year, 1, 1) - epoch).days
+        assert row[1] == (pydt.date(d.year, d.month, 1) - epoch).days

@@ -1,0 +1,171 @@
+"""WindowExec tests against hand oracles: running/unbounded/bounded frames,
+rank family, lag/lead, ties under the default RANGE frame."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.basic import InMemoryScanExec
+from spark_rapids_tpu.exec.window import WindowExec
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.expr.windowexprs import (
+    DenseRank, FirstValue, Lag, LastValue, Lead, Rank, RowNumber, WindowAgg,
+    WindowFrame, window,
+)
+from spark_rapids_tpu.types import (
+    DOUBLE, INT, LONG, STRING, Schema, StructField,
+)
+
+SCHEMA = Schema((StructField("p", STRING), StructField("o", INT),
+                 StructField("v", INT)))
+DATA = {
+    "p": ["a", "a", "a", "b", "b", "a", "b"],
+    "o": [1, 2, 2, 1, 3, 3, 2],
+    "v": [10, 20, 30, 5, 15, 40, None],
+}
+
+
+def scan(data=DATA, schema=SCHEMA, split=0):
+    n = len(next(iter(data.values())))
+    if split:
+        batches = [ColumnarBatch.from_pydict(
+            {k: v[s:s + split] for k, v in data.items()}, schema)
+            for s in range(0, n, split)]
+    else:
+        batches = [ColumnarBatch.from_pydict(data, schema)]
+    return InMemoryScanExec(batches, schema)
+
+
+def rows_by_key(rows):
+    return {(r[0], r[1], r[2]): r[3:] for r in rows}
+
+
+def test_row_number_and_ranks():
+    spec = window(partition_by=["p"], order_by=["o"])
+    plan = WindowExec([(RowNumber().over(spec), "rn"),
+                       (Rank().over(spec), "rk"),
+                       (DenseRank().over(spec), "dr")], scan())
+    got = plan.collect()
+    # partition a sorted by o: (1,10) (2,20) (2,30) (3,40)
+    a = [r for r in got if r[0] == "a"]
+    assert [(r[1], r[3], r[4], r[5]) for r in a] == [
+        (1, 1, 1, 1), (2, 2, 2, 2), (2, 3, 2, 2), (3, 4, 4, 3)]
+    b = [r for r in got if r[0] == "b"]
+    assert [(r[1], r[3], r[4], r[5]) for r in b] == [
+        (1, 1, 1, 1), (2, 2, 2, 2), (3, 3, 3, 3)]
+
+
+def test_running_sum_with_ties():
+    # default frame: RANGE UNBOUNDED..CURRENT ROW -> ties share the value
+    spec = window(partition_by=["p"], order_by=["o"])
+    plan = WindowExec([(WindowAgg("sum", col("v")).over(spec), "rs")],
+                      scan(split=3))
+    got = [r for r in plan.collect() if r[0] == "a"]
+    assert [r[3] for r in got] == [10, 60, 60, 100]
+
+
+def test_rows_running_sum_no_ties_semantics():
+    spec = window(partition_by=["p"], order_by=["o"],
+                  frame=WindowFrame.rows(None, 0))
+    plan = WindowExec([(WindowAgg("sum", col("v")).over(spec), "rs")],
+                      scan())
+    got = [r for r in plan.collect() if r[0] == "a"]
+    assert [r[3] for r in got] == [10, 30, 60, 100]
+
+
+def test_whole_partition_agg():
+    spec = window(partition_by=["p"])
+    plan = WindowExec([(WindowAgg("sum", col("v")).over(spec), "t"),
+                       (WindowAgg("count", col("v")).over(spec), "c"),
+                       (WindowAgg("max", col("v")).over(spec), "mx")],
+                      scan())
+    for r in plan.collect():
+        if r[0] == "a":
+            assert r[3:] == (100, 4, 40)
+        else:
+            assert r[3:] == (20, 2, 15)  # 5+15, None excluded
+
+
+def test_bounded_rows_frame_sum():
+    spec = window(partition_by=["p"], order_by=["o"],
+                  frame=WindowFrame.rows(1, 1))
+    plan = WindowExec([(WindowAgg("sum", col("v")).over(spec), "s")],
+                      scan())
+    a = [r[3] for r in plan.collect() if r[0] == "a"]
+    # sorted a rows: 10,20,30,40 -> windows: 30,60,90,70
+    assert a == [30, 60, 90, 70]
+
+
+def test_running_min_max():
+    spec = window(partition_by=["p"], order_by=["o"],
+                  frame=WindowFrame.rows(None, 0))
+    data = {"p": ["x"] * 5, "o": [1, 2, 3, 4, 5], "v": [3, 1, None, 5, 2]}
+    plan = WindowExec([(WindowAgg("min", col("v")).over(spec), "mn"),
+                       (WindowAgg("max", col("v")).over(spec), "mx")],
+                      scan(data))
+    got = plan.collect()
+    assert [r[3] for r in got] == [3, 1, 1, 1, 1]
+    assert [r[4] for r in got] == [3, 3, 3, 5, 5]
+
+
+def test_lag_lead():
+    spec = window(partition_by=["p"], order_by=["o"])
+    plan = WindowExec([(Lag(col("v"), 1).over(spec), "lg"),
+                       (Lead(col("v"), 1).over(spec), "ld")], scan())
+    a = [r for r in plan.collect() if r[0] == "a"]
+    assert [r[3] for r in a] == [None, 10, 20, 30]
+    assert [r[4] for r in a] == [20, 30, 40, None]
+
+
+def test_lag_default_value():
+    spec = window(partition_by=["p"], order_by=["o"])
+    data = {"p": ["x", "x"], "o": [1, 2], "v": [7, 8]}
+    plan = WindowExec([(Lag(col("v"), 1, default=-1).over(spec), "lg")],
+                      scan(data))
+    assert [r[3] for r in plan.collect()] == [-1, 7]
+
+
+def test_first_last_value():
+    spec = window(partition_by=["p"], order_by=["o"])
+    plan = WindowExec([(FirstValue(col("v")).over(spec), "fv"),
+                       (LastValue(col("v")).over(spec), "lv")], scan())
+    a = [r for r in plan.collect() if r[0] == "a"]
+    assert [r[3] for r in a] == [10, 10, 10, 10]
+    # default frame last_value = end of current order group (ties)
+    assert [r[4] for r in a] == [10, 30, 30, 40]
+
+
+def test_no_partition_window():
+    spec = window(order_by=["o"])
+    data = {"p": ["x", "y", "z"], "o": [3, 1, 2], "v": [1, 2, 3]}
+    plan = WindowExec([(RowNumber().over(spec), "rn")], scan(data))
+    got = {r[1]: r[3] for r in plan.collect()}
+    assert got == {1: 1, 2: 2, 3: 3}
+
+
+def test_avg_window():
+    spec = window(partition_by=["p"])
+    plan = WindowExec([(WindowAgg("avg", col("v")).over(spec), "av")],
+                      scan())
+    for r in plan.collect():
+        if r[0] == "a":
+            assert r[3] == pytest.approx(25.0)
+        else:
+            assert r[3] == pytest.approx(10.0)
+
+
+def test_window_via_dataframe_api():
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.expr.windowexprs import window
+    s = TpuSession()
+    d = s.from_pydict(DATA, SCHEMA)
+    w = window(partition_by=["p"], order_by=["o"])
+    out = d.with_windows((F.row_number().over(w), "rn"),
+                         (F.window_sum("v").over(w), "rs"))
+    report = out.explain()
+    assert "* Window" in report
+    a = [r for r in out.collect() if r[0] == "a"]
+    assert [r[3] for r in a] == [1, 2, 3, 4]
+    assert [r[4] for r in a] == [10, 60, 60, 100]
