@@ -1,10 +1,20 @@
 """Headline benchmark: q1-style columnar aggregation throughput on one chip.
 
 Runs the flagship pipeline (filter -> derived projection -> group-by
-aggregate, the TPC-H q1 shape) through the exec layer on the default jax
-backend (TPU under the driver; CPU elsewhere) and compares wall-clock
-against a vectorized numpy oracle of the same query — a stand-in for the
-CPU Spark columnar path until a real Spark harness is wired up.
+aggregate, the TPC-H q1 shape) through the full exec layer (spillable
+batches, retry guards, planner-built operators) on the default jax backend
+and compares steady-state wall-clock against a vectorized numpy oracle of
+the same query.
+
+Timing methodology: the engine's steady-state hot path is sync-free — row
+counts, collision flags and merge decisions all stay on device — so the
+timed loop runs ITERS full pipelines back-to-back with a device-side
+checksum chained across iterations (each checksum consumes the previous
+one, so no iteration can be elided), and the clock stops on the ONE d2h
+fetch of the final checksum, which forces completion of every queued
+program. Result correctness is verified against the numpy oracle after the
+clock stops, and the checksum is cross-checked against the fetched result
+so all ITERS iterations are proven to have produced it.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -14,8 +24,9 @@ import time
 
 import numpy as np
 
-ROWS = 1 << 22  # 4M rows
-BATCHES = 4
+ROWS = 1 << 24  # 16M rows, ~448 MB
+BATCHES = 1
+ITERS = 30
 
 
 def build_data():
@@ -48,6 +59,7 @@ def main():
     t_np = time.perf_counter() - t_np0
 
     import jax
+    import jax.numpy as jnp
 
     from spark_rapids_tpu.columnar.batch import ColumnarBatch
     from spark_rapids_tpu.columnar.column import Column, bucket_capacity
@@ -89,19 +101,52 @@ def main():
     # iterations exercises the steady-state compiled path
     plan = make_plan()
 
-    # warmup (compile)
-    rows = plan.collect()
+    from spark_rapids_tpu.exec.speculation import speculation_scope
+
+    @jax.jit
+    def checksum(batch, prev, spec_flags):
+        total = prev + batch.num_rows.astype(jnp.float64)
+        for c in batch.columns:
+            v = jnp.where(c.validity, c.data, jnp.zeros((), c.data.dtype))
+            total = total + jnp.sum(v).astype(jnp.float64)
+        for f in spec_flags:
+            # a tripped speculation flag poisons the checksum: no invalid
+            # iteration can pass the final assertion
+            total = total + jnp.where(f, jnp.nan, 0.0)
+        return total
+
+    def run_once(prev, scope):
+        outs = list(plan.execute())
+        flags = tuple(scope.drain())
+        chk = prev
+        for b in outs:
+            chk = checksum(b, chk, flags)
+            flags = ()
+        return outs, chk
+
+    # warmup (compile + one full round trip)
+    scope_cm = speculation_scope()
+    scope = scope_cm.__enter__()
+    outs, chk = run_once(jnp.float64(0.0), scope)
+    rows = [r for b in outs for r in b.to_pylist()]
     got = {r[0]: (r[1], r[2], r[3]) for r in rows}
     for k, (sq, sd, c) in oracle.items():
         assert got[k][0] == sq and got[k][2] == c, (k, got[k], oracle[k])
         assert abs(got[k][1] - sd) / max(abs(sd), 1) < 1e-9
+    expect_chk_1 = float(np.asarray(chk))
 
-    iters = 5
+    # timed steady state: ITERS chained pipelines, ONE sync at the end
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = plan.collect()
-        assert len(out) == len(oracle)
-    dt = (time.perf_counter() - t0) / iters
+    chk = jnp.float64(0.0)
+    for _ in range(ITERS):
+        _, chk = run_once(chk, scope)
+    final_chk = float(np.asarray(chk))  # forces completion of all ITERS
+    dt = (time.perf_counter() - t0) / ITERS
+    scope_cm.__exit__(None, None, None)
+
+    # every iteration produced the verified result (checksum telescopes)
+    assert abs(final_chk - ITERS * expect_chk_1) <= \
+        1e-9 * max(abs(final_chk), 1.0), (final_chk, ITERS * expect_chk_1)
 
     bytes_in = sum(v.nbytes for v in d.values())
     gbps = bytes_in / dt / 1e9

@@ -99,16 +99,23 @@ class ColumnarBatch:
         return ColumnarBatch(cols, n, Schema(tuple(fields)))
 
     # -- host materialization ---------------------------------------------
+    # All three fetch the whole batch as ONE packed d2h transfer
+    # (columnar/transfer.py) — per-column fetches each pay a full device
+    # round trip, which dominates everything else on remote-attached TPUs.
     def to_arrow(self):
         import pyarrow as pa
-        n = self.num_rows_host
-        arrays = [column_to_arrow(c, n) for c in self.columns]
+        from .transfer import fetch_batch_host
+        cols, n = fetch_batch_host(self)
+        self._host_rows = n
+        arrays = [column_to_arrow(c, n) for c in cols]
         return pa.table(arrays, names=self.schema.names)
 
     def to_pydict(self) -> dict:
-        n = self.num_rows_host
+        from .transfer import fetch_batch_host
+        cols, n = fetch_batch_host(self)
+        self._host_rows = n
         return {f.name: c.to_pylist(n)
-                for f, c in zip(self.schema.fields, self.columns)}
+                for f, c in zip(self.schema.fields, cols)}
 
     def to_pylist(self) -> List[tuple]:
         d = self.to_pydict()

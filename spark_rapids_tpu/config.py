@@ -185,6 +185,31 @@ DECIMAL_ENABLED = conf_bool(
     "Enable decimal offload (decimal128 columns stay on CPU until the "
     "two-limb kernels land; reference decimalType.enabled).")
 
+FUSION_ENABLED = conf_bool(
+    "spark.rapids.tpu.fusion.enabled", True,
+    "Whole-stage fusion: compose chains of narrow operators "
+    "(filter/project) into the consuming operator's single XLA program — "
+    "the TPU analog of Spark's whole-stage codegen. One program per batch "
+    "instead of one per operator; filters become reduction masks instead "
+    "of gathers.", commonly_used=True)
+
+AGG_SPECULATIVE = conf_bool(
+    "spark.rapids.tpu.agg.speculative.enabled", True,
+    "Speculative masked-bucket aggregation: emit small partials plus a "
+    "device overflow flag; the plan re-runs exactly if the flag ever trips "
+    "(checked once at result materialization). Active only inside a "
+    "speculation scope (collect / session queries).")
+
+AGG_GROUP_SLOTS = conf_int(
+    "spark.rapids.tpu.agg.bucketSlots", 32,
+    "Buckets per round of the masked-bucket group-by kernel (max 64). "
+    "Fast-path group cardinality is bucketSlots * bucketRounds; higher "
+    "cardinality falls back to the exact sort path.")
+
+AGG_ROUNDS = conf_int(
+    "spark.rapids.tpu.agg.bucketRounds", 2,
+    "Re-hash rounds of the masked-bucket group-by kernel.")
+
 
 class RapidsConf:
     """Immutable snapshot of settings; construct from a dict of

@@ -32,9 +32,7 @@ from ..memory.retry import (
     TpuSplitAndRetryOOM, split_in_half_by_rows, with_retry,
 )
 from ..memory.spillable import SpillableBatch
-from ..ops.aggregate import (
-    groupby_aggregate, groupby_aggregate_hash, reduce_no_keys,
-)
+from ..ops.aggregate import groupby_aggregate, groupby_aggregate_hash
 from ..ops.basic import active_mask, sanitize
 from ..ops.sort import string_words_for
 from ..types import DataType, LongType, Schema, StructField
@@ -65,6 +63,19 @@ class AggregateExec(TpuExec):
         self.aggregates = list(aggregates)
         in_schema = child.output_schema
 
+        from ..config import (
+            AGG_GROUP_SLOTS, AGG_ROUNDS, AGG_SPECULATIVE, FUSION_ENABLED,
+            active_conf,
+        )
+        conf = active_conf()
+        self._slots = max(8, min(64, conf.get(AGG_GROUP_SLOTS)))
+        self._rounds = max(1, conf.get(AGG_ROUNDS))
+        self._spec_enabled = conf.get(AGG_SPECULATIVE)
+
+        self._fusion_enabled = conf.get(FUSION_ENABLED)
+        self._fused_steps: list = []
+        self._source: TpuExec = child
+
         # compiled kernels (cache keyed by capacity bucket + string words)
         self._jit_update = jax.jit(self._update_batch, static_argnums=(1,))
         self._jit_merge = jax.jit(self._merge_batch, static_argnums=(1,))
@@ -76,7 +87,12 @@ class AggregateExec(TpuExec):
         self._jit_merge_hash = {
             r: jax.jit(partial(self._merge_batch, hash_path=True,
                                hash_rounds=r)) for r in (2, 6)}
+        # sync-free exact merge: masked buckets + in-program sort fallback
+        self._jit_merge_auto = jax.jit(
+            partial(self._merge_batch, auto_path=True))
         self._jit_pre = jax.jit(self._pre_project)
+        self._jit_concat_merge = jax.jit(self._concat_merge_pair,
+                                         static_argnums=(2,))
 
         if mode == "final":
             # input is keys+buffers produced by a partial instance
@@ -102,6 +118,25 @@ class AggregateExec(TpuExec):
                 [self._pre_schema.fields[s].data_type for s in slots]
                 for slots in self._input_slots]
             self._buffer_schema = self._make_buffer_schema()
+
+        # whole-stage fusion: inline upstream filter/project chains into
+        # this operator's program (one XLA program per source batch; the
+        # reference's analog is whole-stage codegen — XLA is the codegen).
+        # Only for the masked tier: the string tiers consume child batches.
+        if self._fusion_enabled and mode != "final" and self._masked_ok:
+            steps, node = [], child
+            while hasattr(node, "fused_step"):
+                steps.append(node.fused_step())
+                node = node.child
+            self._fused_steps = list(reversed(steps))
+            self._source = node
+
+        # streaming speculative kernel: fused steps + masked-bucket update
+        # + fold into the O(1) device state — ONE program per source batch
+        self._jit_step_spec = jax.jit(self._streaming_step)
+        self._jit_step_exact = jax.jit(self._fused_update_exact)
+        self._jit_evaluate = jax.jit(self._evaluate)
+        self._initial_state_cache = None
 
     # -- schemas -----------------------------------------------------------
     def _make_buffer_schema(self) -> Schema:
@@ -135,9 +170,7 @@ class AggregateExec(TpuExec):
     def _pre_project(self, batch: ColumnarBatch) -> ColumnarBatch:
         return eval_projection(self._pre_bound, batch, self._pre_schema)
 
-    def _update_batch(self, batch: ColumnarBatch, words: int = 4,
-                      hash_path: bool = False, hash_rounds: int = 2):
-        """First-pass aggregation of one pre-projected batch."""
+    def _update_inputs(self, batch: ColumnarBatch):
         keys = list(batch.columns[: self._key_count])
         agg_inputs = []
         for i, (fn, _) in enumerate(self.aggregates):
@@ -145,13 +178,9 @@ class AggregateExec(TpuExec):
                 col = batch.columns[self._input_slots[i][slot]] \
                     if slot is not None else None
                 agg_inputs.append((op, col))
-        return self._run_groupby(keys, agg_inputs, batch,
-                                 self._buffer_schema, words, hash_path,
-                                 hash_rounds)
+        return keys, agg_inputs
 
-    def _merge_batch(self, batch: ColumnarBatch, words: int = 4,
-                     hash_path: bool = False, hash_rounds: int = 2):
-        """Re-aggregate a keys+buffers batch with merge ops."""
+    def _merge_inputs(self, batch: ColumnarBatch):
         keys = list(batch.columns[: self._key_count])
         agg_inputs = []
         pos = self._key_count
@@ -159,29 +188,162 @@ class AggregateExec(TpuExec):
             for op in fn.merge_ops():
                 agg_inputs.append((op, batch.columns[pos]))
                 pos += 1
+        return keys, agg_inputs
+
+    def _update_batch(self, batch: ColumnarBatch, words: int = 4,
+                      hash_path: bool = False, hash_rounds: int = 2,
+                      auto_path: bool = False, row_mask=None):
+        """First-pass aggregation of one pre-projected batch."""
+        keys, agg_inputs = self._update_inputs(batch)
         return self._run_groupby(keys, agg_inputs, batch,
                                  self._buffer_schema, words, hash_path,
-                                 hash_rounds)
+                                 hash_rounds, auto_path, row_mask)
+
+    def _merge_batch(self, batch: ColumnarBatch, words: int = 4,
+                     hash_path: bool = False, hash_rounds: int = 2,
+                     auto_path: bool = False, row_mask=None):
+        """Re-aggregate a keys+buffers batch with merge ops."""
+        keys, agg_inputs = self._merge_inputs(batch)
+        return self._run_groupby(keys, agg_inputs, batch,
+                                 self._buffer_schema, words, hash_path,
+                                 hash_rounds, auto_path, row_mask)
+
+    # -- fused + speculative streaming kernels -----------------------------
+    def _apply_fused(self, batch: ColumnarBatch):
+        """Traced: run the inlined filter/project chain. Filters become a
+        row MASK (no compaction gather — gathers are slow on TPU; masked
+        reductions ignore dead rows for free)."""
+        mask = None
+        cur = batch
+        for step in self._fused_steps:
+            if step[0] == "filter":
+                pred = step[1].columnar_eval(cur)
+                m = pred.data & pred.validity
+                mask = m if mask is None else (mask & m)
+            else:
+                _, bound, schema = step
+                cur = eval_projection(bound, cur, schema)
+        return cur, mask
+
+    def _fused_update_exact(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Exact tier, one program: fused steps -> pre-project -> masked
+        bucket group-by with in-program lax.cond sort fallback."""
+        assert self.mode != "final", "final mode merges via _merge_jitted"
+        cur, mask = self._apply_fused(batch)
+        pre = eval_projection(self._pre_bound, cur, self._pre_schema)
+        return self._update_batch(pre, auto_path=True, row_mask=mask)
+
+    def _small_cap(self) -> int:
+        from ..columnar.column import bucket_capacity
+        return bucket_capacity(self._slots * self._rounds)
+
+    def _build_small_batch(self, out_keys, results, num_groups
+                           ) -> ColumnarBatch:
+        cols = list(out_keys)
+        buf_fields = self._buffer_schema.fields[self._key_count:]
+        for r, f in zip(results, buf_fields):
+            data, valid = r[1]
+            cols.append(Column(data.astype(f.data_type.jnp_dtype), valid,
+                               f.data_type))
+        return ColumnarBatch(cols, num_groups, self._buffer_schema)
+
+    def _streaming_step(self, batch: ColumnarBatch, state: ColumnarBatch,
+                        flag):
+        """Speculative tier, ONE program per source batch: fused steps ->
+        masked-bucket update into a SMALL partial -> fold into the O(1)
+        running state. Overflow/collision leftovers only raise the device
+        flag; the plan re-runs exactly if it ever trips (speculation.py)."""
+        from ..ops.basic import concat_columns
+        from ..ops.maskedagg import masked_groupby, masked_reduce
+        out_cap = self._small_cap()
+
+        if self.mode == "final":
+            cur, mask = batch, None
+            keys, agg_inputs = self._merge_inputs(batch)
+        else:
+            cur, mask = self._apply_fused(batch)
+            pre = eval_projection(self._pre_bound, cur, self._pre_schema)
+            keys, agg_inputs = self._update_inputs(pre)
+            cur = pre
+
+        if not keys:
+            results = [("raw", r) for r in masked_reduce(
+                agg_inputs, cur.num_rows, mask, out_cap)]
+            part = self._build_small_batch([], results, jnp.int32(1))
+        else:
+            out_keys, results, num_groups, leftover = masked_groupby(
+                keys, agg_inputs, cur.num_rows, cur.capacity, mask,
+                self._slots, self._rounds)
+            flag = flag | leftover
+            part = self._build_small_batch(out_keys, results, num_groups)
+
+        # fold: concat state + part, re-aggregate with merge ops
+        cat_cap = 2 * out_cap
+        cols = [concat_columns(a, b, state.num_rows, part.num_rows, cat_cap)
+                for a, b in zip(state.columns, part.columns)]
+        both = ColumnarBatch(cols, state.num_rows + part.num_rows,
+                             self._buffer_schema)
+        mkeys, minputs = self._merge_inputs(both)
+        if not mkeys:
+            mres = [("raw", r) for r in masked_reduce(
+                minputs, both.num_rows, None, out_cap)]
+            new_state = self._build_small_batch([], mres, jnp.int32(1))
+        else:
+            mk, mres, mgroups, mleft = masked_groupby(
+                mkeys, minputs, both.num_rows, cat_cap, None,
+                self._slots, self._rounds)
+            flag = flag | mleft
+            new_state = self._build_small_batch(mk, mres, mgroups)
+        return new_state, flag
+
+    def _initial_state(self) -> ColumnarBatch:
+        """Empty small state (built once; reused across executions)."""
+        if self._initial_state_cache is None:
+            from ..columnar.batch import empty_batch
+            self._initial_state_cache = (
+                empty_batch(self._buffer_schema, capacity=self._small_cap()),
+                jnp.asarray(False))
+        return self._initial_state_cache
+
+    def _concat_merge_pair(self, a: ColumnarBatch, b: ColumnarBatch,
+                           cap: int) -> ColumnarBatch:
+        """Device-only merge of two keys+buffers partials: concat into one
+        `cap`-capacity batch, then re-aggregate with merge ops. Output
+        groups <= a_groups + b_groups <= cap always, so this is exact with
+        no host involvement."""
+        from ..ops.basic import concat_columns
+        cols = [concat_columns(ca, cb, a.num_rows, b.num_rows, cap)
+                for ca, cb in zip(a.columns, b.columns)]
+        both = ColumnarBatch(cols, a.num_rows + b.num_rows,
+                             self._buffer_schema)
+        return self._merge_batch(both, auto_path=True)
 
     def _run_groupby(self, keys, agg_inputs, batch, out_schema, words: int,
-                     hash_path: bool = False, hash_rounds: int = 2):
+                     hash_path: bool = False, hash_rounds: int = 2,
+                     auto_path: bool = False, row_mask=None):
+        from ..ops.maskedagg import masked_groupby_exact, masked_reduce
         cap = batch.capacity
         if not keys:
-            # a count(*)-only aggregate has no input columns at all; give the
-            # one-row output a real capacity bucket
-            cap = max(cap, 128)
-            results = reduce_no_keys(agg_inputs, batch.num_rows, cap)
+            # a count(*)-only aggregate has no input columns at all; give
+            # the one-row output a real capacity bucket. Scatter-free
+            # masked reductions (scatters are the slowest TPU op family).
+            out_cap = 128
+            results = masked_reduce(agg_inputs, batch.num_rows,
+                                    row_mask, out_cap)
             cols = []
             fields = out_schema.fields
             for (data, valid), f in zip(results, fields):
-                act1 = active_mask(jnp.int32(1), cap)
-                cols.append(Column(
-                    jnp.where(act1, data.astype(f.data_type.jnp_dtype), 0),
-                    valid & act1, f.data_type))
+                cols.append(Column(data.astype(f.data_type.jnp_dtype),
+                                   valid, f.data_type))
             out = ColumnarBatch(cols, 1, out_schema)
             return (out, jnp.asarray(False)) if hash_path else out
         leftover = None
-        if hash_path:
+        if auto_path:
+            out_keys, results, num_groups = masked_groupby_exact(
+                keys, agg_inputs, batch.num_rows, cap, row_mask,
+                string_words=words, group_slots=self._slots,
+                rounds=self._rounds)
+        elif hash_path:
             out_keys, results, num_groups, leftover = groupby_aggregate_hash(
                 keys, agg_inputs, batch.num_rows, cap, rounds=hash_rounds)
         else:
@@ -216,7 +378,74 @@ class AggregateExec(TpuExec):
                              batch._host_rows)
 
     # -- drive -------------------------------------------------------------
+
+    #: merge this many partials device-side before one amortized host sync
+    #: shrinks the running result into a tight capacity bucket
+    MERGE_FAN_IN = 8
+
+    #: exact-tier partials at or above this capacity are shrunk eagerly
+    #: (one host sync each) instead of holding full-size buckets in HBM
+    SHRINK_THRESHOLD_CAP = 1 << 16
+
     def internal_execute(self) -> Iterator[ColumnarBatch]:
+        from .speculation import speculation_allowed
+        if (self._masked_ok and self._spec_enabled
+                and speculation_allowed()):
+            yield from self._execute_speculative()
+            return
+        yield from self._execute_exact()
+
+    def _execute_speculative(self) -> Iterator[ColumnarBatch]:
+        """Streaming speculative drive: ONE program per source batch folds
+        into an O(1)-size device state; the overflow flag is recorded with
+        the active speculation scope and never read here."""
+        from .speculation import current_scope
+        agg_time = self.metrics[AGG_TIME]
+        in_rows = self.metrics[NUM_INPUT_ROWS]
+        in_batches = self.metrics[NUM_INPUT_BATCHES]
+        state, flag = self._initial_state()
+        saw_input = False
+        with agg_time.ns_timer():
+            for batch in self._source.execute():
+                in_batches.add(1)
+                if batch._host_rows is not None:
+                    in_rows.add(batch._host_rows)
+                else:
+                    in_rows.add_device(batch.num_rows)
+                saw_input = True
+                spillable = SpillableBatch.from_batch(batch)
+                box = [state, flag]
+                try:
+                    def run(s: SpillableBatch):
+                        b = s.get_batch()
+                        try:
+                            return self._jit_step_spec(b, box[0], box[1])
+                        finally:
+                            s.release()
+                    for out in with_retry(spillable, run,
+                                          split_policy=split_in_half_by_rows):
+                        box[0], box[1] = out
+                finally:
+                    spillable.close()
+                state, flag = box
+        if not saw_input:
+            if self.group_exprs or self.mode == "partial":
+                return  # no output rows (matches the exact path)
+            # grand aggregate over empty input still emits one row
+            from ..columnar.batch import empty_batch
+            src_schema = (self._buffer_schema if self.mode == "final"
+                          else self._source.output_schema)
+            state, flag = self._jit_step_spec(
+                empty_batch(src_schema), state, flag)
+        scope = current_scope()
+        if scope is not None:
+            scope.record(flag)
+        if self.mode == "partial":
+            yield state
+        else:
+            yield self._jit_evaluate(state)
+
+    def _execute_exact(self) -> Iterator[ColumnarBatch]:
         agg_time = self.metrics[AGG_TIME]
         in_rows = self.metrics[NUM_INPUT_ROWS]
         in_batches = self.metrics[NUM_INPUT_BATCHES]
@@ -225,24 +454,45 @@ class AggregateExec(TpuExec):
         with agg_time.ns_timer():
             first_pass = self._merge_jitted if self.mode == "final" \
                 else self._update_and_aggregate
-            for batch in self.child.execute():
+            for batch in self._source.execute():
                 in_batches.add(1)
-                in_rows.add(batch.num_rows_host)
+                if batch._host_rows is not None:
+                    in_rows.add(batch._host_rows)
+                else:
+                    in_rows.add_device(batch.num_rows)
                 spillable = SpillableBatch.from_batch(batch)
                 try:
                     for out in with_retry(spillable,
                                           self._spill_wrap(first_pass),
                                           split_policy=split_in_half_by_rows):
-                        from ..columnar.column import bucket_capacity
-                        rows = out.num_rows_host
-                        small_cap = bucket_capacity(max(rows, 1))
-                        if small_cap < out.capacity:
-                            shrunk = _shrink_batch(out, small_cap)
-                            out = ColumnarBatch(shrunk.columns, rows,
-                                                out.schema)
+                        if out.capacity >= self.SHRINK_THRESHOLD_CAP:
+                            # big-batch partials keep the input capacity
+                            # (groups are usually few): pay ONE host sync
+                            # to shrink rather than hold MERGE_FAN_IN
+                            # full-size partials in HBM
+                            from ..columnar.column import bucket_capacity
+                            rows = out.num_rows_host
+                            small = bucket_capacity(max(rows, 1))
+                            if small < out.capacity:
+                                shrunk = _shrink_batch(out, small)
+                                out = ColumnarBatch(shrunk.columns, rows,
+                                                    out.schema)
                         aggregated.append(SpillableBatch.from_batch(out))
                 finally:
                     spillable.close()
+                if len(aggregated) >= self.MERGE_FAN_IN:
+                    # bound live partials: merge the window device-side,
+                    # then ONE host sync shrinks the result into a tight
+                    # bucket (amortized over MERGE_FAN_IN batches).
+                    merged = self._merge_all(aggregated)
+                    from ..columnar.column import bucket_capacity
+                    rows = merged.num_rows_host
+                    small_cap = bucket_capacity(max(rows, 1))
+                    if small_cap < merged.capacity:
+                        shrunk = _shrink_batch(merged, small_cap)
+                        merged = ColumnarBatch(shrunk.columns, rows,
+                                               merged.schema)
+                    aggregated = [SpillableBatch.from_batch(merged)]
 
             if not aggregated:
                 if not self.group_exprs and self.mode != "partial":
@@ -254,7 +504,7 @@ class AggregateExec(TpuExec):
                                         else self._buffer_schema)
                     merged = self._update_batch(empty) \
                         if self.mode != "final" else self._merge_batch(empty)
-                    yield self._evaluate(merged)
+                    yield self._jit_evaluate(merged)
                 return
 
             if len(aggregated) == 1:
@@ -268,7 +518,7 @@ class AggregateExec(TpuExec):
             if self.mode == "partial":
                 yield merged
             else:
-                yield self._evaluate(merged)
+                yield self._jit_evaluate(merged)
 
     def _key_words(self, batch: ColumnarBatch) -> int:
         """String-lane width for exact key ordering (host sync, pre-jit)."""
@@ -291,7 +541,26 @@ class AggregateExec(TpuExec):
                 pos += 1
         return True
 
+    @property
+    def _masked_ok(self) -> bool:
+        """True when the masked-bucket kernels apply: every key and buffer
+        column is fixed-width (strings have no static order lanes for the
+        in-program exact fallback and no masked min/max encoding)."""
+        from ..types import ArrayType, BinaryType, StringType, StructType
+        return not any(
+            isinstance(f.data_type,
+                       (StringType, BinaryType, StructType, ArrayType))
+            for f in self._buffer_schema.fields)
+
+    @property
+    def _sync_free(self) -> bool:
+        return self._masked_ok
+
     def _update_and_aggregate(self, batch: ColumnarBatch) -> ColumnarBatch:
+        if self._masked_ok:
+            # one program: fused steps + masked buckets + lax.cond exact
+            # sort fallback; the host never reads any flag (no round trip)
+            return self._jit_step_exact(batch)
         pre = self._jit_pre(batch)
         if self._hash_path_ok:
             for rounds in (2, 6):
@@ -303,6 +572,8 @@ class AggregateExec(TpuExec):
         return self._jit_update(pre, self._key_words(pre))
 
     def _merge_jitted(self, batch: ColumnarBatch) -> ColumnarBatch:
+        if self._masked_ok:
+            return self._jit_merge_auto(batch)
         if self._hash_path_ok:
             for rounds in (2, 6):
                 out, leftover = self._jit_merge_hash[rounds](batch)
@@ -336,6 +607,8 @@ class AggregateExec(TpuExec):
         def do(items: List[SpillableBatch]) -> ColumnarBatch:
             batches = [s.get_batch() for s in items]
             try:
+                if self._sync_free:
+                    return self._tree_merge_device(batches)
                 merged = concat_batches(batches, self._buffer_schema)
                 return self._merge_jitted(merged)
             finally:
@@ -352,6 +625,25 @@ class AggregateExec(TpuExec):
         # split path produced several partials: re-merge them
         spill = [SpillableBatch.from_batch(b) for b in outs]
         return self._merge_all(spill)
+
+    def _tree_merge_device(self, batches: List[ColumnarBatch]
+                           ) -> ColumnarBatch:
+        """Pairwise device-only merge: every level concats pairs into the
+        capacity bucket of the pair and re-aggregates — no host syncs, no
+        row-count reads. Peak capacity is the bucket of the total, same as
+        the concat-all path, but each level shrinks live groups."""
+        from ..columnar.column import bucket_capacity
+        level = list(batches)
+        while len(level) > 1:
+            nxt: List[ColumnarBatch] = []
+            for i in range(0, len(level) - 1, 2):
+                a, b = level[i], level[i + 1]
+                cap = bucket_capacity(a.capacity + b.capacity)
+                nxt.append(self._jit_concat_merge(a, b, cap))
+            if len(level) % 2:
+                nxt.append(level[-1])
+            level = nxt
+        return level[0]
 
     def node_description(self):
         aggs = ", ".join(f"{fn!r} AS {name}" for fn, name in self.aggregates)

@@ -23,17 +23,44 @@ DEBUG = 2
 
 
 class TpuMetric:
-    """Accumulating operator metric (reference GpuMetric)."""
+    """Accumulating operator metric (reference GpuMetric).
 
-    __slots__ = ("name", "level", "value")
+    Device-produced values (e.g. a traced row count) are accumulated as
+    device scalars and only materialized when the metric is READ. A d2h
+    sync in the steady-state batch loop costs orders of magnitude more
+    than the kernels themselves (the analog of a cudaStreamSynchronize
+    per batch), so `add_device` must never block.
+    """
+
+    __slots__ = ("name", "level", "_value", "_pending")
 
     def __init__(self, name: str, level: int = MODERATE):
         self.name = name
         self.level = level
-        self.value = 0
+        self._value = 0
+        self._pending: List = []
 
     def add(self, v):
-        self.value += v
+        self._value += v
+
+    def add_device(self, scalar):
+        """Accumulate a device scalar lazily (no sync until read)."""
+        self._pending.append(scalar)
+
+    @property
+    def value(self):
+        if self._pending:
+            import jax.numpy as jnp
+            pending, self._pending = self._pending, []
+            # one stacked transfer, not one round trip per scalar
+            self._value += int(jnp.sum(jnp.stack(
+                [jnp.asarray(s).astype(jnp.int64) for s in pending])))
+        return self._value
+
+    @value.setter
+    def value(self, v):
+        self._pending = []
+        self._value = v
 
     def ns_timer(self):
         return _NsTimer(self)
@@ -98,7 +125,10 @@ class TpuExec:
         batches = self.metrics[NUM_OUTPUT_BATCHES]
         for batch in self.internal_execute():
             batches.add(1)
-            rows.add(batch.num_rows_host)
+            if batch._host_rows is not None:
+                rows.add(batch._host_rows)
+            else:
+                rows.add_device(batch.num_rows)
             yield batch
 
     @property
@@ -107,9 +137,23 @@ class TpuExec:
         return self.children[0]
 
     def collect(self) -> List[tuple]:
-        out: List[tuple] = []
-        for batch in self.execute():
-            out.extend(batch.to_pylist())
+        """Materialize results. Opens a speculation scope: aggregates may
+        run their fast masked-bucket tier and flag overflow on device; the
+        flag costs one extra host read here, and a trip re-runs the plan
+        with every operator on its exact tier."""
+        from .speculation import force_exact, speculation_scope
+
+        def run() -> List[tuple]:
+            out: List[tuple] = []
+            for batch in self.execute():
+                out.extend(batch.to_pylist())
+            return out
+
+        with speculation_scope() as scope:
+            out = run()
+            if scope.tripped():
+                with force_exact():
+                    out = run()
         return out
 
     def tree_string(self, indent: int = 0) -> str:

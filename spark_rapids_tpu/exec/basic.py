@@ -111,6 +111,12 @@ class ProjectExec(TpuExec):
         finally:
             s.release()
 
+    def fused_step(self):
+        """Whole-stage fusion hook: this operator as a pure traced step a
+        consumer can inline into its own program (the reference's analog is
+        whole-stage codegen; XLA is the codegen)."""
+        return ("project", self._bound, self._schema)
+
     def node_description(self):
         return f"ProjectExec[{', '.join(map(repr, self.exprs))}]"
 
@@ -152,6 +158,12 @@ class FilterExec(TpuExec):
             return self._jit(batch)
         finally:
             s.release()
+
+    def fused_step(self):
+        """Fusion hook: in a fused stage the filter contributes a row MASK
+        (ANDed into the consumer's reductions) instead of a compaction
+        gather — gathers are among the slowest ops on TPU, masks are free."""
+        return ("filter", self._bound)
 
     def node_description(self):
         return f"FilterExec[{self.condition!r}]"

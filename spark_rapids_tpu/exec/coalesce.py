@@ -45,10 +45,18 @@ def concat_batches(batches: List[ColumnarBatch], schema: Schema
         nxt_level = []
         for i in range(0, len(level) - 1, 2):
             a, b = level[i], level[i + 1]
-            rows = a.num_rows_host + b.num_rows_host
-            cap = bucket_capacity(rows)
-            out = _concat_pair(a, b, cap)
-            nxt_level.append(ColumnarBatch(out.columns, rows, schema))
+            if a._host_rows is not None and b._host_rows is not None:
+                # exact: tight output bucket from known row counts
+                rows = a._host_rows + b._host_rows
+                cap = bucket_capacity(rows)
+                out = _concat_pair(a, b, cap)
+                nxt_level.append(ColumnarBatch(out.columns, rows, schema))
+            else:
+                # device row counts: don't sync — bucket by capacities
+                cap = bucket_capacity(a.capacity + b.capacity)
+                out = _concat_pair(a, b, cap)
+                nxt_level.append(ColumnarBatch(out.columns, out.num_rows,
+                                               schema))
         if len(level) % 2:
             nxt_level.append(level[-1])
         level = nxt_level
@@ -95,7 +103,10 @@ class CoalesceBatchesExec(TpuExec):
 
         for batch in self.child.execute():
             in_batches.add(1)
-            in_rows.add(batch.num_rows_host)
+            if batch._host_rows is not None:
+                in_rows.add(batch._host_rows)
+            else:
+                in_rows.add_device(batch.num_rows)
             size = batch.device_size_bytes()
             if pending and pending_bytes + size > self.target_bytes:
                 yield flush()

@@ -1,0 +1,81 @@
+"""Plan-level speculative execution scope.
+
+The masked-bucket aggregation kernel (ops/maskedagg.py) emits SMALL
+partials plus a device `leftover` flag instead of paying for a
+full-capacity exact fallback on every batch. Inside a speculation scope
+the flag is never read per batch (a d2h sync costs more than the kernel);
+it is recorded as a device scalar and checked ONCE when results are
+materialized. If any flag tripped, the scope owner re-runs the plan with
+speculation disabled (every aggregate takes its exact sync-free tier).
+
+This is the engine's analog of the reference's optimistic
+hash-aggregate-then-sort-fallback duality (GpuAggregateExec.scala:909),
+lifted from per-batch to per-plan granularity because TPU host round
+trips, not device memory, are the scarce resource.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import List, Optional
+
+import numpy as np
+
+
+class SpeculationScope:
+    def __init__(self):
+        self.flags: List = []  # device bool scalars
+
+    def record(self, flag) -> None:
+        self.flags.append(flag)
+
+    def drain(self) -> List:
+        out, self.flags = self.flags, []
+        return out
+
+    def tripped(self) -> bool:
+        """ONE host sync over all recorded flags."""
+        if not self.flags:
+            return False
+        import jax.numpy as jnp
+        flags = self.drain()
+        return bool(np.asarray(jnp.any(jnp.stack(flags))))
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.scope: Optional[SpeculationScope] = None
+        self.forced_exact = False
+
+
+_state = _State()
+
+
+def current_scope() -> Optional[SpeculationScope]:
+    return _state.scope
+
+
+def speculation_allowed() -> bool:
+    return _state.scope is not None and not _state.forced_exact
+
+
+@contextmanager
+def speculation_scope():
+    prev = _state.scope
+    scope = SpeculationScope()
+    _state.scope = scope
+    try:
+        yield scope
+    finally:
+        _state.scope = prev
+
+
+@contextmanager
+def force_exact():
+    prev = _state.forced_exact
+    _state.forced_exact = True
+    try:
+        yield
+    finally:
+        _state.forced_exact = prev

@@ -12,9 +12,9 @@ from .catalog import ACTIVE_BATCHING_PRIORITY, buffer_catalog
 
 
 class SpillableBatch:
-    def __init__(self, handle: str, num_rows: int, schema):
+    def __init__(self, handle: str, num_rows, schema):
         self._handle = handle
-        self._num_rows = num_rows
+        self._num_rows = num_rows  # host int OR device scalar (lazy)
         self._schema = schema
         self._closed = False
 
@@ -22,10 +22,17 @@ class SpillableBatch:
     def from_batch(batch: ColumnarBatch,
                    priority: int = ACTIVE_BATCHING_PRIORITY) -> "SpillableBatch":
         handle = buffer_catalog().add(batch, priority)
-        return SpillableBatch(handle, batch.num_rows_host, batch.schema)
+        # keep the row count lazy: forcing it here would put one d2h sync
+        # on every operator's per-batch path (row counts are device scalars
+        # after filters/joins); only split/debug paths need the host value.
+        rows = batch._host_rows if batch._host_rows is not None \
+            else batch.num_rows
+        return SpillableBatch(handle, rows, batch.schema)
 
     @property
     def num_rows(self) -> int:
+        if not isinstance(self._num_rows, int):
+            self._num_rows = int(self._num_rows)
         return self._num_rows
 
     @property

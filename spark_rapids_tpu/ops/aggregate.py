@@ -204,10 +204,22 @@ def groupby_aggregate_hash(key_columns: Sequence[Column],
     Not supported here: min/max over string inputs (they need ordering
     lanes; the exec routes those plans to the sort path statically).
     """
-    from .hashagg import dense_group_ids, hash_group_assignment
+    from .hashagg import hash_group_assignment
 
     seg_slots, rep_row, leftover = hash_group_assignment(
         key_columns, num_rows, capacity, rounds)
+    keys, results, num_groups = _aggregate_with_assignment(
+        key_columns, agg_inputs, num_rows, capacity, rounds,
+        seg_slots, rep_row)
+    return keys, results, num_groups, leftover
+
+
+def _aggregate_with_assignment(key_columns, agg_inputs, num_rows,
+                               capacity: int, rounds: int,
+                               seg_slots, rep_row):
+    """Aggregate over a precomputed hash group assignment."""
+    from .hashagg import dense_group_ids
+
     seg, group_rep, num_groups = dense_group_ids(seg_slots, rep_row,
                                                  capacity, rounds)
     act = active_mask(num_rows, capacity)
@@ -249,22 +261,4 @@ def groupby_aggregate_hash(key_columns: Sequence[Column],
                               & c.validity[jnp.clip(group_rep, 0,
                                                     capacity - 1)])
                 for c in key_columns]
-    return out_keys, results, num_groups, leftover
-
-
-def reduce_no_keys(agg_inputs: Sequence[Tuple[str, Optional[Column]]],
-                   num_rows, capacity: int):
-    """Grand aggregate (no GROUP BY): one output row, still static shapes."""
-    act = active_mask(num_rows, capacity)
-    seg = jnp.where(act, 0, capacity)
-    positions = jnp.arange(capacity, dtype=jnp.int32)
-    out = []
-    for op, col in agg_inputs:
-        if col is None:
-            data, valid = _segment_reduce("count_star", positions, act, seg,
-                                          capacity, positions)
-        else:
-            data, valid = _segment_reduce(op, col.data, col.validity & act,
-                                          seg, capacity, positions)
-        out.append((data, valid))
-    return out
+    return out_keys, results, num_groups

@@ -51,7 +51,10 @@ def _float_order_bits(data, bits_dtype, sign_bit):
     """IEEE-754 total order as unsigned ints, with Spark semantics:
     all NaNs collapse to one value greater than +inf; -0.0 == 0.0."""
     data = jnp.where(jnp.isnan(data), jnp.full((), jnp.nan, data.dtype), data)
-    data = data + jnp.zeros((), data.dtype)  # -0.0 + 0.0 == +0.0
+    # -0.0 -> +0.0 via select: `x + 0.0` is NOT value-preserving for -0.0
+    # and XLA's algebraic simplifier folds it away under jit
+    data = jnp.where(data == jnp.zeros((), data.dtype),
+                     jnp.zeros((), data.dtype), data)
     bits = jax.lax.bitcast_convert_type(data, bits_dtype)
     neg = (bits >> (sign_bit)) & 1
     flipped = jnp.where(neg == 1, ~bits, bits | (jnp.ones((), bits_dtype) << sign_bit))
