@@ -157,11 +157,14 @@ class WindowExec(TpuExec):
             rank, _ = rank_dense_rank(order_boundary, seg, n, cap)
             return Column(rank, ones, res_type)
         if isinstance(fn, Lag):  # covers Lead (negated offset)
-            out = lag_lead(ins[0], seg, n, cap, fn.offset)
+            out, same_seg = lag_lead(ins[0], seg, n, cap, fn.offset)
             if fn.default is not None:
+                # default only where the offset row does NOT exist; an
+                # existing-but-null offset row stays NULL (Spark)
                 fill = jnp.full((cap,), fn.default, out.data.dtype)
-                data = jnp.where(out.validity, out.data, fill)
-                return Column(data, ones, res_type)
+                data = jnp.where(same_seg, out.data, fill)
+                valid = out.validity | ~same_seg
+                return Column(data, valid, res_type)
             return out
         if isinstance(fn, LastValue):
             idx = group_last if group_last is not None \

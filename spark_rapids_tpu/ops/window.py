@@ -148,7 +148,13 @@ def rank_dense_rank(order_boundary, seg, num_rows, capacity: int):
 
 def lag_lead(col: Column, seg, num_rows, capacity: int, offset: int,
              default_value=None):
-    """lag (offset>0 looks back) / lead (offset<0) within the segment."""
+    """lag (offset>0 looks back) / lead (offset<0) within the segment.
+
+    Returns (gathered column, same_seg mask). The mask distinguishes
+    "offset row does not exist" (default applies, Spark semantics) from
+    "offset row exists but is NULL" (result stays NULL even with a
+    default) — collapsing both into validity would substitute the default
+    for real nulls."""
     i = jnp.arange(capacity, dtype=jnp.int32)
     src = i - offset
     in_range = (src >= 0) & (src < capacity)
@@ -156,7 +162,7 @@ def lag_lead(col: Column, seg, num_rows, capacity: int, offset: int,
     same_seg = in_range & (seg[safe] == seg)
     from .basic import gather_column
     out = gather_column(col, jnp.where(same_seg, safe, -1))
-    return out
+    return out, same_seg
 
 
 def whole_partition_broadcast(reduced, seg, capacity: int):
