@@ -25,11 +25,24 @@ class _InMemorySource:
     def batches(self):
         return list(self._batches)
 
+    def estimated_size_bytes(self) -> int:
+        return sum(b.device_size_bytes() for b in self._batches)
+
 
 class TpuSession:
-    def __init__(self, conf: Optional[Dict] = None):
+    def __init__(self, conf: Optional[Dict] = None,
+                 mesh_devices: Optional[int] = None, mesh=None):
+        """mesh_devices/mesh: enable distributed planning — group-bys and
+        equi-joins compile to partial → ICI all-to-all exchange → final
+        SPMD stages over the device mesh (exec/exchange.py). Default: the
+        single-partition plan (no exchange nodes)."""
+        from ..parallel.mesh import device_mesh, set_active_mesh
         self.conf = RapidsConf(conf or {})
         set_active_conf(self.conf)
+        if mesh is None and mesh_devices is not None:
+            mesh = device_mesh(mesh_devices)
+        self.mesh = mesh
+        set_active_mesh(mesh)
 
     # -- ingestion ---------------------------------------------------------
     def from_pydict(self, data: Dict, schema: Schema,
@@ -125,6 +138,12 @@ class DataFrame:
              left_on=None, right_on=None, condition=None) -> "DataFrame":
         if on is not None:
             names = [on] if isinstance(on, str) else list(on)
+            if how not in ("left_semi", "left_anti", "existence"):
+                # USING-join semantics (Spark): ONE output column per key.
+                # Rename the right keys, join, project the dup away; the
+                # surviving key is left's (right's for right_outer,
+                # coalesced for full_outer).
+                return self._using_join(other, names, how, condition)
             lkeys = [col(n) for n in names]
             rkeys = [col(n) for n in names]
         elif left_on is not None:
@@ -136,6 +155,27 @@ class DataFrame:
             lkeys, rkeys = [], []
         return self._with(L.LogicalJoin(self._plan, other._plan, lkeys,
                                         rkeys, how, condition))
+
+    def _using_join(self, other: "DataFrame", names: List[str], how: str,
+                    condition) -> "DataFrame":
+        from ..expr.conditional import Coalesce
+        tmp = {n: f"__using_r_{n}" for n in names}
+        rproj = other.select(*[col(n).alias(tmp[n]) if n in tmp else col(n)
+                               for n in other.columns])
+        joined = L.LogicalJoin(self._plan, rproj._plan,
+                               [col(n) for n in names],
+                               [col(tmp[n]) for n in names], how, condition)
+        out: List[Expression] = []
+        for n in names:
+            if how == "right_outer":
+                out.append(col(tmp[n]).alias(n))
+            elif how == "full_outer":
+                out.append(Coalesce(col(n), col(tmp[n])).alias(n))
+            else:
+                out.append(col(n))
+        out += [col(n) for n in self.columns if n not in names]
+        out += [col(n) for n in other.columns if n not in names]
+        return self._with(L.LogicalProject(out, joined))
 
     def sort(self, *orders) -> "DataFrame":
         norm = []
@@ -178,6 +218,9 @@ class DataFrame:
 
     # -- actions -----------------------------------------------------------
     def _exec(self):
+        from ..parallel.mesh import set_active_mesh
+        set_active_conf(self.session.conf)
+        set_active_mesh(self.session.mesh)
         return TpuOverrides(self.session.conf).apply(self._plan)
 
     def collect(self) -> List[tuple]:

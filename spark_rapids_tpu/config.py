@@ -139,6 +139,22 @@ SHUFFLE_MODE = conf_str(
     "mesh all-to-all over interconnect), CACHE_ONLY (reference "
     "RapidsShuffleManagerMode).", commonly_used=True)
 
+BROADCAST_SIZE_THRESHOLD = conf_bytes(
+    "spark.rapids.sql.broadcastSizeThreshold", 10 << 20,
+    "Max estimated build-side bytes for planning a broadcast hash join "
+    "instead of exchanging both sides (Spark's "
+    "spark.sql.autoBroadcastJoinThreshold; reference "
+    "GpuBroadcastHashJoinExecBase). -1 disables broadcast planning.",
+    commonly_used=True)
+
+SHUFFLE_PLAN_EXCHANGE = conf_bool(
+    "spark.rapids.tpu.shuffle.planExchange", True,
+    "Plan distributed stages when a multi-device mesh is active (session "
+    "mesh_devices / parallel.mesh.set_active_mesh): group-bys become "
+    "partial → ICI all-to-all exchange → final, equi-joins become "
+    "exchange-both-sides → per-partition shuffled hash join (reference "
+    "GpuShuffleExchangeExecBase planning).", commonly_used=True)
+
 SHUFFLE_WRITER_THREADS = conf_int(
     "spark.rapids.shuffle.multiThreaded.writer.threads", 8,
     "Writer-side serialization threads (reference "

@@ -1,0 +1,269 @@
+"""Exchange execs — planner-produced repartitioning over the device mesh
+(reference GpuShuffleExchangeExecBase.scala:167 planning entry,
+prepareBatchShuffleDependency:277 device-side split, and the shuffle-plugin
+UCX transport; SURVEY §2.5).
+
+TPU-first redesign: no shuffle service, no serialized blocks. An exchange
+is ONE compiled SPMD program over the mesh — evaluate the partition key
+expressions on device, hash-partition rows (Spark-exact murmur3 pmod),
+`lax.all_to_all` over the ICI axis, compact the received rows. XLA lowers
+the collective to ICI neighbor exchanges with no host involvement.
+
+Receive-buffer sizing (review finding r1: the worst-case default was
+n_parts × capacity): a histogram program measures the actual max partition
+load and max string byte length across all devices first — ONE host sync
+per exchange, amortized over the whole stage — so the slot capacity fits
+the data and fixed-width string lanes can never truncate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..columnar.batch import ColumnarBatch, empty_batch
+from ..columnar.column import StringColumn, bucket_capacity
+from ..expr.core import Expression
+from ..ops.basic import active_mask
+from ..ops.strings import string_lengths
+from ..parallel.exchange import exchange_columns, partition_ids
+from ..parallel.mesh import DATA_AXIS, active_mesh, mesh_axis_size
+from ..types import Schema
+from .base import NUM_INPUT_BATCHES, NUM_INPUT_ROWS, OP_TIME, TpuExec
+from .basic import InMemoryScanExec, bind_projection
+from .coalesce import concat_batches
+
+PARTITION_SIZE = "dataSize"  # reference GpuShuffleExchangeExecBase metric
+
+
+def _squeeze0(tree):
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+
+def _expand0(tree):
+    return jax.tree_util.tree_map(lambda x: x[None], tree)
+
+
+class ShuffleExchangeExec(TpuExec):
+    """Hash-repartition child output across the mesh so rows with equal
+    partition-key values colocate on one device shard.
+
+    With no active mesh (or a 1-device mesh) the exchange is the identity —
+    the single-partition plan needs no data movement. Otherwise it emits
+    exactly `n_partitions` batches, one per device shard (empty shards
+    included, so consumers may zip the two sides of a join)."""
+
+    def __init__(self, partition_exprs: Sequence[Expression], child: TpuExec,
+                 mesh=None):
+        super().__init__(child)
+        self.partition_exprs = list(partition_exprs)
+        self._mesh = mesh if mesh is not None else active_mesh()
+        self._bound = bind_projection(self.partition_exprs,
+                                      child.output_schema)
+        self._jit_measure = jax.jit(self._measure_kernel)
+        self._steps = {}
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def additional_metrics(self):
+        return (NUM_INPUT_BATCHES, NUM_INPUT_ROWS, PARTITION_SIZE)
+
+    @property
+    def n_partitions(self) -> int:
+        return 1 if self._mesh is None else mesh_axis_size(self._mesh)
+
+    # -- kernels -----------------------------------------------------------
+    def _local_pid(self, local: ColumnarBatch, n: int):
+        keys = [e.columnar_eval(local) for e in self._bound]
+        return partition_ids(keys, local.num_rows, local.capacity, n)
+
+    def _measure_kernel(self, stacked):
+        """Per-device partition histogram + max string byte length. Runs
+        vmapped over the device axis (it is pure per-device measurement —
+        no collective), one host sync for both scalars."""
+        n = self.n_partitions
+
+        def per_dev(local: ColumnarBatch):
+            pid = self._local_pid(local, n)
+            ones = jnp.where(pid < n, jnp.int32(1), jnp.int32(0))
+            counts = jax.ops.segment_sum(ones, pid.astype(jnp.int32),
+                                         num_segments=n + 1)
+            max_count = jnp.max(counts[:n])
+            max_len = jnp.int32(0)
+            act = active_mask(local.num_rows, local.capacity)
+            for c in local.columns:
+                if isinstance(c, StringColumn):
+                    lens = string_lengths(c)
+                    max_len = jnp.maximum(
+                        max_len, jnp.max(jnp.where(act, lens, 0)))
+            return max_count, max_len
+
+        max_count, max_len = jax.vmap(per_dev)(stacked)
+        return jnp.max(max_count), jnp.max(max_len)
+
+    def _get_step(self, cap: int, slot_cap: int, width: int):
+        key = (cap, slot_cap, width)
+        step = self._steps.get(key)
+        if step is not None:
+            return step
+        n = self.n_partitions
+        schema = self.output_schema
+
+        def spmd(stacked):
+            local = _squeeze0(stacked)
+            pid = self._local_pid(local, n)
+            cols, n_recv = exchange_columns(
+                list(local.columns), (), local.num_rows, local.capacity,
+                DATA_AXIS, n, slot_cap=slot_cap, string_width=width,
+                pid=pid)
+            return _expand0(ColumnarBatch(cols, n_recv, schema))
+
+        step = jax.jit(jax.shard_map(
+            spmd, mesh=self._mesh, in_specs=P(DATA_AXIS),
+            out_specs=P(DATA_AXIS), check_vma=False))
+        self._steps[key] = step
+        return step
+
+    # -- drive -------------------------------------------------------------
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        from ..parallel.distributed import stack_batches, unstack_batches
+
+        n = self.n_partitions
+        schema = self.output_schema
+        in_batches = self.metrics[NUM_INPUT_BATCHES]
+        in_rows = self.metrics[NUM_INPUT_ROWS]
+        batches: List[ColumnarBatch] = []
+        for b in self.child.execute():
+            in_batches.add(1)
+            if b._host_rows is not None:
+                in_rows.add(b._host_rows)
+            else:
+                in_rows.add_device(b.num_rows)
+            batches.append(b)
+        if n == 1:
+            yield from batches
+            return
+
+        with self.metrics[OP_TIME].ns_timer():
+            # round-robin batches onto device shards, one batch per device
+            groups = [batches[d::n] for d in range(n)]
+            per_dev = []
+            for g in groups:
+                if not g:
+                    per_dev.append(empty_batch(schema))
+                elif len(g) == 1:
+                    per_dev.append(g[0])
+                else:
+                    per_dev.append(concat_batches(g, schema))
+            cap = max(b.capacity for b in per_dev)
+            per_dev = [b.sized_to(cap) for b in per_dev]
+            stacked = stack_batches(per_dev)
+
+            max_count, max_len = self._jit_measure(stacked)
+            # one host sync per exchange: size the receive buffer to the
+            # measured max partition load, and string lanes to the measured
+            # max byte length (truncation structurally impossible)
+            slot_cap = min(bucket_capacity(max(int(max_count), 1)), cap)
+            width = max(8, (int(max_len) + 7) // 8 * 8)
+            self.metrics[PARTITION_SIZE].add(int(max_count))
+
+            out = self._get_step(cap, slot_cap, width)(stacked)
+            yield from unstack_batches(out, n)
+
+    def node_description(self):
+        return (f"ShuffleExchangeExec[n={self.n_partitions}, "
+                f"keys={self.partition_exprs!r}]")
+
+
+class BroadcastExchangeExec(TpuExec):
+    """Materialize the child once as a single device-resident batch and
+    replay it to every consumer execution (reference
+    GpuBroadcastExchangeExec.scala:352: the build side is collected,
+    serialized once, and kept device-resident on every executor).
+
+    On a TPU mesh the replication itself is free at this layer: the batch
+    lives in HBM and multi-chip consumers read it replicated (an
+    all-gather-free broadcast — the stream side never moves at all, which
+    is the entire point of a broadcast join)."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+        self._materialized: Optional[ColumnarBatch] = None
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def additional_metrics(self):
+        return ("broadcastTime", PARTITION_SIZE)
+
+    def materialize(self) -> ColumnarBatch:
+        if self._materialized is None:
+            with self.metrics["broadcastTime"].ns_timer():
+                batches = list(self.child.execute())
+                if not batches:
+                    self._materialized = empty_batch(self.output_schema)
+                elif len(batches) == 1:
+                    self._materialized = batches[0]
+                else:
+                    self._materialized = concat_batches(
+                        batches, self.output_schema)
+            self.metrics[PARTITION_SIZE].add(
+                self._materialized.device_size_bytes())
+        return self._materialized
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        yield self.materialize()
+
+    def node_description(self):
+        return "BroadcastExchangeExec"
+
+
+class ShuffledHashJoinExec(TpuExec):
+    """Per-partition hash join over two shuffle exchanges (reference
+    GpuShuffledHashJoinExec.scala). Both children are hash-partitioned on
+    the join keys with the SAME partitioning, so rows with equal keys
+    colocate on one shard; the union of per-partition joins is globally
+    exact — including outer sides, because an unmatched row can only ever
+    match within its own partition.
+
+    One inner HashJoinExec instance is reused across partitions (its jit
+    caches key on batch shapes, which repeat across shards)."""
+
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 join_type: str = "inner",
+                 build_side: str = "right",
+                 condition: Optional[Expression] = None):
+        super().__init__(left, right)
+        from .joins import HashJoinExec
+        self.join_type = join_type
+        self._lscan = InMemoryScanExec([], left.output_schema)
+        self._rscan = InMemoryScanExec([], right.output_schema)
+        self._join = HashJoinExec(self._lscan, self._rscan, left_keys,
+                                  right_keys, join_type,
+                                  build_side=build_side, condition=condition)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._join.output_schema
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        lparts = list(self.children[0].execute())
+        rparts = list(self.children[1].execute())
+        assert len(lparts) == len(rparts), \
+            "both sides must use the same partitioning"
+        for lp, rp in zip(lparts, rparts):
+            self._lscan._batches = [lp]
+            self._rscan._batches = [rp]
+            yield from self._join.execute()
+
+    def node_description(self):
+        return f"ShuffledHashJoinExec[{self.join_type}]"

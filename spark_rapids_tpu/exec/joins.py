@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..columnar.batch import ColumnarBatch
-from ..columnar.column import Column, bucket_capacity
+from ..columnar.column import Column, StringColumn, bucket_capacity
 from ..expr.core import Expression, resolve
 from ..memory.spillable import SpillableBatch
 from ..ops.basic import active_mask, compaction_order, gather_column
@@ -45,10 +45,47 @@ LEFT_SEMI, LEFT_ANTI, EXISTENCE, CROSS = "left_semi", "left_anti", \
     "existence", "cross"
 
 
-def _gather_batch(columns: Sequence[Column], idx, n) -> List[Column]:
+def _gather_batch(columns: Sequence[Column], idx, n,
+                  byte_caps: Optional[Tuple] = None) -> List[Column]:
+    """byte_caps: per-column static output byte bucket (None entries keep
+    the input bucket). Joins DUPLICATE rows, so string columns must size
+    their output byte bucket from the measured join byte need — the input
+    bucket silently truncates payloads once output bytes exceed it."""
     cap = idx.shape[0]
     act = active_mask(n, cap)
-    return [gather_column(c, jnp.where(act, idx, -1)) for c in columns]
+    caps = byte_caps or (None,) * len(columns)
+    return [gather_column(c, jnp.where(act, idx, -1), out_byte_capacity=bc)
+            for c, bc in zip(columns, caps)]
+
+
+def _string_byte_needs(stream_columns, build: BuildTable, lo, counts, act):
+    """Exact output byte requirement per string column of the join, all on
+    device (fetched together with the candidate total in the one host sync
+    per stream batch).
+
+    Stream side: row i is emitted count_i times (candidates) plus at most
+    once more (outer-unmatched tail). Build side: candidate bytes are the
+    per-row sorted-order prefix-sum ranges [lo, lo+count)."""
+    from ..ops.strings import string_lengths
+    cnt = counts.astype(jnp.int64)
+    stream_needs = []
+    for c in stream_columns:
+        if isinstance(c, StringColumn):
+            lens = jnp.where(act, string_lengths(c), 0).astype(jnp.int64)
+            stream_needs.append(jnp.sum(cnt * lens) + jnp.sum(lens))
+    build_needs = []
+    for prefix in build.payload_prefix:
+        # precomputed in BuildTable.build (invariant across stream batches)
+        build_needs.append(jnp.sum(prefix[lo + counts] - prefix[lo]))
+    return tuple(stream_needs), tuple(build_needs)
+
+
+def _byte_cap_tuple(columns, needs) -> Tuple:
+    """Static per-column byte buckets from fetched needs (None = keep the
+    input bucket for fixed-width columns)."""
+    it = iter(needs)
+    return tuple(bucket_capacity(max(int(next(it)), 8))
+                 if isinstance(c, StringColumn) else None for c in columns)
 
 
 class HashJoinExec(TpuExec):
@@ -75,7 +112,8 @@ class HashJoinExec(TpuExec):
         # body (sized by stream + candidate buckets, static per shape)
         self._jit_build = jax.jit(self._build_kernel)
         self._jit_counts = jax.jit(self._counts_kernel)
-        self._jit_probe = jax.jit(self._probe_kernel, static_argnums=(5,))
+        self._jit_probe = jax.jit(self._probe_kernel,
+                                  static_argnums=(5, 6, 7))
 
     # -- schema ------------------------------------------------------------
     @property
@@ -162,19 +200,25 @@ class HashJoinExec(TpuExec):
         lo, counts, _ = probe_counts(build, skey_cols,
                                      stream_batch.num_rows,
                                      stream_batch.capacity)
-        return lo, counts, skey_cols
+        act = active_mask(stream_batch.num_rows, stream_batch.capacity)
+        needs = _string_byte_needs(stream_batch.columns, build, lo, counts,
+                                   act)
+        return lo, counts, skey_cols, jnp.sum(counts.astype(jnp.int64)), needs
 
     def _probe_kernel(self, build: BuildTable, build_batch: ColumnarBatch,
                       stream_batch: ColumnarBatch, lo_counts, build_matched,
-                      cand_cap: int):
+                      cand_cap: int, s_caps: Tuple = (), b_caps: Tuple = ()):
         lo, counts, skey_cols = lo_counts
+        s_caps = s_caps or (None,) * len(stream_batch.columns)
+        b_caps = b_caps or (None,) * len(build.payload)
         scap = stream_batch.capacity
         s_idx, b_pos, total_dev = expand_candidates(lo, counts, cand_cap)
         verified, b_row = verify_pairs(build, skey_cols, s_idx, b_pos,
                                        s_idx >= 0)
         if self.condition is not None:
             verified = verified & self._eval_condition(
-                stream_batch, build_batch, s_idx, b_row, cand_cap)
+                stream_batch, build_batch, s_idx, b_row, cand_cap,
+                s_caps, b_caps)
 
         jt, bs = self.join_type, self.build_side
         stream_preserved = (jt == LEFT_OUTER and bs == "right") or \
@@ -210,8 +254,8 @@ class HashJoinExec(TpuExec):
         else:
             n_out = n_pairs
 
-        scols = _gather_batch(stream_batch.columns, s_map, n_out)
-        bcols = _gather_batch(build.payload, b_map, n_out)
+        scols = _gather_batch(stream_batch.columns, s_map, n_out, s_caps)
+        bcols = _gather_batch(build.payload, b_map, n_out, b_caps)
         left_cols = scols if self.build_side == "right" else bcols
         right_cols = bcols if self.build_side == "right" else scols
         return (ColumnarBatch(left_cols + right_cols, n_out,
@@ -219,12 +263,17 @@ class HashJoinExec(TpuExec):
 
     def _probe_one(self, build: BuildTable, build_batch: ColumnarBatch,
                    stream_batch: ColumnarBatch, build_matched):
-        lo, counts, skey_cols = self._jit_counts(build, stream_batch)
-        total = int(jnp.sum(counts))  # host sync: size the candidate bucket
-        cand_cap = bucket_capacity(max(total, 1))
+        lo, counts, skey_cols, total_dev, needs_dev = \
+            self._jit_counts(build, stream_batch)
+        # ONE host sync per stream batch sizes the candidate bucket AND the
+        # string byte buckets (exact measured needs, no truncation)
+        total, (s_needs, b_needs) = jax.device_get((total_dev, needs_dev))
+        cand_cap = bucket_capacity(max(int(total), 1))
+        s_caps = _byte_cap_tuple(stream_batch.columns, s_needs)
+        b_caps = _byte_cap_tuple(build.payload, b_needs)
         return self._jit_probe(build, build_batch, stream_batch,
                                (lo, counts, skey_cols), build_matched,
-                               cand_cap)
+                               cand_cap, s_caps, b_caps)
 
     def _emit_build_unmatched(self, build: BuildTable,
                               build_batch: ColumnarBatch, build_matched):
@@ -244,11 +293,16 @@ class HashJoinExec(TpuExec):
         return ColumnarBatch(left_cols + right_cols, n_un, self.output_schema)
 
     def _eval_condition(self, stream_batch, build_batch, s_idx, b_row,
-                        cand_cap: int):
+                        cand_cap: int, s_caps: Tuple = (),
+                        b_caps: Tuple = ()):
         """Evaluate the residual condition over candidate pairs: build a
         pair batch of gathered left+right columns in output order."""
-        scols = [gather_column(c, s_idx) for c in stream_batch.columns]
-        bcols = [gather_column(c, b_row) for c in build_batch.columns]
+        s_caps = s_caps or (None,) * len(stream_batch.columns)
+        b_caps = b_caps or (None,) * len(build_batch.columns)
+        scols = [gather_column(c, s_idx, out_byte_capacity=bc)
+                 for c, bc in zip(stream_batch.columns, s_caps)]
+        bcols = [gather_column(c, b_row, out_byte_capacity=bc)
+                 for c, bc in zip(build_batch.columns, b_caps)]
         left_cols = scols if self.build_side == "right" else bcols
         right_cols = bcols if self.build_side == "right" else scols
         lf = list(self.left_schema.fields)
@@ -295,6 +349,33 @@ class NestedLoopJoinExec(TpuExec):
               for f in self.children[1].output_schema.fields]
         return Schema(tuple(lf + rf))
 
+    @staticmethod
+    def _max_lens(batch: ColumnarBatch, n_rows: int) -> List[Optional[int]]:
+        """Max string byte length per column (None for fixed-width); ONE
+        host sync per batch (stacked fetch), hoisted out of the chunk
+        loop."""
+        from ..ops.strings import string_lengths
+        maxes = []
+        for c in batch.columns:
+            if isinstance(c, StringColumn):
+                act = jnp.arange(c.capacity, dtype=jnp.int32) < n_rows
+                maxes.append(jnp.max(jnp.where(act, string_lengths(c), 0)))
+        if not maxes:
+            return [None] * len(batch.columns)
+        fetched = iter(jax.device_get(jnp.stack(maxes)).tolist())
+        return [int(next(fetched)) if isinstance(c, StringColumn) else None
+                for c in batch.columns]
+
+    @staticmethod
+    def _chunk_byte_caps(max_lens: List[Optional[int]], chunk_cap: int
+                         ) -> Tuple:
+        """Cross joins duplicate every row: size each string column's
+        output byte bucket from its max row length × chunk capacity (the
+        input bucket truncates once duplicated bytes exceed it)."""
+        return tuple(None if ml is None
+                     else bucket_capacity(max(chunk_cap * ml, 8))
+                     for ml in max_lens)
+
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         right_batches = list(self.children[1].execute())
         if right_batches:
@@ -304,9 +385,11 @@ class NestedLoopJoinExec(TpuExec):
             from ..columnar.batch import empty_batch
             build = empty_batch(self.children[1].output_schema)
         b_rows = build.num_rows_host
+        b_lens = self._max_lens(build, b_rows)
 
         for stream in self.children[0].execute():
             s_rows = stream.num_rows_host
+            s_lens = self._max_lens(stream, s_rows)
             total = s_rows * b_rows
             jt = self.join_type
             smatched = jnp.zeros((stream.capacity,), jnp.bool_)
@@ -319,18 +402,22 @@ class NestedLoopJoinExec(TpuExec):
                 chunk = min(total - start, cap)
                 s_idx, b_idx, n = cross_pairs(
                     jnp.int32(s_rows), jnp.int32(b_rows), jnp.int32(start), cap)
+                s_caps = self._chunk_byte_caps(s_lens, cap)
+                b_caps = self._chunk_byte_caps(b_lens, cap)
                 verified = (s_idx >= 0)
                 if self.condition is not None:
                     verified = verified & self._condition_mask(
-                        stream, build, s_idx, b_idx, cap)
+                        stream, build, s_idx, b_idx, cap, s_caps, b_caps)
                 if jt in (LEFT_SEMI, LEFT_ANTI, EXISTENCE, LEFT_OUTER):
                     smatched = smatched | matched_flags(
                         verified, s_idx, stream.capacity)
                 if jt in (INNER, CROSS, LEFT_OUTER):
                     s_map, b_map, n_pairs = inner_gather_maps(
                         verified, s_idx, b_idx, n)
-                    scols = _gather_batch(stream.columns, s_map, n_pairs)
-                    bcols = _gather_batch(build.columns, b_map, n_pairs)
+                    scols = _gather_batch(stream.columns, s_map, n_pairs,
+                                          s_caps)
+                    bcols = _gather_batch(build.columns, b_map, n_pairs,
+                                          b_caps)
                     yield ColumnarBatch(scols + bcols, n_pairs,
                                         self.output_schema)
                 start += chunk
@@ -355,9 +442,14 @@ class NestedLoopJoinExec(TpuExec):
                 yield ColumnarBatch(list(stream.columns) + [flag],
                                     stream.num_rows, self.output_schema)
 
-    def _condition_mask(self, stream, build, s_idx, b_idx, cap: int):
-        scols = [gather_column(c, s_idx) for c in stream.columns]
-        bcols = [gather_column(c, b_idx) for c in build.columns]
+    def _condition_mask(self, stream, build, s_idx, b_idx, cap: int,
+                        s_caps: Tuple = (), b_caps: Tuple = ()):
+        s_caps = s_caps or (None,) * len(stream.columns)
+        b_caps = b_caps or (None,) * len(build.columns)
+        scols = [gather_column(c, s_idx, out_byte_capacity=bc)
+                 for c, bc in zip(stream.columns, s_caps)]
+        bcols = [gather_column(c, b_idx, out_byte_capacity=bc)
+                 for c, bc in zip(build.columns, b_caps)]
         pair_schema = Schema(tuple(self.children[0].output_schema.fields) +
                              tuple(self.children[1].output_schema.fields))
         pair = ColumnarBatch(scols + bcols, jnp.int32(cap), pair_schema)

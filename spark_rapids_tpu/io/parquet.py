@@ -46,6 +46,12 @@ class ParquetSource:
             fields.append(StructField(f.name, from_arrow(f.type), f.nullable))
         self.schema = Schema(tuple(fields))
 
+    def estimated_size_bytes(self) -> int:
+        """Broadcast-planning size estimate: on-disk bytes (compressed, so
+        an underestimate like Spark's file-size statistics)."""
+        import os
+        return sum(os.path.getsize(p) for p in self.paths)
+
     def batches(self) -> Iterator[ColumnarBatch]:
         import pyarrow.parquet as pq
 

@@ -50,7 +50,7 @@ class BuildTable:
 
     def __init__(self, sorted_hash, perm, valid_count, num_rows,
                  key_cols: Sequence[Column], payload: Sequence[Column],
-                 capacity: int):
+                 capacity: int, payload_prefix: Sequence = ()):
         self.sorted_hash = sorted_hash
         self.perm = perm  # sorted position -> original build row
         self.valid_count = valid_count
@@ -58,10 +58,15 @@ class BuildTable:
         self.key_cols = list(key_cols)
         self.payload = list(payload)
         self.capacity = capacity
+        # per STRING payload column (payload order): (capacity+1,) int64
+        # prefix sum of row byte lengths in sorted order — sizes the join's
+        # string output buckets without per-stream-batch recomputation
+        self.payload_prefix = tuple(payload_prefix)
 
     @staticmethod
     def build(key_cols: Sequence[Column], payload: Sequence[Column],
               num_rows, capacity: int) -> "BuildTable":
+        from .strings import string_lengths
         valid = _keys_valid(key_cols, num_rows, capacity)
         h = xxhash64_batch(list(key_cols), seed=JOIN_HASH_SEED)
         # invalid/inactive rows: push to the end with the max hash AND keep
@@ -72,19 +77,30 @@ class BuildTable:
         iota = jnp.arange(capacity, dtype=jnp.int32)
         sorted_h, _, perm = jax.lax.sort(
             (sort_h, (~valid).astype(jnp.int8), iota), num_keys=2)
-        return BuildTable(sorted_h, perm, jnp.sum(valid, dtype=jnp.int32),
-                          num_rows, key_cols, payload, capacity)
+        valid_count = jnp.sum(valid, dtype=jnp.int32)
+        prefixes = []
+        for c in payload:
+            if isinstance(c, StringColumn):
+                lens = string_lengths(c).astype(jnp.int64)
+                sorted_lens = jnp.where(iota < valid_count, lens[perm], 0)
+                prefixes.append(jnp.concatenate(
+                    [jnp.zeros((1,), jnp.int64), jnp.cumsum(sorted_lens)]))
+        return BuildTable(sorted_h, perm, valid_count,
+                          num_rows, key_cols, payload, capacity, prefixes)
 
 
 def _bt_flatten(bt: BuildTable):
     return ((bt.sorted_hash, bt.perm, bt.valid_count, bt.num_rows,
-             tuple(bt.key_cols), tuple(bt.payload)), bt.capacity)
+             tuple(bt.key_cols), tuple(bt.payload), bt.payload_prefix),
+            bt.capacity)
 
 
 def _bt_unflatten(capacity, children):
-    sorted_hash, perm, valid_count, num_rows, key_cols, payload = children
+    (sorted_hash, perm, valid_count, num_rows, key_cols, payload,
+     payload_prefix) = children
     return BuildTable(sorted_hash, perm, valid_count, num_rows,
-                      list(key_cols), list(payload), capacity)
+                      list(key_cols), list(payload), capacity,
+                      payload_prefix)
 
 
 jax.tree_util.register_pytree_node(BuildTable, _bt_flatten, _bt_unflatten)
@@ -110,14 +126,19 @@ def expand_candidates(lo, counts, out_capacity: int):
     out_capacity >= total candidates (host-chosen bucket). Pair i belongs to
     the stream row whose cumulative count interval contains i.
     """
-    cum = jnp.cumsum(counts)  # inclusive
-    total = cum[-1] if counts.shape[0] else jnp.int32(0)
-    i = jnp.arange(out_capacity, dtype=jnp.int32)
+    # int64 accumulation: with extreme key skew the candidate count can
+    # exceed 2^31; an int32 cumsum would wrap silently and drop join rows
+    # (review finding r1)
+    cum = jnp.cumsum(counts.astype(jnp.int64))  # inclusive
+    total = cum[-1] if counts.shape[0] else jnp.int64(0)
+    i = jnp.arange(out_capacity, dtype=jnp.int64)
     stream_idx = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
     in_range = i < total
     safe_stream = jnp.clip(stream_idx, 0, counts.shape[0] - 1)
     before = cum[safe_stream] - counts[safe_stream]
-    build_pos = lo[safe_stream] + (i - before)
+    # (i - before) < per-row count <= capacity, so the int64->int32 narrowing
+    # is safe after the subtraction
+    build_pos = lo[safe_stream] + (i - before).astype(jnp.int32)
     return jnp.where(in_range, safe_stream, -1), build_pos, total
 
 

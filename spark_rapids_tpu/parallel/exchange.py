@@ -73,18 +73,22 @@ def _fixed_to_blocks(col: Column, send_idx, n_parts: int, slot_cap: int):
 def exchange_columns(columns: Sequence[Column], key_ordinals: Sequence[int],
                      num_rows, capacity: int, axis_name: str, n_parts: int,
                      slot_cap: int | None = None, string_width: int = 64,
-                     ) -> Tuple[List[Column], jnp.ndarray]:
+                     pid=None) -> Tuple[List[Column], jnp.ndarray]:
     """SPMD body (call inside shard_map): hash-partition local rows and
     all-to-all them so partition p's rows land on device p.
 
-    Returns (received columns, received row count); received capacity is
-    n_parts*slot_cap with active rows compacted to the front.
+    Partitioning comes from `pid` when given (precomputed partition ids,
+    e.g. from expressions over the batch) else from hashing the columns at
+    `key_ordinals`. Returns (received columns, received row count);
+    received capacity is n_parts*slot_cap with active rows compacted to
+    the front.
     """
     from ..ops.strings import string_from_padded, string_to_padded
 
     slot_cap = slot_cap or capacity
-    key_cols = [columns[i] for i in key_ordinals]
-    pid = partition_ids(key_cols, num_rows, capacity, n_parts)
+    if pid is None:
+        key_cols = [columns[i] for i in key_ordinals]
+        pid = partition_ids(key_cols, num_rows, capacity, n_parts)
     send_idx = partition_slots(pid, num_rows, capacity, n_parts, slot_cap)
 
     out_cols: List[Column] = []

@@ -32,3 +32,21 @@ def device_mesh(n_devices: Optional[int] = None,
 
 def mesh_axis_size(mesh: Mesh, axis_name: str = DATA_AXIS) -> int:
     return mesh.shape[axis_name]
+
+
+# -- active mesh (planner seam) ---------------------------------------------
+# The session installs its mesh here; TpuOverrides reads it to decide
+# whether to plan distributed stages (partial → exchange → final, shuffled
+# joins). The analog of the reference's "is a shuffle manager configured"
+# check (RapidsShuffleInternalManagerBase).
+
+_ACTIVE_MESH: Optional[Mesh] = None
+
+
+def set_active_mesh(mesh: Optional[Mesh]) -> None:
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH

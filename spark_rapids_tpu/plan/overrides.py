@@ -136,6 +136,32 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
 # plan metas
 # ---------------------------------------------------------------------------
 
+def estimate_plan_size(plan: L.LogicalPlan) -> Optional[int]:
+    """Best-effort bytes estimate for broadcast planning (the analog of
+    Spark's logical-plan statistics feeding autoBroadcastJoinThreshold).
+    None = unknown (never broadcast)."""
+    if isinstance(plan, L.LogicalScan):
+        est = getattr(plan.source, "estimated_size_bytes", None)
+        return est() if callable(est) else None
+    if isinstance(plan, L.LogicalRange):
+        if plan.step > 0:
+            n = max(0, (plan.end - plan.start + plan.step - 1) // plan.step)
+        else:
+            n = max(0, (plan.start - plan.end - plan.step - 1) // -plan.step)
+        return n * 8
+    if isinstance(plan, (L.LogicalProject, L.LogicalFilter, L.LogicalLimit,
+                         L.LogicalSort)):
+        # conservative: assume no reduction (Spark sizes filters the same
+        # way without column stats)
+        return estimate_plan_size(plan.children[0])
+    if isinstance(plan, L.LogicalUnion):
+        sizes = [estimate_plan_size(c) for c in plan.children]
+        if any(s is None for s in sizes):
+            return None
+        return sum(sizes)
+    return None
+
+
 class PlanMeta(BaseMeta):
     def __init__(self, plan: L.LogicalPlan, conf: RapidsConf):
         super().__init__()
@@ -215,6 +241,95 @@ class PlanMeta(BaseMeta):
         return "\n".join(lines)
 
     # -- conversion --------------------------------------------------------
+    def _plan_mesh(self):
+        """Active multi-device mesh, or None when the plan should stay
+        single-partition (no mesh / 1-device mesh / exchange planning
+        disabled)."""
+        from ..config import SHUFFLE_PLAN_EXCHANGE
+        from ..parallel.mesh import active_mesh, mesh_axis_size
+        mesh = active_mesh()
+        if mesh is None or mesh_axis_size(mesh) <= 1:
+            return None
+        if not self.conf.get(SHUFFLE_PLAN_EXCHANGE):
+            return None
+        return mesh
+
+    def _convert_distributed_aggregate(self, p, child: TpuExec, mesh
+                                       ) -> TpuExec:
+        """partial → shuffle exchange on the group keys → final (reference
+        Spark's partial/final split feeding GpuShuffleExchangeExecBase)."""
+        from ..exec.exchange import ShuffleExchangeExec
+        partial = AggregateExec(p.group_exprs, p.aggregates, child,
+                                mode="partial")
+        key_names = partial.output_schema.names[: len(p.group_exprs)]
+        part_keys = [UnresolvedAttribute(n) for n in key_names]
+        exchange = ShuffleExchangeExec(part_keys, partial, mesh)
+        return AggregateExec(p.group_exprs, p.aggregates, exchange,
+                             mode="final")
+
+    def _convert_distributed_join(self, p, left: TpuExec, right: TpuExec,
+                                  mesh) -> Optional[TpuExec]:
+        """exchange both sides on the join keys → per-partition shuffled
+        hash join (reference GpuShuffledHashJoinExec). Returns None when
+        the key partitioning cannot be made consistent (mismatched key
+        types hash differently) — caller falls back to the single-partition
+        join."""
+        from ..exec.basic import bind_projection
+        from ..exec.exchange import ShuffledHashJoinExec, ShuffleExchangeExec
+        lb = bind_projection(p.left_keys, left.output_schema)
+        rb = bind_projection(p.right_keys, right.output_schema)
+        if any(l.data_type != r.data_type for l, r in zip(lb, rb)):
+            return None
+        lex = ShuffleExchangeExec(p.left_keys, left, mesh)
+        rex = ShuffleExchangeExec(p.right_keys, right, mesh)
+        return ShuffledHashJoinExec(lex, rex, p.left_keys, p.right_keys,
+                                    p.join_type, condition=p.condition)
+
+    def _convert_join(self, p, kids) -> TpuExec:
+        """Join strategy selection, in the reference's preference order
+        (GpuOverrides + Spark's JoinSelection): broadcast when a side's
+        estimated size is under the threshold (no data movement for the
+        stream side at all), else shuffled hash join over the mesh, else
+        the single-partition hash join. Keyless joins go to the
+        (broadcast) nested-loop join."""
+        from ..config import BROADCAST_SIZE_THRESHOLD
+        from ..exec.exchange import BroadcastExchangeExec
+        thr = self.conf.get(BROADCAST_SIZE_THRESHOLD)
+        jt = p.join_type
+        size_l = estimate_plan_size(p.children[0])
+        size_r = estimate_plan_size(p.children[1])
+        can_bcast_r = thr >= 0 and size_r is not None and size_r <= thr \
+            and jt in ("inner", "left_outer", "left_semi", "left_anti",
+                       "existence", "cross")
+        can_bcast_l = thr >= 0 and size_l is not None and size_l <= thr \
+            and jt in ("inner", "right_outer")
+
+        if not p.left_keys:
+            if can_bcast_r:
+                return NestedLoopJoinExec(kids[0],
+                                          BroadcastExchangeExec(kids[1]),
+                                          jt, p.condition)
+            return NestedLoopJoinExec(kids[0], kids[1], jt, p.condition)
+
+        # prefer broadcasting the smaller eligible side
+        if can_bcast_r and can_bcast_l and size_l < size_r:
+            can_bcast_r = False
+        if can_bcast_r:
+            return HashJoinExec(kids[0], BroadcastExchangeExec(kids[1]),
+                                p.left_keys, p.right_keys, jt,
+                                build_side="right", condition=p.condition)
+        if can_bcast_l:
+            return HashJoinExec(BroadcastExchangeExec(kids[0]), kids[1],
+                                p.left_keys, p.right_keys, jt,
+                                build_side="left", condition=p.condition)
+        mesh = self._plan_mesh()
+        if mesh is not None:
+            out = self._convert_distributed_join(p, kids[0], kids[1], mesh)
+            if out is not None:
+                return out
+        return HashJoinExec(kids[0], kids[1], p.left_keys, p.right_keys,
+                            p.join_type, condition=p.condition)
+
     def convert(self) -> TpuExec:
         p = self.plan
         kids = [c.convert() for c in self.children]
@@ -229,6 +344,9 @@ class PlanMeta(BaseMeta):
         if isinstance(p, L.LogicalFilter):
             return FilterExec(p.condition, kids[0])
         if isinstance(p, L.LogicalAggregate):
+            mesh = self._plan_mesh()
+            if mesh is not None and p.group_exprs:
+                return self._convert_distributed_aggregate(p, kids[0], mesh)
             return AggregateExec(p.group_exprs, p.aggregates, kids[0])
         if isinstance(p, L.LogicalSort):
             if p.limit is None:
@@ -243,11 +361,7 @@ class PlanMeta(BaseMeta):
         if isinstance(p, L.LogicalWindow):
             return WindowExec(p.window_exprs, kids[0])
         if isinstance(p, L.LogicalJoin):
-            if not p.left_keys:
-                return NestedLoopJoinExec(kids[0], kids[1], p.join_type,
-                                          p.condition)
-            return HashJoinExec(kids[0], kids[1], p.left_keys, p.right_keys,
-                                p.join_type, condition=p.condition)
+            return self._convert_join(p, kids)
         raise PlanNotSupported(f"no conversion for {type(p).__name__}")
 
 

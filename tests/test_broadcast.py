@@ -1,0 +1,128 @@
+"""Broadcast exchange + broadcast hash join planning tests (reference
+GpuBroadcastExchangeExec.scala:352, GpuBroadcastHashJoinExecBase,
+Spark JoinSelection's autoBroadcastJoinThreshold)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.types import LONG, STRING, Schema, StructField
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                             reason="needs 8 virtual devices")
+
+
+def _sorted(rows):
+    return sorted(rows, key=lambda r: tuple((x is None, x) for x in r))
+
+
+LSCH = Schema((StructField("k", LONG), StructField("lv", LONG)))
+RSCH = Schema((StructField("k", LONG), StructField("rv", STRING)))
+
+
+def _frames(sess, nl=200, nr=10):
+    rng = np.random.default_rng(11)
+    l = sess.from_pydict(
+        {"k": [int(x) for x in rng.integers(0, 20, nl)],
+         "lv": [int(x) for x in rng.integers(0, 1000, nl)]},
+        LSCH, batch_rows=64)
+    r = sess.from_pydict(
+        {"k": [int(x) for x in rng.integers(0, 20, nr)],
+         "rv": [f"r{i}" for i in range(nr)]}, RSCH)
+    return l, r
+
+
+def test_small_build_side_plans_broadcast():
+    sess = TpuSession()
+    l, r = _frames(sess)
+    tree = l.join(r, on="k")._exec().tree_string()
+    assert "BroadcastExchangeExec" in tree
+    assert "build=right" in tree
+
+
+@needs_8
+def test_broadcast_beats_shuffle_when_small():
+    """With a mesh active, a small build side must still broadcast (no
+    exchange of the big stream side)."""
+    sess = TpuSession(mesh_devices=8)
+    l, r = _frames(sess)
+    tree = l.join(r, on="k")._exec().tree_string()
+    assert "BroadcastExchangeExec" in tree
+    assert "ShuffleExchangeExec" not in tree
+
+
+@needs_8
+def test_large_build_side_shuffles():
+    sess = TpuSession({"spark.rapids.sql.broadcastSizeThreshold": "1"},
+                      mesh_devices=8)
+    l, r = _frames(sess)
+    tree = l.join(r, on="k")._exec().tree_string()
+    assert "BroadcastExchangeExec" not in tree
+    assert "ShuffledHashJoinExec" in tree
+
+
+def test_broadcast_disabled():
+    sess = TpuSession({"spark.rapids.sql.broadcastSizeThreshold": "-1"})
+    l, r = _frames(sess)
+    tree = l.join(r, on="k")._exec().tree_string()
+    assert "BroadcastExchangeExec" not in tree
+
+
+def test_broadcast_left_for_right_outer():
+    sess = TpuSession()
+    rng = np.random.default_rng(3)
+    small = sess.from_pydict({"k": [1, 2], "lv": [10, 20]}, LSCH)
+    big = sess.from_pydict(
+        {"k": [int(x) for x in rng.integers(0, 5, 300)],
+         "rv": [f"r{i}" for i in range(300)]}, RSCH, batch_rows=64)
+    tree = small.join(big, on="k", how="right_outer")._exec().tree_string()
+    assert "BroadcastExchangeExec" in tree
+    assert "build=left" in tree
+
+
+@pytest.mark.parametrize("how", ["inner", "left_outer", "left_semi",
+                                 "left_anti"])
+def test_broadcast_join_results_match(how):
+    bcast = TpuSession()
+    plain = TpuSession({"spark.rapids.sql.broadcastSizeThreshold": "-1"})
+
+    def run(sess):
+        l, r = _frames(sess)
+        return _sorted(l.join(r, on="k", how=how).collect())
+
+    assert run(bcast) == run(plain)
+
+
+def test_broadcast_materializes_once():
+    sess = TpuSession()
+    l, r = _frames(sess)
+    exec_tree = l.join(r, on="k")._exec()
+
+    def find(node):
+        from spark_rapids_tpu.exec.exchange import BroadcastExchangeExec
+        if isinstance(node, BroadcastExchangeExec):
+            return node
+        for c in node.children:
+            got = find(c)
+            if got is not None:
+                return got
+        return None
+
+    bx = find(exec_tree)
+    assert bx is not None
+    first = bx.materialize()
+    assert bx.materialize() is first
+
+
+def test_broadcast_nested_loop_join():
+    sess = TpuSession()
+    l = sess.from_pydict({"k": [1, 2, 3], "lv": [10, 20, 30]}, LSCH)
+    r = sess.from_pydict({"k": [7, 8], "rv": ["a", "b"]}, RSCH)
+    df = l.join(r.select(col("k").alias("k2"), col("rv")), how="cross")
+    tree = df._exec().tree_string()
+    assert "BroadcastExchangeExec" in tree
+    assert len(df.collect()) == 6
