@@ -36,6 +36,41 @@ from ..types import (
 MIN_BUCKET = 128
 
 
+def _logical_to_physical(dtype: DataType):
+    """Value converter for host ingestion: accept the *logical* Python
+    values Spark's rows carry (datetime.date, datetime.datetime,
+    decimal.Decimal) alongside the raw physical encodings (int days /
+    micros / unscaled)."""
+    import datetime as _dt
+    import decimal as _dec
+
+    from ..types import DateType, DecimalType, TimestampNTZType, TimestampType
+    if isinstance(dtype, DateType):
+        epoch = _dt.date(1970, 1, 1)
+        return lambda v: (v - epoch).days if isinstance(v, _dt.date) \
+            and not isinstance(v, _dt.datetime) else v
+    if isinstance(dtype, (TimestampType, TimestampNTZType)):
+        epoch = _dt.datetime(1970, 1, 1)
+        one_us = _dt.timedelta(microseconds=1)
+        ntz = isinstance(dtype, TimestampNTZType)
+
+        def conv_ts(v):
+            if not isinstance(v, _dt.datetime):
+                return v
+            if v.tzinfo is not None:
+                # NTZ keeps the wall clock; TIMESTAMP converts the instant
+                v = v.replace(tzinfo=None) if ntz \
+                    else v.astimezone(_dt.timezone.utc).replace(tzinfo=None)
+            return (v - epoch) // one_us
+        return conv_ts
+    if isinstance(dtype, DecimalType):
+        scale = dtype.scale
+        return lambda v: int(v.scaleb(scale).to_integral_value(
+            rounding=_dec.ROUND_HALF_UP)) \
+            if isinstance(v, _dec.Decimal) else v
+    return lambda v: v
+
+
 def bucket_capacity(n: int) -> int:
     """Round row/byte counts up to a shape bucket to bound XLA recompiles.
 
@@ -85,7 +120,8 @@ class Column:
         n = len(values)
         validity = np.array([v is not None for v in values], dtype=np.bool_)
         fill = np.zeros((), dtype=dtype.jnp_dtype).item()
-        dense = np.array([fill if v is None else v for v in values],
+        conv = _logical_to_physical(dtype)
+        dense = np.array([fill if v is None else conv(v) for v in values],
                          dtype=dtype.jnp_dtype)
         return Column.from_numpy(dense, dtype, validity, capacity)
 

@@ -18,7 +18,6 @@ the data and fixed-width string lanes can never truncate.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Iterator, List, Optional, Sequence
 
 import jax

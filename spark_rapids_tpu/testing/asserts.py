@@ -1,0 +1,116 @@
+"""Equality harness — the engine's asserts.py (reference
+integration_tests/src/main/python/asserts.py:579
+assert_gpu_and_cpu_are_equal_collect and friends).
+
+The reference's oracle is CPU Spark executing the same query. Standalone,
+correctness is established two ways:
+
+  * `assert_rows_equal(got, expected)` against an explicit Python-semantics
+    oracle (NaN==NaN, -0.0==0.0 per Spark group semantics is NOT applied
+    here — exact row values, with float tolerance for accumulation-order
+    differences);
+  * `assert_consistent_across_configs(build)` runs the same logical query
+    on independent engine tiers — speculative vs exact, fused vs unfused,
+    single-partition vs mesh-distributed — and requires them all to agree.
+    A bug must hit every tier identically to slip through.
+"""
+
+from __future__ import annotations
+
+import math
+from decimal import Decimal
+from typing import Callable, Dict, List, Optional, Sequence
+
+#: engine tiers that must agree on every query (cross-oracle matrix)
+CONFIG_TIERS: List[Dict] = [
+    {},  # default: speculative masked-bucket + whole-stage fusion
+    {"spark.rapids.tpu.agg.speculative.enabled": False},
+    {"spark.rapids.tpu.agg.speculative.enabled": False,
+     "spark.rapids.tpu.fusion.enabled": False},
+]
+
+
+def collect_with_conf(build: Callable, conf: Optional[Dict] = None,
+                      mesh_devices: Optional[int] = None) -> List[tuple]:
+    """Run `build(session) -> DataFrame` under a fresh session and collect."""
+    from ..api.session import TpuSession
+    sess = TpuSession(dict(conf or {}), mesh_devices=mesh_devices)
+    return build(sess).collect()
+
+
+def _value_equal(a, b, rel_tol: float) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) or isinstance(b, float):
+        fa, fb = float(a), float(b)
+        if math.isnan(fa) or math.isnan(fb):
+            return math.isnan(fa) and math.isnan(fb)
+        if math.isinf(fa) or math.isinf(fb):
+            return fa == fb
+        return math.isclose(fa, fb, rel_tol=rel_tol, abs_tol=rel_tol)
+    if isinstance(a, Decimal) or isinstance(b, Decimal):
+        return Decimal(a) == Decimal(b)
+    return a == b
+
+
+def _sort_key(row: tuple):
+    out = []
+    for v in row:
+        if v is None:
+            out.append((2, ""))
+        elif isinstance(v, float) and math.isnan(v):
+            out.append((1, ""))
+        else:
+            out.append((0, str(v)))
+    return out
+
+
+def assert_rows_equal(got: Sequence[tuple], expected: Sequence[tuple],
+                      rel_tol: float = 1e-9, ordered: bool = False):
+    """Row-set equality with Spark-style float handling (NaN equals NaN;
+    tolerance absorbs accumulation-order float differences)."""
+    assert len(got) == len(expected), \
+        f"row count {len(got)} != {len(expected)}\n" \
+        f"got={list(got)[:10]}\nexpected={list(expected)[:10]}"
+    g = list(got) if ordered else sorted(got, key=_sort_key)
+    e = list(expected) if ordered else sorted(expected, key=_sort_key)
+    for i, (rg, re_) in enumerate(zip(g, e)):
+        assert len(rg) == len(re_), f"row {i} arity {rg} vs {re_}"
+        for j, (a, b) in enumerate(zip(rg, re_)):
+            assert _value_equal(a, b, rel_tol), \
+                f"row {i} col {j}: {a!r} != {b!r}\n got: {rg}\n exp: {re_}"
+
+
+def assert_equal_with_tolerance(got, expected, rel_tol: float = 1e-9):
+    assert_rows_equal(got, expected, rel_tol=rel_tol)
+
+
+def assert_consistent_across_configs(build: Callable,
+                                     mesh_devices: Optional[int] = 8,
+                                     rel_tol: float = 1e-6,
+                                     expected: Optional[Sequence] = None):
+    """Run `build(session) -> DataFrame` on every engine tier (and the
+    mesh-distributed plan when >= mesh_devices devices exist) and assert
+    all results agree; optionally also against an explicit oracle."""
+    import jax
+    results = [(repr(conf), collect_with_conf(build, conf))
+               for conf in CONFIG_TIERS]
+    if mesh_devices and len(jax.devices()) >= mesh_devices:
+        results.append((f"mesh[{mesh_devices}]",
+                        collect_with_conf(build,
+                                          mesh_devices=mesh_devices)))
+        results.append(
+            (f"mesh[{mesh_devices}]+exact",
+             collect_with_conf(
+                 build, {"spark.rapids.tpu.agg.speculative.enabled": False},
+                 mesh_devices=mesh_devices)))
+    base_name, base = results[0]
+    for name, rows in results[1:]:
+        try:
+            assert_rows_equal(rows, base, rel_tol=rel_tol)
+        except AssertionError as ex:
+            raise AssertionError(
+                f"tier {name} disagrees with {base_name}: {ex}") from ex
+    if expected is not None:
+        assert_rows_equal(base, list(expected), rel_tol=rel_tol)
+    return base

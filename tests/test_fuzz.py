@@ -1,0 +1,185 @@
+"""Datagen-driven fuzz suite: random data through every engine tier
+(speculative/exact/fused/unfused/distributed) must agree, and core
+pipelines must match independent Python oracles (reference analog:
+integration_tests data_gen.py + asserts.py cross-engine runs)."""
+
+import collections
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.testing import (
+    BooleanGen, DateGen, DecimalGen, DoubleGen, IntegerGen, LongGen,
+    SetValuesGen, StringGen, assert_consistent_across_configs,
+    assert_rows_equal, gen_df, gen_pydict,
+)
+from spark_rapids_tpu.types import LONG, STRING
+
+
+def test_datagen_reproducible():
+    gens = [("a", LongGen()), ("s", StringGen()), ("d", DoubleGen())]
+    d1, sch1 = gen_pydict(gens, 100, seed=7)
+    d2, sch2 = gen_pydict(gens, 100, seed=7)
+    assert d1 == d2 or (str(d1) == str(d2))  # NaN-safe compare via repr
+    assert sch1 == sch2
+    d3, _ = gen_pydict(gens, 100, seed=8)
+    assert str(d3) != str(d1)
+
+
+def test_datagen_specials_present():
+    data, _ = gen_pydict([("a", IntegerGen())], 2000, seed=1)
+    vals = [v for v in data["a"] if v is not None]
+    assert (1 << 31) - 1 in vals or -(1 << 31) in vals
+    assert any(v is None for v in data["a"])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_groupby_sum_count(seed):
+    gens = [("k", SetValuesGen(LONG, [0, 1, 2, 3, 4, None])),
+            ("v", LongGen(min_val=-1 << 40, max_val=1 << 40))]
+    data, sch = gen_pydict(gens, 300, seed=seed)
+
+    acc = collections.defaultdict(lambda: [0, 0])
+    for k, v in zip(data["k"], data["v"]):
+        if v is not None:
+            acc[k][0] += v
+            acc[k][1] += 1
+        else:
+            acc[k]  # group still exists
+    oracle = [(k, (s if c else None), c) for k, (s, c) in acc.items()]
+
+    def build(sess):
+        df = sess.from_pydict(data, sch, batch_rows=64)
+        return df.group_by("k").agg((F.sum("v"), "s"), (F.count("v"), "c"))
+
+    assert_consistent_across_configs(build, expected=oracle)
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_fuzz_groupby_string_keys_minmax(seed):
+    gens = [("k", SetValuesGen(STRING, ["a", "bb", "ccc", None])),
+            ("v", DoubleGen(no_nans=True))]
+    data, sch = gen_pydict(gens, 200, seed=seed)
+
+    acc = collections.defaultdict(list)
+    for k, v in zip(data["k"], data["v"]):
+        if v is not None:
+            acc[k].append(v)
+        else:
+            acc[k]
+    oracle = [(k, (min(vs) if vs else None), (max(vs) if vs else None))
+              for k, vs in acc.items()]
+
+    def build(sess):
+        df = sess.from_pydict(data, sch, batch_rows=50)
+        return df.group_by("k").agg((F.min("v"), "mn"), (F.max("v"), "mx"))
+
+    assert_consistent_across_configs(build, expected=oracle)
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_fuzz_filter_project(seed):
+    gens = [("a", IntegerGen()), ("b", LongGen()),
+            ("s", StringGen(max_length=12))]
+    data, sch = gen_pydict(gens, 400, seed=seed)
+
+    def wrap64(x):  # Spark non-ANSI long arithmetic wraps two's-complement
+        return (x + (1 << 63)) % (1 << 64) - (1 << 63)
+
+    oracle = []
+    for a, b, s in zip(data["a"], data["b"], data["s"]):
+        if a is not None and a > 0:
+            oracle.append((a, None if b is None else wrap64(b + 1), s))
+
+    def build(sess):
+        df = sess.from_pydict(data, sch, batch_rows=128)
+        return df.filter(col("a") > 0).select(
+            col("a"), (col("b") + 1).alias("b1"), col("s"))
+
+    assert_consistent_across_configs(build, expected=oracle)
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_fuzz_join(seed):
+    lgens = [("k", SetValuesGen(LONG, list(range(20)) + [None])),
+             ("lv", LongGen())]
+    rgens = [("k", SetValuesGen(LONG, list(range(10, 30)) + [None])),
+             ("rv", StringGen(max_length=30))]
+    ldata, lsch = gen_pydict(lgens, 150, seed=seed)
+    rdata, rsch = gen_pydict(rgens, 100, seed=seed + 100)
+
+    rmap = collections.defaultdict(list)
+    for k, v in zip(rdata["k"], rdata["rv"]):
+        if k is not None:
+            rmap[k].append(v)
+    oracle = []
+    for k, lv in zip(ldata["k"], ldata["lv"]):
+        matches = rmap.get(k, []) if k is not None else []
+        if matches:
+            oracle.extend((k, lv, rv) for rv in matches)
+        else:
+            oracle.append((k, lv, None))
+
+    def build(sess):
+        l = sess.from_pydict(ldata, lsch, batch_rows=64)
+        r = sess.from_pydict(rdata, rsch, batch_rows=64)
+        return l.join(r, on="k", how="left_outer")
+
+    assert_consistent_across_configs(build, expected=oracle)
+
+
+def test_fuzz_sort_limit():
+    gens = [("a", IntegerGen()), ("s", StringGen(max_length=8))]
+    data, sch = gen_pydict(gens, 300, seed=9)
+
+    # Spark ascending default is NULLS FIRST
+    key = [(a is not None, a if a is not None else 0, s is not None, s or "")
+           for a, s in zip(data["a"], data["s"])]
+    order = sorted(range(300), key=lambda i: key[i])
+    oracle = [(data["a"][i], data["s"][i]) for i in order[:25]]
+
+    def build(sess):
+        df = sess.from_pydict(data, sch, batch_rows=100)
+        return df.sort("a", "s").limit(25)
+
+    got = assert_consistent_across_configs(build)
+    assert_rows_equal(got, oracle, ordered=True)
+
+
+def test_fuzz_boolean_date_decimal_roundtrip():
+    """Logical-value ingestion: bool/date/decimal generators feed the
+    engine and round-trip through a projection."""
+    from spark_rapids_tpu.api.session import TpuSession
+    gens = [("b", BooleanGen()), ("d", DateGen()),
+            ("x", DecimalGen(precision=10, scale=2))]
+    data, sch = gen_pydict(gens, 100, seed=10)
+    sess = TpuSession()
+    df = sess.from_pydict(data, sch)
+    out = df.select("b", "d", "x").collect()
+    assert len(out) == 100
+    import datetime
+    epoch = datetime.date(1970, 1, 1)
+    for (b, d, x), (eb, ed, ex) in zip(out, zip(*data.values())):
+        assert b == eb
+        assert d == (None if ed is None else (ed - epoch).days)
+        assert x == (None if ex is None else int(ex.scaleb(2)))
+
+
+def test_fuzz_double_specials_groupby():
+    """NaN/inf/-0.0 group keys: Spark groups NaN together and 0.0==-0.0."""
+    data = {"k": [float("nan"), float("nan"), 0.0, -0.0, 1.0, None],
+            "v": [1, 2, 3, 4, 5, 6]}
+    from spark_rapids_tpu.types import DOUBLE, Schema, StructField
+    sch = Schema((StructField("k", DOUBLE), StructField("v", LONG)))
+
+    def build(sess):
+        return sess.from_pydict(data, sch).group_by("k").agg(
+            (F.sum("v"), "s"))
+
+    got = assert_consistent_across_configs(build)
+    as_map = {("nan" if (k is not None and math.isnan(k)) else k): s
+              for k, s in got}
+    assert as_map == {"nan": 3, 0.0: 7, 1.0: 5, None: 6}
