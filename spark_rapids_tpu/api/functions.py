@@ -99,6 +99,162 @@ def substring(x, pos, length_):
     return stringexprs.Substring(_e(x), pos, length_)
 
 
+def trim(x, trim_str=None):
+    return stringexprs.StringTrim(_e(x), trim_str)
+
+
+def ltrim(x, trim_str=None):
+    return stringexprs.StringTrimLeft(_e(x), trim_str)
+
+
+def rtrim(x, trim_str=None):
+    return stringexprs.StringTrimRight(_e(x), trim_str)
+
+
+def lpad(x, length_, pad=" "):
+    return stringexprs.StringLPad(_e(x), length_, pad)
+
+
+def rpad(x, length_, pad=" "):
+    return stringexprs.StringRPad(_e(x), length_, pad)
+
+
+def repeat(x, n):
+    return stringexprs.StringRepeat(_e(x), n)
+
+
+def reverse(x):
+    return stringexprs.Reverse(_e(x))
+
+
+def initcap(x):
+    return stringexprs.InitCap(_e(x))
+
+
+def locate(substr, x, pos=1):
+    return stringexprs.StringLocate(substr, _e(x), pos)
+
+
+def instr(x, substr):
+    return stringexprs.StringLocate(substr, _e(x), 1)
+
+
+def replace(x, search, replacement=""):
+    return stringexprs.StringReplace(_e(x), search, replacement)
+
+
+def concat(*xs):
+    return stringexprs.Concat(*[_e(x) for x in xs])
+
+
+def concat_ws(sep, *xs):
+    return stringexprs.ConcatWs(sep, *[_e(x) for x in xs])
+
+
+def translate(x, from_str, to_str):
+    return stringexprs.StringTranslate(_e(x), from_str, to_str)
+
+
+def ascii(x):  # noqa: A001
+    return stringexprs.Ascii(_e(x))
+
+
+def chr(x):  # noqa: A001
+    return stringexprs.Chr(_e(x))
+
+
+def left(x, n):
+    return stringexprs.Left(_e(x), n)
+
+
+def right(x, n):
+    return stringexprs.Right(_e(x), n)
+
+
+def octet_length(x):
+    return stringexprs.OctetLength(_e(x))
+
+
+def bit_length(x):
+    return stringexprs.BitLength(_e(x))
+
+
+def contains(x, needle):
+    return stringexprs.Contains(_e(x), needle)
+
+
+def startswith(x, prefix):
+    return stringexprs.StartsWith(_e(x), prefix)
+
+
+def endswith(x, suffix):
+    return stringexprs.EndsWith(_e(x), suffix)
+
+
+def rlike(x, pattern):
+    return stringexprs.RLike(_e(x), pattern)
+
+
+def like(x, pattern, escape_char="\\"):
+    return stringexprs.Like(_e(x), pattern, escape_char)
+
+
+def nvl(a, b):
+    return conditional.Nvl(_e(a), _e(b))
+
+
+ifnull = nvl
+
+
+def nvl2(a, b, c):
+    return conditional.Nvl2(_e(a), _e(b), _e(c))
+
+
+def nullif(a, b):
+    return conditional.NullIf(_e(a), _e(b))
+
+
+# collections ----------------------------------------------------------------
+def size(x):
+    from ..expr import collectionexprs
+    return collectionexprs.Size(_e(x))
+
+
+def array_contains(x, value):
+    from ..expr import collectionexprs
+    return collectionexprs.ArrayContains(_e(x), value)
+
+
+def element_at(x, index):
+    from ..expr import collectionexprs
+    return collectionexprs.ElementAt(_e(x), index)
+
+
+def get_array_item(x, index):
+    from ..expr import collectionexprs
+    return collectionexprs.GetArrayItem(_e(x), index)
+
+
+def sort_array(x, asc=True):
+    from ..expr import collectionexprs
+    return collectionexprs.SortArray(_e(x), asc)
+
+
+def array_min(x):
+    from ..expr import collectionexprs
+    return collectionexprs.ArrayMin(_e(x))
+
+
+def array_max(x):
+    from ..expr import collectionexprs
+    return collectionexprs.ArrayMax(_e(x))
+
+
+def array(*xs):
+    from ..expr import collectionexprs
+    return collectionexprs.CreateArray(*[_e(x) for x in xs])
+
+
 def hash(*xs):  # noqa: A001
     return hashexprs.Murmur3Hash(*[_e(x) for x in xs])
 

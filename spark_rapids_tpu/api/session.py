@@ -209,6 +209,21 @@ class DataFrame:
                 named.append((we, f"{we.fn.name}_{i}"))
         return self._with(L.LogicalWindow(named, self._plan))
 
+    def explode(self, column, alias: str = "col",
+                outer: bool = False) -> "DataFrame":
+        """One output row per array element; empty/null arrays drop the
+        row (outer=True keeps it with a null element). PySpark's
+        select(explode(c)) surface, keeping the other columns."""
+        return self._with(L.LogicalGenerate(_to_expr(column), self._plan,
+                                            outer=outer, elem_name=alias))
+
+    def posexplode(self, column, alias: str = "col", pos_name: str = "pos",
+                   outer: bool = False) -> "DataFrame":
+        return self._with(L.LogicalGenerate(_to_expr(column), self._plan,
+                                            outer=outer, position=True,
+                                            elem_name=alias,
+                                            pos_name=pos_name))
+
     def union(self, other: "DataFrame") -> "DataFrame":
         return self._with(L.LogicalUnion(self._plan, other._plan))
 

@@ -72,10 +72,15 @@ class ColumnarBatch:
         assert len(lengths) == 1, "ragged input columns"
         n = lengths.pop()
         cap = capacity or bucket_capacity(n)
+        from ..types import ArrayType
+        from .column import ArrayColumn
         cols = []
         for f in schema.fields:
             vals = data[f.name]
-            if isinstance(f.data_type, StringType) or f.data_type.jnp_dtype is None:
+            if isinstance(f.data_type, ArrayType):
+                cols.append(ArrayColumn.from_pylist(vals, f.data_type,
+                                                    capacity=cap))
+            elif isinstance(f.data_type, StringType) or f.data_type.jnp_dtype is None:
                 cols.append(StringColumn.from_pylist(vals, capacity=cap,
                                                      dtype=f.data_type))
             else:

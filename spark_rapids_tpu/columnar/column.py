@@ -266,6 +266,31 @@ class ArrayColumn(Column):
     def capacity(self) -> int:
         return int(self.validity.shape[0])
 
+    @property
+    def child_capacity(self) -> int:
+        return self.child.capacity
+
+    @staticmethod
+    def from_pylist(values: Sequence, dtype: ArrayType,
+                    capacity: Optional[int] = None) -> "ArrayColumn":
+        n = len(values)
+        cap = capacity or bucket_capacity(n)
+        validity = _pad_np(np.array([v is not None for v in values],
+                                    np.bool_), cap, False)
+        lengths = np.array([0 if v is None else len(v) for v in values],
+                           np.int32)
+        off = np.zeros(cap + 1, np.int32)
+        np.cumsum(lengths, out=off[1:n + 1])
+        off[n + 1:] = off[n] if n else 0
+        flat = [x for v in values if v is not None for x in v]
+        elem_t = dtype.element_type
+        if isinstance(elem_t, StringType) or elem_t.jnp_dtype is None:
+            child: Column = StringColumn.from_pylist(flat, dtype=elem_t)
+        else:
+            child = Column.from_pylist(flat, elem_t)
+        return ArrayColumn(child, jnp.asarray(off),
+                           jnp.asarray(validity), dtype)
+
     def to_pylist(self, num_rows: int) -> List:
         offsets = np.asarray(self.offsets)
         valid = np.asarray(self.validity[:num_rows])
@@ -321,6 +346,47 @@ jax.tree_util.register_pytree_node(StructColumn, _struct_flatten, _struct_unflat
 jax.tree_util.register_pytree_node(ArrayColumn, _array_flatten, _array_unflatten)
 
 
+def _string_from_arrow_buffers(arr, dt: DataType, n: int) -> StringColumn:
+    """Arrow string/binary array -> device column straight from the Arrow
+    (validity bitmap, offsets, bytes) buffers — no per-value Python loop
+    (review finding r1: `to_pylist` dominated string-heavy scans)."""
+    import pyarrow as pa
+
+    if pa.types.is_large_string(arr.type):
+        arr = arr.cast(pa.string())
+    elif pa.types.is_large_binary(arr.type):
+        arr = arr.cast(pa.binary())
+    bufs = arr.buffers()
+    off_all = np.frombuffer(bufs[1], dtype=np.int32)
+    offsets = off_all[arr.offset: arr.offset + n + 1].astype(np.int32)
+    base = offsets[0] if n else 0
+    offsets = offsets - base
+    total = int(offsets[-1]) if n else 0
+    cap = bucket_capacity(n)
+    off_padded = np.full(cap + 1, total, dtype=np.int32)
+    off_padded[: n + 1] = offsets
+    byte_cap = bucket_capacity(max(total, 1))
+    data = np.zeros(byte_cap, dtype=np.uint8)
+    if total:
+        data[:total] = np.frombuffer(bufs[2], dtype=np.uint8,
+                                     count=total, offset=int(base))
+    if bufs[0] is None:
+        validity = np.ones(n, dtype=np.bool_)
+    else:
+        bits = np.frombuffer(bufs[0], dtype=np.uint8)
+        validity = np.unpackbits(bits, bitorder="little")[
+            arr.offset: arr.offset + n].astype(np.bool_)
+    # Arrow permits null slots with non-zero spans; the engine's length
+    # kernels promise 0 for nulls — rebuild through the slow path in that
+    # (rare in practice) case
+    if n and not validity.all():
+        lens_np = np.diff(offsets)
+        if (lens_np[~validity] != 0).any():
+            return StringColumn.from_pylist(arr.to_pylist(), dtype=dt)
+    return StringColumn(jnp.asarray(data), jnp.asarray(off_padded),
+                        jnp.asarray(_pad_np(validity, cap, False)), dt)
+
+
 def column_from_arrow(arr, dtype: Optional[DataType] = None) -> Column:
     """pyarrow Array/ChunkedArray -> device column."""
     import pyarrow as pa
@@ -330,8 +396,7 @@ def column_from_arrow(arr, dtype: Optional[DataType] = None) -> Column:
     dt = dtype or from_arrow(arr.type)
     n = len(arr)
     if isinstance(dt, (StringType, BinaryType)):
-        values = arr.to_pylist()
-        return StringColumn.from_pylist(values, dtype=dt)
+        return _string_from_arrow_buffers(arr, dt, n)
     if isinstance(dt, StructType):
         validity = np.asarray(arr.is_valid())
         kids = tuple(column_from_arrow(arr.field(i), f.data_type)

@@ -164,6 +164,19 @@ SHUFFLE_READER_THREADS = conf_int(
     "spark.rapids.shuffle.multiThreaded.reader.threads", 8,
     "Reader-side fetch/decode threads (reference :569).")
 
+PARQUET_READER_TYPE = conf_str(
+    "spark.rapids.sql.format.parquet.reader.type", "MULTITHREADED",
+    "Parquet reader strategy: MULTITHREADED (prefetch pool, one device "
+    "upload per row group) or COALESCING (stitch small row groups "
+    "host-side into ~batchSize tables before upload; reference "
+    "GpuMultiFileReader.scala:830).")
+
+PARQUET_PUSHDOWN_ENABLED = conf_bool(
+    "spark.rapids.sql.format.parquet.filterPushdown.enabled", True,
+    "Push simple comparison conjuncts from a Filter into the parquet scan "
+    "for footer min/max row-group pruning (reference "
+    "GpuParquetScan predicate pushdown).")
+
 MULTITHREADED_READ_NUM_THREADS = conf_int(
     "spark.rapids.sql.multiThreadedRead.numThreads", 8,
     "Threads for the cloud multi-file readers (reference "
@@ -172,6 +185,13 @@ MULTITHREADED_READ_NUM_THREADS = conf_int(
 METRICS_LEVEL = conf_str(
     "spark.rapids.sql.metrics.level", "MODERATE",
     "ESSENTIAL | MODERATE | DEBUG (reference GpuExec.scala:36-47).")
+
+SORT_OOC_ENABLED = conf_bool(
+    "spark.rapids.sql.sort.outOfCore.enabled", True,
+    "Bounded-memory streamed run merge for big sorts: runs stay spilled, "
+    "only MERGE_FAN_IN chunks are device-resident at a time, and output "
+    "batches emit as soon as they are globally final (reference "
+    "GpuOutOfCoreSortIterator, GpuSortExec.scala:281).")
 
 STABLE_SORT = conf_bool(
     "spark.rapids.sql.stableSort.enabled", False,

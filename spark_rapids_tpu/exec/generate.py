@@ -1,0 +1,118 @@
+"""GenerateExec — explode/posexplode over array columns (reference
+GpuGenerateExec.scala:829: GpuExplode/GpuPosExplode generators with
+outer/position variants).
+
+TPU shape strategy: the output capacity is the array child's static
+capacity bucket (every element becomes at most one row) plus the input
+capacity for the outer variant — so the whole generate is ONE compiled
+program per batch shape with no host sync at all."""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import ArrayColumn, Column, bucket_capacity
+from ..expr.core import Expression, resolve
+from ..ops.basic import active_mask, compaction_order, gather_column
+from ..types import ArrayType, IntegerType, Schema, StructField
+from .base import NUM_INPUT_BATCHES, OP_TIME, TpuExec
+
+
+class GenerateExec(TpuExec):
+    def __init__(self, generator: Expression, child: TpuExec,
+                 outer: bool = False, position: bool = False,
+                 elem_name: str = "col", pos_name: str = "pos"):
+        super().__init__(child)
+        self.generator = generator
+        self.outer = outer
+        self.position = position
+        self.elem_name = elem_name
+        self.pos_name = pos_name
+        self._bound = resolve(generator, child.output_schema)
+        arr_t = self._bound.data_type
+        assert isinstance(arr_t, ArrayType), \
+            f"explode needs an ARRAY input, got {arr_t}"
+        self._elem_type = arr_t.element_type
+        self._jit = jax.jit(self._kernel)
+
+    @property
+    def output_schema(self) -> Schema:
+        fields = list(self.child.output_schema.fields)
+        if self.position:
+            fields.append(StructField(self.pos_name, IntegerType(),
+                                      self.outer))
+        fields.append(StructField(self.elem_name, self._elem_type, True))
+        return Schema(tuple(fields))
+
+    def additional_metrics(self):
+        return (NUM_INPUT_BATCHES,)
+
+    def _kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
+        arr = self._bound.columnar_eval(batch)
+        assert isinstance(arr, ArrayColumn)
+        cap = batch.capacity
+        child_cap = arr.child_capacity
+        lens = arr.offsets[1:] - arr.offsets[:-1]
+        act_rows = active_mask(batch.num_rows, cap)
+
+        # elements to emit: inside the byte span of an ACTIVE, NON-NULL
+        # row (computed arrays — e.g. CreateArray — carry element slots
+        # for inactive/null rows too; compact those away)
+        e_all = jnp.arange(child_cap, dtype=jnp.int32)
+        row_all = jnp.clip(
+            jnp.searchsorted(arr.offsets, e_all, side="right")
+            .astype(jnp.int32) - 1, 0, cap - 1)
+        keep = (e_all < arr.offsets[-1]) & act_rows[row_all] \
+            & arr.validity[row_all]
+        perm, total = compaction_order(keep, jnp.int32(child_cap))
+
+        out_cap = bucket_capacity(child_cap + (cap if self.outer else 0))
+        slots = jnp.arange(out_cap, dtype=jnp.int32)
+        e = perm[jnp.clip(slots, 0, child_cap - 1)]
+        e = jnp.clip(e, 0, child_cap - 1)
+        src_row_of_elem = row_all[e]
+        intra = e - arr.offsets[src_row_of_elem]
+        is_elem = slots < total
+
+        if self.outer:
+            empty = act_rows & ((lens == 0) | ~arr.validity)
+            empty_perm, n_empty = compaction_order(empty, batch.num_rows)
+            k = jnp.clip(slots - total, 0, cap - 1)
+            outer_row = jnp.where((slots >= total)
+                                  & (slots < total + n_empty),
+                                  empty_perm[k], -1)
+            n_out = total + n_empty
+        else:
+            outer_row = jnp.full((out_cap,), -1, jnp.int32)
+            n_out = total
+
+        src_row = jnp.where(is_elem, src_row_of_elem, outer_row)
+        act_out = active_mask(n_out, out_cap)
+        src_row = jnp.where(act_out, src_row, -1)
+        cols = [gather_column(c, src_row) for c in batch.columns]
+        if self.position:
+            pos_valid = is_elem & act_out
+            cols.append(Column(jnp.where(pos_valid, intra, 0),
+                               pos_valid if self.outer
+                               else jnp.where(act_out, True, False),
+                               IntegerType()))
+        elem_idx = jnp.where(is_elem & act_out, e, -1)
+        cols.append(gather_column(arr.child, elem_idx))
+        return ColumnarBatch(cols, n_out, self.output_schema)
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        op_time = self.metrics[OP_TIME]
+        in_batches = self.metrics[NUM_INPUT_BATCHES]
+        for batch in self.child.execute():
+            in_batches.add(1)
+            with op_time.ns_timer():
+                yield self._jit(batch)
+
+    def node_description(self):
+        kind = "PosExplode" if self.position else "Explode"
+        return (f"GenerateExec[{kind}{'Outer' if self.outer else ''}"
+                f"({self.generator!r})]")

@@ -58,20 +58,35 @@ def _gather_batch(columns: Sequence[Column], idx, n,
             for c, bc in zip(columns, caps)]
 
 
+def _is_varsize(c: Column) -> bool:
+    from ..columnar.column import ArrayColumn
+    return isinstance(c, (StringColumn, ArrayColumn))
+
+
+def _var_lengths(c: Column):
+    """Per-row payload size of a variable-size column: bytes for strings,
+    elements for arrays."""
+    from ..columnar.column import ArrayColumn
+    from ..ops.collection import array_lengths
+    from ..ops.strings import string_lengths
+    if isinstance(c, ArrayColumn):
+        return array_lengths(c)
+    return string_lengths(c)
+
+
 def _string_byte_needs(stream_columns, build: BuildTable, lo, counts, act):
-    """Exact output byte requirement per string column of the join, all on
-    device (fetched together with the candidate total in the one host sync
-    per stream batch).
+    """Exact output payload requirement per variable-size column of the
+    join (string bytes / array elements), all on device — fetched together
+    with the candidate total in the one host sync per stream batch.
 
     Stream side: row i is emitted count_i times (candidates) plus at most
-    once more (outer-unmatched tail). Build side: candidate bytes are the
+    once more (outer-unmatched tail). Build side: candidate payload is the
     per-row sorted-order prefix-sum ranges [lo, lo+count)."""
-    from ..ops.strings import string_lengths
     cnt = counts.astype(jnp.int64)
     stream_needs = []
     for c in stream_columns:
-        if isinstance(c, StringColumn):
-            lens = jnp.where(act, string_lengths(c), 0).astype(jnp.int64)
+        if _is_varsize(c):
+            lens = jnp.where(act, _var_lengths(c), 0).astype(jnp.int64)
             stream_needs.append(jnp.sum(cnt * lens) + jnp.sum(lens))
     build_needs = []
     for prefix in build.payload_prefix:
@@ -81,11 +96,11 @@ def _string_byte_needs(stream_columns, build: BuildTable, lo, counts, act):
 
 
 def _byte_cap_tuple(columns, needs) -> Tuple:
-    """Static per-column byte buckets from fetched needs (None = keep the
-    input bucket for fixed-width columns)."""
+    """Static per-column payload buckets from fetched needs (None = keep
+    the input bucket for fixed-width columns)."""
     it = iter(needs)
     return tuple(bucket_capacity(max(int(next(it)), 8))
-                 if isinstance(c, StringColumn) else None for c in columns)
+                 if _is_varsize(c) else None for c in columns)
 
 
 class HashJoinExec(TpuExec):

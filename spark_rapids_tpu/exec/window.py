@@ -4,10 +4,12 @@ every frame shape lowers to segmented scans over partition-sorted rows
 (ops/window.py), so the reference's four execution strategies collapse
 into one compiled program per window-expression set.
 
-v1 scope: whole input is windowed as one concatenated batch (the
-reference's batched/carry-over machinery is the out-of-core follow-up);
-RANGE frames support the default (UNBOUNDED PRECEDING..CURRENT ROW with
-ties) shape; bounded min/max frames route to unsupported (planner tags).
+Frame coverage: ROWS frames with any bounds (sum/count/avg via prefix
+differences; min/max via the sparse-table sliding-extrema kernel,
+ops/window.bounded_min_max); RANGE frames support the default (UNBOUNDED
+PRECEDING..CURRENT ROW with ties) shape. Whole input is windowed as one
+concatenated batch — partition-boundary batching rides the out-of-core
+sort work.
 """
 
 from __future__ import annotations
@@ -30,8 +32,9 @@ from ..ops.sort import (
     string_words_for,
 )
 from ..ops.window import (
-    lag_lead, rank_dense_rank, row_number, running_min_max, segment_ends,
-    segment_starts, whole_partition_broadcast, windowed_sum_count,
+    bounded_min_max, lag_lead, rank_dense_rank, row_number, running_min_max,
+    segment_ends, segment_starts, whole_partition_broadcast,
+    windowed_sum_count,
 )
 from ..types import DoubleType, IntegerType, LongType, Schema, StructField
 from .base import OP_TIME, TpuExec
@@ -231,9 +234,12 @@ class WindowExec(TpuExec):
                 data = data[group_last]
                 valid = valid[group_last]
             return Column(data.astype(values.data.dtype), valid, res_type)
-        raise NotImplementedError(
-            f"bounded {fn.op} frames need the sliding min/max kernel; "
-            "planner must tag unsupported")
+        # bounded frames: sparse-table sliding extrema (reference
+        # GpuBatchedBoundedWindowExec.scala:220)
+        data, valid = bounded_min_max(values.data, values.validity, seg,
+                                      n, cap, preceding, following,
+                                      fn.op == "max")
+        return Column(data.astype(values.data.dtype), valid, res_type)
 
     # -- drive -------------------------------------------------------------
     def internal_execute(self) -> Iterator[ColumnarBatch]:

@@ -1,0 +1,140 @@
+"""Collection (array) expressions — the engine's first slice of the
+reference's collectionOperations.scala operator family (GpuSize,
+GpuArrayContains, GpuElementAt, GpuGetArrayItem, GpuSortArray,
+GpuArrayMin/Max, GpuCreateArray)."""
+
+from __future__ import annotations
+
+from ..columnar.column import ArrayColumn
+from ..ops import collection as C
+from ..types import BOOLEAN, INT, ArrayType
+from .core import Expression, Literal
+
+
+class Size(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return Size(children[0])
+
+    @property
+    def data_type(self):
+        return INT
+
+    def columnar_eval(self, batch):
+        return C.array_size(self.children[0].columnar_eval(batch))
+
+
+class ArrayContains(Expression):
+    def __init__(self, child: Expression, value):
+        self.children = (child,)
+        self.value = value.value if isinstance(value, Literal) else value
+
+    def with_children(self, children):
+        return ArrayContains(children[0], self.value)
+
+    def _semantic_args(self):
+        return (self.value,)
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+    def columnar_eval(self, batch):
+        return C.array_contains(self.children[0].columnar_eval(batch),
+                                self.value)
+
+
+class ElementAt(Expression):
+    """element_at(arr, i): 1-based, negative from end, null out of bounds
+    (non-ANSI)."""
+
+    def __init__(self, child: Expression, index):
+        self.children = (child,)
+        self.index = index.value if isinstance(index, Literal) else index
+
+    def with_children(self, children):
+        return type(self)(children[0], self.index)
+
+    def _semantic_args(self):
+        return (self.index,)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def columnar_eval(self, batch):
+        return C.element_at(self.children[0].columnar_eval(batch),
+                            self.index)
+
+
+class GetArrayItem(ElementAt):
+    """arr[i]: 0-based, null out of bounds (non-ANSI)."""
+
+    def columnar_eval(self, batch):
+        return C.get_array_item(self.children[0].columnar_eval(batch),
+                                self.index)
+
+
+class SortArray(Expression):
+    def __init__(self, child: Expression, ascending: bool = True):
+        self.children = (child,)
+        self.ascending = ascending.value if isinstance(ascending, Literal) \
+            else ascending
+
+    def with_children(self, children):
+        return SortArray(children[0], self.ascending)
+
+    def _semantic_args(self):
+        return (self.ascending,)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def columnar_eval(self, batch):
+        return C.sort_array(self.children[0].columnar_eval(batch),
+                            self.ascending)
+
+
+class ArrayMin(Expression):
+    OP = "min"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def columnar_eval(self, batch):
+        return C.array_min_max(self.children[0].columnar_eval(batch),
+                               self.OP)
+
+
+class ArrayMax(ArrayMin):
+    OP = "max"
+
+
+class CreateArray(Expression):
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return CreateArray(*children)
+
+    @property
+    def data_type(self):
+        return ArrayType(self.children[0].data_type)
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        cols = [c.columnar_eval(batch) for c in self.children]
+        return C.create_array(cols, self.data_type)

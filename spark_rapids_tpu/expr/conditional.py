@@ -135,6 +135,66 @@ class Coalesce(Expression):
         return result
 
 
+class Nvl(Coalesce):
+    """nvl/ifnull(a, b) == coalesce(a, b) (reference GpuNvl)."""
+
+    def __init__(self, a: Expression, b: Expression):
+        super().__init__(a, b)
+
+    def with_children(self, children):
+        return Nvl(*children)
+
+
+class Nvl2(Expression):
+    """nvl2(a, b, c): b when a is not null, else c (reference GpuNvl2 —
+    NOT an If(IsNotNull(a)) rewrite because b/c eval unconditionally)."""
+
+    def __init__(self, a: Expression, b: Expression, c: Expression):
+        self.children = (a, b, c)
+
+    def with_children(self, children):
+        return Nvl2(*children)
+
+    @property
+    def data_type(self):
+        return self.children[1].data_type
+
+    def columnar_eval(self, batch):
+        a = self.children[0].columnar_eval(batch)
+        b = self.children[1].columnar_eval(batch)
+        c = self.children[2].columnar_eval(batch)
+        return _blend(a.validity, jnp.ones_like(a.validity), b, c)
+
+
+class NullIf(Expression):
+    """nullif(a, b): null when a == b else a (reference GpuNullIf)."""
+
+    def __init__(self, a: Expression, b: Expression):
+        self.children = (a, b)
+
+    def with_children(self, children):
+        return NullIf(*children)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def columnar_eval(self, batch):
+        from ..columnar.column import StringColumn
+        a = self.children[0].columnar_eval(batch)
+        b = self.children[1].columnar_eval(batch)
+        if isinstance(a, StringColumn):
+            from ..ops.strings import string_equal
+            eq_col = string_equal(a, b)
+            eq = eq_col.data & eq_col.validity
+            return StringColumn(a.data, a.offsets, a.validity & ~eq,
+                                a.dtype)
+        eq = (a.data == b.data) & a.validity & b.validity
+        valid = a.validity & ~eq
+        return Column(jnp.where(valid, a.data, jnp.zeros((), a.data.dtype)),
+                      valid, a.dtype)
+
+
 class IsNaN(Expression):
     def __init__(self, child: Expression):
         self.children = (child,)

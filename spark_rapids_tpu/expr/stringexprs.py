@@ -99,3 +99,379 @@ class EndsWith(_LiteralNeedle):
 class Contains(_LiteralNeedle):
     def columnar_eval(self, batch):
         return S.str_contains(self.children[0].columnar_eval(batch), self.needle)
+
+
+def _as_bytes(v) -> bytes:
+    if isinstance(v, Literal):
+        v = v.value
+    return v.encode("utf-8") if isinstance(v, str) else bytes(v)
+
+
+class StringTrim(_UnaryString):
+    """trim/ltrim/rtrim with an optional literal trim set (reference
+    GpuStringTrim/TrimLeft/TrimRight, stringFunctions.scala)."""
+
+    SIDE = "both"
+
+    def __init__(self, child: Expression, trim_str=None):
+        super().__init__(child)
+        self.trim_str = None if trim_str is None else _as_bytes(trim_str)
+
+    def with_children(self, children):
+        return type(self)(children[0], self.trim_str)
+
+    def _semantic_args(self):
+        return (self.SIDE, self.trim_str)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        chars = self.trim_str if self.trim_str is not None else b" "
+        return S.str_trim(self.children[0].columnar_eval(batch),
+                          self.SIDE, chars)
+
+
+class StringTrimLeft(StringTrim):
+    SIDE = "left"
+
+
+class StringTrimRight(StringTrim):
+    SIDE = "right"
+
+
+class _PadBase(Expression):
+    SIDE = "left"
+
+    def __init__(self, child: Expression, length, pad=" "):
+        self.children = (child,)
+        self.length = length.value if isinstance(length, Literal) else length
+        self.pad = _as_bytes(pad)
+
+    def with_children(self, children):
+        return type(self)(children[0], self.length, self.pad)
+
+    def _semantic_args(self):
+        return (self.SIDE, self.length, self.pad)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        return S.str_pad(self.children[0].columnar_eval(batch),
+                         self.length, self.pad, self.SIDE)
+
+
+class StringLPad(_PadBase):
+    SIDE = "left"
+
+
+class StringRPad(_PadBase):
+    SIDE = "right"
+
+
+class StringRepeat(Expression):
+    def __init__(self, child: Expression, n):
+        self.children = (child,)
+        self.n = n.value if isinstance(n, Literal) else n
+
+    def with_children(self, children):
+        return StringRepeat(children[0], self.n)
+
+    def _semantic_args(self):
+        return (self.n,)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        return S.str_repeat(self.children[0].columnar_eval(batch), self.n)
+
+
+class Reverse(_UnaryString):
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        return S.str_reverse(self.children[0].columnar_eval(batch))
+
+
+class InitCap(_UnaryString):
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        return S.str_initcap(self.children[0].columnar_eval(batch))
+
+
+class StringLocate(Expression):
+    """locate(substr, str, start) / instr (reference GpuStringLocate)."""
+
+    def __init__(self, substr, child: Expression, start=1):
+        self.children = (child,)
+        self.needle = _as_bytes(substr)
+        self.start = start.value if isinstance(start, Literal) else start
+
+    def with_children(self, children):
+        return StringLocate(self.needle, children[0], self.start)
+
+    def _semantic_args(self):
+        return (self.needle, self.start)
+
+    @property
+    def data_type(self):
+        return INT
+
+    def columnar_eval(self, batch):
+        return S.str_locate(self.children[0].columnar_eval(batch),
+                            self.needle, self.start)
+
+
+class StringReplace(Expression):
+    def __init__(self, child: Expression, search, replacement):
+        self.children = (child,)
+        self.search = _as_bytes(search)
+        self.replacement = _as_bytes(replacement)
+
+    def with_children(self, children):
+        return StringReplace(children[0], self.search, self.replacement)
+
+    def _semantic_args(self):
+        return (self.search, self.replacement)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        return S.str_replace(self.children[0].columnar_eval(batch),
+                             self.search, self.replacement)
+
+
+class Concat(Expression):
+    """concat(...): null-intolerant string concatenation (reference
+    GpuConcat, collectionOperations.scala for the string overload).
+
+    One k-ary kernel pass (the concat_ws segment-table machinery with an
+    empty separator), not a pairwise fold — a fold re-copies earlier
+    columns' bytes O(k) times. Rows with any null child are invalid, so
+    the skip-null byte layout under them is irrelevant."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return Concat(*children)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        import jax.numpy as jnp
+        cols = [c.columnar_eval(batch) for c in self.children]
+        if len(cols) == 1:
+            return cols[0]
+        if len(cols) == 2:
+            return S.str_concat_pair(cols[0], cols[1])
+        joined = S.str_concat_ws(b"", cols)
+        valid = cols[0].validity
+        for c in cols[1:]:
+            valid = valid & c.validity
+        return StringColumn(joined.data, joined.offsets, valid,
+                            cols[0].dtype)
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, ...): skips nulls, never returns null (reference
+    GpuConcatWs)."""
+
+    def __init__(self, sep, *children: Expression):
+        self.children = tuple(children)
+        self.sep = _as_bytes(sep)
+
+    def with_children(self, children):
+        return ConcatWs(self.sep, *children)
+
+    def _semantic_args(self):
+        return (self.sep,)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        cols = [c.columnar_eval(batch) for c in self.children]
+        return S.str_concat_ws(self.sep, cols)
+
+
+class StringTranslate(Expression):
+    def __init__(self, child: Expression, from_str, to_str):
+        self.children = (child,)
+        self.from_str = _as_bytes(from_str)
+        self.to_str = _as_bytes(to_str)
+
+    def with_children(self, children):
+        return StringTranslate(children[0], self.from_str, self.to_str)
+
+    def _semantic_args(self):
+        return (self.from_str, self.to_str)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        return S.str_translate(self.children[0].columnar_eval(batch),
+                               self.from_str, self.to_str)
+
+
+class Ascii(_UnaryString):
+    @property
+    def data_type(self):
+        return INT
+
+    def columnar_eval(self, batch):
+        return S.str_ascii(self.children[0].columnar_eval(batch))
+
+
+class Chr(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return Chr(children[0])
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        return S.str_chr(self.children[0].columnar_eval(batch))
+
+
+class OctetLength(_UnaryString):
+    @property
+    def data_type(self):
+        return INT
+
+    def columnar_eval(self, batch):
+        return S.str_length_bytes(self.children[0].columnar_eval(batch))
+
+
+class BitLength(_UnaryString):
+    @property
+    def data_type(self):
+        return INT
+
+    def columnar_eval(self, batch):
+        c = S.str_length_bytes(self.children[0].columnar_eval(batch))
+        return Column(c.data * 8, c.validity, INT)
+
+
+class Left(Expression):
+    def __init__(self, child: Expression, n):
+        self.children = (child,)
+        self.n = n.value if isinstance(n, Literal) else n
+
+    def with_children(self, children):
+        return type(self)(children[0], self.n)
+
+    def _semantic_args(self):
+        return (self.n,)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def columnar_eval(self, batch):
+        return S.substring(self.children[0].columnar_eval(batch), 1,
+                           max(self.n, 0))
+
+
+class Right(Left):
+    def columnar_eval(self, batch):
+        if self.n <= 0:
+            return S.substring(self.children[0].columnar_eval(batch), 1, 0)
+        return S.substring(self.children[0].columnar_eval(batch), -self.n,
+                           None)
+
+
+class RLike(Expression):
+    """rlike/regexp (reference GpuRLike + RegexParser.scala transpiler):
+    the literal pattern compiles lazily to a device Glushkov program;
+    unsupported constructs raise RegexUnsupported, which the rule table's
+    tag_fn turns into an off-TPU tag at PLAN time (constructing the
+    expression itself never throws, matching Spark's analyze-then-tag
+    order)."""
+
+    def __init__(self, child: Expression, pattern):
+        self.children = (child,)
+        self.pattern = pattern.value if isinstance(pattern, Literal) \
+            else pattern
+        self._program = None
+
+    @property
+    def program(self):
+        if self._program is None:
+            from ..regex import compile_regex
+            self._program = compile_regex(self.pattern)
+        return self._program
+
+    def with_children(self, children):
+        return RLike(children[0], self.pattern)
+
+    def _semantic_args(self):
+        return (self.pattern,)
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+    def columnar_eval(self, batch):
+        from ..regex import regex_find
+        return regex_find(self.children[0].columnar_eval(batch),
+                          self.program)
+
+
+class Like(Expression):
+    """SQL LIKE ... ESCAPE (reference GpuLike): translated lazily to an
+    anchored device regex program (tagging mirrors RLike)."""
+
+    def __init__(self, child: Expression, pattern, escape_char="\\"):
+        self.children = (child,)
+        self.pattern = pattern.value if isinstance(pattern, Literal) \
+            else pattern
+        self.escape_char = escape_char
+        self._program = None
+
+    @property
+    def program(self):
+        if self._program is None:
+            from ..regex import like_to_program
+            self._program = like_to_program(self.pattern, self.escape_char)
+        return self._program
+
+    def with_children(self, children):
+        return Like(children[0], self.pattern, self.escape_char)
+
+    def _semantic_args(self):
+        return (self.pattern, self.escape_char)
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+    def columnar_eval(self, batch):
+        from ..regex import regex_find
+        return regex_find(self.children[0].columnar_eval(batch),
+                          self.program)

@@ -2,11 +2,16 @@
 :1860/:2051/:2739, writer GpuParquetFileFormat.scala:167).
 
 Read path: footer-driven row-group slicing (each row group is one decode
-task, the granularity the reference stitches in its COALESCING reader),
-decoded by pyarrow's C++ reader on a prefetch thread pool (MULTITHREADED
-analog), uploaded as device columns. Column pruning via `columns`;
-row-group pruning via min/max statistics against simple predicates
-(the reference's predicate pushdown).
+task), decoded by pyarrow's C++ reader on a prefetch thread pool
+(MULTITHREADED analog), uploaded as device columns. Column pruning via
+`columns`. Row-group pruning evaluates pushed-down simple predicates
+(col <op> literal conjuncts, extracted by the planner from the Filter
+above the scan) against footer min/max/null-count statistics — pruned
+groups are never decoded; `row_groups_read`/`row_groups_pruned` record
+the effect. The COALESCING reader mode stitches small row groups into one
+host table per ~batch_rows before upload (reference
+GpuMultiFileReader.scala:830), halving per-batch upload overhead for
+many-small-files layouts.
 
 Write path: host materialization -> pyarrow writer, with Spark-style
 dynamic partitioning (partition_by -> key=value directories, reference
@@ -15,7 +20,7 @@ GpuFileFormatDataWriter dynamic partitioning)."""
 from __future__ import annotations
 
 import os
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 from ..columnar.batch import ColumnarBatch
 from ..config import RapidsConf
@@ -27,48 +32,156 @@ DEFAULT_NUM_THREADS = 8
 #: rows per emitted device batch before coalescing
 DEFAULT_BATCH_ROWS = 1 << 20
 
+#: pushed predicate: (column name, op, literal) with op in the set below
+_PRUNE_OPS = ("<", "<=", ">", ">=", "==", "is_null", "is_not_null")
+
+
+def _stats_can_skip(stats, op: str, value) -> bool:
+    """True iff footer statistics PROVE no row in the group can satisfy
+    the predicate (missing/partial stats never prune)."""
+    if stats is None:
+        return False
+    if op == "is_null":
+        return stats.null_count == 0 if stats.null_count is not None \
+            else False
+    if op == "is_not_null":
+        nc = stats.null_count
+        nv = stats.num_values
+        return nv == 0 if (nc is not None and nv is not None) else False
+    if not stats.has_min_max:
+        return False
+    mn, mx = stats.min, stats.max
+    if mn is None or mx is None:
+        return False
+    try:
+        if op == "==":
+            return value < mn or value > mx
+        if op == "<":
+            return mn >= value
+        if op == "<=":
+            return mn > value
+        if op == ">":
+            return mx <= value
+        if op == ">=":
+            return mx < value
+    except TypeError:
+        return False  # incomparable (e.g. bytes stats vs str literal)
+    return False
+
 
 class ParquetSource:
     def __init__(self, path, conf: Optional[RapidsConf] = None,
                  columns: Optional[Sequence[str]] = None,
                  num_threads: int = DEFAULT_NUM_THREADS,
-                 batch_rows: int = DEFAULT_BATCH_ROWS):
+                 batch_rows: int = DEFAULT_BATCH_ROWS,
+                 filters: Optional[Sequence[Tuple[str, str, object]]] = None,
+                 reader_type: Optional[str] = None):
         import pyarrow.parquet as pq
         self.paths = expand_paths(path)
         assert self.paths, f"no parquet files at {path!r}"
         self.columns = list(columns) if columns is not None else None
         self.num_threads = num_threads
         self.batch_rows = batch_rows
+        self.filters = list(filters or [])
+        self._conf = conf
+        if reader_type is None and conf is not None:
+            from ..config import PARQUET_READER_TYPE
+            reader_type = conf.get(PARQUET_READER_TYPE)
+        self.reader_type = (reader_type or "MULTITHREADED").upper()
         arrow_schema = pq.read_schema(self.paths[0])
         fields = []
         for name in (self.columns or arrow_schema.names):
             f = arrow_schema.field(name)
             fields.append(StructField(f.name, from_arrow(f.type), f.nullable))
         self.schema = Schema(tuple(fields))
+        #: observability: updated by the last batches() drive; shared with
+        #: with_filters() copies so the user-held source sees the effect
+        self.scan_stats = {"row_groups_read": 0, "row_groups_pruned": 0}
+
+    @property
+    def row_groups_read(self) -> int:
+        return self.scan_stats["row_groups_read"]
+
+    @property
+    def row_groups_pruned(self) -> int:
+        return self.scan_stats["row_groups_pruned"]
+
+    def with_filters(self, filters: Sequence[Tuple[str, str, object]]
+                     ) -> "ParquetSource":
+        """Planner pushdown hook: a copy of this source that prunes row
+        groups with the given conjuncts (the Filter stays above the scan
+        for exactness — stats only prove absence, never presence)."""
+        out = ParquetSource(self.paths, self._conf, self.columns,
+                            self.num_threads, self.batch_rows,
+                            list(self.filters) + list(filters),
+                            self.reader_type)
+        out.scan_stats = self.scan_stats
+        return out
 
     def estimated_size_bytes(self) -> int:
         """Broadcast-planning size estimate: on-disk bytes (compressed, so
         an underestimate like Spark's file-size statistics)."""
-        import os
         return sum(os.path.getsize(p) for p in self.paths)
+
+    def _group_pruned(self, md, rg: int, name_to_idx) -> bool:
+        row_group = md.row_group(rg)
+        for (name, op, value) in self.filters:
+            ci = name_to_idx.get(name)
+            if ci is None:
+                continue
+            stats = row_group.column(ci).statistics
+            if _stats_can_skip(stats, op, value):
+                return True
+        return False
 
     def batches(self) -> Iterator[ColumnarBatch]:
         import pyarrow.parquet as pq
 
         tasks = []
+        self.scan_stats["row_groups_read"] = 0
+        self.scan_stats["row_groups_pruned"] = 0
         for p in self.paths:
             pf = pq.ParquetFile(p)
-            for rg in range(pf.metadata.num_row_groups):
+            md = pf.metadata
+            name_to_idx = {md.schema.column(i).name: i
+                           for i in range(md.num_columns)}
+            for rg in range(md.num_row_groups):
+                if self.filters and self._group_pruned(md, rg, name_to_idx):
+                    self.scan_stats["row_groups_pruned"] += 1
+                    continue
+                self.scan_stats["row_groups_read"] += 1
+
                 def decode(p=p, rg=rg):
                     # fresh handle per task: ParquetFile is not thread-safe
                     return pq.ParquetFile(p).read_row_group(
                         rg, columns=self.columns)
                 tasks.append(decode)
-            if pf.metadata.num_row_groups == 0:
+            if md.num_row_groups == 0:
                 tasks.append(lambda p=p: pq.read_table(p,
-                                                      columns=self.columns))
+                                                       columns=self.columns))
+        if self.reader_type == "COALESCING":
+            yield from self._coalescing_drive(tasks)
+        else:
+            for table in threaded_chunks(tasks, self.num_threads):
+                yield from arrow_to_batches(table, self.batch_rows)
+
+    def _coalescing_drive(self, tasks) -> Iterator[ColumnarBatch]:
+        """Stitch decoded row groups host-side into ~batch_rows tables
+        before the (expensive) device upload (reference COALESCING reader,
+        GpuMultiFileReader.scala:830)."""
+        import pyarrow as pa
+        pending: List = []
+        pending_rows = 0
         for table in threaded_chunks(tasks, self.num_threads):
-            yield from arrow_to_batches(table, self.batch_rows)
+            pending.append(table)
+            pending_rows += table.num_rows
+            if pending_rows >= self.batch_rows:
+                yield from arrow_to_batches(pa.concat_tables(pending),
+                                            self.batch_rows)
+                pending, pending_rows = [], 0
+        if pending:
+            yield from arrow_to_batches(pa.concat_tables(pending),
+                                        self.batch_rows)
 
 
 def write_parquet(df, path, partition_by: Optional[Sequence[str]] = None):

@@ -63,9 +63,9 @@ def gather_column(col: Column, indices, out_valid=None,
                      for k in col.children)
         return StructColumn(kids, valid, col.dtype)
     if isinstance(col, ArrayColumn):
-        raise NotImplementedError(
-            "ARRAY gather lands with the nested-types phase; the planner "
-            "must tag ARRAY columns unsupported for row-reordering ops")
+        from .collection import gather_array
+        return gather_array(col, safe, valid,
+                            out_child_capacity=out_byte_capacity)
     data = jnp.where(valid, col.data[safe], jnp.zeros((), col.data.dtype))
     return Column(data, valid, col.dtype)
 
@@ -120,6 +120,11 @@ def concat_columns(a: Column, b: Column, a_rows, b_rows, out_capacity: int
                      for ka, kb in zip(a.children, b.children))
         valid = _concat_fixed(a.validity, b.validity, from_b, b_idx, idx) & out_valid
         return StructColumn(kids, valid, a.dtype)
+    if isinstance(a, ArrayColumn):
+        # gather both sides' rows into the output slot order; gather_array
+        # rebuilds offsets and compacts the child elements
+        from .collection import concat_arrays
+        return concat_arrays(a, b, a_rows, b_rows, out_capacity)
     data = _concat_fixed(a.data, b.data, from_b, b_idx, idx)
     valid = _concat_fixed(a.validity, b.validity, from_b, b_idx, idx) & out_valid
     data = jnp.where(out_valid, data, jnp.zeros((), data.dtype))

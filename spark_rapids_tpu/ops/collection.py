@@ -1,0 +1,265 @@
+"""Array (list) kernels over the (child, offsets) layout — the engine's
+first slice of the reference's collectionOperations.scala / cuDF lists
+column support.
+
+Same dense-gather design as strings: `searchsorted` maps each child
+element to its owning row, turning per-row operations into segment
+reductions and row-gathers into two vectorized gathers. Fixed-width and
+string element types supported; deeper nesting is tagged off."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import ArrayColumn, Column, StringColumn
+from ..types import BOOLEAN, INT, BooleanType
+
+
+def array_lengths(col: ArrayColumn):
+    return col.offsets[1:] - col.offsets[:-1]
+
+
+def _row_of_child(col: ArrayColumn, idx):
+    row = jnp.searchsorted(col.offsets, idx, side="right").astype(jnp.int32) - 1
+    return jnp.clip(row, 0, col.capacity - 1)
+
+
+def gather_array(col: ArrayColumn, safe_indices, out_valid,
+                 out_child_capacity=None) -> ArrayColumn:
+    """Row gather (filter/join/sort reordering) for list columns with
+    fixed-width or string children.
+
+    out_child_capacity: static element bucket of the result. Defaults to
+    the input's (sufficient for permutations/filters); row-DUPLICATING
+    gathers (join probe sides) must pass the measured element need, like
+    gather_string's out_byte_capacity. Duplicating gathers of
+    string-element arrays additionally need child byte sizing, which is
+    not plumbed yet — guarded by assertion."""
+    from .strings import _rebuild_offsets
+    in_child_cap = col.child_capacity
+    child_cap = out_child_capacity or in_child_cap
+    assert child_cap <= in_child_cap or not isinstance(
+        col.child, StringColumn), \
+        "duplicating gather of array<string> needs child byte measurement"
+    lens = array_lengths(col)[safe_indices]
+    lens = jnp.where(out_valid, lens, 0)
+    new_offsets = _rebuild_offsets(lens)
+    src_starts = col.offsets[safe_indices]
+    pos = jnp.arange(child_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right")
+                   .astype(jnp.int32) - 1, 0, safe_indices.shape[0] - 1)
+    intra = pos - new_offsets[row]
+    in_use = pos < new_offsets[-1]
+    src = jnp.where(in_use, jnp.clip(src_starts[row] + intra, 0,
+                                     in_child_cap - 1), 0)
+    from .basic import gather_column
+    child = gather_column(col.child, jnp.where(in_use, src, -1))
+    return ArrayColumn(child, new_offsets, out_valid, col.dtype)
+
+
+def concat_arrays(a: ArrayColumn, b: ArrayColumn, a_rows, b_rows,
+                  out_capacity: int) -> ArrayColumn:
+    """Concatenate two array columns' active rows (coalesce primitive):
+    row lengths concatenate, and each side's kept elements gather into the
+    combined child buffer."""
+    from .strings import _rebuild_offsets
+    idx = jnp.arange(out_capacity, dtype=jnp.int32)
+    from_b = idx >= a_rows
+    b_idx = idx - a_rows
+    total = a_rows + b_rows
+    out_valid = idx < total
+
+    def side_lens(col, rows):
+        lens = array_lengths(col)
+        act = jnp.arange(col.capacity, dtype=jnp.int32) < rows
+        return jnp.where(act, lens, 0), act
+
+    la, act_a = side_lens(a, a_rows)
+    lb, act_b = side_lens(b, b_rows)
+    a_safe = jnp.where(idx < a.capacity, idx, 0)
+    b_safe = jnp.clip(b_idx, 0, b.capacity - 1)
+    out_lens = jnp.where(out_valid,
+                         jnp.where(from_b, lb[b_safe], la[a_safe]), 0)
+    new_offsets = _rebuild_offsets(out_lens)
+    valid = jnp.where(from_b, b.validity[b_safe], a.validity[a_safe]) \
+        & out_valid
+
+    from ..columnar.column import bucket_capacity
+    child_cap = bucket_capacity(max(a.child_capacity + b.child_capacity, 1))
+    pos = jnp.arange(child_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right")
+                   .astype(jnp.int32) - 1, 0, out_capacity - 1)
+    intra = pos - new_offsets[row]
+    in_use = pos < new_offsets[-1]
+    elem_from_b = from_b[jnp.clip(row, 0, out_capacity - 1)]
+    src_a = a.offsets[jnp.clip(row, 0, a.capacity - 1)] + intra
+    src_b = b.offsets[jnp.clip(row - a_rows, 0, b.capacity - 1)] + intra
+    from .basic import gather_column
+    child_a = gather_column(
+        a.child, jnp.where(in_use & ~elem_from_b,
+                           jnp.clip(src_a, 0, a.child_capacity - 1), -1))
+    child_b = gather_column(
+        b.child, jnp.where(in_use & elem_from_b,
+                           jnp.clip(src_b, 0, b.child_capacity - 1), -1))
+    # merge the two gathers (disjoint slots)
+    if isinstance(a.child, StringColumn):
+        from .strings import string_lengths as _sl
+        # string children: pick per-slot from whichever side owns it
+        lens_c = jnp.where(elem_from_b, _sl(child_b), _sl(child_a))
+        lens_c = jnp.where(in_use, lens_c, 0)
+        off_c = _rebuild_offsets(lens_c)
+        byte_cap = bucket_capacity(child_a.byte_capacity
+                                   + child_b.byte_capacity)
+        bpos = jnp.arange(byte_cap, dtype=jnp.int32)
+        brow = jnp.clip(jnp.searchsorted(off_c, bpos, side="right")
+                        .astype(jnp.int32) - 1, 0, child_cap - 1)
+        bintra = bpos - off_c[brow]
+        b_use = bpos < off_c[-1]
+        eb = elem_from_b[brow]
+        pa = jnp.clip(child_a.offsets[brow] + bintra, 0,
+                      child_a.byte_capacity - 1)
+        pb = jnp.clip(child_b.offsets[brow] + bintra, 0,
+                      child_b.byte_capacity - 1)
+        data = jnp.where(b_use, jnp.where(eb, child_b.data[pb],
+                                          child_a.data[pa]), jnp.uint8(0))
+        cvalid = jnp.where(elem_from_b, child_b.validity, child_a.validity)
+        child = StringColumn(data, off_c, cvalid, a.child.dtype)
+    else:
+        cdata = jnp.where(elem_from_b, child_b.data, child_a.data)
+        cvalid = jnp.where(elem_from_b, child_b.validity, child_a.validity)
+        child = Column(cdata, cvalid, a.child.dtype)
+    return ArrayColumn(child, new_offsets, valid, a.dtype)
+
+
+def array_size(col: ArrayColumn) -> Column:
+    """size(arr) (spark.sql.legacy.sizeOfNull=false: null for null)."""
+    return Column(array_lengths(col).astype(jnp.int32), col.validity, INT)
+
+
+def array_contains(col: ArrayColumn, value) -> Column:
+    """array_contains(arr, lit): Spark 3-valued result — true if present,
+    null if absent but the array has null elements, false otherwise."""
+    child = col.child
+    cap = col.capacity
+    idx = jnp.arange(child.capacity, dtype=jnp.int32)
+    row = _row_of_child(col, idx)
+    in_use = idx < col.offsets[-1]
+    if isinstance(child, StringColumn):
+        from .strings import str_starts_with, string_lengths
+        needle = value.encode("utf-8") if isinstance(value, str) else value
+        eq_data = str_starts_with(child, needle).data & \
+            (string_lengths(child) == len(needle))
+        match = eq_data & child.validity & in_use
+    else:
+        match = (child.data == value) & child.validity & in_use
+    has_match = jax.ops.segment_max(match.astype(jnp.int32), row,
+                                    num_segments=cap) > 0
+    has_null = jax.ops.segment_max(
+        ((~child.validity) & in_use).astype(jnp.int32), row,
+        num_segments=cap) > 0
+    valid = col.validity & (has_match | ~has_null)
+    return Column(has_match, valid, BOOLEAN)
+
+
+def element_at(col: ArrayColumn, index: int) -> Column:
+    """element_at(arr, i): 1-based; negative from the end; null when out
+    of bounds (non-ANSI Spark)."""
+    lens = array_lengths(col)
+    if index >= 0:
+        pos0 = jnp.int32(index - 1)
+        pos = jnp.broadcast_to(pos0, lens.shape)
+    else:
+        pos = lens + index
+    ok = (pos >= 0) & (pos < lens) & col.validity
+    src = jnp.where(ok, col.offsets[:-1] + pos, -1)
+    from .basic import gather_column
+    return gather_column(col.child, src)
+
+
+def get_array_item(col: ArrayColumn, index: int) -> Column:
+    """arr[i]: 0-based, null out of bounds (GetArrayItem non-ANSI)."""
+    lens = array_lengths(col)
+    pos = jnp.broadcast_to(jnp.int32(index), lens.shape)
+    ok = (pos >= 0) & (pos < lens) & col.validity
+    src = jnp.where(ok, col.offsets[:-1] + pos, -1)
+    from .basic import gather_column
+    return gather_column(col.child, src)
+
+
+def sort_array(col: ArrayColumn, ascending: bool = True) -> ArrayColumn:
+    """sort_array: sort elements within each row (fixed-width children).
+    Spark: asc => nulls first, desc => nulls last."""
+    child = col.child
+    assert not isinstance(child, StringColumn), \
+        "sort_array over string elements requires sort lanes (planned)"
+    idx = jnp.arange(child.capacity, dtype=jnp.int32)
+    row = _row_of_child(col, idx)
+    in_use = idx < col.offsets[-1]
+    data = child.data
+    if isinstance(child.dtype, BooleanType):
+        data = data.astype(jnp.int8)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        # total order incl NaN: flip sign bit trick
+        bits = jax.lax.bitcast_convert_type(
+            data, jnp.int32 if data.dtype == jnp.float32 else jnp.int64)
+        data = jnp.where(bits < 0, ~bits, bits | (jnp.ones((), bits.dtype)
+                                                  << (bits.dtype.itemsize * 8 - 1)))
+        data = data ^ (jnp.ones((), data.dtype)
+                       << (data.dtype.itemsize * 8 - 1))
+    # bitwise-not reverses total order with no INT_MIN negation overflow
+    key = data if ascending else ~data
+    # nulls first (asc) / last (desc): validity as leading key
+    null_key = jnp.where(child.validity, 1, 0).astype(jnp.int8)
+    if not ascending:
+        null_key = -null_key
+    # inactive slots stay put at the end of their row span: sort within
+    # (row, active) groups by sorting on (row, inactive, null_key, key)
+    inactive = (~in_use).astype(jnp.int8)
+    _, _, _, _, perm = jax.lax.sort(
+        (row, inactive, null_key.astype(jnp.int32),
+         key.astype(jnp.int64) if key.dtype != jnp.int64 else key, idx),
+        num_keys=4)
+    from .basic import gather_column
+    new_child = gather_column(child, perm)
+    return ArrayColumn(new_child, col.offsets, col.validity, col.dtype)
+
+
+def array_min_max(col: ArrayColumn, op: str) -> Column:
+    """array_min/array_max over fixed-width elements (nulls skipped; null
+    when every element is null or the array is empty/null)."""
+    child = col.child
+    cap = col.capacity
+    idx = jnp.arange(child.capacity, dtype=jnp.int32)
+    row = _row_of_child(col, idx)
+    ok = (idx < col.offsets[-1]) & child.validity
+    if op == "min":
+        big = jnp.asarray(jnp.inf if jnp.issubdtype(child.data.dtype,
+                                                    jnp.floating)
+                          else jnp.iinfo(child.data.dtype).max,
+                          child.data.dtype)
+        vals = jnp.where(ok, child.data, big)
+        res = jax.ops.segment_min(vals, row, num_segments=cap)
+    else:
+        small = jnp.asarray(-jnp.inf if jnp.issubdtype(child.data.dtype,
+                                                       jnp.floating)
+                            else jnp.iinfo(child.data.dtype).min,
+                            child.data.dtype)
+        vals = jnp.where(ok, child.data, small)
+        res = jax.ops.segment_max(vals, row, num_segments=cap)
+    any_ok = jax.ops.segment_max(ok.astype(jnp.int32), row,
+                                 num_segments=cap) > 0
+    valid = col.validity & any_ok
+    return Column(jnp.where(valid, res, jnp.zeros((), res.dtype)), valid,
+                  col.dtype.element_type)
+
+
+def create_array(cols, dtype) -> ArrayColumn:
+    """array(c1..ck): k elements per row (fixed-width inputs)."""
+    k = len(cols)
+    cap = cols[0].capacity
+    data = jnp.stack([c.data for c in cols], axis=1).reshape(cap * k)
+    valid = jnp.stack([c.validity for c in cols], axis=1).reshape(cap * k)
+    child = Column(data, valid, dtype.element_type)
+    offsets = jnp.arange(cap + 1, dtype=jnp.int32) * k
+    return ArrayColumn(child, offsets, jnp.ones(cap, jnp.bool_), dtype)

@@ -27,7 +27,9 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..columnar.column import Column, StringColumn, bucket_capacity
+from ..columnar.column import (
+    ArrayColumn, Column, StringColumn, bucket_capacity,
+)
 from .basic import active_mask, compaction_order, gather_column
 from .hashing import xxhash64_batch
 from .strings import string_equal
@@ -80,8 +82,11 @@ class BuildTable:
         valid_count = jnp.sum(valid, dtype=jnp.int32)
         prefixes = []
         for c in payload:
-            if isinstance(c, StringColumn):
-                lens = string_lengths(c).astype(jnp.int64)
+            if isinstance(c, (StringColumn, ArrayColumn)):
+                if isinstance(c, ArrayColumn):
+                    lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+                else:
+                    lens = string_lengths(c).astype(jnp.int64)
                 sorted_lens = jnp.where(iota < valid_count, lens[perm], 0)
                 prefixes.append(jnp.concatenate(
                     [jnp.zeros((1,), jnp.int64), jnp.cumsum(sorted_lens)]))

@@ -203,6 +203,391 @@ def str_contains(col: StringColumn, needle: bytes) -> Column:
     return Column(per_row, col.validity, BOOLEAN)
 
 
+def _row_of_byte(col: StringColumn, pos):
+    """Row owning each byte position of `col`'s buffer."""
+    row = jnp.searchsorted(col.offsets, pos, side="right").astype(jnp.int32) - 1
+    return jnp.clip(row, 0, col.capacity - 1)
+
+
+def str_trim(col: StringColumn, side: str = "both",
+             trim_chars: bytes = b" \t\n\r\x0b\x0c") -> StringColumn:
+    """trim/ltrim/rtrim (reference GpuStringTrim, stringFunctions.scala).
+    Default trim set matches Spark's whitespace trimming."""
+    assert side in ("both", "left", "right")
+    lens = string_lengths(col)
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    intra = pos - col.offsets[row]
+    in_use = pos < col.offsets[-1]
+    is_trim = jnp.zeros(byte_cap, jnp.bool_)
+    for ch in trim_chars:
+        is_trim = is_trim | (col.data == jnp.uint8(ch))
+    non_trim = in_use & ~is_trim
+    big = jnp.int32(1 << 30)
+    first_non = jax.ops.segment_min(jnp.where(non_trim, intra, big), row,
+                                    num_segments=col.capacity)
+    last_non = jax.ops.segment_max(jnp.where(non_trim, intra, -1), row,
+                                   num_segments=col.capacity)
+    lead = jnp.minimum(first_non, lens)
+    # segment_max identity is INT_MIN for byte-less rows; clamp to "all
+    # trimmed" (end 0) before arithmetic
+    end = jnp.clip(last_non + 1, 0, lens)
+    if side == "left":
+        start, new_len = lead, lens - lead
+    elif side == "right":
+        start, new_len = jnp.zeros_like(lens), end
+    else:
+        start, new_len = lead, jnp.maximum(end - lead, 0)
+    return _substring_gather(col, col.offsets[:-1] + start, new_len)
+
+
+def str_pad(col: StringColumn, target: int, pad: bytes,
+            side: str) -> StringColumn:
+    """lpad/rpad, byte semantics (reference GpuStringLPad/RPad). Rows
+    longer than `target` truncate to it; empty pad keeps short rows."""
+    from ..columnar.column import bucket_capacity
+    assert side in ("left", "right")
+    target = max(target, 0)
+    lens = string_lengths(col)
+    if pad:
+        out_lens = jnp.where(col.validity, jnp.int32(target), 0)
+    else:
+        out_lens = jnp.minimum(lens, target)
+    out_lens = jnp.where(col.validity, out_lens, 0)
+    new_offsets = _rebuild_offsets(out_lens)
+    byte_cap = bucket_capacity(max(col.capacity * max(target, 1), 1))
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right")
+                   .astype(jnp.int32) - 1, 0, col.capacity - 1)
+    intra = pos - new_offsets[row]
+    in_use = pos < new_offsets[-1]
+    rl = lens[row]
+    pad_arr = jnp.asarray(bytearray(pad or b"\0"), jnp.uint8)
+    lp = max(len(pad), 1)
+    if side == "left":
+        pad_n = jnp.maximum(jnp.int32(target) - rl, 0) if pad \
+            else jnp.zeros_like(rl)
+        from_pad = intra < pad_n
+        src_intra = intra - pad_n
+        pad_idx = intra % lp
+    else:
+        from_pad = (intra >= rl) if pad else jnp.zeros_like(intra, jnp.bool_)
+        src_intra = intra
+        pad_idx = (intra - rl) % lp
+    pad_byte = pad_arr[jnp.where(from_pad, pad_idx, 0)]
+    src_pos = jnp.clip(col.offsets[row] + jnp.maximum(src_intra, 0), 0,
+                       col.byte_capacity - 1)
+    data = jnp.where(in_use, jnp.where(from_pad, pad_byte,
+                                       col.data[src_pos]), jnp.uint8(0))
+    return StringColumn(data, new_offsets, col.validity, col.dtype)
+
+
+def str_repeat(col: StringColumn, n: int) -> StringColumn:
+    """repeat(str, n) (reference GpuStringRepeat)."""
+    from ..columnar.column import bucket_capacity
+    n = max(int(n), 0)
+    lens = string_lengths(col)
+    out_lens = lens * n
+    new_offsets = _rebuild_offsets(out_lens)
+    byte_cap = bucket_capacity(max(col.byte_capacity * max(n, 1), 1))
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right")
+                   .astype(jnp.int32) - 1, 0, col.capacity - 1)
+    intra = pos - new_offsets[row]
+    in_use = pos < new_offsets[-1]
+    rl = jnp.maximum(lens[row], 1)
+    src = jnp.clip(col.offsets[row] + intra % rl, 0, col.byte_capacity - 1)
+    data = jnp.where(in_use, col.data[src], jnp.uint8(0))
+    return StringColumn(data, new_offsets, col.validity, col.dtype)
+
+
+def str_reverse(col: StringColumn) -> StringColumn:
+    """reverse(str), byte order (exact for ASCII; multi-byte UTF-8 code
+    points are byte-reversed — documented divergence, like the reference's
+    early string kernels)."""
+    lens = string_lengths(col)
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    intra = pos - col.offsets[row]
+    in_use = pos < col.offsets[-1]
+    src = jnp.clip(col.offsets[row] + lens[row] - 1 - intra, 0, byte_cap - 1)
+    data = jnp.where(in_use, col.data[src], jnp.uint8(0))
+    return StringColumn(data, col.offsets, col.validity, col.dtype)
+
+
+def str_initcap(col: StringColumn) -> StringColumn:
+    """initcap: first letter of each whitespace-delimited word uppercase,
+    rest lowercase (Spark semantics, ASCII letters)."""
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    at_start = pos == col.offsets[row]
+    prev = col.data[jnp.clip(pos - 1, 0, byte_cap - 1)]
+    prev_is_space = (prev == ord(" ")) | (prev == ord("\t")) | \
+        (prev == ord("\n")) | (prev == ord("\r"))
+    word_start = at_start | prev_is_space
+    b = col.data
+    is_lower = (b >= ord("a")) & (b <= ord("z"))
+    is_upper = (b >= ord("A")) & (b <= ord("Z"))
+    up = jnp.where(is_lower, b - 32, b)
+    low = jnp.where(is_upper, b + 32, b)
+    data = jnp.where(word_start, up, low)
+    return StringColumn(data, col.offsets, col.validity, col.dtype)
+
+
+def str_locate(col: StringColumn, needle: bytes, start: int = 1) -> Column:
+    """locate/instr/position: 1-based byte index of the first occurrence at
+    or after `start` (1-based), 0 if absent (Java String.indexOf
+    semantics, which Spark delegates to)."""
+    from ..types import INT
+    lens = string_lengths(col)
+    start0 = max(int(start) - 1, 0)
+    if not needle:
+        # Java indexOf("", from) = min(max(from,0), len)
+        res = jnp.minimum(jnp.int32(start0), lens) + 1
+        return Column(res.astype(jnp.int32), col.validity, INT)
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    hit = jnp.ones(byte_cap, dtype=jnp.bool_)
+    for j, ch in enumerate(needle):
+        p = jnp.clip(pos + j, 0, byte_cap - 1)
+        hit = hit & (col.data[p] == jnp.uint8(ch))
+    row = _row_of_byte(col, pos)
+    intra = pos - col.offsets[row]
+    inside = (pos + len(needle)) <= col.offsets[row + 1]
+    ok = hit & inside & (intra >= start0) & (pos < col.offsets[-1])
+    big = jnp.int32(1 << 30)
+    first = jax.ops.segment_min(jnp.where(ok, intra, big), row,
+                                num_segments=col.capacity)
+    res = jnp.where(first >= big, 0, first + 1)
+    return Column(res.astype(jnp.int32), col.validity, INT)
+
+
+def _needle_has_border(needle: bytes) -> bool:
+    return any(needle[:k] == needle[len(needle) - k:]
+               for k in range(1, len(needle)))
+
+
+def str_replace(col: StringColumn, search: bytes,
+                replacement: bytes) -> StringColumn:
+    """replace(str, search, replace): non-overlapping left-to-right literal
+    replacement (reference GpuStringReplace).
+
+    Fast path: a needle with no proper border cannot overlap itself, so
+    every raw hit is automatically part of the greedy non-overlapping set.
+    Bordered needles (e.g. "aa") run a device while_loop that advances
+    per-row cursors hit by hit — exact Java semantics, vectorized across
+    rows."""
+    from ..columnar.column import bucket_capacity
+    if not search:
+        return col
+    ls, lr = len(search), len(replacement)
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    intra = pos - col.offsets[row]
+    in_use = pos < col.offsets[-1]
+    hit = jnp.ones(byte_cap, dtype=jnp.bool_)
+    for j, ch in enumerate(search):
+        p = jnp.clip(pos + j, 0, byte_cap - 1)
+        hit = hit & (col.data[p] == jnp.uint8(ch))
+    hit = hit & in_use & ((pos + ls) <= col.offsets[row + 1])
+
+    if _needle_has_border(search):
+        # greedy selection: per-row cursor jumps to the next hit >= cursor
+        big = jnp.int32(1 << 30)
+
+        def next_hit(cursor):
+            cand = jnp.where(hit & (intra >= cursor[row]), intra, big)
+            return jax.ops.segment_min(cand, row,
+                                       num_segments=col.capacity)
+
+        def body(carry):
+            cursor, sel = carry
+            nxt = next_hit(cursor)
+            found = nxt < big
+            # rows with no further hit scatter out of bounds (dropped) —
+            # clipping would collide them onto real byte positions
+            sel_pos = jnp.where(found, col.offsets[:-1] + nxt,
+                                jnp.int32(byte_cap))
+            sel = sel.at[sel_pos].set(True, mode="drop")
+            cursor = jnp.where(found, nxt + ls, big)
+            return cursor, sel
+
+        def cond(carry):
+            cursor, _ = carry
+            return jnp.any(cursor < big)
+
+        cursor0 = jnp.zeros(col.capacity, jnp.int32)
+        sel0 = jnp.zeros(byte_cap, jnp.bool_)
+        _, selected = jax.lax.while_loop(cond, body, (cursor0, sel0))
+        selected = selected & hit
+    else:
+        selected = hit
+
+    # emit lengths: 1 per plain byte, lr at a match start, 0 inside a match
+    sel_csum = jnp.cumsum(selected.astype(jnp.int32))
+    lo = jnp.clip(pos - ls, 0, byte_cap - 1)
+    covered_cnt = jnp.where(pos >= 1, sel_csum[jnp.clip(pos - 1, 0, byte_cap - 1)], 0) \
+        - jnp.where(pos >= ls, sel_csum[lo], 0)
+    covered = (covered_cnt > 0) & ~selected
+    emit = jnp.where(in_use, 1, 0)
+    emit = jnp.where(selected, lr, emit)
+    emit = jnp.where(covered, 0, emit)
+
+    out_lens = jax.ops.segment_sum(emit, row, num_segments=col.capacity)
+    out_lens = jnp.where(col.validity, out_lens, 0)
+    new_offsets = _rebuild_offsets(out_lens)
+    emit_start = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                  jnp.cumsum(emit, dtype=jnp.int32)])
+    out_byte_cap = byte_cap if lr <= ls else \
+        bucket_capacity(max((byte_cap // ls + 1) * lr, byte_cap))
+    opos = jnp.arange(out_byte_cap, dtype=jnp.int32)
+    src = jnp.clip(jnp.searchsorted(emit_start, opos, side="right")
+                   .astype(jnp.int32) - 1, 0, byte_cap - 1)
+    k = opos - emit_start[src]
+    out_in_use = opos < new_offsets[-1]
+    repl_arr = jnp.asarray(bytearray(replacement or b"\0"), jnp.uint8)
+    from_repl = selected[src]
+    byte = jnp.where(from_repl, repl_arr[jnp.clip(k, 0, max(lr - 1, 0))],
+                     col.data[src])
+    data = jnp.where(out_in_use, byte, jnp.uint8(0))
+    return StringColumn(data, new_offsets, col.validity, col.dtype)
+
+
+def str_concat_pair(a: StringColumn, b: StringColumn) -> StringColumn:
+    """concat(a, b): null-intolerant pairwise concatenation."""
+    from ..columnar.column import bucket_capacity
+    la, lb = string_lengths(a), string_lengths(b)
+    valid = a.validity & b.validity
+    out_lens = jnp.where(valid, la + lb, 0)
+    new_offsets = _rebuild_offsets(out_lens)
+    byte_cap = bucket_capacity(max(a.byte_capacity + b.byte_capacity, 1))
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right")
+                   .astype(jnp.int32) - 1, 0, a.capacity - 1)
+    intra = pos - new_offsets[row]
+    in_use = pos < new_offsets[-1]
+    from_a = intra < la[row]
+    pa = jnp.clip(a.offsets[row] + intra, 0, a.byte_capacity - 1)
+    pb = jnp.clip(b.offsets[row] + intra - la[row], 0, b.byte_capacity - 1)
+    data = jnp.where(in_use, jnp.where(from_a, a.data[pa], b.data[pb]),
+                     jnp.uint8(0))
+    return StringColumn(data, new_offsets, valid, a.dtype)
+
+
+def str_concat_ws(sep: bytes, cols) -> StringColumn:
+    """concat_ws(sep, c1..ck): skips NULL children entirely; separator only
+    between present children; never null (Spark semantics)."""
+    from ..columnar.column import bucket_capacity
+    k = len(cols)
+    cap = cols[0].capacity
+    lsep = len(sep)
+    lens = [jnp.where(c.validity, string_lengths(c), 0) for c in cols]
+    present = [c.validity for c in cols]
+    # segment table per row: [c0, sep, c1, sep, c2, ...] (2k-1 segments)
+    seg_lens = [lens[0] * present[0]]
+    any_before = present[0]
+    for i in range(1, k):
+        seg_lens.append(jnp.where(any_before & present[i],
+                                  jnp.int32(lsep), 0))
+        seg_lens.append(jnp.where(present[i], lens[i], 0))
+        any_before = any_before | present[i]
+    seg = jnp.stack(seg_lens, axis=1)  # (cap, 2k-1)
+    seg_ends = jnp.cumsum(seg, axis=1)
+    out_lens = seg_ends[:, -1]
+    new_offsets = _rebuild_offsets(out_lens)
+    total_in = sum(c.byte_capacity for c in cols) + cap * lsep * (k - 1)
+    byte_cap = bucket_capacity(max(total_in, 1))
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right")
+                   .astype(jnp.int32) - 1, 0, cap - 1)
+    intra = pos - new_offsets[row]
+    in_use = pos < new_offsets[-1]
+    # segment index: count of segment ends <= intra
+    seg_idx = jnp.sum(intra[:, None] >= seg_ends[row], axis=1)
+    seg_idx = jnp.clip(seg_idx, 0, 2 * k - 2)
+    seg_start = seg_ends[row, seg_idx] - seg[row, seg_idx]
+    local = intra - seg_start
+    sep_arr = jnp.asarray(bytearray(sep or b"\0"), jnp.uint8)
+    byte = sep_arr[jnp.clip(local, 0, max(lsep - 1, 0))]
+    for i, c in enumerate(cols):
+        pi = jnp.clip(c.offsets[row] + local, 0, c.byte_capacity - 1)
+        byte = jnp.where(seg_idx == 2 * i, c.data[pi], byte)
+    data = jnp.where(in_use, byte, jnp.uint8(0))
+    valid = jnp.ones(cap, jnp.bool_)
+    return StringColumn(data, new_offsets, valid, cols[0].dtype)
+
+
+def str_translate(col: StringColumn, from_str: bytes,
+                  to_str: bytes) -> StringColumn:
+    """translate(str, from, to): per-byte mapping; positions of `from`
+    beyond len(to) delete the byte (ASCII semantics; first occurrence in
+    `from` wins, like Java)."""
+    import numpy as np
+    lut = np.arange(256, dtype=np.uint8)
+    keep = np.ones(256, dtype=bool)
+    seen = set()
+    for i, ch in enumerate(from_str):
+        if ch in seen:
+            continue
+        seen.add(ch)
+        if i < len(to_str):
+            lut[ch] = to_str[i]
+        else:
+            keep[ch] = False
+    lut_d = jnp.asarray(lut)
+    keep_d = jnp.asarray(keep)
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    in_use = pos < col.offsets[-1]
+    emit = jnp.where(in_use & keep_d[col.data], 1, 0)
+    out_lens = jax.ops.segment_sum(emit, row, num_segments=col.capacity)
+    out_lens = jnp.where(col.validity, out_lens, 0)
+    new_offsets = _rebuild_offsets(out_lens)
+    emit_start = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                  jnp.cumsum(emit, dtype=jnp.int32)])
+    opos = jnp.arange(byte_cap, dtype=jnp.int32)
+    src = jnp.clip(jnp.searchsorted(emit_start, opos, side="right")
+                   .astype(jnp.int32) - 1, 0, byte_cap - 1)
+    out_in_use = opos < new_offsets[-1]
+    data = jnp.where(out_in_use, lut_d[col.data[src]], jnp.uint8(0))
+    return StringColumn(data, new_offsets, col.validity, col.dtype)
+
+
+def str_ascii(col: StringColumn) -> Column:
+    """ascii(str): code of the first byte, 0 for empty (Spark: first
+    character's codepoint; exact for ASCII)."""
+    from ..types import INT
+    lens = string_lengths(col)
+    first = col.data[jnp.clip(col.offsets[:-1], 0, col.byte_capacity - 1)]
+    res = jnp.where(lens > 0, first.astype(jnp.int32), 0)
+    return Column(res, col.validity, INT)
+
+
+def str_chr(codes: Column) -> StringColumn:
+    """chr(n): 1-byte string from code n % 256; empty for n <= 0
+    (Spark/Java Chr semantics for the ASCII range)."""
+    from ..columnar.column import bucket_capacity
+    from ..types import StringType
+    cap = codes.capacity
+    n = codes.data.astype(jnp.int64)
+    code = (n % 256).astype(jnp.int32)
+    out_lens = jnp.where(codes.validity & (n > 0) & (code > 0), 1, 0)
+    out_lens = out_lens.astype(jnp.int32)
+    new_offsets = _rebuild_offsets(out_lens)
+    byte_cap = bucket_capacity(max(cap, 1))
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_offsets, pos, side="right")
+                   .astype(jnp.int32) - 1, 0, cap - 1)
+    in_use = pos < new_offsets[-1]
+    data = jnp.where(in_use, code[row].astype(jnp.uint8), jnp.uint8(0))
+    return StringColumn(data, new_offsets, codes.validity, StringType())
+
+
 def string_compare_cols(a: StringColumn, b: StringColumn):
     """Row-wise lexicographic byte compare -> int32 sign (-1/0/1).
 

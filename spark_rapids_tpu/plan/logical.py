@@ -191,6 +191,41 @@ class LogicalExpand(LogicalPlan):
                                  self.children[0].schema)
 
 
+class LogicalGenerate(LogicalPlan):
+    """explode/posexplode of an array expression (reference
+    GpuGenerateExec.scala:829)."""
+
+    def __init__(self, generator: Expression, child: LogicalPlan,
+                 outer: bool = False, position: bool = False,
+                 elem_name: str = "col", pos_name: str = "pos"):
+        self.generator = generator
+        self.outer = outer
+        self.position = position
+        self.elem_name = elem_name
+        self.pos_name = pos_name
+        self.children = (child,)
+
+    @property
+    def schema(self) -> Schema:
+        from ..types import ArrayType, IntegerType
+        bound = resolve(self.generator, self.children[0].schema)
+        arr_t = bound.data_type
+        if not isinstance(arr_t, ArrayType):
+            raise TypeError(
+                f"explode needs an ARRAY input, got {arr_t.simple_name()}")
+        fields = list(self.children[0].schema.fields)
+        if self.position:
+            fields.append(StructField(self.pos_name, IntegerType(),
+                                      self.outer))
+        fields.append(StructField(self.elem_name, arr_t.element_type, True))
+        return Schema(tuple(fields))
+
+    def describe(self):
+        kind = "posexplode" if self.position else "explode"
+        return f"Generate {kind}{'_outer' if self.outer else ''}" \
+               f"({self.generator!r})"
+
+
 class LogicalWindow(LogicalPlan):
     def __init__(self, window_exprs, child: LogicalPlan):
         self.window_exprs = list(window_exprs)

@@ -63,6 +63,14 @@ class ExprMeta(BaseMeta):
     @staticmethod
     def wrap(expr: Expression, conf: RapidsConf, input_schema) -> "ExprMeta":
         from .overrides import expression_rules
+        if input_schema is not None:
+            # bind column references so type-signature checks see real
+            # types (reference tags over resolved Catalyst expressions)
+            from ..expr.core import resolve
+            try:
+                expr = resolve(expr, input_schema)
+            except (KeyError, TypeError):
+                pass  # unresolvable here (e.g. join pair scope)
         rule = expression_rules().get(type(expr))
         return ExprMeta(expr, rule, conf, input_schema)
 

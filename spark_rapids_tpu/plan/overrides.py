@@ -25,8 +25,8 @@ from ..exec.coalesce import CoalesceBatchesExec
 from ..exec.joins import HashJoinExec, NestedLoopJoinExec
 from ..exec.sort import SortExec, TopNExec
 from ..exec.window import WindowExec
-from ..expr import arithmetic, cast, conditional, datetimeexprs, \
-    hashexprs, math as emath, predicates, stringexprs
+from ..expr import arithmetic, cast, collectionexprs, conditional, \
+    datetimeexprs, hashexprs, math as emath, predicates, stringexprs
 from ..expr.core import (
     Alias, BoundReference, Expression, Literal, UnresolvedAttribute, resolve,
 )
@@ -63,11 +63,13 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
         return _EXPR_RULES
     rules: Dict[Type[Expression], ExprRule] = {}
     num = numeric_and_decimal
-    # leaves
+    # leaves: pass through whatever the column holds — the consuming
+    # expression's input signature is what gates support
+    from .typesig import all_types
     _r(rules, Literal, "literal value")
-    _r(rules, BoundReference, "column reference")
-    _r(rules, UnresolvedAttribute, "column reference")
-    _r(rules, Alias, "named expression")
+    _r(rules, BoundReference, "column reference", all_types, all_types)
+    _r(rules, UnresolvedAttribute, "column reference", all_types, all_types)
+    _r(rules, Alias, "named expression", all_types, all_types)
     # arithmetic
     for c in (arithmetic.Add, arithmetic.Subtract, arithmetic.Multiply):
         _r(rules, c, f"{c.__name__.lower()}", num, num)
@@ -128,6 +130,80 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
     _r(rules, stringexprs.StartsWith, "prefix match", stringlike, BOOLEAN)
     _r(rules, stringexprs.EndsWith, "suffix match", stringlike, BOOLEAN)
     _r(rules, stringexprs.Contains, "substring match", stringlike, BOOLEAN)
+    for c, d in ((stringexprs.StringTrim, "trim"),
+                 (stringexprs.StringTrimLeft, "ltrim"),
+                 (stringexprs.StringTrimRight, "rtrim"),
+                 (stringexprs.StringLPad, "lpad"),
+                 (stringexprs.StringRPad, "rpad"),
+                 (stringexprs.StringRepeat, "repeat"),
+                 (stringexprs.Reverse, "reverse (byte order)"),
+                 (stringexprs.InitCap, "initcap"),
+                 (stringexprs.StringReplace, "literal replace"),
+                 (stringexprs.Concat, "string concatenation"),
+                 (stringexprs.ConcatWs, "concat with separator"),
+                 (stringexprs.StringTranslate, "character translation"),
+                 (stringexprs.Left, "left substring"),
+                 (stringexprs.Right, "right substring")):
+        _r(rules, c, d, stringlike, stringlike)
+    _r(rules, stringexprs.StringLocate, "substring position", stringlike,
+       integral)
+    _r(rules, stringexprs.Ascii, "first byte code", stringlike, integral)
+    _r(rules, stringexprs.Chr, "code point to string", integral, stringlike)
+    _r(rules, stringexprs.OctetLength, "byte length", stringlike, integral)
+    _r(rules, stringexprs.BitLength, "bit length", stringlike, integral)
+    def _tag_regex(meta):
+        """Transpile at tag time; unsupported constructs tag the
+        expression off the TPU instead of throwing (reference
+        RegexParser.scala:687 transpile-or-fallback)."""
+        from ..regex import RegexUnsupported
+        try:
+            meta.expr.program
+        except RegexUnsupported as e:
+            meta.will_not_work_on_tpu(str(e))
+
+    _r(rules, stringexprs.RLike,
+       "regex match (device Glushkov automaton; unsupported constructs "
+       "tag off-TPU, reference RegexParser.scala:687)",
+       stringlike, BOOLEAN, tag_fn=_tag_regex)
+    _r(rules, stringexprs.Like, "SQL LIKE pattern", stringlike, BOOLEAN,
+       tag_fn=_tag_regex)
+    # null handling / misc
+    _r(rules, conditional.Nvl, "nvl/ifnull")
+    _r(rules, conditional.Nvl2, "nvl2")
+    _r(rules, conditional.NullIf, "nullif")
+    # collections (fixed-width + string elements; deeper nesting tagged off)
+    arr = TypeSig.of("ARRAY")
+    _r(rules, collectionexprs.Size, "array size", arr, integral)
+    _r(rules, collectionexprs.ArrayContains, "array membership", arr, BOOLEAN)
+    _r(rules, collectionexprs.ElementAt, "1-based element access", arr,
+       commonly_supported)
+    _r(rules, collectionexprs.GetArrayItem, "0-based element access", arr,
+       commonly_supported)
+    def _fixed_width_elements(meta):
+        """Sort/min/max kernels need fixed-width elements (no string sort
+        lanes in arrays yet); reject at plan time, not eval time."""
+        from ..types import ArrayType
+        for c in meta.children:
+            try:
+                dt = c.expr.data_type
+            except TypeError:
+                continue
+            if isinstance(dt, ArrayType) and not dt.element_type.is_fixed_width:
+                meta.will_not_work_on_tpu(
+                    f"array<{dt.element_type.simple_name()}> elements are "
+                    "not fixed-width (string sort lanes in arrays planned)")
+
+    _r(rules, collectionexprs.SortArray, "in-array sort", arr, arr,
+       tag_fn=_fixed_width_elements)
+    _r(rules, collectionexprs.ArrayMin, "array minimum", arr,
+       numeric_and_decimal, tag_fn=_fixed_width_elements)
+    _r(rules, collectionexprs.ArrayMax, "array maximum", arr,
+       numeric_and_decimal, tag_fn=_fixed_width_elements)
+    # fixed-width inputs only: the interleave constructor has no string
+    # element path yet (reject loudly instead of reinterpreting bytes)
+    _r(rules, collectionexprs.CreateArray, "array constructor",
+       numeric_and_decimal + TypeSig.of("BOOLEAN", "DATE", "TIMESTAMP",
+                                        "TIMESTAMP_NTZ"), arr)
     _EXPR_RULES = rules
     return rules
 
@@ -135,6 +211,53 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
 # ---------------------------------------------------------------------------
 # plan metas
 # ---------------------------------------------------------------------------
+
+def extract_pushable_filters(condition: Expression, schema) -> List[tuple]:
+    """Split a filter condition into (name, op, literal) conjuncts a scan
+    can prune row groups with (the reference's predicate pushdown feeding
+    GpuParquetScan). Non-extractable conjuncts simply don't push — the
+    Filter stays above the scan either way."""
+    out: List[tuple] = []
+
+    def name_of(e) -> Optional[str]:
+        if isinstance(e, (UnresolvedAttribute, BoundReference)) \
+                and e.name in schema.names:
+            return e.name
+        return None
+
+    def visit(e: Expression):
+        if isinstance(e, predicates.And):
+            visit(e.children[0])
+            visit(e.children[1])
+            return
+        ops = {predicates.LessThan: "<", predicates.LessThanOrEqual: "<=",
+               predicates.GreaterThan: ">",
+               predicates.GreaterThanOrEqual: ">=",
+               predicates.EqualTo: "=="}
+        op = ops.get(type(e))
+        if op is not None:
+            l, r = e.children
+            if name_of(l) is not None and isinstance(r, Literal) \
+                    and r.value is not None:
+                out.append((name_of(l), op, r.value))
+            elif name_of(r) is not None and isinstance(l, Literal) \
+                    and l.value is not None:
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                        "==": "=="}
+                out.append((name_of(r), flip[op], l.value))
+            return
+        if isinstance(e, predicates.IsNull):
+            n = name_of(e.children[0])
+            if n is not None:
+                out.append((n, "is_null", None))
+        if isinstance(e, predicates.IsNotNull):
+            n = name_of(e.children[0])
+            if n is not None:
+                out.append((n, "is_not_null", None))
+
+    visit(condition)
+    return out
+
 
 def estimate_plan_size(plan: L.LogicalPlan) -> Optional[int]:
     """Best-effort bytes estimate for broadcast planning (the analog of
@@ -169,38 +292,49 @@ class PlanMeta(BaseMeta):
         self.conf = conf
         self.children = [PlanMeta(c, conf) for c in plan.children]
         self.expr_metas: List[ExprMeta] = [
-            ExprMeta.wrap(e, conf, None) for e in self._expressions()]
+            ExprMeta.wrap(e, conf, sch)
+            for e, sch in self._expression_pairs()]
 
-    def _expressions(self) -> List[Expression]:
+    def _expression_pairs(self):
+        """(expression, input schema) pairs — the schema lets tagging bind
+        column references so type checks see real types."""
         p = self.plan
+        child_sch = p.children[0].schema if p.children else None
         if isinstance(p, L.LogicalProject):
-            return list(p.exprs)
+            return [(e, child_sch) for e in p.exprs]
         if isinstance(p, L.LogicalFilter):
-            return [p.condition]
+            return [(p.condition, child_sch)]
         if isinstance(p, L.LogicalAggregate):
-            out = list(p.group_exprs)
+            out = [(e, child_sch) for e in p.group_exprs]
             for fn, _ in p.aggregates:
-                out.extend(fn.inputs)
+                out.extend((e, child_sch) for e in fn.inputs)
             return out
         if isinstance(p, L.LogicalJoin):
-            out = list(p.left_keys) + list(p.right_keys)
+            lsch = p.children[0].schema
+            rsch = p.children[1].schema
+            out = [(e, lsch) for e in p.left_keys]
+            out += [(e, rsch) for e in p.right_keys]
             if p.condition is not None:
-                out.append(p.condition)
+                out.append((p.condition, None))  # pair-scope, binds later
             return out
         if isinstance(p, L.LogicalExpand):
-            return [e for proj in p.projections for e in proj]
+            return [(e, child_sch) for proj in p.projections for e in proj]
+        if isinstance(p, L.LogicalGenerate):
+            return [(p.generator, child_sch)]
         if isinstance(p, L.LogicalSort):
             out = []
             for o in p.orders:
-                out.append(o[0] if isinstance(o, tuple) else o)
-            return [e for e in out if isinstance(e, Expression)]
+                e = o[0] if isinstance(o, tuple) else o
+                if isinstance(e, Expression):
+                    out.append((e, child_sch))
+            return out
         if isinstance(p, L.LogicalWindow):
             out = []
             for we, _ in p.window_exprs:
-                out.extend(we.fn.inputs)
-                out.extend(we.spec.partition_by)
+                out.extend((e, child_sch) for e in we.fn.inputs)
+                out.extend((e, child_sch) for e in we.spec.partition_by)
                 for o in we.spec.order_by:
-                    out.append(o[0])
+                    out.append((o[0], child_sch))
             return out
         return []
 
@@ -210,6 +344,20 @@ class PlanMeta(BaseMeta):
             c.tag_for_tpu()
             if not c.can_run_on_tpu:
                 self.will_not_work_on_tpu("child plan cannot run on TPU")
+        if isinstance(self.plan, L.LogicalJoin):
+            # joins duplicate payload rows; the duplicating array gather
+            # has no string-element byte measurement yet — reject at plan
+            # time instead of asserting mid-execution
+            from ..types import ArrayType
+            for child in self.plan.children:
+                for f in child.schema.fields:
+                    if isinstance(f.data_type, ArrayType) \
+                            and not f.data_type.element_type.is_fixed_width:
+                        self.will_not_work_on_tpu(
+                            f"join payload column {f.name!r}: "
+                            f"{f.data_type.simple_name()} elements are not "
+                            "fixed-width (duplicating gather lacks string "
+                            "byte measurement)")
         for em in self.expr_metas:
             em.tag_for_tpu()
             if not em.can_run_on_tpu:
@@ -332,6 +480,22 @@ class PlanMeta(BaseMeta):
 
     def convert(self) -> TpuExec:
         p = self.plan
+        if isinstance(p, L.LogicalFilter) \
+                and isinstance(p.children[0], L.LogicalScan):
+            # predicate pushdown: hand simple conjuncts to the source for
+            # footer-stats row-group pruning; the Filter stays for
+            # exactness (stats prove absence, never presence)
+            from ..config import PARQUET_PUSHDOWN_ENABLED
+            scan = p.children[0]
+            src = scan.source
+            if self.conf.get(PARQUET_PUSHDOWN_ENABLED) \
+                    and hasattr(src, "with_filters"):
+                pushed = extract_pushable_filters(p.condition, scan.schema)
+                if pushed:
+                    src = src.with_filters(pushed)
+            scan_exec = CoalesceBatchesExec(
+                InMemoryScanExec(list(src.batches()), scan.schema))
+            return FilterExec(p.condition, scan_exec)
         kids = [c.convert() for c in self.children]
         if isinstance(p, L.LogicalScan):
             batches = list(p.source.batches())
@@ -360,6 +524,10 @@ class PlanMeta(BaseMeta):
             return ExpandExec(p.projections, kids[0])
         if isinstance(p, L.LogicalWindow):
             return WindowExec(p.window_exprs, kids[0])
+        if isinstance(p, L.LogicalGenerate):
+            from ..exec.generate import GenerateExec
+            return GenerateExec(p.generator, kids[0], p.outer, p.position,
+                                p.elem_name, p.pos_name)
         if isinstance(p, L.LogicalJoin):
             return self._convert_join(p, kids)
         raise PlanNotSupported(f"no conversion for {type(p).__name__}")

@@ -1,0 +1,18 @@
+"""Device regex — the engine's answer to the reference's regex transpiler
+(RegexParser.scala:687 + cuDF device regex). SURVEY §2.8 flags this as the
+hardest expression family; the TPU design is different from cuDF's
+backtracking VM: a Java-regex *subset* parses to a Glushkov position
+automaton (≤ 32 positions, one uint32 state mask per row) and matching is
+a vectorized device loop — each step advances EVERY row by one byte with
+pure bitwise VPU ops, trip count = max row length (device scalar, no
+recompile).
+
+Unsupported constructs (backreferences, lookaround, lazy quantifiers,
+unbounded counted repeats, char-by-char Unicode classes) raise
+RegexUnsupported at PLAN time so the planner can tag the expression off
+the TPU — exactly the reference's transpile-or-fallback contract.
+"""
+
+from .parser import RegexUnsupported, parse_regex  # noqa: F401
+from .program import RegexProgram, compile_regex, like_to_program  # noqa: F401
+from .kernel import regex_find  # noqa: F401

@@ -1,0 +1,86 @@
+"""Device regex matching kernel: one uint32 Glushkov state mask per row,
+advanced byte-by-byte in a vectorized lax.while_loop — every iteration
+moves ALL rows forward one byte with pure bitwise VPU ops; trip count is
+the max row byte-length in the batch (a device scalar, so no recompiles
+across batches). O(max_len × capacity × n_positions) bit-ops total.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+from ..types import BOOLEAN
+from .program import RegexProgram
+
+
+def regex_find(col: StringColumn, prog: RegexProgram) -> Column:
+    """Java Matcher.find()/matches() over every row.
+
+    anchored_start/anchored_end=False (RLike): true iff any substring
+    matches. Both anchored (LIKE): true iff the whole row matches.
+    """
+    from ..ops.strings import string_lengths
+
+    cap = col.capacity
+    lens = string_lengths(col)
+    valid = col.validity
+
+    # nullable patterns match the empty string; under find() semantics an
+    # empty match exists at some position unless BOTH ends are anchored
+    if prog.nullable and not (prog.anchored_start and prog.anchored_end):
+        return Column(jnp.ones(cap, jnp.bool_), valid, BOOLEAN)
+    if prog.n_pos == 0:
+        # empty anchored pattern: matches only the empty string
+        return Column(lens == 0, valid, BOOLEAN)
+
+    byte_table = jnp.asarray(prog.byte_table)           # (256,) uint32
+    follow_rows = jnp.asarray(prog.follow_rows)         # (n,) uint32
+    first = jnp.uint32(prog.first_mask)
+    last = jnp.uint32(prog.last_mask)
+    starts = col.offsets[:-1]
+    byte_cap = col.byte_capacity
+    max_t = jnp.max(lens)
+
+    def body(carry):
+        t, state, matched = carry
+        p = jnp.clip(starts + t, 0, byte_cap - 1)
+        cmask = byte_table[col.data[p]]
+        # follow(state): OR of follow rows of set positions (static unroll
+        # over <=32 positions; XLA fuses this into a handful of vector ops)
+        fol = jnp.zeros(cap, jnp.uint32)
+        for s in range(prog.n_pos):
+            bit = (state >> jnp.uint32(s)) & jnp.uint32(1)
+            fol = fol | jnp.where(bit != 0, follow_rows[s], jnp.uint32(0))
+        inject = first if not prog.anchored_start else \
+            jnp.where(t == 0, first, jnp.uint32(0))
+        new_state = (fol | inject) & cmask
+        active = t < lens
+        new_state = jnp.where(active, new_state, state)
+        if not prog.anchored_end:
+            matched = matched | (active & ((new_state & last) != 0))
+        return t + 1, new_state, matched
+
+    def cond(carry):
+        t, state, matched = carry
+        more = t < max_t
+        if not prog.anchored_end and prog.anchored_start:
+            # anchored-start find can stop early once every row is decided
+            # (state only goes dead after the t=0 injection has happened)
+            return more & ((t == 0)
+                           | ~jnp.all(matched | (state == 0) | (t >= lens)))
+        return more
+
+    state0 = jnp.zeros(cap, jnp.uint32)
+    matched0 = jnp.zeros(cap, jnp.bool_)
+    _, state, matched = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), state0, matched0))
+
+    if prog.anchored_end:
+        # accept iff a last position is live exactly at each row's end
+        # (state freezes at the final byte); whole-match of the empty row
+        # is the nullable case
+        matched = (state & last) != 0
+        matched = jnp.where(lens == 0, prog.nullable, matched)
+    return Column(matched, valid, BOOLEAN)

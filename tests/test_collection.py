@@ -1,0 +1,125 @@
+"""Collection expression tests vs Python oracles (reference
+collectionOperations.scala; integration analog collection_ops_test.py)."""
+
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.types import (
+    DOUBLE, LONG, STRING, ArrayType, Schema, StructField,
+)
+
+ARRS = [[1, 2, 3], [], None, [5], [7, None, 3], [10, 10], [None], [-4, 0]]
+
+
+@pytest.fixture(scope="module")
+def df():
+    s = TpuSession()
+    sch = Schema((StructField("a", ArrayType(LONG)),
+                  StructField("n", LONG)))
+    return s.from_pydict({"a": ARRS, "n": list(range(len(ARRS)))}, sch)
+
+
+def run1(df, expr):
+    return [r[0] for r in df.select(expr.alias("r")).collect()]
+
+
+def test_roundtrip(df):
+    assert run1(df, col("a")) == ARRS
+
+
+def test_size(df):
+    assert run1(df, F.size(col("a"))) == [
+        None if a is None else len(a) for a in ARRS]
+
+
+def test_array_contains(df):
+    got = run1(df, F.array_contains(col("a"), 3))
+    exp = []
+    for a in ARRS:
+        if a is None:
+            exp.append(None)
+        elif 3 in a:
+            exp.append(True)
+        elif None in a:
+            exp.append(None)
+        else:
+            exp.append(False)
+    assert got == exp
+
+
+def test_element_at(df):
+    assert run1(df, F.element_at(col("a"), 2)) == [
+        None if a is None or len(a) < 2 else a[1] for a in ARRS]
+    assert run1(df, F.element_at(col("a"), -1)) == [
+        None if a is None or not a else a[-1] for a in ARRS]
+    assert run1(df, F.get_array_item(col("a"), 0)) == [
+        None if a is None or not a else a[0] for a in ARRS]
+
+
+def test_sort_array(df):
+    def srt(a, asc):
+        if a is None:
+            return None
+        nulls = [x for x in a if x is None]
+        vals = sorted(x for x in a if x is not None)
+        return nulls + vals if asc else vals[::-1] + nulls
+    assert run1(df, F.sort_array(col("a"))) == [srt(a, True) for a in ARRS]
+    assert run1(df, F.sort_array(col("a"), False)) == [
+        srt(a, False) for a in ARRS]
+
+
+def test_array_min_max(df):
+    assert run1(df, F.array_min(col("a"))) == [
+        None if a is None or all(x is None for x in a)
+        else min(x for x in a if x is not None) for a in ARRS]
+    assert run1(df, F.array_max(col("a"))) == [
+        None if a is None or all(x is None for x in a)
+        else max(x for x in a if x is not None) for a in ARRS]
+
+
+def test_create_array(df):
+    got = run1(df, F.array(col("n"), col("n") + 100, F.lit(7).cast(LONG)))
+    assert got == [[n, n + 100, 7] for n in range(len(ARRS))]
+
+
+def test_filter_preserves_arrays(df):
+    got = df.filter(col("n") < 4).select("a", "n").collect()
+    assert got == [(a, i) for i, a in enumerate(ARRS[:4])]
+
+
+def test_string_element_arrays():
+    s = TpuSession()
+    sch = Schema((StructField("a", ArrayType(STRING)),))
+    arrs = [["x", "yy"], None, [], ["z", None, "abc"]]
+    df = s.from_pydict({"a": arrs}, sch)
+    assert run1(df, col("a")) == arrs
+    assert run1(df, F.size(col("a"))) == [2, None, 0, 3]
+    assert run1(df, F.array_contains(col("a"), "abc")) == [
+        False, None, False, True]
+    assert run1(df, F.element_at(col("a"), 2)) == ["yy", None, None, None]
+
+
+def test_sort_array_doubles():
+    s = TpuSession()
+    sch = Schema((StructField("a", ArrayType(DOUBLE)),))
+    arrs = [[3.5, -1.0, float("inf")], [float("-inf"), 0.0], None]
+    df = s.from_pydict({"a": arrs}, sch)
+    assert run1(df, F.sort_array(col("a"))) == [
+        [-1.0, 3.5, float("inf")], [float("-inf"), 0.0], None]
+
+
+def test_nvl_family():
+    s = TpuSession()
+    sch = Schema((StructField("x", LONG), StructField("y", LONG)))
+    df = s.from_pydict({"x": [1, None, 3, None], "y": [9, 8, None, None]},
+                       sch)
+    assert [r[0] for r in df.select(F.nvl(col("x"), col("y")).alias("r"))
+            .collect()] == [1, 8, 3, None]
+    assert [r[0] for r in df.select(
+        F.nvl2(col("x"), col("y"), F.lit(0).cast(LONG)).alias("r"))
+        .collect()] == [9, 0, None, 0]
+    assert [r[0] for r in df.select(F.nullif(col("x"), F.lit(3).cast(LONG))
+                                    .alias("r")).collect()] == [1, None,
+                                                                None, None]

@@ -196,3 +196,56 @@ def test_first_last_after_sort():
     got = {r[0]: r[1:] for r in plan.collect()}
     assert got["a"] == (1, 5)
     assert got["c"] == (2, 6)
+
+
+def test_out_of_core_sort_streams_bounded_chunks():
+    """>MERGE_FAN_IN runs: the streamed merge must emit multiple bounded
+    batches whose concatenation is exactly the global sort (reference
+    GpuOutOfCoreSortIterator, GpuSortExec.scala:281)."""
+    import numpy as np
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.exec.basic import InMemoryScanExec
+    from spark_rapids_tpu.exec.sort import SortExec
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.types import INT, STRING, Schema, StructField
+
+    rng = np.random.default_rng(23)
+    sch = Schema((StructField("k", INT), StructField("s", STRING)))
+    n_batches, rows = 20, 64
+    batches, all_rows = [], []
+    for _ in range(n_batches):
+        ks = [None if rng.random() < 0.1 else int(x)
+              for x in rng.integers(-1000, 1000, rows)]
+        ss = [f"s{int(x):03d}" for x in rng.integers(0, 500, rows)]
+        all_rows += list(zip(ks, ss))
+        batches.append(ColumnarBatch.from_pydict({"k": ks, "s": ss}, sch))
+    plan = SortExec([(col("k"), True), (col("s"), True)],
+                    InMemoryScanExec(batches, sch))
+    out_batches = list(plan.execute())
+    assert len(out_batches) > 1, "streamed merge must emit multiple chunks"
+    # bounded device footprint: no emitted chunk anywhere near the total
+    total = n_batches * rows
+    assert all(b.capacity < total for b in out_batches)
+    got = [r for b in out_batches for r in b.to_pylist()]
+    exp = sorted(all_rows, key=lambda r: (r[0] is not None, r[0] or 0, r[1]))
+    assert got == exp
+
+
+def test_out_of_core_sort_disabled_conf():
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.exec.basic import InMemoryScanExec
+    from spark_rapids_tpu.exec.sort import SortExec
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.types import INT, Schema, StructField
+
+    TpuSession({"spark.rapids.sql.sort.outOfCore.enabled": False})
+    sch = Schema((StructField("k", INT),))
+    batches = [ColumnarBatch.from_pydict({"k": [i, 100 - i]}, sch)
+               for i in range(12)]
+    plan = SortExec([(col("k"), True)], InMemoryScanExec(batches, sch))
+    out = list(plan.execute())
+    assert len(out) == 1  # concat-all path
+    got = [r[0] for b in out for r in b.to_pylist()]
+    assert got == sorted(got)
+    TpuSession()  # reset active conf

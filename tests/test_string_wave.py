@@ -1,0 +1,206 @@
+"""String function wave + device regex, each vs a Python-semantics oracle
+(reference: stringFunctions.scala operators, RegexParser.scala transpiler;
+integration test analog string_test.py / regexp_test.py)."""
+
+import re as pyre
+
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.testing import StringGen, gen_pydict
+from spark_rapids_tpu.types import INT, LONG, STRING, Schema, StructField
+
+STRS = ["hello world", "  padded  ", "", "a", "aaa bbb", "MiXeD CaSe",
+        None, "tab\there", "x,y,z", "abcabcabc", "trailing   ",
+        "   leading", "one two  three"]
+
+
+@pytest.fixture(scope="module")
+def df():
+    s = TpuSession()
+    sch = Schema((StructField("s", STRING), StructField("n", INT)))
+    return s.from_pydict({"s": STRS, "n": list(range(len(STRS)))}, sch)
+
+
+def run1(df, expr):
+    return [r[0] for r in df.select(expr.alias("r")).collect()]
+
+
+def oracle(fn):
+    return [None if s is None else fn(s) for s in STRS]
+
+
+def test_trim_family(df):
+    assert run1(df, F.trim(col("s"))) == oracle(str.strip)
+    assert run1(df, F.ltrim(col("s"))) == oracle(str.lstrip)
+    assert run1(df, F.rtrim(col("s"))) == oracle(str.rstrip)
+    assert run1(df, F.trim(col("s"), "ag ")) == oracle(
+        lambda s: s.strip("ag "))
+
+
+def test_pad(df):
+    assert run1(df, F.lpad(col("s"), 8, "*")) == oracle(
+        lambda s: s.rjust(8, "*") if len(s) < 8 else s[:8])
+    assert run1(df, F.rpad(col("s"), 8, "xy")) == oracle(
+        lambda s: (s + "xyxyxyxy")[:8] if len(s) < 8 else s[:8])
+    # empty pad keeps short strings (Spark semantics)
+    assert run1(df, F.lpad(col("s"), 6, "")) == oracle(lambda s: s[:6])
+
+
+def test_repeat_reverse(df):
+    assert run1(df, F.repeat(col("s"), 3)) == oracle(lambda s: s * 3)
+    assert run1(df, F.repeat(col("s"), 0)) == oracle(lambda s: "")
+    assert run1(df, F.reverse(col("s"))) == oracle(lambda s: s[::-1])
+
+
+def test_initcap(df):
+    def ic(s):
+        out, prev_space = [], True
+        for ch in s:
+            out.append(ch.upper() if prev_space else ch.lower())
+            prev_space = ch in " \t\n\r"
+        return "".join(out)
+    assert run1(df, F.initcap(col("s"))) == oracle(ic)
+
+
+def test_locate_instr(df):
+    assert run1(df, F.locate("l", col("s"))) == oracle(
+        lambda s: s.find("l") + 1)
+    assert run1(df, F.locate("l", col("s"), 4)) == oracle(
+        lambda s: s.find("l", 3) + 1)
+    assert run1(df, F.instr(col("s"), "ab")) == oracle(
+        lambda s: s.find("ab") + 1)
+    # empty needle: Java indexOf("") semantics
+    assert run1(df, F.locate("", col("s"), 3)) == oracle(
+        lambda s: min(2, len(s)) + 1)
+
+
+def test_replace(df):
+    assert run1(df, F.replace(col("s"), "ab", "QQ")) == oracle(
+        lambda s: s.replace("ab", "QQ"))
+    assert run1(df, F.replace(col("s"), "a", "")) == oracle(
+        lambda s: s.replace("a", ""))
+    assert run1(df, F.replace(col("s"), " ", "__")) == oracle(
+        lambda s: s.replace(" ", "__"))
+
+
+def test_replace_bordered_needle():
+    """Self-overlapping needles need greedy non-overlapping selection."""
+    s = TpuSession()
+    vals = ["aaaa", "aaa", "aa", "a", "", "baaab", "aabaa", None]
+    sch = Schema((StructField("s", STRING),))
+    df = s.from_pydict({"s": vals}, sch)
+    got = [r[0] for r in df.select(
+        F.replace(col("s"), "aa", "X").alias("r")).collect()]
+    assert got == [None if v is None else v.replace("aa", "X")
+                   for v in vals]
+
+
+def test_concat_and_ws(df):
+    got = run1(df, F.concat(col("s"), F.lit("!"), col("s")))
+    assert got == oracle(lambda s: s + "!" + s)
+    # concat is null-intolerant
+    assert got[STRS.index(None)] is None
+    # concat_ws skips nulls and never returns null
+    got_ws = run1(df, F.concat_ws("-", col("s"), F.lit("A"), col("s")))
+    exp = ["-".join(x for x in (s, "A", s) if x is not None)
+           for s in STRS]
+    assert got_ws == exp
+
+
+def test_translate(df):
+    assert run1(df, F.translate(col("s"), "abc", "xy")) == oracle(
+        lambda s: s.translate(str.maketrans("ab", "xy", "c")))
+
+
+def test_ascii_chr():
+    s = TpuSession()
+    sch = Schema((StructField("s", STRING), StructField("n", LONG)))
+    df = s.from_pydict({"s": ["Abc", "", "zz", None],
+                        "n": [65, 0, 256 + 66, None]}, sch)
+    assert [r[0] for r in df.select(F.ascii(col("s")).alias("r")).collect()] \
+        == [65, 0, 122, None]
+    assert [r[0] for r in df.select(F.chr(col("n")).alias("r")).collect()] \
+        == ["A", "", "B", None]
+
+
+def test_left_right(df):
+    assert run1(df, F.left(col("s"), 3)) == oracle(lambda s: s[:3])
+    assert run1(df, F.right(col("s"), 3)) == oracle(
+        lambda s: s[-3:] if len(s) >= 3 else s)
+    assert run1(df, F.left(col("s"), 0)) == oracle(lambda s: "")
+
+
+def test_lengths(df):
+    assert run1(df, F.octet_length(col("s"))) == oracle(
+        lambda s: len(s.encode()))
+    assert run1(df, F.bit_length(col("s"))) == oracle(
+        lambda s: len(s.encode()) * 8)
+
+
+@pytest.mark.parametrize("pattern", [
+    r"^hello", r"world$", r"a+", r"[a-m]+", r"\s\s", r"^\s*[a-z]+",
+    r"(one|two) ", r"a{3}", r".b.", r"^[^aeiou]+$", r"x,y,z",
+])
+def test_rlike_vs_python(df, pattern):
+    got = run1(df, F.rlike(col("s"), pattern))
+    assert got == oracle(lambda s: bool(pyre.search(pattern, s))), pattern
+
+
+@pytest.mark.parametrize("pattern", [
+    "hello%", "%world", "%a%", "___", "", "%", "a", "%  %", "x,y,z",
+])
+def test_like_vs_python(df, pattern):
+    rx = "^" + "".join(
+        ".*" if c == "%" else "." if c == "_" else pyre.escape(c)
+        for c in pattern) + "$"
+    got = run1(df, F.like(col("s"), pattern))
+    assert got == oracle(lambda s: bool(pyre.search(rx, s))), pattern
+
+
+def test_rlike_unsupported_tags_off_tpu():
+    """Unsupported regex constructs tag the plan off at PLAN time (the
+    reference's transpile-or-fallback), not at expression construction."""
+    from spark_rapids_tpu.plan.overrides import PlanNotSupported
+    s = TpuSession()
+    sch = Schema((StructField("s", STRING),))
+    df = s.from_pydict({"s": ["x"]}, sch)
+    for bad in (r"(?=x)", r"a*?", r"\1", r"\bw", r"\p{L}", r"x{1,200}"):
+        plan = df.select(F.rlike(col("s"), bad).alias("r"))  # no throw
+        with pytest.raises(PlanNotSupported):
+            plan.collect()
+
+
+def test_string_wave_fuzz():
+    """Random strings through the whole wave vs Python oracles."""
+    data, sch = gen_pydict(
+        [("s", StringGen(max_length=24, ascii_only=True))], 500, seed=42)
+    sess = TpuSession()
+    df = sess.from_pydict(data, sch, batch_rows=128)
+    vals = data["s"]
+
+    def run(expr):
+        return [r[0] for r in df.select(expr.alias("r")).collect()]
+
+    checks = [
+        (F.trim(col("s")), str.strip),
+        (F.reverse(col("s")), lambda s: s[::-1]),
+        (F.lpad(col("s"), 10, "#"),
+         lambda s: s.rjust(10, "#") if len(s) < 10 else s[:10]),
+        (F.replace(col("s"), "a", "zz"), lambda s: s.replace("a", "zz")),
+        (F.locate("e", col("s")), lambda s: s.find("e") + 1),
+        (F.rlike(col("s"), r"[0-9][a-z]"),
+         lambda s: bool(pyre.search(r"[0-9][a-z]", s))),
+        (F.like(col("s"), "%a%"), lambda s: "a" in s),
+    ]
+    for expr, fn in checks:
+        got = run(expr)
+        exp = [None if s is None else fn(s) for s in vals]
+        assert got == exp, f"{expr!r}"
+
+
+def test_rule_count_grew():
+    from spark_rapids_tpu.plan.overrides import expression_rules
+    assert len(expression_rules()) >= 80

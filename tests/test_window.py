@@ -169,3 +169,59 @@ def test_window_via_dataframe_api():
     a = [r for r in out.collect() if r[0] == "a"]
     assert [r[3] for r in a] == [1, 2, 3, 4]
     assert [r[4] for r in a] == [10, 60, 60, 100]
+
+
+def _minmax_oracle(vals, p, f, want_max):
+    """Python oracle for ROWS [i-p, i+f] min/max, None = unbounded."""
+    n = len(vals)
+    out = []
+    for i in range(n):
+        a = 0 if p is None else max(i - p, 0)
+        b = n - 1 if f is None else min(i + f, n - 1)
+        window_vals = [v for v in vals[a:b + 1] if v is not None]
+        out.append((max(window_vals) if want_max else min(window_vals))
+                   if window_vals else None)
+    return out
+
+
+@pytest.mark.parametrize("p,f", [(1, 1), (2, 0), (0, 2), (2, 1), (None, 2),
+                                 (3, None)])
+def test_bounded_min_max_frames(p, f):
+    """The sparse-table sliding extrema kernel vs a Python oracle
+    (reference GpuBatchedBoundedWindowExec.scala:220)."""
+    rng = np.random.default_rng(17)
+    n = 60
+    parts = sorted(["x", "y", "z"][i] for i in rng.integers(0, 3, n))
+    vals = [None if rng.random() < 0.2 else int(v)
+            for v in rng.integers(-50, 50, n)]
+    data = {"p": parts, "o": list(range(n)), "v": vals}
+    spec = window(partition_by=["p"], order_by=["o"],
+                  frame=WindowFrame.rows(p, f))
+    plan = WindowExec([(WindowAgg("min", col("v")).over(spec), "mn"),
+                       (WindowAgg("max", col("v")).over(spec), "mx")],
+                      scan(data, split=16))
+    got = sorted(plan.collect(), key=lambda r: r[1])
+    by_part = {}
+    for part, o, v in zip(parts, data["o"], vals):
+        by_part.setdefault(part, []).append((o, v))
+    exp_mn, exp_mx = {}, {}
+    for part, items in by_part.items():
+        items.sort()
+        vs = [v for _, v in items]
+        mns = _minmax_oracle(vs, p, f, False)
+        mxs = _minmax_oracle(vs, p, f, True)
+        for (o, _), mn, mx in zip(items, mns, mxs):
+            exp_mn[o], exp_mx[o] = mn, mx
+    for part, o, v, mn, mx in got:
+        assert mn == exp_mn[o], (o, mn, exp_mn[o])
+        assert mx == exp_mx[o], (o, mx, exp_mx[o])
+
+
+def test_bounded_min_max_empty_frame():
+    """Frame entirely outside (2 PRECEDING .. 1 PRECEDING at row 0)."""
+    data = {"p": ["x"] * 4, "o": [1, 2, 3, 4], "v": [7, 3, 9, 1]}
+    spec = window(partition_by=["p"], order_by=["o"],
+                  frame=WindowFrame.rows(2, -1))
+    plan = WindowExec([(WindowAgg("min", col("v")).over(spec), "mn")],
+                      scan(data))
+    assert [r[3] for r in plan.collect()] == [None, 7, 3, 3]
