@@ -40,12 +40,12 @@ def max(x):  # noqa: A001
     return Max(_e(x))
 
 
-def first(x):
-    return First(_e(x))
+def first(x, ignore_nulls=False):
+    return First(_e(x), ignore_nulls=ignore_nulls)
 
 
-def last(x):
-    return Last(_e(x))
+def last(x, ignore_nulls=False):
+    return Last(_e(x), ignore_nulls=ignore_nulls)
 
 
 def stddev(x):

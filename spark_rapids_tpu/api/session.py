@@ -75,16 +75,27 @@ class TpuSession:
         return self._df(L.LogicalScan(ParquetSource(path, self.conf)))
 
     def read_csv(self, path, schema: Optional[Schema] = None,
-                 header: bool = True) -> "DataFrame":
+                 header: bool = True, **options) -> "DataFrame":
         from ..io.csv import CsvSource
         return self._df(L.LogicalScan(CsvSource(path, self.conf,
                                                 schema=schema,
-                                                header=header)))
+                                                header=header, **options)))
 
-    def read_json(self, path, schema: Optional[Schema] = None) -> "DataFrame":
+    def read_json(self, path, schema: Optional[Schema] = None,
+                  **options) -> "DataFrame":
         from ..io.json import JsonSource
         return self._df(L.LogicalScan(JsonSource(path, self.conf,
-                                                 schema=schema)))
+                                                 schema=schema, **options)))
+
+    def read_orc(self, path, columns=None) -> "DataFrame":
+        from ..io.orc import OrcSource
+        return self._df(L.LogicalScan(OrcSource(path, self.conf,
+                                                columns=columns)))
+
+    def read_avro(self, path, **options) -> "DataFrame":
+        from ..io.orc import AvroSource
+        return self._df(L.LogicalScan(AvroSource(path, self.conf,
+                                                 **options)))
 
     def _df(self, plan: L.LogicalPlan) -> "DataFrame":
         return DataFrame(plan, self)
@@ -269,6 +280,18 @@ class DataFrame:
     def write_parquet(self, path, partition_by: Optional[Sequence[str]] = None):
         from ..io.parquet import write_parquet
         write_parquet(self, path, partition_by=partition_by)
+
+    def write_csv(self, path, header: bool = True, delimiter: str = ","):
+        from ..io.csv import write_csv
+        write_csv(self, path, header=header, delimiter=delimiter)
+
+    def write_json(self, path):
+        from ..io.json import write_json
+        write_json(self, path)
+
+    def write_orc(self, path):
+        from ..io.orc import write_orc
+        write_orc(self, path)
 
     def _with(self, plan: L.LogicalPlan) -> "DataFrame":
         return DataFrame(plan, self.session)

@@ -182,6 +182,17 @@ MULTITHREADED_READ_NUM_THREADS = conf_int(
     "Threads for the cloud multi-file readers (reference "
     "GpuMultiFileReader.scala:345).")
 
+PROFILE_ENABLED = conf_bool(
+    "spark.rapids.tpu.profile.enabled", False,
+    "Capture jax profiler traces (xprof/TensorBoard) around driven "
+    "queries; operator names appear as trace annotations over their XLA "
+    "ops (reference spark.rapids.profile.* NVTX integration).")
+
+PROFILE_DIR = conf_str(
+    "spark.rapids.tpu.profile.dir", "",
+    "Output directory for captured profiler traces; empty = "
+    "/tmp/spark_rapids_tpu_trace.")
+
 METRICS_LEVEL = conf_str(
     "spark.rapids.sql.metrics.level", "MODERATE",
     "ESSENTIAL | MODERATE | DEBUG (reference GpuExec.scala:36-47).")
@@ -209,12 +220,6 @@ TEST_RETRY_OOM_INJECTION_MODE = conf_str(
     "TpuSplitAndRetryOOM on the Nth guarded device call of each task "
     "(reference RmmSpark fault injection, RmmSparkRetrySuiteBase).",
     internal=True)
-
-CPU_FALLBACK_ENABLED = conf_bool(
-    "spark.rapids.sql.cpuFallback.enabled", True,
-    "Allow per-operator fallback to the host (arrow/numpy) engine when an "
-    "operator or type is not supported on TPU (reference semantics: "
-    "untagged operators stay on Spark's CPU path).")
 
 DECIMAL_ENABLED = conf_bool(
     "spark.rapids.sql.decimalType.enabled", True,
@@ -258,9 +263,21 @@ class RapidsConf:
                          "spark.rapids.sql.input.",
                          "spark.rapids.sql.format.")
 
+    #: retired keys accepted (ignored with a warning) for compatibility
+    _DEPRECATED = {
+        "spark.rapids.sql.cpuFallback.enabled":
+            "standalone engine has no host engine to fall back to",
+    }
+
     def __init__(self, settings: Optional[Dict[str, Any]] = None):
         self._settings = dict(settings or {})
-        for k in self._settings:
+        for k in list(self._settings):
+            if k in self._DEPRECATED:
+                import warnings
+                warnings.warn(f"config {k!r} is deprecated and ignored: "
+                              f"{self._DEPRECATED[k]}")
+                del self._settings[k]
+                continue
             if (k.startswith("spark.rapids.") and k not in _REGISTRY
                     and not k.startswith(self._DYNAMIC_PREFIXES)):
                 raise KeyError(f"unknown config {k!r}; see docs/configs.md")

@@ -120,10 +120,20 @@ class TpuExec:
     # -- public ------------------------------------------------------------
     def execute(self) -> Iterator[ColumnarBatch]:
         """Final wrapper (reference GpuExec.doExecuteColumnar:365): counts
-        output rows/batches around the operator's own iterator."""
+        output rows/batches around the operator's own iterator, with an
+        xprof trace annotation per batch step (the reference's NVTX
+        range; shows operator names over their XLA ops in timelines)."""
+        from ..utils.tracing import annotate_op
         rows = self.metrics[NUM_OUTPUT_ROWS]
         batches = self.metrics[NUM_OUTPUT_BATCHES]
-        for batch in self.internal_execute():
+        name = type(self).__name__
+        it = self.internal_execute()
+        while True:
+            with annotate_op(name):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
             batches.add(1)
             if batch._host_rows is not None:
                 rows.add(batch._host_rows)

@@ -37,7 +37,8 @@ class GenerateExec(TpuExec):
         assert isinstance(arr_t, ArrayType), \
             f"explode needs an ARRAY input, got {arr_t}"
         self._elem_type = arr_t.element_type
-        self._jit = jax.jit(self._kernel)
+        self._jit = jax.jit(self._kernel, static_argnums=(1,))
+        self._jit_measure = jax.jit(self._measure_kernel)
 
     @property
     def output_schema(self) -> Schema:
@@ -51,7 +52,62 @@ class GenerateExec(TpuExec):
     def additional_metrics(self):
         return (NUM_INPUT_BATCHES,)
 
-    def _kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
+    def _measure_kernel(self, batch: ColumnarBatch):
+        """Exact output payload need per variable-size payload column
+        (explode DUPLICATES each row once per array element — the input's
+        static byte bucket overflows silently otherwise, same hazard the
+        joins measure away). One host sync per batch."""
+        from ..columnar.column import StringColumn
+        from ..ops.collection import array_lengths
+        from ..ops.strings import string_lengths
+        arr = self._bound.columnar_eval(batch)
+        lens = array_lengths(arr).astype(jnp.int64)
+        act = active_mask(batch.num_rows, batch.capacity)
+        copies = jnp.where(act & arr.validity, lens, 0)
+        if self.outer:
+            empty = act & ((lens == 0) | ~arr.validity)
+            copies = copies + jnp.where(empty, 1, 0)
+        needs = []
+        for c in batch.columns:
+            if isinstance(c, StringColumn):
+                sl = jnp.where(act, string_lengths(c), 0).astype(jnp.int64)
+                needs.append(jnp.sum(copies * sl))
+            elif isinstance(c, ArrayColumn):
+                al = jnp.where(act, array_lengths(c), 0).astype(jnp.int64)
+                needs.append(jnp.sum(copies * al))
+                if isinstance(c.child, StringColumn):
+                    # per-row child BYTE span for nested sizing
+                    row_bytes = (c.child.offsets[c.offsets[1:]]
+                                 - c.child.offsets[c.offsets[:-1]]
+                                 ).astype(jnp.int64)
+                    needs.append(jnp.sum(
+                        copies * jnp.where(act, row_bytes, 0)))
+        return tuple(needs)
+
+    def _payload_caps(self, batch: ColumnarBatch) -> tuple:
+        from ..columnar.column import StringColumn
+        if not any(isinstance(c, (StringColumn, ArrayColumn))
+                   for c in batch.columns):
+            return (None,) * len(batch.columns)
+        needs = iter(int(n) for n in jax.device_get(
+            self._jit_measure(batch)))
+        caps = []
+        for c in batch.columns:
+            if isinstance(c, StringColumn):
+                caps.append(bucket_capacity(max(next(needs), 8)))
+            elif isinstance(c, ArrayColumn):
+                elems = bucket_capacity(max(next(needs), 8))
+                if isinstance(c.child, StringColumn):
+                    caps.append((elems,
+                                 bucket_capacity(max(next(needs), 8))))
+                else:
+                    caps.append(elems)
+            else:
+                caps.append(None)
+        return tuple(caps)
+
+    def _kernel(self, batch: ColumnarBatch, payload_caps: tuple = ()
+                ) -> ColumnarBatch:
         arr = self._bound.columnar_eval(batch)
         assert isinstance(arr, ArrayColumn)
         cap = batch.capacity
@@ -93,7 +149,9 @@ class GenerateExec(TpuExec):
         src_row = jnp.where(is_elem, src_row_of_elem, outer_row)
         act_out = active_mask(n_out, out_cap)
         src_row = jnp.where(act_out, src_row, -1)
-        cols = [gather_column(c, src_row) for c in batch.columns]
+        caps = payload_caps or (None,) * len(batch.columns)
+        cols = [gather_column(c, src_row, out_byte_capacity=bc)
+                for c, bc in zip(batch.columns, caps)]
         if self.position:
             pos_valid = is_elem & act_out
             cols.append(Column(jnp.where(pos_valid, intra, 0),
@@ -110,7 +168,7 @@ class GenerateExec(TpuExec):
         for batch in self.child.execute():
             in_batches.add(1)
             with op_time.ns_timer():
-                yield self._jit(batch)
+                yield self._jit(batch, self._payload_caps(batch))
 
     def node_description(self):
         kind = "PosExplode" if self.position else "Explode"

@@ -171,9 +171,10 @@ class SortExec(TpuExec):
         streams directly to the consumer."""
         fan = self.MERGE_FAN_IN
         live: List[List[SpillableBatch]] = run_lists
+        nxt: List[List[SpillableBatch]] = []
         try:
             while len(live) > fan:
-                nxt: List[List[SpillableBatch]] = []
+                nxt = []
                 for g in range(0, len(live), fan):
                     group = live[g:g + fan]
                     if len(group) == 1:
@@ -182,7 +183,7 @@ class SortExec(TpuExec):
                     merged = [SpillableBatch.from_batch(b)
                               for b in self._stream_merge(group)]
                     nxt.append(merged)
-                live = nxt
+                live, nxt = nxt, []
             if len(live) == 1:
                 for s in list(live[0]):
                     b = s.get_batch()
@@ -193,8 +194,10 @@ class SortExec(TpuExec):
                 return
             yield from self._stream_merge(live)
         finally:
-            # error or early consumer abandonment: close whatever is left
-            for r in live:
+            # error or early consumer abandonment: close everything left —
+            # the current pass's inputs AND any merged runs already
+            # produced into the next pass
+            for r in live + nxt:
                 for s in r:
                     s.close()
 

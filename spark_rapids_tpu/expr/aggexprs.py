@@ -144,14 +144,23 @@ class Max(Min):
 
 
 class First(AggregateFunction):
-    """first_value(expr) with ignoreNulls (deterministic only after sort)."""
+    """first(expr[, ignoreNulls]) — Spark defaults ignoreNulls=False (the
+    first row's value even when null); deterministic only after sort."""
     name = "first"
+    _OPS = ("first", "first_any")
+
+    def __init__(self, *inputs, ignore_nulls: bool = False):
+        super().__init__(*inputs)
+        self.ignore_nulls = ignore_nulls
+
+    def _op(self):
+        return self._OPS[0] if self.ignore_nulls else self._OPS[1]
 
     def update_ops(self):
-        return [("first", 0)]
+        return [(self._op(), 0)]
 
     def merge_ops(self):
-        return ["first"]
+        return [self._op()]
 
     def buffer_types(self, input_types):
         return [input_types[0]]
@@ -165,12 +174,7 @@ class First(AggregateFunction):
 
 class Last(First):
     name = "last"
-
-    def update_ops(self):
-        return [("last", 0)]
-
-    def merge_ops(self):
-        return ["last"]
+    _OPS = ("last", "last_any")
 
 
 class Average(AggregateFunction):

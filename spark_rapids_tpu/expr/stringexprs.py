@@ -423,7 +423,11 @@ class RLike(Expression):
     @property
     def program(self):
         if self._program is None:
-            from ..regex import compile_regex
+            from ..regex import RegexUnsupported, compile_regex
+            if not isinstance(self.pattern, str):
+                raise RegexUnsupported(
+                    "only literal regex patterns are supported "
+                    f"(got {type(self.pattern).__name__})")
             self._program = compile_regex(self.pattern)
         return self._program
 
@@ -457,7 +461,11 @@ class Like(Expression):
     @property
     def program(self):
         if self._program is None:
-            from ..regex import like_to_program
+            from ..regex import RegexUnsupported, like_to_program
+            if not isinstance(self.pattern, str):
+                raise RegexUnsupported(
+                    "only literal LIKE patterns are supported "
+                    f"(got {type(self.pattern).__name__})")
             self._program = like_to_program(self.pattern, self.escape_char)
         return self._program
 

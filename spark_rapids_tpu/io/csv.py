@@ -1,6 +1,13 @@
-"""CSV scan (reference GpuCSVScan.scala / GpuTextBasedPartitionReader.scala:
-host line framing + device parse; here pyarrow's C++ CSV reader does the
-framing+parse on the prefetch pool, producing device columns)."""
+"""CSV scan + write (reference GpuCSVScan.scala /
+GpuTextBasedPartitionReader.scala: host line framing + device parse; here
+pyarrow's C++ CSV reader does the framing+parse on the prefetch pool,
+producing device columns).
+
+Spark option coverage: header, sep/delimiter, quote, escape, comment
+(raw-line prefilter, exact Spark semantics), nullValue, mode
+(PERMISSIVE/DROPMALFORMED = skip unparseable rows, FAILFAST = raise;
+there is no columnNameOfCorruptRecord sink yet, so PERMISSIVE behaves as
+DROPMALFORMED with a skipped-row counter)."""
 
 from __future__ import annotations
 
@@ -16,16 +23,30 @@ from .parquet import DEFAULT_BATCH_ROWS, DEFAULT_NUM_THREADS
 class CsvSource:
     def __init__(self, path, conf: Optional[RapidsConf] = None,
                  schema: Optional[Schema] = None, header: bool = True,
-                 delimiter: str = ",",
+                 delimiter: str = ",", quote: str = '"',
+                 escape: Optional[str] = None, comment: Optional[str] = None,
+                 null_value: str = "",
+                 mode: str = "PERMISSIVE",
                  num_threads: int = DEFAULT_NUM_THREADS,
                  batch_rows: int = DEFAULT_BATCH_ROWS):
         self.paths = expand_paths(path)
         assert self.paths, f"no csv files at {path!r}"
         self.header = header
         self.delimiter = delimiter
+        self.quote = quote
+        self.escape = escape
+        self.comment = comment
+        self.null_value = null_value
+        self.mode = mode.upper()
+        assert self.mode in ("PERMISSIVE", "DROPMALFORMED", "FAILFAST"), mode
         self.num_threads = num_threads
         self.batch_rows = batch_rows
         self._user_schema = schema
+        #: rows skipped by PERMISSIVE/DROPMALFORMED in the last drive
+        #: (incremented from prefetch threads — guarded by a lock)
+        self.malformed_rows = 0
+        import threading
+        self._count_lock = threading.Lock()
         if schema is not None:
             self.schema = schema
         else:
@@ -40,21 +61,66 @@ class CsvSource:
             autogenerate_column_names=not self.header,
             column_names=None if self.header else
             (list(self._user_schema.names) if self._user_schema else None))
-        parse_opts = pacsv.ParseOptions(delimiter=self.delimiter)
-        # Spark CSV semantics: empty field -> null (also for strings)
-        convert = pacsv.ConvertOptions(strings_can_be_null=True)
+
+        def on_invalid(row):
+            with self._count_lock:
+                self.malformed_rows += 1
+            return "skip"
+
+        parse_opts = pacsv.ParseOptions(
+            delimiter=self.delimiter,
+            quote_char=self.quote if self.quote else False,
+            escape_char=self.escape if self.escape else False,
+            invalid_row_handler=(on_invalid
+                                 if self.mode != "FAILFAST" else None))
+        null_values = [self.null_value] if self.null_value != "" \
+            else ["", "null", "NULL"]
+        kw = dict(
+            strings_can_be_null=True,  # Spark: empty field -> null
+            null_values=null_values,
+            true_values=["true", "True", "TRUE"],
+            false_values=["false", "False", "FALSE"],
+        )
         if self._user_schema is not None:
-            convert = pacsv.ConvertOptions(
-                strings_can_be_null=True,
-                column_types={f.name: to_arrow(f.data_type)
-                              for f in self._user_schema.fields})
-        return pacsv.read_csv(path, read_options=read_opts,
+            kw["column_types"] = {f.name: to_arrow(f.data_type)
+                                  for f in self._user_schema.fields}
+        convert = pacsv.ConvertOptions(**kw)
+        src = path
+        if self.comment:
+            # pyarrow has no comment-char support; Spark treats only RAW
+            # lines starting with the char as comments (a quoted first
+            # field like "#tag" is data) — prefilter the raw bytes
+            import io
+            comment_b = self.comment.encode()
+            with open(path, "rb") as f:
+                kept = [ln for ln in f
+                        if not ln.lstrip().startswith(comment_b)]
+            src = io.BytesIO(b"".join(kept))
+        return pacsv.read_csv(src, read_options=read_opts,
                               parse_options=parse_opts,
                               convert_options=convert)
 
+    def estimated_size_bytes(self) -> int:
+        import os
+        return sum(os.path.getsize(p) for p in self.paths)
+
     def batches(self) -> Iterator[ColumnarBatch]:
+        self.malformed_rows = 0
         tasks = [lambda p=p: self._read_one(p) for p in self.paths]
         for table in threaded_chunks(tasks, self.num_threads):
             if self._user_schema is not None:
                 table = table.select(list(self._user_schema.names))
             yield from arrow_to_batches(table, self.batch_rows)
+
+
+def write_csv(df, path, header: bool = True, delimiter: str = ","):
+    """DataFrame -> CSV file (reference GpuCSVFileFormat writer path)."""
+    import os
+
+    import pyarrow.csv as pacsv
+
+    table = df.to_arrow()
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    pacsv.write_csv(table, path, write_options=pacsv.WriteOptions(
+        include_header=header, delimiter=delimiter))

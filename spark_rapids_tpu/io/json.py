@@ -1,6 +1,11 @@
-"""JSON-lines scan (reference GpuJsonReadCommon.scala / JSON scan in L3:
-host line framing + device parse via JSONUtils JNI; here pyarrow's C++
-JSON reader on the prefetch pool)."""
+"""JSON-lines scan + write (reference GpuJsonReadCommon.scala / JSON scan
+in L3: host line framing + device parse via JSONUtils JNI; here pyarrow's
+C++ JSON reader on the prefetch pool).
+
+mode: PERMISSIVE (default, Spark) drops lines pyarrow cannot parse by
+re-framing the file line-by-line on the host and parsing only well-formed
+records (counted in `malformed_rows`); FAILFAST surfaces the parse
+error."""
 
 from __future__ import annotations
 
@@ -16,13 +21,21 @@ from .parquet import DEFAULT_BATCH_ROWS, DEFAULT_NUM_THREADS
 class JsonSource:
     def __init__(self, path, conf: Optional[RapidsConf] = None,
                  schema: Optional[Schema] = None,
+                 mode: str = "PERMISSIVE",
                  num_threads: int = DEFAULT_NUM_THREADS,
                  batch_rows: int = DEFAULT_BATCH_ROWS):
         self.paths = expand_paths(path)
         assert self.paths, f"no json files at {path!r}"
+        self.mode = mode.upper()
+        assert self.mode in ("PERMISSIVE", "DROPMALFORMED", "FAILFAST"), mode
         self.num_threads = num_threads
         self.batch_rows = batch_rows
         self._user_schema = schema
+        #: lines dropped by PERMISSIVE mode in the last batches() drive
+        #: (incremented from prefetch threads — guarded by a lock)
+        self.malformed_rows = 0
+        import threading
+        self._count_lock = threading.Lock()
         if schema is not None:
             self.schema = schema
         else:
@@ -31,19 +44,82 @@ class JsonSource:
                 StructField(f.name, from_arrow(f.type), f.nullable)
                 for f in table.schema))
 
-    def _read_one(self, path):
+    def _parse_options(self):
         import pyarrow.json as pajson
-        parse = None
         if self._user_schema is not None:
             import pyarrow as pa
-            parse = pajson.ParseOptions(explicit_schema=pa.schema(
+            return pajson.ParseOptions(explicit_schema=pa.schema(
                 [(f.name, to_arrow(f.data_type))
                  for f in self._user_schema.fields]))
-        return pajson.read_json(path, parse_options=parse)
+        return None
+
+    def _read_one(self, path):
+        import pyarrow.json as pajson
+        try:
+            return pajson.read_json(path,
+                                    parse_options=self._parse_options())
+        except Exception:
+            if self.mode == "FAILFAST":
+                raise
+            return self._read_permissive(path)
+
+    def _read_permissive(self, path):
+        """Line-framed recovery: parse each line independently, drop the
+        malformed ones (Spark PERMISSIVE without a corrupt-record sink)."""
+        import io
+        import json as pyjson
+
+        import pyarrow as pa
+        import pyarrow.json as pajson
+
+        good = []
+        with open(path, "rb") as f:
+            for line in f:
+                s = line.strip()
+                if not s:
+                    continue
+                try:
+                    pyjson.loads(s)
+                    good.append(s)
+                except ValueError:
+                    with self._count_lock:
+                        self.malformed_rows += 1
+        if not good:
+            # every line malformed: zero rows (needs an explicit schema —
+            # there is nothing left to infer from)
+            if self._user_schema is None:
+                raise ValueError(
+                    f"{path}: no parseable JSON lines and no explicit "
+                    "schema to shape an empty result")
+            return pa.table({f.name: pa.array([], to_arrow(f.data_type))
+                             for f in self._user_schema.fields})
+        buf = io.BytesIO(b"\n".join(good))
+        return pajson.read_json(buf, parse_options=self._parse_options())
+
+    def estimated_size_bytes(self) -> int:
+        import os
+        return sum(os.path.getsize(p) for p in self.paths)
 
     def batches(self) -> Iterator[ColumnarBatch]:
+        self.malformed_rows = 0
         tasks = [lambda p=p: self._read_one(p) for p in self.paths]
         for table in threaded_chunks(tasks, self.num_threads):
             if self._user_schema is not None:
                 table = table.select(list(self._user_schema.names))
             yield from arrow_to_batches(table, self.batch_rows)
+
+
+def write_json(df, path):
+    """DataFrame -> JSON-lines file (Spark df.write.json)."""
+    import json as pyjson
+    import os
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    d = df.to_pydict()
+    names = list(d.keys())
+    n = len(d[names[0]]) if names else 0
+    with open(path, "w") as f:
+        for i in range(n):
+            row = {k: d[k][i] for k in names if d[k][i] is not None}
+            f.write(pyjson.dumps(row) + "\n")

@@ -1,0 +1,88 @@
+"""ORC scan + write (reference GpuOrcScan.scala / GpuOrcFileFormat:
+footer-driven stripe slicing + device decode; here pyarrow's C++ ORC
+reader decodes stripes on the prefetch pool, uploaded as device columns).
+
+Stripe-per-task granularity mirrors the parquet row-group reader; column
+pruning via `columns`."""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional, Sequence
+
+from ..columnar.batch import ColumnarBatch
+from ..config import RapidsConf
+from ..types import Schema, StructField, from_arrow
+from .multifile import arrow_to_batches, expand_paths, threaded_chunks
+from .parquet import DEFAULT_BATCH_ROWS, DEFAULT_NUM_THREADS
+
+
+class OrcSource:
+    def __init__(self, path, conf: Optional[RapidsConf] = None,
+                 columns: Optional[Sequence[str]] = None,
+                 num_threads: int = DEFAULT_NUM_THREADS,
+                 batch_rows: int = DEFAULT_BATCH_ROWS):
+        import pyarrow.orc as paorc
+        self.paths = expand_paths(path)
+        assert self.paths, f"no orc files at {path!r}"
+        self.columns = list(columns) if columns is not None else None
+        self.num_threads = num_threads
+        self.batch_rows = batch_rows
+        f = paorc.ORCFile(self.paths[0])
+        arrow_schema = f.schema
+        fields = []
+        for name in (self.columns or arrow_schema.names):
+            fld = arrow_schema.field(name)
+            fields.append(StructField(fld.name, from_arrow(fld.type),
+                                      fld.nullable))
+        self.schema = Schema(tuple(fields))
+
+    def estimated_size_bytes(self) -> int:
+        return sum(os.path.getsize(p) for p in self.paths)
+
+    def batches(self) -> Iterator[ColumnarBatch]:
+        import pyarrow.orc as paorc
+
+        tasks = []
+        for p in self.paths:
+            f = paorc.ORCFile(p)
+            n = f.nstripes
+            for s in range(n):
+                def decode(p=p, s=s):
+                    return paorc.ORCFile(p).read_stripe(
+                        s, columns=self.columns)
+                tasks.append(decode)
+            if n == 0:
+                tasks.append(lambda p=p: paorc.ORCFile(p).read(
+                    columns=self.columns))
+        for item in threaded_chunks(tasks, self.num_threads):
+            import pyarrow as pa
+            table = pa.Table.from_batches([item]) \
+                if isinstance(item, pa.RecordBatch) else item
+            yield from arrow_to_batches(table, self.batch_rows)
+
+
+def write_orc(df, path):
+    """DataFrame -> ORC file (reference GpuOrcFileFormat writer)."""
+    import pyarrow.orc as paorc
+
+    table = df.to_arrow()
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    paorc.write_table(table, path)
+
+
+class AvroSource:
+    """Avro scan (reference GpuAvroScan.scala). The host decoder requires
+    the `fastavro` package, which this environment does not ship — the
+    source raises a clear error at construction until one is available
+    (same gating the reference applies to its optional formats)."""
+
+    def __init__(self, path, conf: Optional[RapidsConf] = None, **kw):
+        try:
+            import fastavro  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "Avro scan needs the optional 'fastavro' host decoder; "
+                "it is not installed in this environment") from e
+        raise NotImplementedError("fastavro decode path pending")

@@ -110,12 +110,12 @@ class ParquetSource:
                      ) -> "ParquetSource":
         """Planner pushdown hook: a copy of this source that prunes row
         groups with the given conjuncts (the Filter stays above the scan
-        for exactness — stats only prove absence, never presence)."""
-        out = ParquetSource(self.paths, self._conf, self.columns,
-                            self.num_threads, self.batch_rows,
-                            list(self.filters) + list(filters),
-                            self.reader_type)
-        out.scan_stats = self.scan_stats
+        for exactness — stats only prove absence, never presence). A
+        shallow copy: the schema/path work from __init__ (footer read) is
+        NOT repeated."""
+        out = ParquetSource.__new__(ParquetSource)
+        out.__dict__.update(self.__dict__)
+        out.filters = list(self.filters) + list(filters)
         return out
 
     def estimated_size_bytes(self) -> int:

@@ -31,9 +31,11 @@ from .sort import (
     SortOrder, group_segment_ids, sort_permutation, string_words_for,
 )
 
-#: aggregate op names understood by the kernel
+#: aggregate op names understood by the kernel. first/last skip nulls
+#: (ignoreNulls=True); first_any/last_any take the first/last row
+#: regardless of null (Spark's default ignoreNulls=False).
 AGG_OPS = ("sum", "count", "count_star", "min", "max", "first", "last",
-           "any_value", "sum_sq")
+           "first_any", "last_any", "any_value", "sum_sq")
 
 
 @dataclass(frozen=True)
@@ -82,9 +84,8 @@ def _segment_reduce(op: str, values, validity, seg, capacity: int, positions):
         r = fn(v, seg, num_segments=num_segments)
         return r, has_any
     if op in ("first", "last", "any_value"):
-        # first/any_value: value at the smallest position with a valid row;
-        # last: largest. (Spark first/last default ignoreNulls=False: first
-        # row regardless of null — model that with validity=active.)
+        # ignoreNulls=True: value at the smallest (first) / largest (last)
+        # position holding a VALID row
         big = jnp.int32(capacity)
         if op == "last":
             p = jnp.where(validity, positions, -1)
@@ -95,6 +96,18 @@ def _segment_reduce(op: str, values, validity, seg, capacity: int, positions):
         ok = (pick >= 0) & (pick < capacity)
         safe = jnp.clip(pick, 0, capacity - 1)
         return values[safe], ok & has_any
+    if op in ("first_any", "last_any"):
+        # ignoreNulls=False (Spark default): first/last row regardless of
+        # null; the result is null when that row's value is null
+        if op == "last_any":
+            pick = jax.ops.segment_max(positions, seg,
+                                       num_segments=num_segments)
+        else:
+            pick = jax.ops.segment_min(positions, seg,
+                                       num_segments=num_segments)
+        ok = (pick >= 0) & (pick < capacity)
+        safe = jnp.clip(pick, 0, capacity - 1)
+        return values[safe], ok & validity[safe]
     raise AssertionError(op)
 
 
@@ -127,7 +140,8 @@ def groupby_aggregate(key_columns: Sequence[Column],
         else:
             g = gather_column(col, perm)
             if isinstance(g, StringColumn):
-                if op in ("min", "max", "first", "last", "any_value"):
+                if op in ("min", "max", "first", "last", "first_any",
+                          "last_any", "any_value"):
                     # order strings via their sort lanes; pick the row index
                     # then gather the string (exact given string_words).
                     from .sort import string_prefix_lanes
@@ -165,6 +179,10 @@ def _pick_string_pos(op, lanes, valid, seg, capacity, positions):
     if op == "last":
         p = jnp.where(valid, positions, -1)
         return jax.ops.segment_max(p, seg, num_segments=capacity)
+    if op == "first_any":  # ignoreNulls=False: position regardless of null
+        return jax.ops.segment_min(positions, seg, num_segments=capacity)
+    if op == "last_any":
+        return jax.ops.segment_max(positions, seg, num_segments=capacity)
     # min/max over lexicographic lanes: sort rows by (seg, lanes) and take
     # the first/last row of each segment — reuse lax.sort for exactness.
     key_lanes = [seg.astype(jnp.uint32)]
@@ -233,11 +251,18 @@ def _aggregate_with_assignment(key_columns, agg_inputs, num_rows,
                                           capacity, positions)
         else:
             if isinstance(col, StringColumn):
-                if op in ("first", "last", "any_value"):
+                if op in ("first", "last", "first_any", "last_any",
+                          "any_value"):
                     valid = col.validity
                     if op == "last":
                         p = jnp.where(valid, positions, -1)
                         pick = jax.ops.segment_max(p, seg,
+                                                   num_segments=capacity)
+                    elif op == "last_any":
+                        pick = jax.ops.segment_max(positions, seg,
+                                                   num_segments=capacity)
+                    elif op == "first_any":
+                        pick = jax.ops.segment_min(positions, seg,
                                                    num_segments=capacity)
                     else:
                         p = jnp.where(valid, positions, capacity)
@@ -245,7 +270,10 @@ def _aggregate_with_assignment(key_columns, agg_inputs, num_rows,
                                                    num_segments=capacity)
                     ok = (pick >= 0) & (pick < capacity)
                     safe = jnp.clip(pick, 0, capacity - 1)
-                    out = gather_column(col, safe, out_valid=ok & group_act)
+                    out_valid = ok & group_act
+                    if op in ("first_any", "last_any"):
+                        out_valid = out_valid & valid[safe]
+                    out = gather_column(col, safe, out_valid=out_valid)
                     results.append(("col", out))
                     continue
                 raise NotImplementedError(

@@ -30,17 +30,22 @@ def gather_array(col: ArrayColumn, safe_indices, out_valid,
     """Row gather (filter/join/sort reordering) for list columns with
     fixed-width or string children.
 
-    out_child_capacity: static element bucket of the result. Defaults to
-    the input's (sufficient for permutations/filters); row-DUPLICATING
-    gathers (join probe sides) must pass the measured element need, like
-    gather_string's out_byte_capacity. Duplicating gathers of
-    string-element arrays additionally need child byte sizing, which is
-    not plumbed yet — guarded by assertion."""
+    out_child_capacity: static element bucket of the result — an int, or
+    an (elements, child_bytes) pair for string-element arrays. Defaults
+    to the input's buckets (sufficient for permutations/filters);
+    row-DUPLICATING gathers (join probe sides, explode payloads) must
+    pass measured needs, like gather_string's out_byte_capacity. A
+    duplicating gather of a string-element array WITHOUT a byte
+    measurement is guarded by assertion."""
     from .strings import _rebuild_offsets
     in_child_cap = col.child_capacity
-    child_cap = out_child_capacity or in_child_cap
-    assert child_cap <= in_child_cap or not isinstance(
-        col.child, StringColumn), \
+    child_byte_cap = None
+    if isinstance(out_child_capacity, tuple):
+        child_cap, child_byte_cap = out_child_capacity
+    else:
+        child_cap = out_child_capacity or in_child_cap
+    assert child_byte_cap is not None or child_cap <= in_child_cap \
+        or not isinstance(col.child, StringColumn), \
         "duplicating gather of array<string> needs child byte measurement"
     lens = array_lengths(col)[safe_indices]
     lens = jnp.where(out_valid, lens, 0)
@@ -54,7 +59,8 @@ def gather_array(col: ArrayColumn, safe_indices, out_valid,
     src = jnp.where(in_use, jnp.clip(src_starts[row] + intra, 0,
                                      in_child_cap - 1), 0)
     from .basic import gather_column
-    child = gather_column(col.child, jnp.where(in_use, src, -1))
+    child = gather_column(col.child, jnp.where(in_use, src, -1),
+                          out_byte_capacity=child_byte_cap)
     return ArrayColumn(child, new_offsets, out_valid, col.dtype)
 
 

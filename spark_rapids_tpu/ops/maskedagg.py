@@ -208,6 +208,15 @@ def _slot_reduce(op: str, m, col: Optional[Column], positions,
             pick = jnp.min(jnp.where(v, positions, capacity))
         ok = (pick >= 0) & (pick < capacity)
         return col.data[jnp.clip(pick, 0, capacity - 1)], ok
+    if op in ("first_any", "last_any"):
+        # ignoreNulls=False: pick over ACTIVE rows regardless of null
+        if op == "last_any":
+            pick = jnp.max(jnp.where(m, positions, -1))
+        else:
+            pick = jnp.min(jnp.where(m, positions, capacity))
+        ok = (pick >= 0) & (pick < capacity)
+        safe = jnp.clip(pick, 0, capacity - 1)
+        return col.data[safe], ok & col.validity[safe]
     raise AssertionError(op)
 
 

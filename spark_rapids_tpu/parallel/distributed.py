@@ -131,7 +131,25 @@ def make_distributed_groupby(mesh: Mesh, key_count: int,
                            in_specs=P(axis_name),
                            out_specs=P(axis_name),
                            check_vma=False)
-    return jax.jit(mapped)
+    jitted = jax.jit(mapped)
+
+    def checked(stacked: ColumnarBatch) -> ColumnarBatch:
+        # the fixed-width exchange codec TRUNCATES beyond string_width;
+        # enforce the contract here instead of relying on callers to
+        # remember required_string_width (review finding r1). One host
+        # sync per step call, outside the compiled program.
+        for c in stacked.columns:
+            if isinstance(c, StringColumn) and c.offsets.shape[-1] > 1:
+                lengths = c.offsets[:, 1:] - c.offsets[:, :-1]
+                max_len = int(jnp.max(lengths))
+                if max_len > string_width:
+                    raise ValueError(
+                        f"string key of {max_len} bytes exceeds the "
+                        f"exchange width {string_width}; size it with "
+                        "required_string_width(batches)")
+        return jitted(stacked)
+
+    return checked
 
 
 def unstack_batches(stacked: ColumnarBatch, n: int) -> List[ColumnarBatch]:

@@ -89,6 +89,20 @@ class ExprMeta(BaseMeta):
         if str(self.conf._settings.get(key, "true")).lower() == "false":
             self.will_not_work_on_tpu(
                 f"expression {self.rule.name} disabled by {key}")
+        # decimal gating (reference decimalType.enabled)
+        from ..config import DECIMAL_ENABLED
+        from ..types import DecimalType
+        if not self.conf.get(DECIMAL_ENABLED):
+            # children tag themselves in the recursion above; only this
+            # node's own output type needs checking here
+            try:
+                is_dec = isinstance(self.expr.data_type, DecimalType)
+            except TypeError:
+                is_dec = False
+            if is_dec:
+                self.will_not_work_on_tpu(
+                    "decimal disabled by "
+                    "spark.rapids.sql.decimalType.enabled")
         # type checks: children output types against the input signature
         for c in self.children:
             try:

@@ -1,0 +1,1 @@
+from .tracing import annotate_op, profile_trace  # noqa: F401

@@ -1,0 +1,45 @@
+"""Tracing/profiling — the engine's xprof surface (reference analog:
+the NVTX ranges + profiler integration in GpuExec/RapidsConf
+spark.rapids.profile.*; SURVEY §5).
+
+Two layers:
+  * `annotate_op(name)` — a jax.profiler.TraceAnnotation around each
+    operator's per-batch device work, so xprof timelines show
+    engine-level operator names (ProjectExec, AggregateExec, ...) over
+    the XLA ops they launched — the TPU equivalent of the reference's
+    NVTX ranges in Nsight.
+  * `profile_trace(out_dir)` — capture a full profiler trace of a code
+    region to `out_dir` for TensorBoard/xprof, gated by
+    spark.rapids.tpu.profile.enabled + .dir so production configs can
+    switch it on without code changes (reference profile.* confs).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def annotate_op(name: str) -> Iterator[None]:
+    """Named trace annotation (no-op cost when no trace is active)."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def profile_trace(out_dir: Optional[str] = None) -> Iterator[None]:
+    """Capture a jax profiler trace around the body. With out_dir=None,
+    reads spark.rapids.tpu.profile.{enabled,dir}; a disabled conf makes
+    this a no-op so call sites can wrap unconditionally."""
+    from ..config import PROFILE_DIR, PROFILE_ENABLED, active_conf
+    conf = active_conf()
+    if out_dir is None:
+        if not conf.get(PROFILE_ENABLED):
+            yield
+            return
+        out_dir = conf.get(PROFILE_DIR) or "/tmp/spark_rapids_tpu_trace"
+    import jax
+    with jax.profiler.trace(out_dir):
+        yield

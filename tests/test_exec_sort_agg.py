@@ -191,7 +191,8 @@ def test_partial_final_split():
 def test_first_last_after_sort():
     plan = AggregateExec(
         [col("k")],
-        [(First(col("v")), "f"), (Last(col("v")), "l")],
+        [(First(col("v"), ignore_nulls=True), "f"),
+         (Last(col("v"), ignore_nulls=True), "l")],
         SortExec([(col("k"), True), (col("v"), True)], make_scan()))
     got = {r[0]: r[1:] for r in plan.collect()}
     assert got["a"] == (1, 5)

@@ -86,3 +86,23 @@ def test_explode_of_create_array():
 def test_explode_in_plan_explain(df):
     tree = df.explode("a")._exec().tree_string()
     assert "GenerateExec[Explode" in tree
+
+
+def test_explode_duplicates_string_payload():
+    """Duplicating variable-size payload columns must size output buckets
+    from measured needs (review regression: long strings truncated)."""
+    s = TpuSession()
+    sch = Schema((StructField("s", STRING),
+                  StructField("t", ArrayType(STRING)),
+                  StructField("a", ArrayType(LONG))))
+    big = "x" * 500
+    tags = ["tag_" + "y" * 60, "q"]
+    df = s.from_pydict({"s": [big, "z"], "t": [tags, []],
+                        "a": [[1, 2, 3, 4, 5, 6], [7]]}, sch)
+    out = df.explode("a", alias="e").collect()
+    assert len(out) == 7
+    for s_val, t_val, a_val, e in out:
+        if a_val == [7]:
+            assert (s_val, t_val) == ("z", [])
+        else:
+            assert s_val == big and t_val == tags

@@ -108,3 +108,44 @@ def test_exec_string_minmax_routes_to_sort():
     assert not plan._hash_path_ok
     got = {r[0]: r[1:] for r in plan.collect()}
     assert got == {1: ("a", "b"), 2: ("y", "z")}
+
+
+def test_first_last_ignore_nulls_semantics():
+    """Spark default ignoreNulls=False: first/last return the first/last
+    ROW's value even when null (review finding r1: the kernels silently
+    modeled ignoreNulls=True)."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import LONG, Schema, StructField
+    s = TpuSession()
+    sch = Schema((StructField("k", LONG), StructField("v", LONG)))
+    data = {"k": [1, 1, 1, 2, 2], "v": [None, 10, None, 7, None]}
+    df = s.from_pydict(data, sch)
+
+    def run(**kw):
+        rows = df.group_by("k").agg(
+            (F.first(F.col("v"), **kw), "f"),
+            (F.last(F.col("v"), **kw), "l")).collect()
+        return {k: (f, l) for k, f, l in rows}
+
+    # default: positional first/last regardless of nulls
+    assert run() == {1: (None, None), 2: (7, None)}
+    # ignore_nulls=True: skip nulls
+    assert run(ignore_nulls=True) == {1: (10, 10), 2: (7, 7)}
+
+
+def test_decimal_disabled_conf_tags_off():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.plan.overrides import PlanNotSupported
+    from spark_rapids_tpu.types import DecimalType, Schema, StructField
+    import pytest
+    try:
+        s = TpuSession({"spark.rapids.sql.decimalType.enabled": False})
+        sch = Schema((StructField("x", DecimalType(10, 2)),))
+        df = s.from_pydict({"x": [100]}, sch)
+        with pytest.raises(PlanNotSupported):
+            df.select((col("x") + col("x")).alias("y")).collect()
+    finally:
+        TpuSession()  # reset active conf for the rest of the process

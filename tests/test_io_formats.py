@@ -1,0 +1,93 @@
+"""CSV/JSON Spark-semantics options, ORC scan/write, format writers
+(reference GpuCSVScan / GpuJsonReadCommon / GpuOrcScan + writers)."""
+
+import pytest
+
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.types import (
+    DOUBLE, LONG, STRING, Schema, StructField,
+)
+
+SCH = Schema((StructField("a", LONG), StructField("s", STRING)))
+
+
+def test_csv_options_quote_null_sep(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text('a|s\n1|"x|y"\n2|NA\n3|plain\n')
+    sess = TpuSession()
+    df = sess.read_csv(str(p), schema=SCH, delimiter="|", null_value="NA")
+    assert df.collect() == [(1, "x|y"), (2, None), (3, "plain")]
+
+
+def test_csv_permissive_skips_malformed(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("a,s\n1,x\n2,y,EXTRA,COLS\n3,z\n")
+    sess = TpuSession()
+    df = sess.read_csv(str(p), schema=SCH)
+    src = df._plan.source
+    assert df.collect() == [(1, "x"), (3, "z")]
+    assert src.malformed_rows == 1
+    # FAILFAST surfaces the error
+    with pytest.raises(Exception):
+        sess.read_csv(str(p), schema=SCH, mode="FAILFAST").collect()
+
+
+def test_csv_comment_lines(tmp_path):
+    p = tmp_path / "c.csv"
+    p.write_text("s,a\n#skip me,0\nx,1\n")
+    sch = Schema((StructField("s", STRING), StructField("a", LONG)))
+    sess = TpuSession()
+    df = sess.read_csv(str(p), schema=sch, comment="#")
+    assert df.collect() == [("x", 1)]
+
+
+def test_csv_roundtrip_write(tmp_path):
+    sess = TpuSession()
+    df = sess.from_pydict({"a": [1, 2, None], "s": ["x", None, "z"]}, SCH)
+    p = str(tmp_path / "w.csv")
+    df.write_csv(p)
+    back = sess.read_csv(p, schema=SCH)
+    assert back.collect() == [(1, "x"), (2, None), (None, "z")]
+
+
+def test_json_permissive_drops_bad_lines(tmp_path):
+    p = tmp_path / "t.jsonl"
+    p.write_text('{"a": 1, "s": "x"}\nTHIS IS NOT JSON\n{"a": 2, "s": null}\n')
+    sess = TpuSession()
+    df = sess.read_json(str(p), schema=SCH)
+    src = df._plan.source
+    assert df.collect() == [(1, "x"), (2, None)]
+    assert src.malformed_rows == 1
+    with pytest.raises(Exception):
+        sess.read_json(str(p), schema=SCH, mode="FAILFAST").collect()
+
+
+def test_json_roundtrip_write(tmp_path):
+    sess = TpuSession()
+    df = sess.from_pydict({"a": [1, None], "s": ["x", "y"]}, SCH)
+    p = str(tmp_path / "w.jsonl")
+    df.write_json(p)
+    back = sess.read_json(p, schema=SCH)
+    assert back.collect() == [(1, "x"), (None, "y")]
+
+
+def test_orc_roundtrip(tmp_path):
+    sess = TpuSession()
+    sch = Schema((StructField("a", LONG), StructField("s", STRING),
+                  StructField("d", DOUBLE)))
+    data = {"a": [1, 2, None, 4], "s": ["x", None, "zz", ""],
+            "d": [1.5, -2.0, 0.0, None]}
+    df = sess.from_pydict(data, sch)
+    p = str(tmp_path / "t.orc")
+    df.write_orc(p)
+    back = sess.read_orc(p)
+    assert back.collect() == list(zip(data["a"], data["s"], data["d"]))
+    # column pruning
+    pruned = sess.read_orc(p, columns=["s"])
+    assert pruned.collect() == [(v,) for v in data["s"]]
+
+
+def test_avro_gated():
+    sess = TpuSession()
+    with pytest.raises(ImportError):
+        sess.read_avro("/nonexistent.avro")

@@ -203,7 +203,8 @@ def test_first_last_in_masked_path():
     b = ColumnarBatch.from_pydict(
         {"k": [1, 1, 2, 2, 1], "v": [None, 10, 20, None, 30]}, sch)
     plan = AggregateExec(
-        [col("k")], [(First(col("v")), "f"), (Last(col("v")), "l")],
+        [col("k")], [(First(col("v"), ignore_nulls=True), "f"),
+         (Last(col("v"), ignore_nulls=True), "l")],
         InMemoryScanExec([b], sch))
     got = {r[0]: r[1:] for r in plan.collect()}
     assert got[1] == (10, 30)
