@@ -40,6 +40,16 @@ def max(x):  # noqa: A001
     return Max(_e(x))
 
 
+def collect_list(x):
+    from ..expr.aggexprs import CollectList
+    return CollectList(_e(x))
+
+
+def collect_set(x):
+    from ..expr.aggexprs import CollectSet
+    return CollectSet(_e(x))
+
+
 def first(x, ignore_nulls=False):
     return First(_e(x), ignore_nulls=ignore_nulls)
 

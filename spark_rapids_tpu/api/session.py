@@ -265,6 +265,30 @@ class DataFrame:
         t = self.to_arrow()
         return {name: t.column(name).to_pylist() for name in t.column_names}
 
+    def to_jax(self) -> Dict:
+        """ML handoff (reference ColumnarRdd / spark-rapids-ml bridge):
+        materialize the query DEVICE-RESIDENT as a dict of
+        {name: (data, validity)} jnp arrays, trimmed to the row count —
+        zero host round trip, ready to feed a JAX model. Fixed-width
+        columns only (strings need tokenization first)."""
+        batches = list(self._exec().execute())
+        out: Dict = {}
+        from ..exec.coalesce import concat_batches
+        from ..columnar.batch import empty_batch
+        if not batches:
+            merged = empty_batch(self.schema)
+        elif len(batches) == 1:
+            merged = batches[0]
+        else:
+            merged = concat_batches(batches, self.schema)
+        n = merged.num_rows_host
+        for f, c in zip(self.schema.fields, merged.columns):
+            assert f.data_type.is_fixed_width, \
+                f"to_jax needs fixed-width columns, {f.name} is " \
+                f"{f.data_type.simple_name()}"
+            out[f.name] = (c.data[:n], c.validity[:n])
+        return out
+
     def count(self) -> int:
         from ..expr.aggexprs import Count
         rows = self._with(L.LogicalAggregate([], [(Count(), "count")],

@@ -157,9 +157,12 @@ class AggregateExec(TpuExec):
         pos = 0
         for i, (fn, name) in enumerate(self.aggregates):
             n_buf = len(fn.merge_ops())
-            input_types = self._input_types[i] if self._input_types else \
-                [bufs[pos].data_type]
-            agg_fields.append(StructField(name, fn.result_type(input_types)))
+            if self._input_types is not None:
+                rt = fn.result_type(self._input_types[i])
+            else:  # final mode: derive from buffer types explicitly
+                rt = fn.result_type_from_buffer(
+                    [f.data_type for f in bufs[pos:pos + n_buf]])
+            agg_fields.append(StructField(name, rt))
             pos += n_buf
         return Schema(tuple(key_fields + agg_fields))
 
@@ -324,6 +327,26 @@ class AggregateExec(TpuExec):
         from ..ops.maskedagg import masked_groupby_exact, masked_reduce
         cap = batch.capacity
         if not keys:
+            if any(op.startswith("collect") for op, _ in agg_inputs):
+                # grand collect_list/set: one-row array outputs
+                from ..ops.aggregate import collect_all
+                cols = []
+                fields = out_schema.fields
+                plain = [(op, c) for op, c in agg_inputs
+                         if not op.startswith("collect")]
+                plain_res = iter(masked_reduce(
+                    plain, batch.num_rows, row_mask, cap)) if plain else \
+                    iter(())
+                for (op, c), f in zip(agg_inputs, fields):
+                    if op.startswith("collect"):
+                        cols.append(collect_all(op, c, batch.num_rows, cap))
+                    else:
+                        data, valid = next(plain_res)
+                        cols.append(Column(
+                            data.astype(f.data_type.jnp_dtype), valid,
+                            f.data_type))
+                out = ColumnarBatch(cols, 1, out_schema)
+                return (out, jnp.asarray(False)) if hash_path else out
             # a count(*)-only aggregate has no input columns at all; give
             # the one-row output a real capacity bucket. Scatter-free
             # masked reductions (scatters are the slowest TPU op family).
@@ -530,13 +553,15 @@ class AggregateExec(TpuExec):
         over strings — those need sort lanes. Both update and merge passes
         see them as min/max over a string buffer, so checking the buffer
         schema covers every mode."""
-        from ..types import BinaryType, StringType
+        from ..types import ArrayType, BinaryType, StringType
         pos = self._key_count
         for fn, _ in self.aggregates:
             for op in fn.merge_ops():
                 bt = self._buffer_schema.fields[pos].data_type
                 if op in ("min", "max") and isinstance(
                         bt, (StringType, BinaryType)):
+                    return False
+                if isinstance(bt, ArrayType):  # collect_* need sort order
                     return False
                 pos += 1
         return True

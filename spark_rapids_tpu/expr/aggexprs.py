@@ -36,6 +36,12 @@ class AggregateFunction:
     def __init__(self, *inputs: Expression):
         self.inputs = tuple(inputs)
 
+    def result_type_from_buffer(self, buffer_types):
+        """Result type in FINAL mode, where only buffer types are known
+        (the default treats them as the input types, which most
+        aggregates' result_type handles identically)."""
+        return self.result_type(buffer_types)
+
     @property
     def child(self) -> Expression:
         return self.inputs[0]
@@ -175,6 +181,85 @@ class First(AggregateFunction):
 class Last(First):
     name = "last"
     _OPS = ("last", "last_any")
+
+
+class CollectList(AggregateFunction):
+    """collect_list(expr): values of the group as an array, nulls dropped
+    (reference GpuCollectList; array buffers force the sort tier)."""
+    name = "collect_list"
+    _UPDATE = "collect"
+
+    def update_ops(self):
+        return [(self._UPDATE, 0)]
+
+    def merge_ops(self):
+        return ["collect_merge"]
+
+    def buffer_types(self, input_types):
+        from ..types import ArrayType
+        return [ArrayType(input_types[0])]
+
+    def result_type(self, input_types):
+        from ..types import ArrayType
+        return ArrayType(input_types[0])
+
+    def result_type_from_buffer(self, buffer_types):
+        # final mode: the buffer already IS the list type (distinguished
+        # explicitly — inferring from the input type would collapse
+        # collect_list over array inputs to array<T>)
+        return buffer_types[0]
+
+    def evaluate(self, buffers, input_types):
+        return buffers[0]
+
+
+class CollectSet(CollectList):
+    """collect_set(expr): deduped values (reference GpuCollectSet). The
+    merge pass flattens partial sets; cross-partial duplicates only arise
+    across batches, where the final merge re-dedups via collect_set."""
+    name = "collect_set"
+    _UPDATE = "collect_set"
+
+    def merge_ops(self):
+        # flatten partials, then the evaluate-side dedup is unnecessary
+        # because the exact tier merges ALL rows of a group in one batch
+        # and re-runs collect_set over the flattened elements... which
+        # needs explode; instead merge via collect_merge and rely on the
+        # single-merge-pass layout: each group's partials concat, then a
+        # final dedup happens in evaluate().
+        return ["collect_merge"]
+
+    def evaluate(self, buffers, input_types):
+        from ..columnar.column import ArrayColumn
+        buf = buffers[0]
+        assert isinstance(buf, ArrayColumn)
+        return _dedup_array(buf)
+
+
+def _dedup_array(col):
+    """Remove duplicate elements within each list (fixed-width child)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..columnar.column import ArrayColumn
+    from ..ops.aggregate import _first_occurrence
+    from ..ops.basic import compaction_order, gather_column
+    from ..ops.collection import _row_of_child
+    from ..ops.strings import _rebuild_offsets
+    child = col.child
+    cap = child.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    row = _row_of_child(col, idx)
+    in_use = idx < col.offsets[-1]
+    keep = in_use & child.validity
+    keep = keep & _first_occurrence(child, row, keep, cap)
+    counts = jax.ops.segment_sum(keep.astype(jnp.int32), row,
+                                 num_segments=col.capacity)
+    counts = jnp.where(col.validity, counts, 0)
+    offsets = _rebuild_offsets(counts)
+    perm, _ = compaction_order(keep, jnp.int32(cap))
+    new_child = gather_column(child, perm)
+    return ArrayColumn(new_child, offsets, col.validity, col.dtype)
 
 
 class Average(AggregateFunction):

@@ -73,8 +73,9 @@ class CsvSource:
             escape_char=self.escape if self.escape else False,
             invalid_row_handler=(on_invalid
                                  if self.mode != "FAILFAST" else None))
-        null_values = [self.null_value] if self.null_value != "" \
-            else ["", "null", "NULL"]
+        # Spark's default nullValue is the empty string ONLY — nulling the
+        # literal words "null"/"NULL" would corrupt real string data
+        null_values = [self.null_value]
         kw = dict(
             strings_can_be_null=True,  # Spark: empty field -> null
             null_values=null_values,
@@ -92,9 +93,10 @@ class CsvSource:
             # field like "#tag" is data) — prefilter the raw bytes
             import io
             comment_b = self.comment.encode()
+            # only lines whose FIRST character is the comment char are
+            # comments (Spark/univocity); no lstrip
             with open(path, "rb") as f:
-                kept = [ln for ln in f
-                        if not ln.lstrip().startswith(comment_b)]
+                kept = [ln for ln in f if not ln.startswith(comment_b)]
             src = io.BytesIO(b"".join(kept))
         return pacsv.read_csv(src, read_options=read_opts,
                               parse_options=parse_opts,

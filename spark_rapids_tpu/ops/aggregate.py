@@ -33,9 +33,81 @@ from .sort import (
 
 #: aggregate op names understood by the kernel. first/last skip nulls
 #: (ignoreNulls=True); first_any/last_any take the first/last row
-#: regardless of null (Spark's default ignoreNulls=False).
+#: regardless of null (Spark's default ignoreNulls=False). collect/
+#: collect_set build list results (collect_merge flattens partials).
 AGG_OPS = ("sum", "count", "count_star", "min", "max", "first", "last",
-           "first_any", "last_any", "any_value", "sum_sq")
+           "first_any", "last_any", "any_value", "sum_sq", "collect",
+           "collect_set", "collect_merge")
+
+
+def collect_all(op: str, col: Column, num_rows, capacity: int) -> "Column":
+    """Grand-aggregate (no group keys) collect_list/collect_set: ONE row
+    holding every valid value (deduped for sets)."""
+    from ..columnar.column import ArrayColumn
+    from ..types import ArrayType
+    from .basic import compaction_order
+    from .strings import _rebuild_offsets
+
+    act = active_mask(num_rows, capacity)
+    if op == "collect_merge":
+        assert isinstance(col, ArrayColumn)
+        from .collection import array_lengths
+        lens = jnp.where(act & col.validity, array_lengths(col), 0)
+        total = jnp.sum(lens)
+        counts = jnp.zeros(capacity, jnp.int32).at[0].set(
+            total.astype(jnp.int32))
+        offsets = _rebuild_offsets(counts)
+        valid = jnp.zeros(capacity, jnp.bool_).at[0].set(True)
+        return ArrayColumn(col.child, offsets, valid, col.dtype)
+    keep = act & col.validity
+    if op == "collect_set":
+        keep = keep & _first_occurrence(
+            col, jnp.where(keep, 0, 1).astype(jnp.int32), keep, capacity)
+    total = jnp.sum(keep.astype(jnp.int32))
+    counts = jnp.zeros(capacity, jnp.int32).at[0].set(
+            total.astype(jnp.int32))
+    offsets = _rebuild_offsets(counts)
+    perm, _ = compaction_order(keep, jnp.int32(capacity))
+    child = gather_column(col, perm)
+    valid = jnp.zeros(capacity, jnp.bool_).at[0].set(True)
+    return ArrayColumn(child, offsets, valid, ArrayType(col.dtype))
+
+
+def _dedup_value_lanes(col: Column):
+    """Fixed-width dedup sort lanes with Spark equality semantics: -0.0
+    equals 0.0 and NaN equals NaN. Floats split into (hi, lo) int32
+    lanes — a 64-bit bitcast is not lowerable under the TPU X64 rewrite."""
+    data = col.data
+    if data.dtype == jnp.bool_:
+        data = data.astype(jnp.int8)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        d = data.astype(jnp.float64)
+        d = jnp.where(d == 0.0, 0.0, d)           # -0.0 -> 0.0
+        d = jnp.where(jnp.isnan(d), jnp.float64(jnp.nan), d)  # one NaN
+        pair = jax.lax.bitcast_convert_type(d, jnp.int32)  # (..., 2)
+        return [pair[..., 0], pair[..., 1]]
+    return [data]
+
+
+def _first_occurrence(col: Column, group_key, keep, capacity: int):
+    """Mask of the first kept row of each (group_key, value) pair —
+    the dedup primitive behind collect_set. The dropped-row sentinel is
+    far above any group id (group ids may exceed `capacity` when the
+    group domain is the parent batch of a child buffer)."""
+    lanes = _dedup_value_lanes(col)
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    big = jnp.int32(1 << 30)
+    gk = jnp.where(keep, group_key, big).astype(jnp.int32)
+    sorted_out = jax.lax.sort(tuple([gk] + lanes + [iota]),
+                              num_keys=1 + len(lanes))
+    sgk, sperm = sorted_out[0], sorted_out[-1]
+    slanes = sorted_out[1:-1]
+    diff = sgk[1:] != sgk[:-1]
+    for sl in slanes:
+        diff = diff | (sl[1:] != sl[:-1])
+    first = jnp.concatenate([jnp.ones(1, jnp.bool_), diff])
+    return jnp.zeros(capacity, jnp.bool_).at[sperm].set(
+        first & (sgk < big))
 
 
 @dataclass(frozen=True)
@@ -111,6 +183,45 @@ def _segment_reduce(op: str, values, validity, seg, capacity: int, positions):
     raise AssertionError(op)
 
 
+def _collect_group(op: str, g: Column, seg, act, capacity: int, positions,
+                   group_act) -> Column:
+    """collect_list/collect_set update + merge over key-sorted rows
+    (reference GpuCollectList/GpuCollectSet, aggregate functions over
+    cuDF lists; here the sorted layout makes the list column literally
+    the compacted values with group-boundary offsets).
+
+    'collect': values of each group in row order, nulls dropped.
+    'collect_set': additionally dedup within the group (element order
+    unspecified, as in Spark). 'collect_merge': flatten the per-row
+    lists of each group (the merge of partial collect buffers)."""
+    from ..columnar.column import ArrayColumn
+    from ..types import ArrayType
+    from .strings import _rebuild_offsets
+
+    if op == "collect_merge":
+        assert isinstance(g, ArrayColumn), g
+        # g is key-sorted: each group's row lists are contiguous, so the
+        # gathered child IS the flattened result; offsets accumulate the
+        # per-group totals
+        from .collection import array_lengths
+        lens = jnp.where(act & g.validity, array_lengths(g), 0)
+        counts = jax.ops.segment_sum(lens, seg, num_segments=capacity)
+        offsets = _rebuild_offsets(jnp.where(group_act, counts, 0))
+        return ArrayColumn(g.child, offsets, group_act, g.dtype)
+
+    keep = act & g.validity  # Spark: collect_* drop nulls
+    if op == "collect_set":
+        # dedup: first kept occurrence of each (segment, value)
+        keep = keep & _first_occurrence(g, seg, keep, capacity)
+    counts = jax.ops.segment_sum(keep.astype(jnp.int32), seg,
+                                 num_segments=capacity)
+    offsets = _rebuild_offsets(jnp.where(group_act, counts, 0))
+    from .basic import compaction_order as _co
+    perm2, _ = _co(keep, jnp.int32(capacity))
+    child = gather_column(g, perm2)
+    return ArrayColumn(child, offsets, group_act, ArrayType(g.dtype))
+
+
 def groupby_aggregate(key_columns: Sequence[Column],
                       agg_inputs: Sequence[Tuple[str, Optional[Column]]],
                       num_rows, capacity: int,
@@ -139,6 +250,10 @@ def groupby_aggregate(key_columns: Sequence[Column],
                                           act, seg, capacity, positions)
         else:
             g = gather_column(col, perm)
+            if op in ("collect", "collect_set", "collect_merge"):
+                results.append(("col", _collect_group(
+                    op, g, seg, act, capacity, positions, group_act)))
+                continue
             if isinstance(g, StringColumn):
                 if op in ("min", "max", "first", "last", "first_any",
                           "last_any", "any_value"):

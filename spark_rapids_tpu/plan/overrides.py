@@ -344,6 +344,21 @@ class PlanMeta(BaseMeta):
             c.tag_for_tpu()
             if not c.can_run_on_tpu:
                 self.will_not_work_on_tpu("child plan cannot run on TPU")
+        if isinstance(self.plan, L.LogicalAggregate):
+            # collect_set dedup lanes exist for fixed-width values only
+            from ..expr.aggexprs import CollectSet
+            from ..expr.core import resolve as _resolve
+            for fn, _ in self.plan.aggregates:
+                if isinstance(fn, CollectSet) and fn.inputs:
+                    try:
+                        dt = _resolve(fn.inputs[0],
+                                      self.plan.children[0].schema).data_type
+                    except (KeyError, TypeError):
+                        continue
+                    if not dt.is_fixed_width:
+                        self.will_not_work_on_tpu(
+                            f"collect_set over {dt.simple_name()} needs "
+                            "string dedup lanes (planned)")
         if isinstance(self.plan, L.LogicalJoin):
             # joins duplicate payload rows; the duplicating array gather
             # has no string-element byte measurement yet — reject at plan
@@ -407,8 +422,14 @@ class PlanMeta(BaseMeta):
         """partial → shuffle exchange on the group keys → final (reference
         Spark's partial/final split feeding GpuShuffleExchangeExecBase)."""
         from ..exec.exchange import ShuffleExchangeExec
+        from ..types import ArrayType
         partial = AggregateExec(p.group_exprs, p.aggregates, child,
                                 mode="partial")
+        if any(isinstance(f.data_type, ArrayType)
+               for f in partial.output_schema.fields):
+            # collect_* buffers are list columns; the fixed-width exchange
+            # codec cannot carry them yet — stay single-partition
+            return AggregateExec(p.group_exprs, p.aggregates, child)
         key_names = partial.output_schema.names[: len(p.group_exprs)]
         part_keys = [UnresolvedAttribute(n) for n in key_names]
         exchange = ShuffleExchangeExec(part_keys, partial, mesh)

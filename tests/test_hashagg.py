@@ -149,3 +149,102 @@ def test_decimal_disabled_conf_tags_off():
             df.select((col("x") + col("x")).alias("y")).collect()
     finally:
         TpuSession()  # reset active conf for the rest of the process
+
+
+def test_collect_list_and_set():
+    """collect_list/collect_set (reference GpuCollectList/Set) vs Python
+    oracle, across batches so the merge path runs."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import LONG, Schema, StructField
+    s = TpuSession()
+    sch = Schema((StructField("k", LONG), StructField("v", LONG)))
+    data = {"k": [1, 1, 2, 1, 2, 2, 1, None],
+            "v": [5, None, 7, 5, 8, 7, 3, 9]}
+    df = s.from_pydict(data, sch, batch_rows=3)
+    got = {k: (sorted(lst), sorted(st)) for k, lst, st in
+           df.group_by("k").agg((F.collect_list(F.col("v")), "lst"),
+                                (F.collect_set(F.col("v")), "st")).collect()}
+    import collections
+    exp_list = collections.defaultdict(list)
+    for k, v in zip(data["k"], data["v"]):
+        if v is not None:
+            exp_list[k].append(v)
+        else:
+            exp_list[k]
+    exp = {k: (sorted(vs), sorted(set(vs))) for k, vs in exp_list.items()}
+    assert got == exp, (got, exp)
+
+
+def test_collect_list_grand_aggregate():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import LONG, Schema, StructField
+    s = TpuSession()
+    sch = Schema((StructField("v", LONG),))
+    df = s.from_pydict({"v": [3, None, 1, 3, 2]}, sch)
+    rows = df.agg((F.collect_list(F.col("v")), "lst"),
+                  (F.collect_set(F.col("v")), "st"),
+                  (F.sum(F.col("v")), "s")).collect()
+    lst, st, total = rows[0]
+    assert sorted(lst) == [1, 2, 3, 3] and sorted(st) == [1, 2, 3]
+    assert total == 9
+
+
+def test_to_jax_handoff():
+    """ML handoff: device-resident arrays out of a query (reference
+    ColumnarRdd / spark-rapids-ml bridge)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import DOUBLE, LONG, Schema, StructField
+    s = TpuSession()
+    sch = Schema((StructField("x", DOUBLE), StructField("y", LONG)))
+    df = s.from_pydict({"x": [1.0, 2.0, None, 4.0],
+                        "y": [1, 2, 3, 4]}, sch, batch_rows=2)
+    out = df.filter(col("y") > 1).to_jax()
+    assert set(out) == {"x", "y"}
+    data, valid = out["x"]
+    assert data.shape == (3,) and not bool(valid[1])
+    assert [float(v) for v in out["y"][0]] == [2.0, 3.0, 4.0]
+
+
+def test_collect_set_doubles_and_string_gate():
+    """collect_set: float dedup without 64-bit bitcasts (TPU X64 rewrite),
+    -0.0==0.0, and a plan-time gate for string inputs."""
+    import pytest
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.plan.overrides import PlanNotSupported
+    from spark_rapids_tpu.types import DOUBLE, LONG, STRING, Schema, \
+        StructField
+    s = TpuSession()
+    sch = Schema((StructField("k", LONG), StructField("v", DOUBLE)))
+    df = s.from_pydict({"k": [1, 1, 1, 1, 2, 2],
+                        "v": [1.5, -0.0, 0.0, 1.5, 3.25, 3.25]}, sch)
+    got = {k: sorted(st) for k, st in df.group_by("k").agg(
+        (F.collect_set(col("v")), "st")).collect()}
+    assert got == {1: [0.0, 1.5], 2: [3.25]}
+    ssch = Schema((StructField("k", LONG), StructField("v", STRING)))
+    sdf = s.from_pydict({"k": [1, 1], "v": ["a", "a"]}, ssch)
+    with pytest.raises(PlanNotSupported):
+        sdf.group_by("k").agg((F.collect_set(col("v")), "st")).collect()
+    # collect_LIST over strings stays supported
+    lst = sdf.group_by("k").agg((F.collect_list(col("v")), "l")).collect()
+    assert lst == [(1, ["a", "a"])]
+
+
+def test_collect_list_nested_arrays():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import ArrayType, LONG, Schema, StructField
+    s = TpuSession()
+    sch = Schema((StructField("k", LONG), StructField("v", ArrayType(LONG))))
+    df = s.from_pydict({"k": [1, 1], "v": [[1, 2], [3]]}, sch)
+    agg = df.group_by("k").agg((F.collect_list(col("v")), "l"))
+    assert agg.schema.fields[1].data_type.simple_name() \
+        == "array<array<bigint>>"
+    assert sorted(agg.collect()[0][1]) == [[1, 2], [3]]
