@@ -40,6 +40,11 @@ def max(x):  # noqa: A001
     return Max(_e(x))
 
 
+def udf(fn=None, *, return_type=None):
+    from ..expr.udf import udf as _udf
+    return _udf(fn, return_type=return_type)
+
+
 def collect_list(x):
     from ..expr.aggexprs import CollectList
     return CollectList(_e(x))

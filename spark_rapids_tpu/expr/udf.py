@@ -1,0 +1,135 @@
+"""Python UDFs — the engine's analog of Spark's Arrow-batched Python UDF
+path (reference: rapids accelerates *around* Python UDFs by keeping data
+columnar across the worker boundary, GpuPythonUDF/GpuArrowEvalPythonExec;
+SURVEY §2.7).
+
+TPU shape: `jax.pure_callback` splices a host round trip INTO the
+compiled program — the XLA runtime ships the batch's device buffers to
+the host, the Python function runs row-wise over numpy views, and the
+result re-enters the program as a device array. That is architecturally
+the same thing Spark does with its Arrow socket to a Python worker, with
+XLA as the transport. Fixed-width inputs and outputs (plus string
+INPUTS, decoded host-side); string outputs would need dynamic byte
+buckets and stay unsupported (tagged off)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.column import Column, StringColumn
+from ..types import DataType
+from .core import Expression
+
+
+class PythonUDF(Expression):
+    def __init__(self, fn: Callable, return_type: DataType,
+                 *children: Expression, name: str = None):
+        assert return_type.is_fixed_width, \
+            "Python UDFs return fixed-width types (string outputs need " \
+            "dynamic byte buckets)"
+        self.fn = fn
+        self.return_type = return_type
+        self.children = tuple(children)
+        self.fn_name = name or getattr(fn, "__name__", "udf")
+
+    def with_children(self, children):
+        return PythonUDF(self.fn, self.return_type, *children,
+                         name=self.fn_name)
+
+    def _semantic_args(self):
+        # per-INSTANCE identity: an opaque host function may be
+        # non-deterministic, so distinct call sites must never CSE into
+        # one evaluation (only the literally-same expression object is)
+        return (id(self),)
+
+    @property
+    def data_type(self):
+        return self.return_type
+
+    @property
+    def deterministic(self):
+        # opaque host function; _semantic_args is per-instance so the
+        # projection CSE cache can never merge distinct call sites
+        return False
+
+    def columnar_eval(self, batch) -> Column:
+        cap = batch.capacity
+        cols = [c.columnar_eval(batch) for c in self.children]
+        out_dtype = self.return_type.jnp_dtype
+
+        host_args = []
+        specs = []  # decode recipe per child
+        for c in cols:
+            if isinstance(c, StringColumn):
+                host_args += [c.data, c.offsets, c.validity]
+                specs.append("str")
+            else:
+                host_args += [c.data, c.validity]
+                specs.append("fixed")
+
+        fn = self.fn
+
+        def host(num_rows, *bufs):
+            n = int(num_rows)
+            vals_per_child = []
+            i = 0
+            for spec in specs:
+                if spec == "str":
+                    data, offsets, validity = bufs[i:i + 3]
+                    i += 3
+                    vals = [None if not validity[r] else
+                            bytes(data[offsets[r]:offsets[r + 1]])
+                            .decode("utf-8") for r in range(n)]
+                else:
+                    data, validity = bufs[i:i + 2]
+                    i += 2
+                    vals = [data[r].item() if validity[r] else None
+                            for r in range(n)]
+                vals_per_child.append(vals)
+            out = np.zeros(cap, dtype=out_dtype)
+            ok = np.zeros(cap, dtype=np.bool_)
+            for r in range(n):
+                res = fn(*(v[r] for v in vals_per_child))
+                if res is not None:
+                    out[r] = res
+                    ok[r] = True
+            return out, ok
+
+        result_shape = (jax.ShapeDtypeStruct((cap,), out_dtype),
+                        jax.ShapeDtypeStruct((cap,), np.bool_))
+        data, valid = jax.pure_callback(host, result_shape,
+                                        batch.num_rows, *host_args)
+        return Column(data, valid, self.return_type)
+
+    def __repr__(self):
+        return f"udf:{self.fn_name}({', '.join(map(repr, self.children))})"
+
+
+def udf(fn: Callable = None, *, return_type: DataType = None):
+    """Spark's F.udf surface: `udf(lambda x: ..., return_type=LONG)` or
+    `@udf(return_type=LONG)`. Returns a builder producing PythonUDF
+    expressions over its column arguments."""
+    from .core import col, lit
+
+    if return_type is None:
+        raise TypeError(
+            "udf(...) requires return_type= (a fixed-width DataType); "
+            "e.g. F.udf(lambda x: x + 1, return_type=LONG)")
+
+    def wrap(f):
+        def build(*args):
+            # PySpark surface: a str argument is a COLUMN name
+            exprs = [a if isinstance(a, Expression)
+                     else col(a) if isinstance(a, str) else lit(a)
+                     for a in args]
+            return PythonUDF(f, return_type, *exprs)
+        build.__name__ = getattr(f, "__name__", "udf")
+        return build
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap

@@ -168,6 +168,14 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
     _r(rules, stringexprs.Like, "SQL LIKE pattern", stringlike, BOOLEAN,
        tag_fn=_tag_regex)
     # null handling / misc
+    from ..expr.udf import PythonUDF
+    # inputs/outputs limited to the types the host boundary actually
+    # converts (DECIMAL/DATE/TIMESTAMP would arrive as raw physical ints)
+    udf_io = numeric + BOOLEAN + TypeSig.of("STRING")
+    _r(rules, PythonUDF,
+       "Python UDF (host round trip via pure_callback; the reference's "
+       "Arrow-batched Python worker with XLA as the transport)",
+       udf_io, numeric + BOOLEAN)
     _r(rules, conditional.Nvl, "nvl/ifnull")
     _r(rules, conditional.Nvl2, "nvl2")
     _r(rules, conditional.NullIf, "nullif")
