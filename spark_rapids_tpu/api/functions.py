@@ -414,3 +414,13 @@ def trunc(x, unit):
     from ..expr.datetimeexprs import TruncDate
     return TruncDate(_e(x), unit)
 
+
+
+def from_utc_timestamp(x, tz):
+    from ..expr.datetimeexprs import FromUTCTimestamp
+    return FromUTCTimestamp(_e(x), tz)
+
+
+def to_utc_timestamp(x, tz):
+    from ..expr.datetimeexprs import ToUTCTimestamp
+    return ToUTCTimestamp(_e(x), tz)

@@ -28,6 +28,9 @@ class _InMemorySource:
     def estimated_size_bytes(self) -> int:
         return sum(b.device_size_bytes() for b in self._batches)
 
+    def estimated_num_rows(self) -> int:
+        return sum(b.num_rows_host for b in self._batches)
+
 
 class TpuSession:
     def __init__(self, conf: Optional[Dict] = None,
@@ -92,8 +95,22 @@ class TpuSession:
         return self._df(L.LogicalScan(OrcSource(path, self.conf,
                                                 columns=columns)))
 
+    def read_iceberg(self, path, snapshot_id=None) -> "DataFrame":
+        from ..io.iceberg import IcebergSource
+        return self._df(L.LogicalScan(IcebergSource(path, self.conf,
+                                                    snapshot_id)))
+
+    def read_hive_text(self, path, schema, **options) -> "DataFrame":
+        from ..io.hivetext import HiveTextSource
+        return self._df(L.LogicalScan(HiveTextSource(path, schema,
+                                                     self.conf, **options)))
+
+    def read_delta(self, path, version=None) -> "DataFrame":
+        from ..delta import read_delta
+        return read_delta(self, path, version)
+
     def read_avro(self, path, **options) -> "DataFrame":
-        from ..io.orc import AvroSource
+        from ..io.avro import AvroSource
         return self._df(L.LogicalScan(AvroSource(path, self.conf,
                                                  **options)))
 
@@ -242,6 +259,41 @@ class DataFrame:
         return self._with(L.LogicalAggregate(
             [col(n) for n in self.columns], [], self._plan))
 
+    def repartition(self, n_partitions: int) -> "DataFrame":
+        """Round-robin repartition through the host shuffle (Spark
+        df.repartition(n); reference GpuRoundRobinPartitioning)."""
+        return self._with(L.LogicalRepartition(n_partitions, self._plan,
+                                               mode="roundrobin"))
+
+    def coalesce(self, n_partitions: int = 1) -> "DataFrame":
+        """Collapse to a single partition (Spark df.coalesce(1);
+        reference GpuSinglePartitioning)."""
+        assert n_partitions == 1, "only coalesce(1) is supported"
+        return self._with(L.LogicalRepartition(1, self._plan,
+                                               mode="single"))
+
+    def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
+        """Bernoulli sample (Spark df.sample; reference GpuSampleExec)."""
+        return self._with(L.LogicalSample(fraction, seed, self._plan))
+
+    def cache(self) -> "DataFrame":
+        """Materialize-once columnar cache (reference
+        ParquetCachedBatchSerializer / GpuInMemoryTableScanExec): the
+        first action on the returned frame runs this plan and stores
+        compressed host frames; later actions re-scan the cache. Call
+        `.unpersist()` on the returned frame to drop it."""
+        from ..exec.cache import CachedRelation
+        rel = CachedRelation(self._exec, self.schema)
+        out = self._with(L.LogicalScan(rel))
+        out._cached_relation = rel
+        return out
+
+    def unpersist(self) -> "DataFrame":
+        rel = getattr(self, "_cached_relation", None)
+        if rel is not None:
+            rel.unpersist()
+        return self
+
     # -- actions -----------------------------------------------------------
     def _exec(self):
         from ..parallel.mesh import set_active_mesh
@@ -316,6 +368,23 @@ class DataFrame:
     def write_orc(self, path):
         from ..io.orc import write_orc
         write_orc(self, path)
+
+    def write_avro(self, path, codec: str = "deflate"):
+        from ..io.avro import write_avro
+        write_avro(self, path, codec=codec)
+
+    def write_delta(self, path, mode: str = "append",
+                    partition_by: Optional[Sequence[str]] = None):
+        from ..delta import write_delta
+        write_delta(self, path, mode=mode, partition_by=partition_by)
+
+    def write_iceberg(self, path, mode: str = "append"):
+        from ..io.iceberg import write_iceberg
+        write_iceberg(self, path, mode=mode)
+
+    def write_hive_text(self, path, **options):
+        from ..io.hivetext import write_hive_text
+        write_hive_text(self, path, **options)
 
     def _with(self, plan: L.LogicalPlan) -> "DataFrame":
         return DataFrame(plan, self.session)

@@ -155,6 +155,56 @@ SHUFFLE_PLAN_EXCHANGE = conf_bool(
     "exchange-both-sides → per-partition shuffled hash join (reference "
     "GpuShuffleExchangeExecBase planning).", commonly_used=True)
 
+OPTIMIZER_ENABLED = conf_bool(
+    "spark.rapids.sql.optimizer.enabled", False,
+    "Cost-based device-vs-host placement: device-eligible Project/Filter "
+    "sections whose modeled host cost (row interpreter + transitions) "
+    "beats the device cost (program dispatch + bandwidth) run on the "
+    "host row engine — tiny inputs, mainly (reference "
+    "CostBasedOptimizer.scala, also default-off).")
+
+PALLAS_ENABLED = conf_bool(
+    "spark.rapids.tpu.pallas.enabled", True,
+    "Use hand-written Pallas TPU kernels for hash hotspots (murmur3 "
+    "partition/join/group-by hashing) instead of the fused-XLA path "
+    "when running on real TPU hardware (SURVEY §2.9 Pallas tier; "
+    "reference analog: spark-rapids-jni hand-tuned CUDA Hash kernels). "
+    "Off-TPU backends always use the XLA path; tests drive the kernel "
+    "via the Pallas interpreter for bit-exactness.")
+
+DEBUG_DUMP_PATH = conf_str(
+    "spark.rapids.sql.debug.dumpPath", "",
+    "When set, operators wrapped in dump_on_error write their input "
+    "batches (parquet + metadata) and a repro script there on failure "
+    "(reference DumpUtils.scala / spark.rapids.sql.debug dump hooks). "
+    "Empty disables dumping.")
+
+UDF_COMPILER_ENABLED = conf_bool(
+    "spark.rapids.sql.udfCompiler.enabled", False,
+    "Decompile Python UDF bytecode into device expressions when possible "
+    "(the reference's udf-compiler module / "
+    "spark.rapids.sql.udfCompiler.enabled). Compiled UDFs use SQL null "
+    "semantics (NULL propagates) rather than raising on None — opt-in, "
+    "like the reference.", commonly_used=True)
+
+CPU_FALLBACK_ENABLED = conf_bool(
+    "spark.rapids.sql.cpuFallback.enabled", True,
+    "Run Project/Filter nodes whose expressions have no device kernel on "
+    "the host row engine (ColumnarToRow → host operator → RowToColumnar), "
+    "instead of failing the whole plan — the reference's per-operator "
+    "convertToCpu fallback (GpuOverrides.scala:4427). Only expressions "
+    "the host interpreter implements fall back; others still fail with "
+    "the full explain report.", commonly_used=True)
+
+SHUFFLE_PARTITIONS = conf_int(
+    "spark.rapids.sql.shuffle.partitions", 1,
+    "Partition count for host-shuffled stages (Spark's "
+    "spark.sql.shuffle.partitions). With no multi-device mesh, a value "
+    "> 1 plans group-bys and equi-joins through the MULTITHREADED host "
+    "shuffle (partial → host exchange → final), bounding device memory "
+    "per partition — the out-of-core repartition path.",
+    commonly_used=True)
+
 SHUFFLE_WRITER_THREADS = conf_int(
     "spark.rapids.shuffle.multiThreaded.writer.threads", 8,
     "Writer-side serialization threads (reference "
@@ -170,6 +220,13 @@ PARQUET_READER_TYPE = conf_str(
     "upload per row group) or COALESCING (stitch small row groups "
     "host-side into ~batchSize tables before upload; reference "
     "GpuMultiFileReader.scala:830).")
+
+PARQUET_REBASE_MODE_READ = conf_str(
+    "spark.rapids.sql.format.parquet.datetimeRebaseModeInRead", "CORRECTED",
+    "Datetime rebase for parquet reads: CORRECTED (values are proleptic "
+    "Gregorian, pass through) or LEGACY (file was written by Spark < 3.0 "
+    "in the hybrid Julian calendar; DATE/TIMESTAMP are rebased on device "
+    "— reference datetimeRebaseUtils.scala + JNI DateTimeRebase).")
 
 PARQUET_PUSHDOWN_ENABLED = conf_bool(
     "spark.rapids.sql.format.parquet.filterPushdown.enabled", True,
@@ -264,10 +321,7 @@ class RapidsConf:
                          "spark.rapids.sql.format.")
 
     #: retired keys accepted (ignored with a warning) for compatibility
-    _DEPRECATED = {
-        "spark.rapids.sql.cpuFallback.enabled":
-            "standalone engine has no host engine to fall back to",
-    }
+    _DEPRECATED: Dict[str, str] = {}
 
     def __init__(self, settings: Optional[Dict[str, Any]] = None):
         self._settings = dict(settings or {})

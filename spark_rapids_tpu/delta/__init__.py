@@ -1,0 +1,17 @@
+"""Delta Lake integration — one modern protocol version, as SURVEY §7
+phase 9 prescribes (the reference ships nine per-version modules under
+/root/reference/delta-lake/; this package is the analog of delta-24x +
+delta-lake/common: GpuOptimisticTransaction.scala, GpuDeltaCatalog,
+GpuMergeIntoCommand.scala, GpuStatisticsCollection.scala).
+
+Self-contained: the transaction log (JSON actions + parquet checkpoints),
+snapshot reconstruction, stats-collecting writes, and the copy-on-write
+DELETE/UPDATE/MERGE commands are implemented here directly against the
+engine — no delta-spark dependency.
+"""
+
+from .log import DeltaLog, Snapshot
+from .table import DeltaTable, read_delta, write_delta
+
+__all__ = ["DeltaLog", "Snapshot", "DeltaTable", "read_delta",
+           "write_delta"]

@@ -1,0 +1,573 @@
+"""Delta table scan + write + DML commands (reference delta-24x:
+GpuDeltaCatalog / GpuOptimisticTransaction.scala for writes with stats
+collection, GpuDeleteCommand / GpuUpdateCommand / GpuMergeIntoCommand.scala
+for copy-on-write DML — all re-expressed over this engine's DataFrame
+planner instead of delta-spark).
+
+Scan: snapshot files → per-file parquet reads with partition values
+injected as columns; file skipping uses partition values and the add
+actions' min/max/nullCount stats through the same `with_filters` hook the
+planner uses for parquet pushdown, so `filter(...)` over a delta scan
+prunes whole files (the reference's data-skipping via
+GpuStatisticsCollection).
+
+DML is copy-on-write: only files containing affected rows are rewritten;
+commits are optimistic (DeltaConcurrentModificationException on a lost
+race).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, StringColumn
+from ..config import RapidsConf
+from ..expr.core import Expression, UnresolvedAttribute, lit
+from ..expr.predicates import EqualNullSafe, IsNotNull, Not
+from ..types import (BooleanType, DataType, DateType, DoubleType, FloatType,
+                     IntegerType, LongType, Schema, ShortType, StringType,
+                     StructField, TimestampType)
+from .log import AddFile, DeltaLog, Snapshot, schema_to_json
+
+_MARKER = "__delta_src_match"
+
+
+def _parse_partition_value(raw: Optional[str], dt: DataType):
+    if raw is None:
+        return None
+    if isinstance(dt, (IntegerType, LongType, ShortType)):
+        return int(raw)
+    if isinstance(dt, (DoubleType, FloatType)):
+        return float(raw)
+    if isinstance(dt, BooleanType):
+        return raw.lower() == "true"
+    if isinstance(dt, DateType):
+        import datetime as _dt
+        return (_dt.date.fromisoformat(raw)
+                - _dt.date(1970, 1, 1)).days
+    return raw  # string
+
+
+def _fmt_partition_value(v, dt: DataType) -> Optional[str]:
+    if v is None:
+        return None
+    if isinstance(dt, DateType):
+        import datetime as _dt
+        return (_dt.date(1970, 1, 1) + _dt.timedelta(days=int(v))
+                ).isoformat()
+    if isinstance(dt, BooleanType):
+        return "true" if v else "false"
+    return str(v)
+
+
+class DeltaSource:
+    """Scan source over one snapshot (plugs into LogicalScan; the planner
+    pushes filter conjuncts through `with_filters` for file skipping)."""
+
+    def __init__(self, log: DeltaLog, snapshot: Snapshot,
+                 conf: Optional[RapidsConf] = None,
+                 filters: Optional[Sequence[Tuple[str, str, object]]] = None,
+                 files: Optional[List[AddFile]] = None):
+        self.log = log
+        self.snap = snapshot
+        self.schema = snapshot.schema
+        self._conf = conf
+        self.filters = list(filters or [])
+        self._files = files  # explicit file subset (DML rewrites)
+        self.scan_stats = {"files_read": 0, "files_pruned": 0}
+
+    def with_filters(self, filters) -> "DeltaSource":
+        out = DeltaSource(self.log, self.snap, self._conf,
+                          list(self.filters) + list(filters), self._files)
+        out.scan_stats = self.scan_stats
+        return out
+
+    def estimated_size_bytes(self) -> int:
+        return sum(f.size for f in (self._files or self.snap.files))
+
+    # -- file skipping -----------------------------------------------------
+    def _file_pruned(self, f: AddFile) -> bool:
+        part_cols = set(self.snap.partition_columns)
+        stats = f.parsed_stats()
+        for (name, op, value) in self.filters:
+            if name in part_cols:
+                dt = self.schema.fields[self.schema.index_of(name)].data_type
+                pv = _parse_partition_value(
+                    f.partition_values.get(name), dt)
+                if op == "is_null":
+                    if pv is not None:
+                        return True
+                elif op == "is_not_null":
+                    if pv is None:
+                        return True
+                elif pv is None:
+                    return True  # comparison with NULL partition never true
+                elif op == "==" and pv != value:
+                    return True
+                elif op == "<" and not (pv < value):
+                    return True
+                elif op == "<=" and not (pv <= value):
+                    return True
+                elif op == ">" and not (pv > value):
+                    return True
+                elif op == ">=" and not (pv >= value):
+                    return True
+            elif stats:
+                mn = (stats.get("minValues") or {}).get(name)
+                mx = (stats.get("maxValues") or {}).get(name)
+                nc = (stats.get("nullCount") or {}).get(name)
+                nr = stats.get("numRecords")
+                if op == "is_null" and nc == 0:
+                    return True
+                if op == "is_not_null" and nc is not None \
+                        and nc == nr:
+                    return True
+                if mn is None or mx is None:
+                    continue
+                try:
+                    if op == "==" and (value < mn or value > mx):
+                        return True
+                    if op == "<" and mn >= value:
+                        return True
+                    if op == "<=" and mn > value:
+                        return True
+                    if op == ">" and mx <= value:
+                        return True
+                    if op == ">=" and mx < value:
+                        return True
+                except TypeError:
+                    continue
+        return False
+
+    # -- scan --------------------------------------------------------------
+    def files_after_skipping(self) -> List[AddFile]:
+        out = []
+        self.scan_stats["files_read"] = 0
+        self.scan_stats["files_pruned"] = 0
+        for f in (self._files if self._files is not None
+                  else self.snap.files):
+            if self.filters and self._file_pruned(f):
+                self.scan_stats["files_pruned"] += 1
+                continue
+            self.scan_stats["files_read"] += 1
+            out.append(f)
+        return out
+
+    def batches(self) -> Iterator[ColumnarBatch]:
+        for f in self.files_after_skipping():
+            yield from self._read_file(f)
+
+    def _read_file(self, f: AddFile) -> Iterator[ColumnarBatch]:
+        from ..io.parquet import ParquetSource
+        path = os.path.join(self.log.table_path, f.path)
+        part_cols = self.snap.partition_columns
+        data_cols = [c for c in self.schema.names if c not in part_cols]
+        src = ParquetSource(path, self._conf, columns=data_cols,
+                            filters=[flt for flt in self.filters
+                                     if flt[0] in data_cols])
+        for b in src.batches():
+            cols: List[Column] = []
+            for fld in self.schema.fields:
+                if fld.name in part_cols:
+                    dt = fld.data_type
+                    v = _parse_partition_value(
+                        f.partition_values.get(fld.name), dt)
+                    n = b.num_rows_host
+                    if isinstance(dt, StringType):
+                        col = StringColumn.from_pylist(
+                            [v] * n, capacity=b.capacity)
+                    else:
+                        col = Column.from_pylist([v] * n, dt,
+                                                 capacity=b.capacity)
+                    cols.append(col)
+                else:
+                    cols.append(b.column(fld.name))
+            yield ColumnarBatch(cols, b.num_rows_host, self.schema)
+
+
+# ---------------------------------------------------------------------------
+# write path with stats collection
+# ---------------------------------------------------------------------------
+
+def _collect_stats(table) -> str:
+    """Per-file stats JSON from a pyarrow table (reference
+    GpuStatisticsCollection: numRecords/min/max/nullCount drive data
+    skipping on later reads)."""
+    import pyarrow.compute as pc
+    mins: Dict[str, object] = {}
+    maxs: Dict[str, object] = {}
+    nulls: Dict[str, int] = {}
+    for name in table.column_names:
+        col = table.column(name)
+        nulls[name] = col.null_count
+        if col.length() - col.null_count == 0:
+            continue
+        try:
+            mn = pc.min(col).as_py()
+            mx = pc.max(col).as_py()
+        except Exception:
+            continue
+        import datetime as _dt
+        for tag, v in (("mn", mn), ("mx", mx)):
+            if isinstance(v, _dt.datetime):
+                v = v.isoformat()
+            elif isinstance(v, _dt.date):
+                v = v.isoformat()
+            elif isinstance(v, bytes):
+                continue
+            (mins if tag == "mn" else maxs)[name] = v
+    return json.dumps({"numRecords": table.num_rows, "minValues": mins,
+                       "maxValues": maxs, "nullCount": nulls})
+
+
+def _write_data_files(df, table_path: str, partition_by: List[str]
+                      ) -> List[AddFile]:
+    """Materialize a DataFrame into parquet data files + AddFile actions
+    (one file per partition tuple, or one file total)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    table = df.to_arrow()
+    adds: List[AddFile] = []
+
+    def write_one(sub, rel_dir: str, pvals: Dict[str, str]):
+        if sub.num_rows == 0:
+            return
+        name = f"part-{uuid.uuid4().hex}.snappy.parquet"
+        rel = os.path.join(rel_dir, name) if rel_dir else name
+        full = os.path.join(table_path, rel)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        pq.write_table(sub, full)
+        adds.append(AddFile(rel.replace(os.sep, "/"), pvals,
+                            os.path.getsize(full), _collect_stats(sub),
+                            int(os.path.getmtime(full) * 1000)))
+
+    if not partition_by:
+        write_one(table, "", {})
+        return adds
+
+    schema = df.schema
+    # group rows by partition tuple host-side
+    pcols = [table.column(c).to_pylist() for c in partition_by]
+    data_cols = [c for c in table.column_names if c not in partition_by]
+    groups: Dict[tuple, List[int]] = {}
+    for i, key in enumerate(zip(*pcols)):
+        groups.setdefault(key, []).append(i)
+    for key, idxs in groups.items():
+        sub = table.take(idxs).select(data_cols)
+        pvals = {}
+        parts = []
+        for c, v in zip(partition_by, key):
+            dt = schema.fields[schema.index_of(c)].data_type
+            # arrow gives logical values; normalize to delta's string form
+            import datetime as _dt
+            if isinstance(v, _dt.date):
+                sv = v.isoformat()
+            elif v is None:
+                sv = None
+            else:
+                sv = _fmt_partition_value(v, dt) \
+                    if not isinstance(v, str) else v
+            pvals[c] = sv
+            parts.append(f"{c}={'__HIVE_DEFAULT_PARTITION__' if sv is None else sv}")
+        write_one(sub, os.path.join(*parts), pvals)
+    return adds
+
+
+def write_delta(df, path: str, mode: str = "append",
+                partition_by: Optional[Sequence[str]] = None) -> None:
+    """DataFrame → delta table (append / overwrite / error-if-exists
+    semantics of Spark's DataFrameWriter)."""
+    log = DeltaLog(path)
+    partition_by = list(partition_by or [])
+    exists = log.exists()
+    if mode == "error" and exists:
+        raise FileExistsError(f"delta table {path!r} already exists")
+    os.makedirs(path, exist_ok=True)
+    adds = _write_data_files(df, log.table_path, partition_by)
+    actions: List[dict] = [DeltaLog.commit_info(
+        "WRITE", mode=mode, partitionBy=json.dumps(partition_by))]
+    if not exists:
+        actions.append(DeltaLog.protocol_action())
+        actions.append(log.metadata_action(df.schema, partition_by,
+                                           str(uuid.uuid4())))
+        version = 0
+    else:
+        snap = log.snapshot()
+        if snap.schema.names != df.schema.names:
+            raise ValueError(
+                f"schema mismatch: table {snap.schema.names} "
+                f"vs data {df.schema.names}")
+        version = snap.version + 1
+        if mode == "overwrite":
+            for f in snap.files:
+                actions.append({"remove": {
+                    "path": f.path, "dataChange": True,
+                    "deletionTimestamp": 0}})
+    actions.extend(a.to_action() for a in adds)
+    log.commit(actions, version)
+
+
+def read_delta(session, path: str, version: Optional[int] = None):
+    from ..plan import logical as L
+    log = DeltaLog(path)
+    snap = log.snapshot(version)
+    return session._df(L.LogicalScan(DeltaSource(log, snap, session.conf)))
+
+
+# ---------------------------------------------------------------------------
+# DML commands (copy-on-write)
+# ---------------------------------------------------------------------------
+
+class DeltaTable:
+    """DML entry point (reference GpuDeleteCommand / GpuUpdateCommand /
+    GpuMergeIntoCommand)."""
+
+    def __init__(self, session, path: str):
+        self.session = session
+        self.log = DeltaLog(path)
+
+    @staticmethod
+    def for_path(session, path: str) -> "DeltaTable":
+        return DeltaTable(session, path)
+
+    def to_df(self):
+        return read_delta(self.session, self.log.table_path)
+
+    def history(self) -> List[dict]:
+        out = []
+        for v in range(self.log.latest_version() + 1):
+            for a in self.log._read_version_actions(v):
+                if "commitInfo" in a:
+                    out.append({"version": v, **a["commitInfo"]})
+        return out
+
+    # -- shared rewrite machinery -----------------------------------------
+    def _file_df(self, snap: Snapshot, f: AddFile):
+        from ..plan import logical as L
+        src = DeltaSource(self.log, snap, self.session.conf, files=[f])
+        return self.session._df(L.LogicalScan(src))
+
+    def _rewrite(self, snap: Snapshot, f: AddFile, new_df
+                 ) -> List[dict]:
+        """remove old file + add rewritten rows (partition kept)."""
+        actions = [{"remove": {"path": f.path, "dataChange": True,
+                               "deletionTimestamp": 0}}]
+        part_cols = snap.partition_columns
+        rel_dir = os.path.dirname(f.path)
+        import pyarrow.parquet as pq
+        table = new_df.to_arrow()
+        if table.num_rows:
+            data_cols = [c for c in table.column_names
+                         if c not in part_cols]
+            sub = table.select(data_cols)
+            name = f"part-{uuid.uuid4().hex}.snappy.parquet"
+            rel = os.path.join(rel_dir, name) if rel_dir else name
+            full = os.path.join(self.log.table_path, rel)
+            os.makedirs(os.path.dirname(full), exist_ok=True)
+            pq.write_table(sub, full)
+            actions.append(AddFile(
+                rel.replace(os.sep, "/"), f.partition_values,
+                os.path.getsize(full), _collect_stats(sub),
+                int(os.path.getmtime(full) * 1000)).to_action())
+        return actions
+
+    def _matching_files(self, snap: Snapshot, condition: Expression
+                        ) -> List[AddFile]:
+        """Candidate files via the same skipping stats the scan uses."""
+        from ..plan.overrides import extract_pushable_filters
+        src = DeltaSource(self.log, snap, self.session.conf)
+        pushed = extract_pushable_filters(condition, snap.schema)
+        if pushed:
+            src = src.with_filters(pushed)
+        return src.files_after_skipping()
+
+    # -- DELETE ------------------------------------------------------------
+    def delete(self, condition) -> int:
+        """DELETE FROM t WHERE cond (reference GpuDeleteCommand): rows
+        where cond is TRUE are removed; NULL/false rows stay."""
+        from ..api.session import _to_expr
+        cond = _to_expr(condition)
+        snap = self.log.snapshot()
+        keep = Not(EqualNullSafe(cond, lit(True)))
+        actions: List[dict] = [DeltaLog.commit_info("DELETE")]
+        deleted = 0
+        for f in self._matching_files(snap, cond):
+            file_df = self._file_df(snap, f)
+            total = file_df.count()
+            kept_df = self._file_df(snap, f).filter(keep)
+            kept = kept_df.count()
+            if kept == total:
+                continue
+            deleted += total - kept
+            actions.extend(self._rewrite(snap, f, kept_df))
+        if len(actions) > 1:
+            self.log.commit(actions, snap.version + 1)
+        return deleted
+
+    # -- UPDATE ------------------------------------------------------------
+    def update(self, set: Dict[str, object], condition=None) -> int:
+        """UPDATE t SET col=expr [WHERE cond] (reference
+        GpuUpdateCommand)."""
+        from ..api.functions import col
+        from ..api.session import _to_expr
+        from ..expr.conditional import If
+        cond = _to_expr(condition) if condition is not None else lit(True)
+        sets = {k: _to_expr(v) for k, v in set.items()}
+        snap = self.log.snapshot()
+        is_match = EqualNullSafe(cond, lit(True))
+        actions: List[dict] = [DeltaLog.commit_info("UPDATE")]
+        updated = 0
+        for f in self._matching_files(snap, cond):
+            file_df = self._file_df(snap, f)
+            n_match = file_df.filter(is_match).count()
+            if n_match == 0:
+                continue
+            updated += n_match
+            exprs = []
+            for fld in snap.schema.fields:
+                if fld.name in sets:
+                    exprs.append(If(is_match,
+                                    sets[fld.name].cast(fld.data_type),
+                                    col(fld.name)).alias(fld.name))
+                else:
+                    exprs.append(col(fld.name))
+            new_df = self._file_df(snap, f).select(*exprs)
+            actions.extend(self._rewrite(snap, f, new_df))
+        if len(actions) > 1:
+            self.log.commit(actions, snap.version + 1)
+        return updated
+
+    # -- MERGE -------------------------------------------------------------
+    def merge(self, source_df, on: Sequence[str]) -> "_MergeBuilder":
+        """MERGE INTO t USING source ON t.k = s.k (equi-merge; reference
+        GpuMergeIntoCommand / GpuRapidsProcessDeltaMergeJoinExec)."""
+        return _MergeBuilder(self, source_df, list(on))
+
+
+class _MergeBuilder:
+    def __init__(self, table: DeltaTable, source_df, on: List[str]):
+        self.table = table
+        self.source = source_df
+        self.on = on
+        self._update: Optional[Dict[str, object]] = None
+        self._delete = False
+        self._insert: Optional[Dict[str, object]] = None
+
+    def when_matched_update(self, set: Dict[str, object]
+                            ) -> "_MergeBuilder":
+        self._update = set
+        return self
+
+    def when_matched_delete(self) -> "_MergeBuilder":
+        self._delete = True
+        return self
+
+    def when_not_matched_insert(self, values: Optional[Dict[str, object]]
+                                = None) -> "_MergeBuilder":
+        self._insert = values if values is not None else {}
+        return self
+
+    def execute(self) -> Dict[str, int]:
+        from ..api.functions import col
+        from ..api.session import _to_expr
+        from ..expr.conditional import If
+        t = self.table
+        snap = t.log.snapshot()
+        sess = t.session
+        schema = snap.schema
+        src_names = self.source.columns
+        # prefix source columns to avoid collisions, keep join keys usable
+        renamed = self.source.select(*[
+            col(c).alias(f"__s_{c}") for c in src_names])
+        marked = renamed.with_column(_MARKER, lit(True))
+
+        # 1 source row per key, or the merge is ambiguous (Spark raises)
+        key_counts = self.source.group_by(*self.on).agg(
+            (_count_fn(), "__c")).collect()
+        if any(row[-1] > 1 for row in key_counts):
+            raise ValueError(
+                "MERGE: multiple source rows match the same key")
+
+        src_keys = set()
+        key_idx = [self.source.schema.index_of(k) for k in self.on]
+        for row in self.source.collect():
+            src_keys.add(tuple(row[i] for i in key_idx))
+        # SQL equi-join semantics: NULL keys never match — a source row
+        # with a NULL key can only ever be an unmatched insert
+        src_match_keys = {k for k in src_keys if None not in k}
+
+        stats = {"updated": 0, "deleted": 0, "inserted": 0}
+        actions: List[dict] = [DeltaLog.commit_info("MERGE")]
+
+        matched_keys = set()
+        for f in snap.files:
+            file_df = t._file_df(snap, f)
+            rows = file_df.collect()
+            tkey_idx = [schema.index_of(k) for k in self.on]
+            fkeys = {tuple(r[i] for i in tkey_idx) for r in rows}
+            hit = fkeys & src_match_keys
+            if not hit:
+                continue
+            matched_keys |= hit
+            joined = t._file_df(snap, f).join(
+                marked, left_on=list(self.on),
+                right_on=[f"__s_{k}" for k in self.on], how="left_outer")
+            is_matched = IsNotNull(col(_MARKER))
+            out = joined
+            n_hit_rows = sum(1 for r in rows
+                             if tuple(r[i] for i in tkey_idx) in hit)
+            if self._delete:
+                out = out.filter(Not(EqualNullSafe(is_matched, lit(True))))
+                stats["deleted"] += n_hit_rows
+            exprs = []
+            for fld in schema.fields:
+                if self._update and fld.name in self._update:
+                    upd = _to_expr(self._update[fld.name])
+                    exprs.append(If(is_matched,
+                                    upd.cast(fld.data_type),
+                                    col(fld.name)).alias(fld.name))
+                else:
+                    exprs.append(col(fld.name))
+            out = out.select(*exprs)
+            if self._update:
+                stats["updated"] += n_hit_rows
+            actions.extend(t._rewrite(snap, f, out))
+
+        if self._insert is not None:
+            unmatched = [k for k in src_keys if k not in matched_keys]
+            if unmatched:
+                src_rows = self.source.collect()
+                keep_rows = [r for r in src_rows
+                             if tuple(r[i] for i in key_idx) in
+                             set(unmatched)]
+                ins_values: Dict[str, List] = {n: [] for n in schema.names}
+                src_pos = {n: i for i, n in enumerate(src_names)}
+                for r in keep_rows:
+                    for fld in schema.fields:
+                        if self._insert and fld.name in self._insert:
+                            raise ValueError(
+                                "explicit insert expressions not supported;"
+                                " use column-name mapping")
+                        v = r[src_pos[fld.name]] \
+                            if fld.name in src_pos else None
+                        ins_values[fld.name].append(v)
+                ins_df = sess.from_pydict(ins_values, schema)
+                adds = _write_data_files(ins_df, t.log.table_path,
+                                         snap.partition_columns)
+                actions.extend(a.to_action() for a in adds)
+                stats["inserted"] = len(keep_rows)
+
+        if len(actions) > 1:
+            t.log.commit(actions, snap.version + 1)
+        return stats
+
+
+def _count_fn():
+    from ..expr.aggexprs import Count
+    return Count()

@@ -127,6 +127,14 @@ class TpuExec:
         rows = self.metrics[NUM_OUTPUT_ROWS]
         batches = self.metrics[NUM_OUTPUT_BATCHES]
         name = type(self).__name__
+        # retain last outputs ONLY when failure dumping is configured —
+        # otherwise each operator would pin one device batch for the
+        # whole query, stealing memory the spill machinery counts as free
+        try:
+            from ..config import DEBUG_DUMP_PATH, active_conf
+            dump_enabled = bool(active_conf().get(DEBUG_DUMP_PATH))
+        except Exception:  # noqa: BLE001 — conf unavailable early
+            dump_enabled = False
         it = self.internal_execute()
         while True:
             with annotate_op(name):
@@ -134,12 +142,43 @@ class TpuExec:
                     batch = next(it)
                 except StopIteration:
                     return
+                except Exception:
+                    self._dump_failure_inputs(name)
+                    raise
             batches.add(1)
             if batch._host_rows is not None:
                 rows.add(batch._host_rows)
             else:
                 rows.add_device(batch.num_rows)
+            if dump_enabled:
+                self._last_output = batch
             yield batch
+
+    #: most recent batch this operator yielded (= a child's view of its
+    #: input); consumed by the failure dump below
+    _last_output: "ColumnarBatch" = None
+
+    def _dump_failure_inputs(self, name: str) -> None:
+        """On operator failure, dump the children's last-yielded batches —
+        the failing operator's actual inputs (reference DumpUtils dump-
+        failing-batches hooks) — plus the REAL active exception's
+        traceback. Conf-gated; never masks the error."""
+        try:
+            import sys
+
+            from ..config import DEBUG_DUMP_PATH, active_conf
+            if not active_conf().get(DEBUG_DUMP_PATH):
+                return
+            from ..utils.dump import dump_on_error
+            scope = dump_on_error(name)
+            for c in self.children:
+                if c._last_output is not None:
+                    scope.observe(c._last_output)
+            # called from the operator's except block: sys.exc_info() IS
+            # the failure being dumped
+            scope.__exit__(*sys.exc_info())
+        except Exception:  # noqa: BLE001 — dumping is best-effort
+            pass
 
     @property
     def child(self) -> "TpuExec":

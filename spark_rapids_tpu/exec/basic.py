@@ -16,7 +16,7 @@ from ..columnar.column import Column, bucket_capacity
 from ..expr.core import Alias, BoundReference, Expression, output_name, resolve
 from ..memory.retry import split_in_half_by_rows, with_retry
 from ..memory.spillable import SpillableBatch
-from ..ops.basic import compact_columns, sanitize, slice_rows
+from ..ops.basic import active_mask, compact_columns, sanitize, slice_rows
 from ..types import LongType, Schema, StructField
 from .base import NUM_INPUT_BATCHES, NUM_INPUT_ROWS, OP_TIME, TpuExec
 
@@ -304,3 +304,39 @@ class ExpandExec(TpuExec):
         for batch in self.child.execute():
             for jitfn in self._jits:
                 yield jitfn(batch)
+
+
+class SampleExec(TpuExec):
+    """Bernoulli row sampling (reference GpuSampleExec /
+    GpuPartitionwiseSampledRDD + GpuPoissonSampler,
+    basicPhysicalOperators.scala): each row survives with probability
+    `fraction`, decided by the threefry counter RNG on device — fold_in
+    of the batch index keeps every batch's draw independent AND the whole
+    sample reproducible for a given seed."""
+
+    def __init__(self, fraction: float, seed: int, child: TpuExec):
+        super().__init__(child)
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+        self._jit = jax.jit(self._kernel, static_argnums=(2,))
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def _kernel(self, batch: ColumnarBatch, batch_idx, fraction: float):
+        import jax as _jax
+        key = _jax.random.fold_in(_jax.random.key(self.seed), batch_idx)
+        u = _jax.random.uniform(key, (batch.capacity,), jnp.float32)
+        keep = (u < fraction) & active_mask(batch.num_rows, batch.capacity)
+        cols, n = compact_columns(batch.columns, keep, batch.num_rows)
+        return ColumnarBatch(cols, n, batch.schema)
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        op_time = self.metrics[OP_TIME]
+        for i, batch in enumerate(self.child.execute()):
+            with op_time.ns_timer():
+                yield self._jit(batch, jnp.uint32(i), self.fraction)
+
+    def node_description(self):
+        return f"SampleExec[fraction={self.fraction}, seed={self.seed}]"

@@ -180,6 +180,202 @@ class ShuffleExchangeExec(TpuExec):
                 f"keys={self.partition_exprs!r}]")
 
 
+class HostShuffleExchangeExec(TpuExec):
+    """Hash-repartition through the host shuffle manager (the reference's
+    MULTITHREADED shuffle mode, RapidsShuffleInternalManagerBase.scala:238/
+    :569): partition ids are computed on device (Spark-exact murmur3 pmod),
+    rows are gathered into compact host blocks, serialized + LZ4-compressed
+    on the writer thread pool into per-map data+index files, then read back
+    partition by partition on the reader pool.
+
+    This is the always-works exchange: it needs no mesh, bounds device
+    memory by partition (the out-of-core repartition the reference gets
+    from Spark's file shuffle), and survives any partition count. Emits
+    exactly `n_partitions` batch groups in partition order, empty
+    partitions included."""
+
+    def __init__(self, partition_exprs: Sequence[Expression], child: TpuExec,
+                 n_partitions: int, conf=None, partitioning: str = "hash",
+                 range_order=None):
+        """partitioning ∈ hash | roundrobin | single | range (the
+        reference's GpuHashPartitioningBase / GpuRoundRobinPartitioning /
+        GpuSinglePartitioning / GpuRangePartitioner). Range mode takes
+        `range_order` = (ordinal, ascending, nulls_first) on the child
+        schema and samples the data for split bounds like
+        GpuRangePartitioner's reservoir sampling."""
+        super().__init__(child)
+        from ..config import active_conf
+        self.partition_exprs = list(partition_exprs or [])
+        self.n_partitions = int(n_partitions)
+        self.partitioning = partitioning
+        self.range_order = range_order
+        self._conf = conf or active_conf()
+        if partitioning == "hash":
+            assert self.partition_exprs, "hash partitioning needs keys"
+            self._bound = bind_projection(self.partition_exprs,
+                                          child.output_schema)
+            self._jit_pid = jax.jit(self._pid_kernel)
+        self._rr_offset = 0
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def additional_metrics(self):
+        return (NUM_INPUT_BATCHES, NUM_INPUT_ROWS, PARTITION_SIZE,
+                "shuffleWriteTime", "shuffleReadTime")
+
+    def _pid_kernel(self, batch: ColumnarBatch):
+        keys = [e.columnar_eval(batch) for e in self._bound]
+        return partition_ids(keys, batch.num_rows, batch.capacity,
+                             self.n_partitions)
+
+    # -- partition id per mode --------------------------------------------
+    def _host_keys(self, batch: ColumnarBatch, n: int, stride: int = 1):
+        """First-sort-key values as host objects. With a stride, only the
+        sampled rows are gathered/materialized (the bounds pass needs
+        ~512 values, not a full-column to_pylist)."""
+        import numpy as np
+        ordinal, _asc, _nf = self.range_order
+        col = batch.columns[ordinal]
+        if stride > 1:
+            from ..shuffle.serializer import host_gather_column
+            idx = np.arange(0, n, stride, dtype=np.int64)
+            col = host_gather_column(col, idx)
+            n = len(idx)
+        vals = col.to_pylist(n)
+        return np.array(vals, dtype=object)
+
+    @staticmethod
+    def _is_nan(k) -> bool:
+        return isinstance(k, float) and k != k
+
+    def _range_bounds(self, key_samples):
+        """Sampled split bounds over the first sort key (reference
+        GpuRangePartitioner: sample → sort → n-1 evenly spaced bounds).
+        NaN keys are excluded (they route to the greatest partition like
+        Spark's NaN-sorts-last); all-equal keys collapse to one
+        partition, which is still exact."""
+        sample = [k for k in key_samples
+                  if k is not None and not self._is_nan(k)]
+        sample.sort()
+        if not sample:
+            return []
+        idx = [len(sample) * (i + 1) // self.n_partitions
+               for i in range(self.n_partitions - 1)]
+        return [sample[min(i, len(sample) - 1)] for i in idx]
+
+    def _pid_for(self, batch: ColumnarBatch, n: int, bounds):
+        import numpy as np
+        mode = self.partitioning
+        if mode == "hash":
+            return np.asarray(self._jit_pid(batch))[:n]
+        if mode == "single":
+            return np.zeros(n, np.int64)
+        if mode == "roundrobin":
+            pid = (np.arange(n, dtype=np.int64) + self._rr_offset) \
+                % self.n_partitions
+            self._rr_offset = int((self._rr_offset + n)
+                                  % self.n_partitions)
+            return pid
+        if mode == "range":
+            keys = self._host_keys(batch, n)
+            _ordinal, asc, nulls_first = self.range_order
+            null_pid = 0 if nulls_first else self.n_partitions - 1
+            null_mask = np.array([k is None for k in keys], np.bool_)
+            # NaN sorts greatest (Spark float ordering): last partition
+            # ascending, first descending — never through searchsorted
+            nan_mask = np.array([self._is_nan(k) for k in keys], np.bool_)
+            safe = np.array([bounds[0] if (k is None or self._is_nan(k))
+                             else k for k in keys], dtype=object) \
+                if bounds else keys
+            if bounds:
+                idx = np.searchsorted(np.array(bounds, dtype=object),
+                                      safe, side="left").astype(np.int64)
+            else:
+                idx = np.zeros(n, np.int64)
+            idx[nan_mask] = self.n_partitions - 1
+            if not asc:
+                idx = self.n_partitions - 1 - idx
+            idx[null_mask] = null_pid
+            return idx
+        raise ValueError(f"unknown partitioning {mode!r}")
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        import numpy as np  # noqa: F401 — used by _pid_for
+
+        from ..shuffle.manager import (HostShuffleReader, HostShuffleWriter,
+                                       partition_batch_host, shuffle_manager)
+        mgr = shuffle_manager()
+        handle = mgr.register(self.n_partitions, self.output_schema)
+        in_batches = self.metrics[NUM_INPUT_BATCHES]
+        in_rows = self.metrics[NUM_INPUT_ROWS]
+        self._rr_offset = 0
+        try:
+            if self.partitioning == "range":
+                # bounds need a full pass: buffer the input as SPILLABLE
+                # handles (sampling keys host-side as they stream by), so
+                # the buffered data stays under the memory budget — the
+                # point of the host-shuffled sort (reference
+                # GpuRangePartitioner sampling + spillable buffering)
+                from ..memory.spillable import SpillableBatch
+                spillables = []
+                key_samples: list = []
+                for b in self.child.execute():
+                    nb = b.num_rows_host
+                    if nb:
+                        key_samples.extend(self._host_keys(
+                            b, nb, stride=max(1, nb // 512)))
+                    spillables.append(SpillableBatch.from_batch(b))
+                bounds = self._range_bounds(key_samples)
+
+                def drain():
+                    for sp in spillables:
+                        batch = sp.get_batch()
+                        try:
+                            yield batch
+                        finally:
+                            sp.release()
+                            sp.close()
+
+                source = drain()
+            else:
+                source = self.child.execute()
+                bounds = None
+            map_id = 0
+            for b in source:
+                in_batches.add(1)
+                n = b.num_rows_host
+                in_rows.add(n)
+                # time only the shuffle work (partition/serialize/write),
+                # not the upstream compute driving child.execute()
+                with self.metrics["shuffleWriteTime"].ns_timer():
+                    pid = self._pid_for(b, n, bounds)
+                    parts = partition_batch_host(b, pid, self.n_partitions)
+                    writer = HostShuffleWriter(handle, map_id, mgr,
+                                               self._conf)
+                    writer.write([[p] if p.num_rows_host else []
+                                  for p in parts])
+                self.metrics[PARTITION_SIZE].add(writer.bytes_written)
+                map_id += 1
+            reader = HostShuffleReader(handle, mgr, self._conf)
+            for p in range(self.n_partitions):
+                with self.metrics["shuffleReadTime"].ns_timer():
+                    blocks = list(reader.read_partition(p))
+                if not blocks:
+                    yield empty_batch(self.output_schema)
+                elif len(blocks) == 1:
+                    yield blocks[0]
+                else:
+                    yield concat_batches(blocks, self.output_schema)
+        finally:
+            mgr.unregister(handle)
+
+    def node_description(self):
+        return (f"HostShuffleExchangeExec[n={self.n_partitions}, "
+                f"keys={self.partition_exprs!r}]")
+
+
 class BroadcastExchangeExec(TpuExec):
     """Materialize the child once as a single device-resident batch and
     replay it to every consumer execution (reference
@@ -255,11 +451,20 @@ class ShuffledHashJoinExec(TpuExec):
         return self._join.output_schema
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
-        lparts = list(self.children[0].execute())
-        rparts = list(self.children[1].execute())
-        assert len(lparts) == len(rparts), \
-            "both sides must use the same partitioning"
-        for lp, rp in zip(lparts, rparts):
+        # lazy zip: both exchanges emit exactly n_partitions batches in
+        # partition order, so only ONE partition pair is resident at a
+        # time — the per-partition memory bound is the point of the
+        # host-shuffled join path
+        lit_ = self.children[0].execute()
+        rit = self.children[1].execute()
+        while True:
+            lp = next(lit_, None)
+            rp = next(rit, None)
+            if (lp is None) != (rp is None):
+                raise AssertionError(
+                    "both sides must use the same partitioning")
+            if lp is None:
+                return
             self._lscan._batches = [lp]
             self._rscan._batches = [rp]
             yield from self._join.execute()

@@ -335,3 +335,31 @@ class TopNExec(SortExec):
                                    batch.capacity) for c in batch.columns]
                 batch = ColumnarBatch(cols, n, batch.schema)
             yield batch
+
+
+class PartitionWiseSortExec(TpuExec):
+    """Per-partition sort over a range exchange: the child (a range-
+    partitioned HostShuffleExchangeExec) yields one batch per partition in
+    ascending bound order, so sorting each partition independently yields
+    a GLOBALLY sorted stream (the reference's distributed sort:
+    GpuRangePartitioner bounds + per-partition GpuSortExec). One inner
+    SortExec is reused so compiled sort programs cache across
+    partitions."""
+
+    def __init__(self, orders: Sequence, child: TpuExec):
+        super().__init__(child)
+        from .basic import InMemoryScanExec
+        self._scan = InMemoryScanExec([], child.output_schema)
+        self._sort = SortExec(orders, self._scan)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        for part in self.child.execute():
+            self._scan._batches = [part]
+            yield from self._sort.execute()
+
+    def node_description(self):
+        return "PartitionWiseSortExec"

@@ -258,7 +258,9 @@ class Remainder(BinaryArithmetic):
 
 
 class Pmod(BinaryArithmetic):
-    """Spark pmod: always-positive modulus; NULL on zero divisor."""
+    """Spark pmod (`r = a % n; r < 0 ? (r + n) % n : r`, Java remainder):
+    non-negative for positive divisors, but NEGATIVE results for n < 0
+    (pmod(-7, -2) = -1 in Spark). NULL on zero divisor."""
     symbol = "pmod"
 
     def columnar_eval(self, batch):
@@ -270,11 +272,17 @@ class Pmod(BinaryArithmetic):
         ld, rd = _promote(l, r, out_t)
         div_ok = rd != 0
         safe_r = jnp.where(div_ok, rd, jnp.ones((), rd.dtype))
+        # Spark Pmod (arithmetic.scala): r = a % n; r < 0 ? (r + n) % n : r
+        # — with Java remainder. For n < 0 the result stays negative
+        # (Spark returns -1 for pmod(-7, -6)); do NOT normalize by |n|.
         if isinstance(out_t, FractionalType):
-            m = ld - jnp.trunc(ld / safe_r) * safe_r
+            def rem(x):
+                return x - jnp.trunc(x / safe_r) * safe_r
         else:
-            m = _trunc_mod(ld, safe_r)
-        m = jnp.where(m < 0, m + jnp.abs(safe_r), m)
+            def rem(x):
+                return _trunc_mod(x, safe_r)
+        r0 = rem(ld)
+        m = jnp.where(r0 < 0, rem(r0 + safe_r), r0)
         valid = l.validity & r.validity & div_ok
         m = jnp.where(valid, m, jnp.zeros((), m.dtype))
         return Column(m, valid, out_t)

@@ -183,3 +183,40 @@ class TruncDate(Expression):
 
     def _semantic_args(self):
         return (self.unit,)
+
+
+class FromUTCTimestamp(Expression):
+    """from_utc_timestamp(ts, tz): UTC instant → wall clock in tz
+    (reference GpuFromUTCTimestamp + GpuTimeZoneDB device transition
+    tables; ops/timezone.py)."""
+
+    def __init__(self, ts: Expression, tz):
+        self.children = (ts,)
+        self.tz = tz.value if hasattr(tz, "value") else tz
+
+    @property
+    def data_type(self):
+        return TimestampType()
+
+    def with_children(self, cs):
+        return type(self)(cs[0], self.tz)
+
+    def _semantic_args(self):
+        return (self.tz,)
+
+    def columnar_eval(self, batch):
+        from ..ops.timezone import utc_to_local
+        c = self.children[0].columnar_eval(batch)
+        return Column(utc_to_local(c.data, self.tz), c.validity,
+                      TimestampType())
+
+
+class ToUTCTimestamp(FromUTCTimestamp):
+    """to_utc_timestamp(ts, tz): wall clock in tz → UTC instant (fold=0
+    for ambiguous DST-overlap times, matching Java's zone rules)."""
+
+    def columnar_eval(self, batch):
+        from ..ops.timezone import local_to_utc
+        c = self.children[0].columnar_eval(batch)
+        return Column(local_to_utc(c.data, self.tz), c.validity,
+                      TimestampType())

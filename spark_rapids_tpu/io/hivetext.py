@@ -1,0 +1,97 @@
+"""Hive delimited-text tables (reference GpuHiveTableScanExec /
+GpuHiveTextFileFormat under org/apache/spark/sql/hive/rapids/; SURVEY
+§2.7 #48): LazySimpleSerDe defaults — field delimiter \\x01 (^A), row
+delimiter \\n, NULL sentinel '\\N', no quoting — with the same textual
+value formats Hive uses (lowercase true/false, plain decimal floats).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Sequence
+
+from ..columnar.batch import ColumnarBatch
+from ..config import RapidsConf
+from ..types import (BooleanType, DataType, DoubleType, FloatType,
+                     IntegerType, LongType, Schema, StringType)
+from .multifile import expand_paths
+
+NULL = r"\N"
+FIELD_DELIM = "\x01"
+
+
+def _parse(raw: str, dt: DataType):
+    if raw == NULL:
+        return None
+    if isinstance(dt, (LongType, IntegerType)):
+        try:
+            return int(raw)
+        except ValueError:
+            return None  # Hive: malformed numeric reads as NULL
+    if isinstance(dt, (DoubleType, FloatType)):
+        try:
+            return float(raw)
+        except ValueError:
+            return None
+    if isinstance(dt, BooleanType):
+        return raw.lower() == "true" if raw.lower() in ("true", "false") \
+            else None
+    return raw
+
+
+def _fmt(v, dt: DataType) -> str:
+    if v is None:
+        return NULL
+    if isinstance(dt, BooleanType):
+        return "true" if v else "false"
+    if isinstance(dt, (DoubleType, FloatType)):
+        return repr(float(v))
+    return str(v)
+
+
+class HiveTextSource:
+    def __init__(self, path, schema: Schema,
+                 conf: Optional[RapidsConf] = None,
+                 field_delim: str = FIELD_DELIM,
+                 batch_rows: int = 1 << 17):
+        self.paths = expand_paths(path)
+        assert self.paths, f"no files at {path!r}"
+        self.schema = schema
+        self.field_delim = field_delim
+        self.batch_rows = batch_rows
+
+    def estimated_size_bytes(self) -> int:
+        return sum(os.path.getsize(p) for p in self.paths)
+
+    def batches(self) -> Iterator[ColumnarBatch]:
+        fields = self.schema.fields
+        cols: List[List] = [[] for _ in fields]
+        n = 0
+        for p in self.paths:
+            with open(p, "r", encoding="utf-8") as f:
+                for line in f:
+                    parts = line.rstrip("\n").split(self.field_delim)
+                    for i, fld in enumerate(fields):
+                        raw = parts[i] if i < len(parts) else NULL
+                        cols[i].append(_parse(raw, fld.data_type))
+                    n += 1
+                    if n >= self.batch_rows:
+                        yield self._flush(cols)
+                        cols = [[] for _ in fields]
+                        n = 0
+        yield self._flush(cols)
+
+    def _flush(self, cols: List[List]) -> ColumnarBatch:
+        data = {f.name: c for f, c in zip(self.schema.fields, cols)}
+        return ColumnarBatch.from_pydict(data, self.schema)
+
+
+def write_hive_text(df, path: str, field_delim: str = FIELD_DELIM) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    fields = df.schema.fields
+    with open(path, "w", encoding="utf-8") as f:
+        for row in df.collect():
+            f.write(field_delim.join(
+                _fmt(v, fld.data_type) for v, fld in zip(row, fields)))
+            f.write("\n")

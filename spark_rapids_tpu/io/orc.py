@@ -70,19 +70,3 @@ def write_orc(df, path):
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
                 exist_ok=True)
     paorc.write_table(table, path)
-
-
-class AvroSource:
-    """Avro scan (reference GpuAvroScan.scala). The host decoder requires
-    the `fastavro` package, which this environment does not ship — the
-    source raises a clear error at construction until one is available
-    (same gating the reference applies to its optional formats)."""
-
-    def __init__(self, path, conf: Optional[RapidsConf] = None, **kw):
-        try:
-            import fastavro  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "Avro scan needs the optional 'fastavro' host decoder; "
-                "it is not installed in this environment") from e
-        raise NotImplementedError("fastavro decode path pending")

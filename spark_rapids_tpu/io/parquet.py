@@ -140,13 +140,22 @@ class ParquetSource:
         tasks = []
         self.scan_stats["row_groups_read"] = 0
         self.scan_stats["row_groups_pruned"] = 0
+        # LEGACY rebase: footer stats are hybrid-Julian day numbers while
+        # pushed filter literals are proleptic-Gregorian — comparing them
+        # could prune groups whose REBASED rows match, so stats pruning is
+        # disabled entirely under LEGACY (the reference does the same)
+        from ..config import PARQUET_REBASE_MODE_READ
+        legacy_rebase = (self._conf is not None and
+                         self._conf.get(PARQUET_REBASE_MODE_READ).upper()
+                         == "LEGACY")
+        may_prune = bool(self.filters) and not legacy_rebase
         for p in self.paths:
             pf = pq.ParquetFile(p)
             md = pf.metadata
             name_to_idx = {md.schema.column(i).name: i
                            for i in range(md.num_columns)}
             for rg in range(md.num_row_groups):
-                if self.filters and self._group_pruned(md, rg, name_to_idx):
+                if may_prune and self._group_pruned(md, rg, name_to_idx):
                     self.scan_stats["row_groups_pruned"] += 1
                     continue
                 self.scan_stats["row_groups_read"] += 1
@@ -160,10 +169,43 @@ class ParquetSource:
                 tasks.append(lambda p=p: pq.read_table(p,
                                                        columns=self.columns))
         if self.reader_type == "COALESCING":
-            yield from self._coalescing_drive(tasks)
+            out = self._coalescing_drive(tasks)
         else:
-            for table in threaded_chunks(tasks, self.num_threads):
-                yield from arrow_to_batches(table, self.batch_rows)
+            out = (b for table in threaded_chunks(tasks, self.num_threads)
+                   for b in arrow_to_batches(table, self.batch_rows))
+        yield from self._maybe_rebase(out, legacy_rebase)
+
+    def _maybe_rebase(self, batches: Iterator[ColumnarBatch],
+                      legacy: bool) -> Iterator[ColumnarBatch]:
+        """LEGACY datetimeRebaseModeInRead: files written in the hybrid
+        Julian calendar get their DATE/TIMESTAMP columns rebased to
+        proleptic Gregorian on device (reference datetimeRebaseUtils +
+        JNI DateTimeRebase; kernels in ops/rebase.py). `legacy` comes
+        from the ONE mode parse in batches() — the same flag that
+        disabled stats pruning, so the two can never diverge."""
+        from ..types import DateType, TimestampNTZType, TimestampType
+        if not legacy:
+            yield from batches
+            return
+        from ..columnar.column import Column
+        from ..ops.rebase import (rebase_julian_to_gregorian_days,
+                                  rebase_julian_to_gregorian_micros)
+        for b in batches:
+            cols = []
+            for c, f in zip(b.columns, b.schema.fields):
+                if isinstance(f.data_type, DateType):
+                    cols.append(Column(
+                        rebase_julian_to_gregorian_days(
+                            c.data.astype("int64")).astype(c.data.dtype),
+                        c.validity, c.dtype))
+                elif isinstance(f.data_type,
+                                (TimestampType, TimestampNTZType)):
+                    cols.append(Column(
+                        rebase_julian_to_gregorian_micros(c.data),
+                        c.validity, c.dtype))
+                else:
+                    cols.append(c)
+            yield b.with_columns(cols, b.schema)
 
     def _coalescing_drive(self, tasks) -> Iterator[ColumnarBatch]:
         """Stitch decoded row groups host-side into ~batch_rows tables

@@ -1,0 +1,146 @@
+"""Bounded host allocator — the reference's HostAlloc.scala:24 (pinned
+pool preferred, bounded non-pinned overflow, blocking until memory frees):
+host staging buffers for shuffle/spill/IO must not grow without bound just
+because device memory is budgeted.
+
+TPU shape: there is no cudaHostAlloc pinning; "pinned" here is a reserved
+fast-lane quota for transfer-critical allocations (spill writes, shuffle
+frames) and the rest contends for the bounded general pool. Allocation
+blocks (with timeout) instead of failing, mirroring HostAlloc's
+synchronous wait-for-free behavior; a timeout raises HostOOM so the
+caller's retry machinery can split (the same escalation path as device
+OOM, memory/retry.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+
+class HostOOM(MemoryError):
+    pass
+
+
+class HostAllocation:
+    """Tracked host buffer; release via close() (ARM-style, reference
+    withResource discipline)."""
+
+    __slots__ = ("buffer", "nbytes", "pinned", "_pool", "_closed")
+
+    def __init__(self, buffer: np.ndarray, nbytes: int, pinned: bool,
+                 pool: "HostAlloc"):
+        self.buffer = buffer
+        self.nbytes = nbytes
+        self.pinned = pinned
+        self._pool = pool
+        self._closed = False
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._pool._release(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class HostAlloc:
+    """Bounded two-lane host memory pool (reference HostAlloc.scala:24,
+    :103-111 tryAlloc pinned-first policy)."""
+
+    def __init__(self, limit_bytes: int, pinned_bytes: int = 0):
+        assert pinned_bytes <= limit_bytes
+        self.limit_bytes = limit_bytes
+        self.pinned_bytes = pinned_bytes
+        self._lock = threading.Condition()
+        self._used = 0          # general lane
+        self._pinned_used = 0   # reserved fast lane
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used + self._pinned_used
+
+    @property
+    def free_bytes(self) -> int:
+        return self.limit_bytes - self.used_bytes
+
+    def _try_reserve(self, nbytes: int, prefer_pinned: bool) -> Optional[bool]:
+        """Returns pinned-lane flag, or None if nothing fits right now."""
+        if prefer_pinned \
+                and self._pinned_used + nbytes <= self.pinned_bytes:
+            self._pinned_used += nbytes
+            return True
+        general_cap = self.limit_bytes - self.pinned_bytes
+        if self._used + nbytes <= general_cap:
+            self._used += nbytes
+            return False
+        return None
+
+    # -- API ---------------------------------------------------------------
+    def try_alloc(self, nbytes: int, prefer_pinned: bool = True
+                  ) -> Optional[HostAllocation]:
+        """Non-blocking (reference HostAlloc.tryAlloc)."""
+        with self._lock:
+            lane = self._try_reserve(nbytes, prefer_pinned)
+        if lane is None:
+            return None
+        return HostAllocation(np.empty(nbytes, np.uint8), nbytes, lane,
+                              self)
+
+    def alloc(self, nbytes: int, prefer_pinned: bool = True,
+              timeout_s: float = 30.0) -> HostAllocation:
+        """Blocking: waits for releases like the reference's synchronous
+        host alloc; HostOOM after timeout_s (callers' retry/split logic
+        then shrinks the request)."""
+        if nbytes > self.limit_bytes:
+            raise HostOOM(
+                f"request {nbytes} exceeds host limit {self.limit_bytes}")
+        deadline = None
+        with self._lock:
+            while True:
+                lane = self._try_reserve(nbytes, prefer_pinned)
+                if lane is not None:
+                    break
+                import time
+                if deadline is None:
+                    deadline = time.monotonic() + timeout_s
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._lock.wait(remaining):
+                    raise HostOOM(
+                        f"host allocation of {nbytes} bytes timed out "
+                        f"({self.used_bytes}/{self.limit_bytes} in use)")
+        return HostAllocation(np.empty(nbytes, np.uint8), nbytes, lane,
+                              self)
+
+    def _release(self, a: HostAllocation) -> None:
+        with self._lock:
+            if a.pinned:
+                self._pinned_used -= a.nbytes
+            else:
+                self._used -= a.nbytes
+            self._lock.notify_all()
+
+
+_DEFAULT: Optional[HostAlloc] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def host_alloc(conf=None) -> HostAlloc:
+    """Process-wide pool sized from spark.rapids.memory.host.* confs."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                from ..config import HOST_SPILL_LIMIT, active_conf
+                c = conf or active_conf()
+                limit = c.get(HOST_SPILL_LIMIT)
+                _DEFAULT = HostAlloc(limit, pinned_bytes=limit // 4)
+    return _DEFAULT

@@ -1,0 +1,174 @@
+"""Native host-runtime library: build-on-first-use C++ via ctypes.
+
+The reference's host runtime leans on external native libraries (nvcomp
+LZ4 for shuffle compression, JCudfSerialization framing, RMM bookkeeping —
+SURVEY §2.9). This package holds the TPU build's native pieces, compiled
+from `src/` with g++ at first use and cached next to the sources. Python
+fallbacks exist for every entry point so the engine still runs (slower,
+or with codec COPY) where a toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "blockcodec.cpp")
+_SO = os.path.join(_HERE, "src", "libtpublockcodec.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _compile_so() -> None:
+    tmp = _SO + ".tmp"
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+        check=True, capture_output=True, timeout=120)
+    os.replace(tmp, _SO)
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    try:
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _compile_so()
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # stale/foreign binary (e.g. wrong arch): rebuild from source
+            _compile_so()
+            lib = ctypes.CDLL(_SO)
+    except (OSError, subprocess.SubprocessError):
+        _build_failed = True
+        return None
+    i64, u64, u8p = (ctypes.c_int64, ctypes.c_uint64,
+                     ctypes.POINTER(ctypes.c_uint8))
+    lib.tpu_lz4_compress_bound.restype = i64
+    lib.tpu_lz4_compress_bound.argtypes = [i64]
+    lib.tpu_lz4_compress.restype = i64
+    lib.tpu_lz4_compress.argtypes = [u8p, i64, u8p, i64]
+    lib.tpu_lz4_decompress.restype = i64
+    lib.tpu_lz4_decompress.argtypes = [u8p, i64, u8p, i64]
+    lib.tpu_xxh64.restype = u64
+    lib.tpu_xxh64.argtypes = [u8p, i64, u64]
+    return lib
+
+
+def native_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None when g++/dlopen is unavailable."""
+    global _lib
+    if _lib is None and not _build_failed:
+        with _lock:
+            if _lib is None and not _build_failed:
+                _lib = _build()
+    return _lib
+
+
+def lz4_available() -> bool:
+    return native_lib() is not None
+
+
+def _as_u8p(buf) -> "ctypes.POINTER(ctypes.c_uint8)":
+    return ctypes.cast(
+        (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+        if isinstance(buf, (bytes, bytearray)) else buf,
+        ctypes.POINTER(ctypes.c_uint8))
+
+
+def lz4_compress(data: bytes) -> bytes:
+    lib = native_lib()
+    if lib is None:
+        raise RuntimeError("native LZ4 codec unavailable (no g++)")
+    bound = lib.tpu_lz4_compress_bound(len(data))
+    dst = ctypes.create_string_buffer(bound)
+    n = lib.tpu_lz4_compress(
+        _as_u8p(data), len(data),
+        ctypes.cast(dst, ctypes.POINTER(ctypes.c_uint8)), bound)
+    if n < 0:
+        raise RuntimeError("LZ4 compression failed")
+    return dst.raw[:n]
+
+
+def lz4_decompress(data: bytes, raw_len: int) -> bytes:
+    lib = native_lib()
+    if lib is None:
+        raise RuntimeError("native LZ4 codec unavailable (no g++)")
+    dst = ctypes.create_string_buffer(max(raw_len, 1))
+    n = lib.tpu_lz4_decompress(
+        _as_u8p(data), len(data),
+        ctypes.cast(dst, ctypes.POINTER(ctypes.c_uint8)), raw_len)
+    if n != raw_len:
+        raise ValueError("corrupt LZ4 block")
+    return dst.raw[:raw_len]
+
+
+def _xxh64_py(data: bytes, seed: int) -> int:
+    """Pure-python xxhash64 (canonical constants) fallback."""
+    M = (1 << 64) - 1
+    P1, P2, P3, P4, P5 = (0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F,
+                          0x165667B19E3779F9, 0x85EBCA77C2B2AE63,
+                          0x27D4EB2F165667C5)
+
+    def rotl(v, r):
+        return ((v << r) | (v >> (64 - r))) & M
+
+    n = len(data)
+    i = 0
+    if n >= 32:
+        v1 = (seed + P1 + P2) & M
+        v2 = (seed + P2) & M
+        v3 = seed & M
+        v4 = (seed - P1) & M
+        while i + 32 <= n:
+            for j, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 8 * j:i + 8 * j + 8], "little")
+                v = rotl((v + lane * P2) & M, 31) * P1 & M
+                if j == 0:
+                    v1 = v
+                elif j == 1:
+                    v2 = v
+                elif j == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 32
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+        for v in (v1, v2, v3, v4):
+            h ^= rotl(v * P2 & M, 31) * P1 & M
+            h = (h * P1 + P4) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while i + 8 <= n:
+        lane = int.from_bytes(data[i:i + 8], "little")
+        h ^= rotl(lane * P2 & M, 31) * P1 & M
+        h = (rotl(h, 27) * P1 + P4) & M
+        i += 8
+    if i + 4 <= n:
+        h ^= int.from_bytes(data[i:i + 4], "little") * P1 & M
+        h = (rotl(h, 23) * P2 + P3) & M
+        i += 4
+    while i < n:
+        h ^= data[i] * P5 & M
+        h = rotl(h, 11) * P1 & M
+        i += 1
+    h ^= h >> 33
+    h = h * P2 & M
+    h ^= h >> 29
+    h = h * P3 & M
+    h ^= h >> 32
+    return h
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    lib = native_lib()
+    if lib is None:
+        return _xxh64_py(data, seed)
+    return int(lib.tpu_xxh64(_as_u8p(data), len(data), seed))

@@ -54,13 +54,30 @@ def _fmix(h1, length):
     return h1
 
 
+def _use_pallas() -> bool:
+    """Static (trace-time) tier choice: the Pallas kernel on real TPU
+    when spark.rapids.tpu.pallas.enabled, else the fused-XLA path."""
+    from ..config import PALLAS_ENABLED, active_conf
+    from .pallas_kernels import on_tpu
+    try:
+        return on_tpu() and active_conf().get(PALLAS_ENABLED)
+    except Exception:  # noqa: BLE001 — conf unavailable during early init
+        return False
+
+
 def murmur3_int(v, seed):
     """v: int32 lanes; seed: uint32 lanes. Spark Murmur3_x86_32.hashInt."""
+    if _use_pallas():
+        from .pallas_kernels import murmur3_int_lanes
+        return murmur3_int_lanes(v, seed)
     k1 = _mix_k1(v.astype(jnp.uint32))
     return _fmix(_mix_h1(seed, k1), 4)
 
 
 def murmur3_long(v, seed):
+    if _use_pallas():
+        from .pallas_kernels import murmur3_long_lanes
+        return murmur3_long_lanes(v, seed)
     v = v.astype(jnp.uint64)
     low = (v & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
     high = (v >> 32).astype(jnp.uint32)

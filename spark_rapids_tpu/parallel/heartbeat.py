@@ -1,0 +1,118 @@
+"""Heartbeat-based peer discovery and liveness — the reference's
+RapidsShuffleHeartbeatManager (driver) / RapidsShuffleHeartbeatEndpoint
+(executor), which bootstrap UCX peer identity through driver RPC before
+any shuffle data moves (Plugin.scala:417-437 registration; SURVEY §2.5).
+
+TPU shape: the accelerated data plane is XLA collectives over ICI, which
+need every mesh participant alive before a program launches — exactly the
+problem the reference's heartbeats solve for UCX. The manager is the
+driver-side registry; each executor runs an endpoint thread that
+heartbeats on an interval. A peer missing `timeout` seconds of beats is
+declared dead, and `live_peers()` feeds the exchange planner (a dead peer
+means: fail fast and let task retry reschedule, the reference's recovery
+model — SURVEY §5 'no elastic re-sharding').
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class PeerInfo:
+    __slots__ = ("executor_id", "host", "slot", "registered_at",
+                 "last_beat")
+
+    def __init__(self, executor_id: str, host: str, slot: int, now: float):
+        self.executor_id = executor_id
+        self.host = host
+        self.slot = slot
+        self.registered_at = now
+        self.last_beat = now
+
+
+class HeartbeatManager:
+    """Driver-side registry (reference RapidsShuffleHeartbeatManager)."""
+
+    def __init__(self, timeout_s: float = 10.0):
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._peers: Dict[str, PeerInfo] = {}
+        self._next_slot = 0
+
+    def register(self, executor_id: str, host: str = "local") -> List[PeerInfo]:
+        """Executor start: returns all currently-known peers (the
+        reference's RegisterExecutor reply carries peer identities so
+        clients can connect eagerly)."""
+        now = time.monotonic()
+        with self._lock:
+            if executor_id not in self._peers:
+                self._peers[executor_id] = PeerInfo(
+                    executor_id, host, self._next_slot, now)
+                self._next_slot += 1
+            else:
+                self._peers[executor_id].last_beat = now
+            return [p for p in self._peers.values()
+                    if p.executor_id != executor_id]
+
+    def heartbeat(self, executor_id: str) -> List[PeerInfo]:
+        """Periodic beat: refreshes liveness, returns peers registered
+        since this executor last heard (delta updates, like the
+        reference's ExecutorHeartbeat reply)."""
+        now = time.monotonic()
+        with self._lock:
+            me = self._peers.get(executor_id)
+            if me is None:
+                return self.register(executor_id)
+            prev = me.last_beat
+            me.last_beat = now
+            return [p for p in self._peers.values()
+                    if p.executor_id != executor_id
+                    and p.registered_at > prev]
+
+    def live_peers(self) -> List[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [p.executor_id for p in self._peers.values()
+                    if now - p.last_beat <= self.timeout_s]
+
+    def dead_peers(self) -> List[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [p.executor_id for p in self._peers.values()
+                    if now - p.last_beat > self.timeout_s]
+
+
+class HeartbeatEndpoint:
+    """Executor-side beat thread (reference
+    RapidsShuffleHeartbeatEndpoint with its scheduled executor)."""
+
+    def __init__(self, manager: HeartbeatManager, executor_id: str,
+                 interval_s: float = 1.0,
+                 on_new_peer: Optional[Callable[[PeerInfo], None]] = None):
+        self.manager = manager
+        self.executor_id = executor_id
+        self.interval_s = interval_s
+        self.on_new_peer = on_new_peer
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        for p in self.manager.register(self.executor_id):
+            if self.on_new_peer:
+                self.on_new_peer(p)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"heartbeat-{self.executor_id}")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            for p in self.manager.heartbeat(self.executor_id):
+                if self.on_new_peer:
+                    self.on_new_peer(p)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,114 @@
+"""Cost-based optimizer — the reference's CostBasedOptimizer.scala:1-60
+(optional pass deciding GPU-vs-CPU placement per section from operator
+cost estimates; off by default via spark.rapids.sql.optimizer.enabled,
+same as the reference).
+
+TPU cost shape: a device operator pays a fixed program-dispatch cost
+(tens of microseconds — dominated by host→device launch and the XLA
+runtime) plus a tiny per-row cost at HBM bandwidth; the host row engine
+pays a large per-row interpreter cost but no dispatch. Row↔columnar
+transitions at host/device boundaries cost per-row transfer. For tiny
+inputs the dispatch dominates and the host engine wins — exactly the
+sections the reference's CBO keeps on CPU.
+
+The pass runs over the tagged PlanMeta tree and may flip device-eligible
+Project/Filter nodes (the operators with a host implementation,
+exec/fallback.py) to host placement when the modeled host cost is lower.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import logical as L
+
+# model constants (microseconds); coarse on purpose — the decision only
+# needs to be right in the regimes where the two engines differ by 10x+
+DEVICE_DISPATCH_US = 150.0     # one XLA program launch
+DEVICE_ROW_US = 0.00002        # ~50 GB/s effective over ~1KB rows
+HOST_ROW_US = 1.0              # Python row interpreter
+TRANSITION_ROW_US = 0.5        # to_pylist / from_pydict per row, per side
+
+
+def estimate_rows(plan: L.LogicalPlan) -> Optional[int]:
+    """Crude row-count estimate threaded from scan statistics (Spark
+    sizeInBytes statistics analog; None = unknown)."""
+    from .overrides import estimate_plan_size
+    if isinstance(plan, L.LogicalRange):
+        if plan.step > 0:
+            return max(0, (plan.end - plan.start + plan.step - 1)
+                       // plan.step)
+        return max(0, (plan.start - plan.end - plan.step - 1)
+                   // -plan.step)
+    if isinstance(plan, L.LogicalScan):
+        est = getattr(plan.source, "estimated_num_rows", None)
+        if est is not None:
+            n = est() if callable(est) else est
+            if n is not None:
+                return int(n)
+        size = estimate_plan_size(plan)
+        if size is None:
+            return None
+        width = max(8, 8 * len(plan.schema.fields))
+        return max(1, size // width)
+    if isinstance(plan, L.LogicalFilter):
+        base = estimate_rows(plan.children[0])
+        return None if base is None else max(1, int(base * 0.5))
+    if isinstance(plan, (L.LogicalProject, L.LogicalSort, L.LogicalSample,
+                         L.LogicalRepartition)):
+        return estimate_rows(plan.children[0])
+    if isinstance(plan, L.LogicalLimit):
+        base = estimate_rows(plan.children[0])
+        return plan.limit if base is None else min(plan.limit, base)
+    if isinstance(plan, L.LogicalUnion):
+        parts = [estimate_rows(c) for c in plan.children]
+        if any(p is None for p in parts):
+            return None
+        return sum(parts)
+    return None
+
+
+def device_cost_us(rows: int) -> float:
+    return DEVICE_DISPATCH_US + rows * DEVICE_ROW_US
+
+
+def host_cost_us(rows: int, needs_transitions: bool) -> float:
+    cost = rows * HOST_ROW_US
+    if needs_transitions:
+        cost += 2 * rows * TRANSITION_ROW_US
+    return cost
+
+
+class CostBasedOptimizer:
+    """Optional placement pass (reference Optimizer trait /
+    CostBasedOptimizer). Mutates PlanMeta.host_fallback."""
+
+    def __init__(self, conf):
+        self.conf = conf
+
+    def optimize(self, meta) -> None:
+        from ..exec.fallback import supports_host_eval
+        for c in meta.children:
+            self.optimize(c)
+        p = meta.plan
+        if not isinstance(p, (L.LogicalProject, L.LogicalFilter)):
+            return
+        if meta.host_fallback or not meta.can_run_on_tpu:
+            return  # already decided by capability tagging
+        exprs = list(p.exprs) if isinstance(p, L.LogicalProject) \
+            else [p.condition]
+        if not all(supports_host_eval(e) for e in exprs):
+            return
+        rows = estimate_rows(p)
+        if rows is None:
+            return
+        # a host node between device nodes pays both transitions; a host
+        # node whose child is already host-placed shares the boundary
+        child_on_host = meta.children and meta.children[0].host_fallback
+        dev = device_cost_us(rows)
+        host = host_cost_us(rows, needs_transitions=not child_on_host)
+        if host < dev:
+            meta.host_fallback = True
+            meta.cost_note = (
+                f"cost optimizer: host {host:.0f}us < device {dev:.0f}us "
+                f"for ~{rows} rows (reference CostBasedOptimizer)")

@@ -242,3 +242,39 @@ class LogicalWindow(LogicalPlan):
     def describe(self):
         return "Window [" + ", ".join(
             f"{we!r} AS {n}" for we, n in self.window_exprs) + "]"
+
+
+class LogicalRepartition(LogicalPlan):
+    """Explicit repartition (Spark df.repartition/coalesce(1); reference
+    GpuRoundRobinPartitioning / GpuSinglePartitioning exchanges)."""
+
+    def __init__(self, n_partitions: int, child: LogicalPlan,
+                 mode: str = "roundrobin"):
+        self.n_partitions = n_partitions
+        self.mode = mode  # roundrobin | single
+        self.children = (child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def describe(self):
+        return f"Repartition[{self.mode}, n={self.n_partitions}]"
+
+
+class LogicalSample(LogicalPlan):
+    """Bernoulli row sample (Spark df.sample; reference GpuSampleExec /
+    GpuPoissonSampler, basicPhysicalOperators sampling)."""
+
+    def __init__(self, fraction: float, seed: int, child: LogicalPlan):
+        assert 0.0 <= fraction <= 1.0, fraction
+        self.fraction = fraction
+        self.seed = seed
+        self.children = (child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def describe(self):
+        return f"Sample[fraction={self.fraction}, seed={self.seed}]"

@@ -71,7 +71,12 @@ class ExprMeta(BaseMeta):
                 expr = resolve(expr, input_schema)
             except (KeyError, TypeError):
                 pass  # unresolvable here (e.g. join pair scope)
-        rule = expression_rules().get(type(expr))
+        rules = expression_rules()
+        rule = None
+        for cls in type(expr).__mro__:
+            rule = rules.get(cls)
+            if rule is not None:
+                break
         return ExprMeta(expr, rule, conf, input_schema)
 
     def tag_for_tpu(self):

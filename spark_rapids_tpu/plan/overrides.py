@@ -115,6 +115,29 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
     _r(rules, datetimeexprs.AddMonths, "add_months", dtsig + integral, dtsig)
     _r(rules, datetimeexprs.LastDay, "last_day", dtsig, dtsig)
     _r(rules, datetimeexprs.TruncDate, "trunc", dtsig, dtsig)
+
+    def _tag_timezone(meta):
+        """Resolve the zone at PLAN time: unknown zones tag the expression
+        off the device instead of failing mid-kernel (reference
+        GpuTimeZoneDB load-or-fallback, TimeZoneDB.scala:61)."""
+        import struct as _struct
+
+        from ..ops.timezone import timezone_db
+        try:
+            timezone_db().tables(meta.expr.tz)
+        except (ValueError, OSError, AssertionError, IndexError,
+                TypeError, _struct.error) as e:
+            # unknown zone OR corrupt/truncated tzdata file: tag off the
+            # device either way instead of crashing planning
+            meta.will_not_work_on_tpu(f"timezone: {e}")
+
+    tssig = TypeSig.of("TIMESTAMP", "TIMESTAMP_NTZ")
+    _r(rules, datetimeexprs.FromUTCTimestamp,
+       "UTC → zone wall clock (device tz transition tables)", tssig, tssig,
+       tag_fn=_tag_timezone)
+    _r(rules, datetimeexprs.ToUTCTimestamp,
+       "zone wall clock → UTC (device tz transition tables)", tssig, tssig,
+       tag_fn=_tag_timezone)
     # math
     for c in (emath.UnaryMath, emath.Pow, emath.Atan, emath.Floor,
               emath.Ceil, emath.Round, emath.BRound):
@@ -298,6 +321,7 @@ class PlanMeta(BaseMeta):
         super().__init__()
         self.plan = plan
         self.conf = conf
+        self.host_fallback = False  # convert this node on the host row engine
         self.children = [PlanMeta(c, conf) for c in plan.children]
         self.expr_metas: List[ExprMeta] = [
             ExprMeta.wrap(e, conf, sch)
@@ -383,23 +407,69 @@ class PlanMeta(BaseMeta):
                             "byte measurement)")
         for em in self.expr_metas:
             em.tag_for_tpu()
-            if not em.can_run_on_tpu:
-                self.will_not_work_on_tpu(
-                    f"expression {type(em.expr).__name__} cannot run on TPU")
+        if any(not em.can_run_on_tpu for em in self.expr_metas):
+            if self._can_host_fallback():
+                # reference GpuOverrides.scala:4427 convertToCpu: this
+                # node runs on the host row engine; the plan stays viable
+                self.host_fallback = True
+            else:
+                for em in self.expr_metas:
+                    if not em.can_run_on_tpu:
+                        self.will_not_work_on_tpu(
+                            f"expression {type(em.expr).__name__} "
+                            "cannot run on TPU")
         name = self.plan.node_name()
         key = f"spark.rapids.sql.exec.{name}"
+        if self.host_fallback and \
+                str(self.conf._settings.get(key, "true")).lower() == "false":
+            # operator disabled entirely — fallback cannot save it either
+            self.host_fallback = False
         if str(self.conf._settings.get(key, "true")).lower() == "false":
             self.will_not_work_on_tpu(f"operator {name} disabled by {key}")
         if not self.conf.sql_enabled:
             self.will_not_work_on_tpu(
                 "spark.rapids.sql.enabled is false")
 
+    def _can_host_fallback(self) -> bool:
+        """True when this node's expressions can all run on the host row
+        engine instead (reference convertToCpu; only Project/Filter have
+        host operators today)."""
+        from ..config import CPU_FALLBACK_ENABLED
+        from ..exec.fallback import supports_host_eval
+        if not self.conf.get(CPU_FALLBACK_ENABLED):
+            return False
+        p = self.plan
+        if isinstance(p, L.LogicalProject):
+            exprs = list(p.exprs)
+        elif isinstance(p, L.LogicalFilter):
+            exprs = [p.condition]
+        else:
+            return False
+        # resolve against the child schema first: type-based checks
+        # (decimal rejection, cast targets) need real column types
+        child_schema = p.children[0].schema
+        bound = []
+        for e in exprs:
+            try:
+                bound.append(resolve(e, child_schema))
+            except (KeyError, TypeError):
+                bound.append(e)
+        return all(supports_host_eval(e) for e in bound)
+
     def explain(self, indent: int = 0, lines: Optional[List[str]] = None
                 ) -> str:
         """The reference's explain output (GpuOverrides.scala:4764)."""
         lines = [] if lines is None else lines
         mark = "*" if self.can_run_on_tpu else "!"
+        if self.host_fallback:
+            mark = "~"  # runs, but on the host row engine
         lines.append("  " * indent + f"{mark} {self.plan.describe()}")
+        if self.host_fallback:
+            note = getattr(self, "cost_note", None) \
+                or ("host row engine fallback: expression lacks a "
+                    "device kernel")
+            lines.append("  " * indent
+                         + f"    @ will run on CPU ({note})")
         for r in self._reasons:
             lines.append("  " * indent + f"    @ {r}")
         expr_reasons: List[str] = []
@@ -443,6 +513,73 @@ class PlanMeta(BaseMeta):
         exchange = ShuffleExchangeExec(part_keys, partial, mesh)
         return AggregateExec(p.group_exprs, p.aggregates, exchange,
                              mode="final")
+
+    def _host_shuffle_partitions(self) -> int:
+        """Partition count for the MULTITHREADED host shuffle, or 1 when
+        host-shuffled planning is off (it is the no-mesh fallback: the
+        always-works mode of the reference's shuffle manager)."""
+        from ..config import SHUFFLE_MODE, SHUFFLE_PARTITIONS
+        if self.conf.get(SHUFFLE_MODE).upper() != "MULTITHREADED":
+            return 1
+        return max(1, self.conf.get(SHUFFLE_PARTITIONS))
+
+    def _convert_host_shuffled_aggregate(self, p, child: TpuExec,
+                                         n_parts: int) -> TpuExec:
+        """partial → host shuffle exchange → final over partition files
+        (device memory bounded per partition; reference MULTITHREADED
+        shuffle under partial/final agg)."""
+        from ..exec.exchange import HostShuffleExchangeExec
+        from ..types import ArrayType
+        partial = AggregateExec(p.group_exprs, p.aggregates, child,
+                                mode="partial")
+        if any(isinstance(f.data_type, ArrayType)
+               for f in partial.output_schema.fields):
+            # collect_* partial buffers are list columns; the final-mode
+            # merge can't consume shuffled list buffers yet (same guard
+            # as the mesh path above) — stay single-partition
+            return AggregateExec(p.group_exprs, p.aggregates, child)
+        key_names = partial.output_schema.names[: len(p.group_exprs)]
+        part_keys = [UnresolvedAttribute(n) for n in key_names]
+        exchange = HostShuffleExchangeExec(part_keys, partial, n_parts,
+                                           self.conf)
+        return AggregateExec(p.group_exprs, p.aggregates, exchange,
+                             mode="final")
+
+    def _convert_range_partitioned_sort(self, p, child: TpuExec,
+                                        n_parts: int) -> Optional[TpuExec]:
+        """Distributed global sort: range exchange on the first sort key
+        (sampled bounds) → per-partition sort, stream in partition order
+        (reference GpuRangePartitioner + GpuSortExec over a range
+        shuffle). None when the first key isn't a plain column — the
+        planner would need a pre-projection (single-partition sort is
+        always correct)."""
+        from ..exec.exchange import HostShuffleExchangeExec
+        from ..exec.sort import PartitionWiseSortExec, resolve_sort_orders
+        try:
+            orders = resolve_sort_orders(p.orders, child.output_schema)
+        except (AssertionError, KeyError, TypeError):
+            return None
+        first = orders[0]
+        exchange = HostShuffleExchangeExec(
+            [], child, n_parts, self.conf, partitioning="range",
+            range_order=(first.ordinal, first.ascending,
+                         first.nulls_first))
+        return PartitionWiseSortExec(p.orders, exchange)
+
+    def _convert_host_shuffled_join(self, p, left: TpuExec, right: TpuExec,
+                                    n_parts: int) -> Optional[TpuExec]:
+        from ..exec.basic import bind_projection
+        from ..exec.exchange import (HostShuffleExchangeExec,
+                                     ShuffledHashJoinExec)
+        lb = bind_projection(p.left_keys, left.output_schema)
+        rb = bind_projection(p.right_keys, right.output_schema)
+        if any(l.data_type != r.data_type for l, r in zip(lb, rb)):
+            return None
+        lex = HostShuffleExchangeExec(p.left_keys, left, n_parts, self.conf)
+        rex = HostShuffleExchangeExec(p.right_keys, right, n_parts,
+                                      self.conf)
+        return ShuffledHashJoinExec(lex, rex, p.left_keys, p.right_keys,
+                                    p.join_type, condition=p.condition)
 
     def _convert_distributed_join(self, p, left: TpuExec, right: TpuExec,
                                   mesh) -> Optional[TpuExec]:
@@ -504,12 +641,30 @@ class PlanMeta(BaseMeta):
             out = self._convert_distributed_join(p, kids[0], kids[1], mesh)
             if out is not None:
                 return out
+        n_parts = self._host_shuffle_partitions()
+        if mesh is None and n_parts > 1:
+            out = self._convert_host_shuffled_join(p, kids[0], kids[1],
+                                                   n_parts)
+            if out is not None:
+                return out
         return HashJoinExec(kids[0], kids[1], p.left_keys, p.right_keys,
                             p.join_type, condition=p.condition)
 
+    def _convert_host_node(self, p, child: TpuExec) -> TpuExec:
+        """ColumnarToRow → host row operator → RowToColumnar (reference
+        transition insertion, GpuTransitionOverrides.scala:50)."""
+        from ..exec.fallback import (ColumnarToRowExec, HostFilterExec,
+                                     HostProjectExec, RowToColumnarExec)
+        rows_in = ColumnarToRowExec(child)
+        if isinstance(p, L.LogicalProject):
+            host: TpuExec = HostProjectExec(p.exprs, rows_in)
+        else:
+            host = HostFilterExec(p.condition, rows_in)
+        return RowToColumnarExec(host, host.output_schema)
+
     def convert(self) -> TpuExec:
         p = self.plan
-        if isinstance(p, L.LogicalFilter) \
+        if isinstance(p, L.LogicalFilter) and not self.host_fallback \
                 and isinstance(p.children[0], L.LogicalScan):
             # predicate pushdown: hand simple conjuncts to the source for
             # footer-stats row-group pruning; the Filter stays for
@@ -533,18 +688,40 @@ class PlanMeta(BaseMeta):
         if isinstance(p, L.LogicalRange):
             return RangeExec(p.start, p.end, p.step, name=p.name)
         if isinstance(p, L.LogicalProject):
+            if self.host_fallback:
+                return self._convert_host_node(p, kids[0])
             return ProjectExec(p.exprs, kids[0])
         if isinstance(p, L.LogicalFilter):
+            if self.host_fallback:
+                return self._convert_host_node(p, kids[0])
             return FilterExec(p.condition, kids[0])
         if isinstance(p, L.LogicalAggregate):
             mesh = self._plan_mesh()
             if mesh is not None and p.group_exprs:
                 return self._convert_distributed_aggregate(p, kids[0], mesh)
+            n_parts = self._host_shuffle_partitions()
+            if n_parts > 1 and p.group_exprs:
+                return self._convert_host_shuffled_aggregate(
+                    p, kids[0], n_parts)
             return AggregateExec(p.group_exprs, p.aggregates, kids[0])
         if isinstance(p, L.LogicalSort):
             if p.limit is None:
+                n_parts = self._host_shuffle_partitions()
+                if n_parts > 1 and self._plan_mesh() is None:
+                    out = self._convert_range_partitioned_sort(
+                        p, kids[0], n_parts)
+                    if out is not None:
+                        return out
                 return SortExec(p.orders, kids[0])
             return TopNExec(p.limit, p.orders, kids[0], offset=p.offset)
+        if isinstance(p, L.LogicalRepartition):
+            from ..exec.exchange import HostShuffleExchangeExec
+            return HostShuffleExchangeExec(
+                [], kids[0], p.n_partitions, self.conf,
+                partitioning=p.mode)
+        if isinstance(p, L.LogicalSample):
+            from ..exec.basic import SampleExec
+            return SampleExec(p.fraction, p.seed, kids[0])
         if isinstance(p, L.LogicalLimit):
             return GlobalLimitExec(p.limit, kids[0], offset=p.offset)
         if isinstance(p, L.LogicalUnion):
@@ -571,9 +748,15 @@ class TpuOverrides:
     def wrap_and_tag(self, plan: L.LogicalPlan) -> PlanMeta:
         meta = PlanMeta(plan, self.conf)
         meta.tag_for_tpu()
+        from ..config import OPTIMIZER_ENABLED
+        if self.conf.get(OPTIMIZER_ENABLED):
+            from .cost import CostBasedOptimizer
+            CostBasedOptimizer(self.conf).optimize(meta)
         return meta
 
     def apply(self, plan: L.LogicalPlan) -> TpuExec:
+        from ..udf_compiler import maybe_compile_plan_udfs
+        plan = maybe_compile_plan_udfs(plan, self.conf)
         meta = self.wrap_and_tag(plan)
         if not self._all_ok(meta):
             raise PlanNotSupported(meta.explain())

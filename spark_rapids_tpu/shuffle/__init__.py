@@ -1,0 +1,19 @@
+"""Host shuffle data plane — the reference's MULTITHREADED shuffle mode
+(RapidsShuffleInternalManagerBase.scala:238 writer / :569 reader; SURVEY
+§2.5): partition blocks serialized with a native LZ4 codec on a writer
+thread pool into per-map data+index files, fetched and decoded on a reader
+pool. This is the always-works mode; the ICI all-to-all exchange
+(parallel/exchange.py) is the accelerated data plane, like the reference's
+UCX mode.
+"""
+
+from .manager import (HostShuffleManager, HostShuffleReader,
+                      HostShuffleWriter, shuffle_manager)
+from .serializer import (CODEC_COPY, CODEC_LZ4, deserialize_batch,
+                         serialize_batch)
+
+__all__ = [
+    "HostShuffleManager", "HostShuffleReader", "HostShuffleWriter",
+    "shuffle_manager", "serialize_batch", "deserialize_batch",
+    "CODEC_COPY", "CODEC_LZ4",
+]

@@ -1,0 +1,221 @@
+"""Host shuffle manager — MULTITHREADED mode (the reference's default:
+RapidsShuffleInternalManagerBase.scala:238 threaded writers, :569 threaded
+readers, over Spark's file-based sort shuffle; SURVEY §2.5 + §3.5).
+
+Disk layout mirrors Spark's sort-shuffle contract: one data file + one
+index per map task. Partition blocks are serialized + LZ4-compressed in
+parallel on the writer pool (serialization dominates, so this is where the
+threads pay off), then written sequentially in partition order; the index
+records the partition byte ranges. Readers fetch a partition's segment
+from every map output and decode blocks on the reader pool.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..columnar.batch import ColumnarBatch
+from ..config import (SHUFFLE_READER_THREADS, SHUFFLE_WRITER_THREADS,
+                      SPILL_DIR, RapidsConf, active_conf)
+from ..types import Schema
+from .serializer import deserialize_batch, host_gather_batch, serialize_batch
+
+
+class HostShuffleHandle:
+    """Registration record (Spark's ShuffleHandle analog)."""
+
+    def __init__(self, shuffle_id: int, n_partitions: int, schema: Schema):
+        self.shuffle_id = shuffle_id
+        self.n_partitions = n_partitions
+        self.schema = schema
+        self.map_outputs: List[str] = []  # data file per completed map task
+
+
+class HostShuffleWriter:
+    """Writes one map task's partitioned blocks (reference
+    RapidsShuffleThreadedWriterBase)."""
+
+    def __init__(self, handle: HostShuffleHandle, map_id: int,
+                 manager: "HostShuffleManager",
+                 conf: Optional[RapidsConf] = None):
+        self.handle = handle
+        self.map_id = map_id
+        self.manager = manager
+        conf = conf or active_conf()
+        self._pool = manager.writer_pool(conf)
+        self.bytes_written = 0
+
+    def write(self, partitioned: Sequence[List[ColumnarBatch]]) -> None:
+        """partitioned[p] = list of batches for partition p. Serialization
+        (the expensive part: host gather + LZ4) fans out on the writer
+        pool; the file write is sequential in partition order so the index
+        stays a flat range table."""
+        n = self.handle.n_partitions
+        assert len(partitioned) == n
+        jobs = [(p, i, self._pool.submit(serialize_batch, b))
+                for p in range(n) for i, b in enumerate(partitioned[p])]
+        frames: Dict[tuple, bytes] = {}
+        for p, i, fut in jobs:
+            frames[(p, i)] = fut.result()
+        data_path = self.manager.map_data_path(self.handle.shuffle_id,
+                                               self.map_id)
+        offsets = [0] * (n + 1)
+        with open(data_path + ".tmp", "wb") as f:
+            pos = 0
+            for p in range(n):
+                for i in range(len(partitioned[p])):
+                    frame = frames[(p, i)]
+                    f.write(struct.pack("<Q", len(frame)))
+                    f.write(frame)
+                    pos += 8 + len(frame)
+                offsets[p + 1] = pos
+        os.replace(data_path + ".tmp", data_path)
+        with open(data_path + ".index", "wb") as f:
+            f.write(struct.pack(f"<{n + 1}Q", *offsets))
+        self.bytes_written = offsets[n]
+        self.handle.map_outputs.append(data_path)
+
+
+class HostShuffleReader:
+    """Reads one partition across all map outputs (reference
+    RapidsShuffleThreadedReaderBase / the reduce-side fetch)."""
+
+    def __init__(self, handle: HostShuffleHandle,
+                 manager: "HostShuffleManager",
+                 conf: Optional[RapidsConf] = None):
+        self.handle = handle
+        self.manager = manager
+        conf = conf or active_conf()
+        self._pool = manager.reader_pool(conf)
+        #: per-map index table cache: one parse per map output, not one
+        #: per (map, partition) pair
+        self._index_cache: Dict[str, Tuple[int, ...]] = {}
+
+    def _index(self, data_path: str) -> Tuple[int, ...]:
+        cached = self._index_cache.get(data_path)
+        if cached is None:
+            n = self.handle.n_partitions
+            with open(data_path + ".index", "rb") as f:
+                cached = struct.unpack(f"<{n + 1}Q", f.read(8 * (n + 1)))
+            self._index_cache[data_path] = cached
+        return cached
+
+    def _fetch_segment(self, data_path: str, partition: int) -> List[bytes]:
+        offsets = self._index(data_path)
+        lo, hi = offsets[partition], offsets[partition + 1]
+        frames: List[bytes] = []
+        if hi > lo:
+            with open(data_path, "rb") as f:
+                f.seek(lo)
+                seg = f.read(hi - lo)
+            p = 0
+            while p < len(seg):
+                (ln,) = struct.unpack_from("<Q", seg, p)
+                frames.append(seg[p + 8: p + 8 + ln])
+                p += 8 + ln
+        return frames
+
+    def read_partition(self, partition: int) -> Iterator[ColumnarBatch]:
+        segs = list(self._pool.map(
+            lambda path: self._fetch_segment(path, partition),
+            self.handle.map_outputs))
+        frames = [fr for seg in segs for fr in seg]
+        schema = self.handle.schema
+        yield from self._pool.map(
+            lambda fr: deserialize_batch(fr, schema), frames)
+
+
+class HostShuffleManager:
+    """Process-wide registry + block file manager (Spark's ShuffleManager
+    SPI + RapidsDiskBlockManager)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._handles: Dict[int, HostShuffleHandle] = {}
+        self._root: Optional[str] = None
+        self._writer_pool: Optional[ThreadPoolExecutor] = None
+        self._reader_pool: Optional[ThreadPoolExecutor] = None
+
+    # -- dirs & pools ------------------------------------------------------
+    def root_dir(self, conf: Optional[RapidsConf] = None) -> str:
+        with self._lock:
+            if self._root is None:
+                conf = conf or active_conf()
+                base = conf.get(SPILL_DIR) or tempfile.gettempdir()
+                self._root = tempfile.mkdtemp(prefix="tpu-shuffle-",
+                                              dir=base)
+            return self._root
+
+    def map_data_path(self, shuffle_id: int, map_id: int) -> str:
+        return os.path.join(self.root_dir(),
+                            f"shuffle_{shuffle_id}_{map_id}.data")
+
+    def writer_pool(self, conf: RapidsConf) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._writer_pool is None:
+                self._writer_pool = ThreadPoolExecutor(
+                    max_workers=max(1, conf.get(SHUFFLE_WRITER_THREADS)),
+                    thread_name_prefix="shuffle-writer")
+            return self._writer_pool
+
+    def reader_pool(self, conf: RapidsConf) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._reader_pool is None:
+                self._reader_pool = ThreadPoolExecutor(
+                    max_workers=max(1, conf.get(SHUFFLE_READER_THREADS)),
+                    thread_name_prefix="shuffle-reader")
+            return self._reader_pool
+
+    # -- lifecycle ---------------------------------------------------------
+    def register(self, n_partitions: int, schema: Schema
+                 ) -> HostShuffleHandle:
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            h = HostShuffleHandle(sid, n_partitions, schema)
+            self._handles[sid] = h
+            return h
+
+    def unregister(self, handle: HostShuffleHandle) -> None:
+        with self._lock:
+            self._handles.pop(handle.shuffle_id, None)
+        for path in handle.map_outputs:
+            for p in (path, path + ".index"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        handle.map_outputs.clear()
+
+
+_MANAGER: Optional[HostShuffleManager] = None
+_MANAGER_LOCK = threading.Lock()
+
+
+def shuffle_manager() -> HostShuffleManager:
+    global _MANAGER
+    if _MANAGER is None:
+        with _MANAGER_LOCK:
+            if _MANAGER is None:
+                _MANAGER = HostShuffleManager()
+    return _MANAGER
+
+
+def partition_batch_host(batch: ColumnarBatch, pid: np.ndarray,
+                         n_partitions: int) -> List[ColumnarBatch]:
+    """Split a batch into per-partition compact host batches given the
+    device-computed partition id per row (Spark-exact murmur3 pmod from
+    parallel/exchange.partition_ids). Stable within a partition."""
+    order = np.argsort(pid, kind="stable")
+    sorted_pid = pid[order]
+    bounds = np.searchsorted(sorted_pid, np.arange(n_partitions + 1))
+    return [host_gather_batch(batch, order[bounds[p]: bounds[p + 1]])
+            for p in range(n_partitions)]

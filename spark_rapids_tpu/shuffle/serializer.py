@@ -1,0 +1,265 @@
+"""Columnar batch wire format for the host shuffle (and the disk spill /
+dump tooling): the reference's GpuColumnarBatchSerializer.scala:127 +
+JCudfSerialization host-buffer framing, with nvcomp LZ4 replaced by the
+native block codec (native/src/blockcodec.cpp).
+
+Frame layout (little-endian):
+
+    magic "TPUSHUF1" | u8 version | u8 codec | u16 flags
+    u64 num_rows | u64 schema_hash | u64 raw_len | u64 comp_len
+    u64 checksum (xxh64 of the stored payload)
+    u32 nbuf | nbuf * u64 buffer byte lengths
+    payload (concatenated buffers, possibly compressed)
+
+The buffer *structure* is fully determined by the schema (the reader
+always knows it from the plan), so the header carries only byte lengths
+plus a schema fingerprint to catch mismatches. Buffers per column, in
+order, trimmed to the logical row count (padding never hits the wire):
+
+    fixed-width: validity bitmask (packbits), data[:num_rows]
+    string:      validity bitmask, offsets[:num_rows+1] rebased to 0,
+                 bytes[:total]
+    array:       validity bitmask, offsets[:num_rows+1] rebased to 0,
+                 then the child's buffers for offsets[num_rows] elements
+    struct:      validity bitmask, then each child's buffers
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+import numpy as np
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import (ArrayColumn, Column, StringColumn,
+                               StructColumn, bucket_capacity)
+from ..native import lz4_available, lz4_compress, lz4_decompress, xxh64
+from ..types import Schema
+
+MAGIC = b"TPUSHUF1"
+VERSION = 1
+CODEC_COPY = 0  # reference CopyCompressionCodec
+CODEC_LZ4 = 1   # reference NvcompLZ4CompressionCodec (host analog)
+
+_HEADER = struct.Struct("<8sBBHQQQQQI")
+
+
+def schema_fingerprint(schema: Schema) -> int:
+    return xxh64(repr([(f.name, f.data_type.simple_name())
+                       for f in schema.fields]).encode())
+
+
+# ---------------------------------------------------------------------------
+# host-side column encode (device → trimmed numpy buffers)
+# ---------------------------------------------------------------------------
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def _rebase_offsets(off: np.ndarray, n: int) -> np.ndarray:
+    out = off[: n + 1].astype(np.int32, copy=True)
+    return out - out[0]
+
+
+def _encode_column(col: Column, n: int, out: List[np.ndarray]) -> None:
+    out.append(np.packbits(_np(col.validity)[:n].astype(np.bool_),
+                           bitorder="little"))
+    if isinstance(col, StringColumn):
+        off = _np(col.offsets)
+        reb = _rebase_offsets(off, n)
+        out.append(reb)
+        lo, hi = int(off[0]), int(off[n] if n else off[0])
+        out.append(_np(col.data)[lo:hi].astype(np.uint8, copy=False))
+    elif isinstance(col, ArrayColumn):
+        off = _np(col.offsets)
+        reb = _rebase_offsets(off, n)
+        out.append(reb)
+        # the child is encoded for exactly the referenced element span;
+        # shuffle rows are compacted so the span starts at offsets[0]
+        lo, hi = int(off[0]), int(off[n] if n else off[0])
+        assert lo == 0, "array columns must be compacted before serialize"
+        _encode_column(col.child, hi, out)
+    elif isinstance(col, StructColumn):
+        for ch in col.children:
+            _encode_column(ch, n, out)
+    else:
+        out.append(np.ascontiguousarray(_np(col.data)[:n]))
+
+
+def _decode_column(dtype, n: int, bufs: List[bytes], pos: int,
+                   capacity: int) -> Tuple[Column, int]:
+    import jax.numpy as jnp
+
+    from ..types import ArrayType, StringType, StructType
+
+    vbits = np.frombuffer(bufs[pos], dtype=np.uint8)
+    pos += 1
+    validity = np.unpackbits(vbits, count=n, bitorder="little").astype(
+        np.bool_) if n else np.zeros(0, np.bool_)
+    vpad = np.zeros(capacity, np.bool_)
+    vpad[:n] = validity
+
+    if isinstance(dtype, StructType):
+        kids = []
+        for f in dtype.fields:
+            k, pos = _decode_column(f.data_type, n, bufs, pos, capacity)
+            kids.append(k)
+        return StructColumn(tuple(kids), jnp.asarray(vpad), dtype), pos
+
+    if isinstance(dtype, ArrayType):
+        off = np.frombuffer(bufs[pos], dtype=np.int32)
+        pos += 1
+        opad = np.zeros(capacity + 1, np.int32)
+        opad[: n + 1] = off
+        opad[n + 1:] = off[n] if n else 0
+        child_n = int(off[n]) if n else 0
+        child_cap = bucket_capacity(max(child_n, 1))
+        child, pos = _decode_column(dtype.element_type, child_n, bufs, pos,
+                                    child_cap)
+        return ArrayColumn(child, jnp.asarray(opad), jnp.asarray(vpad),
+                           dtype), pos
+
+    if dtype.jnp_dtype is None or isinstance(dtype, StringType):
+        off = np.frombuffer(bufs[pos], dtype=np.int32)
+        pos += 1
+        data = np.frombuffer(bufs[pos], dtype=np.uint8)
+        pos += 1
+        opad = np.zeros(capacity + 1, np.int32)
+        opad[: n + 1] = off
+        opad[n + 1:] = off[n] if n else 0
+        byte_cap = bucket_capacity(max(len(data), 1))
+        dpad = np.zeros(byte_cap, np.uint8)
+        dpad[: len(data)] = data
+        return StringColumn(jnp.asarray(dpad), jnp.asarray(opad),
+                            jnp.asarray(vpad), dtype), pos
+
+    data = np.frombuffer(bufs[pos], dtype=dtype.jnp_dtype)
+    pos += 1
+    dpad = np.zeros(capacity, dtype.jnp_dtype)
+    dpad[:n] = data
+    return Column(jnp.asarray(dpad), jnp.asarray(vpad), dtype), pos
+
+
+# ---------------------------------------------------------------------------
+# frame encode/decode
+# ---------------------------------------------------------------------------
+
+def serialize_batch(batch: ColumnarBatch, codec: int = None) -> bytes:
+    """Batch → one self-checking frame. Device padding is trimmed; string
+    and array payloads keep only referenced bytes/elements."""
+    if codec is None:
+        codec = CODEC_LZ4 if lz4_available() else CODEC_COPY
+    n = batch.num_rows_host
+    bufs: List[np.ndarray] = []
+    for col in batch.columns:
+        _encode_column(col, n, bufs)
+    raw_parts = [np.ascontiguousarray(b).tobytes() for b in bufs]
+    raw = b"".join(raw_parts)
+    if codec == CODEC_LZ4:
+        payload = lz4_compress(raw)
+        if len(payload) >= len(raw):  # incompressible: store raw
+            codec, payload = CODEC_COPY, raw
+    else:
+        payload = raw
+    header = _HEADER.pack(
+        MAGIC, VERSION, codec, 0, n, schema_fingerprint(batch.schema),
+        len(raw), len(payload), xxh64(payload), len(raw_parts))
+    sizes = struct.pack(f"<{len(raw_parts)}Q", *map(len, raw_parts))
+    return header + sizes + payload
+
+
+def deserialize_batch(frame: bytes, schema: Schema) -> ColumnarBatch:
+    (magic, version, codec, _flags, n, shash, raw_len, comp_len, chk,
+     nbuf) = _HEADER.unpack_from(frame, 0)
+    if magic != MAGIC or version != VERSION:
+        raise ValueError("not a TPU shuffle frame")
+    if shash != schema_fingerprint(schema):
+        raise ValueError("shuffle frame schema mismatch")
+    off = _HEADER.size
+    sizes = struct.unpack_from(f"<{nbuf}Q", frame, off)
+    off += 8 * nbuf
+    payload = frame[off: off + comp_len]
+    if xxh64(payload) != chk:
+        raise ValueError("shuffle frame checksum mismatch (corrupt block)")
+    raw = lz4_decompress(payload, raw_len) if codec == CODEC_LZ4 else payload
+    bufs: List[bytes] = []
+    p = 0
+    for s in sizes:
+        bufs.append(raw[p: p + s])
+        p += s
+    capacity = bucket_capacity(max(n, 1))
+    cols: List[Column] = []
+    pos = 0
+    for f in schema.fields:
+        c, pos = _decode_column(f.data_type, n, bufs, pos, capacity)
+        cols.append(c)
+    return ColumnarBatch(cols, n, schema)
+
+
+# ---------------------------------------------------------------------------
+# host row gather (writer-side partition split)
+# ---------------------------------------------------------------------------
+
+def host_gather_column(col: Column, idx: np.ndarray) -> Column:
+    """Row-gather a device column into a compact host-backed column (used
+    by the shuffle writer to split a batch into partition blocks). The
+    result's arrays are numpy; serialize_batch consumes them directly."""
+    from ..types import ArrayType  # noqa: F401
+
+    validity = _np(col.validity)[idx] if len(idx) else np.zeros(0, np.bool_)
+    cap = bucket_capacity(max(len(idx), 1))
+    vpad = np.zeros(cap, np.bool_)
+    vpad[: len(idx)] = validity
+
+    if isinstance(col, StringColumn):
+        off = _np(col.offsets)
+        data = _np(col.data)
+        starts = off[idx]
+        lens = (off[idx + 1] - starts).astype(np.int64)
+        total = int(lens.sum())
+        new_off = np.zeros(cap + 1, np.int32)
+        np.cumsum(lens, out=new_off[1: len(idx) + 1])
+        new_off[len(idx) + 1:] = new_off[len(idx)]
+        out = np.zeros(bucket_capacity(max(total, 1)), np.uint8)
+        if total:
+            cum = np.concatenate([[0], np.cumsum(lens)[:-1]])
+            byte_idx = (np.repeat(starts, lens)
+                        + np.arange(total) - np.repeat(cum, lens))
+            out[:total] = data[byte_idx]
+        return StringColumn(out, new_off, vpad, col.dtype)
+
+    if isinstance(col, ArrayColumn):
+        off = _np(col.offsets)
+        starts = off[idx]
+        lens = (off[idx + 1] - starts).astype(np.int64)
+        total = int(lens.sum())
+        new_off = np.zeros(cap + 1, np.int32)
+        np.cumsum(lens, out=new_off[1: len(idx) + 1])
+        new_off[len(idx) + 1:] = new_off[len(idx)]
+        if total:
+            cum = np.concatenate([[0], np.cumsum(lens)[:-1]])
+            elem_idx = (np.repeat(starts, lens)
+                        + np.arange(total) - np.repeat(cum, lens))
+        else:
+            elem_idx = np.zeros(0, np.int64)
+        child = host_gather_column(col.child, elem_idx)
+        return ArrayColumn(child, new_off, vpad,
+                           col.dtype)
+
+    if isinstance(col, StructColumn):
+        kids = tuple(host_gather_column(c, idx) for c in col.children)
+        return StructColumn(kids, vpad, col.dtype)
+
+    data = _np(col.data)[idx] if len(idx) else \
+        np.zeros(0, _np(col.data).dtype)
+    dpad = np.zeros(cap, data.dtype)
+    dpad[: len(idx)] = data
+    return Column(dpad, vpad, col.dtype)
+
+
+def host_gather_batch(batch: ColumnarBatch, idx: np.ndarray
+                      ) -> ColumnarBatch:
+    cols = [host_gather_column(c, idx) for c in batch.columns]
+    return ColumnarBatch(cols, len(idx), batch.schema)

@@ -1,0 +1,104 @@
+"""Dump/debug tooling — the reference's DumpUtils.scala (dump the batches
+feeding a failing operator to parquet so the bug reproduces offline) and
+GpuCoreDumpHandler.scala:38 (ship crash diagnostics to durable storage).
+
+`dump_batch` writes one batch as parquet + a metadata sidecar;
+`dump_on_error` wraps an operator drive and dumps every input batch seen
+before the failure, plus a generated repro script, into a timestamped
+directory under spark.rapids.sql.debug.dumpPath.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from typing import Iterator, List, Optional
+
+
+def dump_batch(batch, path: str) -> str:
+    """One batch → parquet + .meta.json (reference
+    DumpUtils.dumpToParquetFile)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    import pyarrow.parquet as pq
+    table = batch.to_arrow()
+    pq.write_table(table, path)
+    meta = {
+        "num_rows": batch.num_rows_host,
+        "capacity": batch.capacity,
+        "schema": [(f.name, f.data_type.simple_name())
+                   for f in batch.schema.fields],
+        "device_size_bytes": batch.device_size_bytes(),
+    }
+    with open(path + ".meta.json", "w") as f:
+        json.dump(meta, f, indent=2)
+    return path
+
+
+class dump_on_error:
+    """Context manager around an operator drive: on exception, dump the
+    batches registered via observe() plus the traceback and a repro
+    script. Conf-gated by spark.rapids.sql.debug.dumpPath (empty = off),
+    like the reference's dump-on-failure hooks."""
+
+    def __init__(self, op_name: str, conf=None):
+        from ..config import DEBUG_DUMP_PATH, active_conf
+        c = conf or active_conf()
+        self.root = c.get(DEBUG_DUMP_PATH)
+        self.op_name = op_name
+        self._batches: List = []
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.root)
+
+    def observe(self, batch):
+        if self.enabled:
+            self._batches.append(batch)
+        return batch
+
+    def observe_iter(self, it: Iterator) -> Iterator:
+        for b in it:
+            yield self.observe(b)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is None or not self.enabled:
+            return False
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        out = os.path.join(self.root, f"{self.op_name}-{stamp}")
+        os.makedirs(out, exist_ok=True)
+        with open(os.path.join(out, "error.txt"), "w") as f:
+            f.write("".join(traceback.format_exception(exc_type, exc, tb)))
+        for i, b in enumerate(self._batches):
+            try:
+                dump_batch(b, os.path.join(out, f"input-{i:04d}.parquet"))
+            except Exception as dump_exc:  # noqa: BLE001 best-effort dump
+                with open(os.path.join(out, f"input-{i:04d}.FAILED"),
+                          "w") as f:
+                    f.write(repr(dump_exc))
+        with open(os.path.join(out, "repro.py"), "w") as f:
+            f.write(_REPRO_TEMPLATE.format(op=self.op_name))
+        return False  # never swallow the error
+
+
+_REPRO_TEMPLATE = '''\
+"""Auto-generated repro for a failed {op} drive (reference DumpUtils).
+
+Loads the dumped input batches; re-apply the failing operator manually.
+"""
+import glob
+import jax
+jax.config.update("jax_platforms", "cpu")
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+import pyarrow.parquet as pq
+
+batches = []
+for p in sorted(glob.glob(__file__.replace("repro.py", "input-*.parquet"))):
+    batches.append(ColumnarBatch.from_arrow(pq.read_table(p)))
+print(f"loaded {{len(batches)}} input batches for {op}")
+'''

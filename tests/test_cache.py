@@ -1,0 +1,58 @@
+"""In-memory table cache tests (reference ParquetCachedBatchSerializer /
+GpuInMemoryTableScanExec, cache_test.py in integration tests)."""
+
+import numpy as np
+
+
+def _sorted(rows):
+    return sorted(rows, key=repr)
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.types import LONG, STRING, Schema, StructField
+
+
+def _df(sess, n=300):
+    rng = np.random.default_rng(0)
+    data = {"k": [int(x) for x in rng.integers(0, 10, n)],
+            "s": [None if x % 5 == 0 else f"row-{x}"
+                  for x in rng.integers(0, 50, n)]}
+    sch = Schema((StructField("k", LONG), StructField("s", STRING)))
+    return sess.from_pydict(data, sch, batch_rows=64)
+
+
+def test_cache_roundtrip_and_single_materialization():
+    sess = TpuSession()
+    base = _df(sess).filter(col("k") < 7)
+    cached = base.cache()
+    rel = cached._cached_relation
+    assert not rel.is_materialized
+    first = cached.collect()
+    assert rel.is_materialized
+    assert _sorted(first) == _sorted(base.collect())
+    frames_before = rel.compressed_bytes
+    # second action re-reads the cache (no re-materialization)
+    again = cached.group_by("k").agg((F.count(), "c")).collect()
+    assert rel.compressed_bytes == frames_before
+    expect = {}
+    for k, _ in first:
+        expect[k] = expect.get(k, 0) + 1
+    assert dict(again) == expect
+
+
+def test_cache_is_compressed():
+    sess = TpuSession()
+    cached = _df(sess, 2000).cache()
+    cached.collect()
+    rel = cached._cached_relation
+    assert 0 < rel.compressed_bytes < rel.raw_bytes
+
+
+def test_unpersist_then_recompute():
+    sess = TpuSession()
+    cached = _df(sess).cache()
+    r1 = cached.collect()
+    cached.unpersist()
+    assert not cached._cached_relation.is_materialized
+    assert _sorted(cached.collect()) == _sorted(r1)

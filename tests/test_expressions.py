@@ -70,7 +70,9 @@ def test_remainder_sign(batch):
                                   Schema.of(a=INT, b=INT))
     # Java %: sign of dividend
     assert ev(col("a") % col("b"), b) == [-1, 1, -1, 1]
-    assert ev(Pmod(col("a"), col("b")), b) == [1, 1, 1, 1]
+    # Spark Pmod formula (arithmetic.scala): r = a % n; r<0 ? (r+n)%n : r
+    # — for n<0 the result can stay negative: pmod(-7,-2) = -1 in Spark
+    assert ev(Pmod(col("a"), col("b")), b) == [1, 1, -1, 1]
 
 
 def test_comparisons(batch):

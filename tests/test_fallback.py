@@ -1,0 +1,138 @@
+"""CPU fallback tests: unsupported-on-device expressions run on the host
+row engine behind ColumnarToRow/RowToColumnar transitions instead of
+failing the plan (reference: GpuOverrides.scala:4427 convertToCpu +
+integration tests' allow_non_gpu marker; SURVEY §2.2 transitions)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.exec.fallback import row_eval, supports_host_eval
+from spark_rapids_tpu.expr import stringexprs as S
+from spark_rapids_tpu.expr.core import lit
+from spark_rapids_tpu.plan.overrides import PlanNotSupported
+from spark_rapids_tpu.types import LONG, STRING, Schema, StructField
+
+
+def _schema():
+    return Schema((StructField("s", STRING), StructField("v", LONG)))
+
+
+def _data(n=120):
+    rng = np.random.default_rng(0)
+    return {
+        "s": [None if x % 7 == 0 else ["abc1", "a1b2c3", "xyz", "aa-bb",
+                                       "Hello World", ""][int(x) % 6]
+              for x in rng.integers(0, 100, n)],
+        "v": [None if x % 11 == 0 else int(x)
+              for x in rng.integers(-100, 100, n)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# host row interpreter semantics
+# ---------------------------------------------------------------------------
+
+def test_row_eval_three_valued_logic():
+    e = (col("a") > lit(1)) & (col("b") > lit(1))
+    from spark_rapids_tpu.expr.core import resolve
+    from spark_rapids_tpu.types import Schema, StructField
+    sch = Schema((StructField("a", LONG), StructField("b", LONG)))
+    b = resolve(e, sch)
+    assert row_eval(b, (2, 2)) is True
+    assert row_eval(b, (0, None)) is False      # False AND NULL = False
+    assert row_eval(b, (2, None)) is None       # True AND NULL = NULL
+
+
+def test_row_eval_divide_by_zero_is_null():
+    from spark_rapids_tpu.expr.arithmetic import Divide
+    assert row_eval(Divide(lit(1.0), lit(0.0)), ()) is None
+
+
+def test_row_eval_in_with_null_items():
+    from spark_rapids_tpu.expr.predicates import In
+    e = In(lit(5), [1, 2, None])
+    assert row_eval(e, ()) is None   # no match + null item → NULL
+    e2 = In(lit(2), [1, 2, None])
+    assert row_eval(e2, ()) is True
+
+
+def test_supports_host_eval_rejects_unknown():
+    from spark_rapids_tpu.expr.hashexprs import Murmur3Hash
+    assert not supports_host_eval(Murmur3Hash([col("s")]))
+    assert supports_host_eval(S.RLike(col("s"), r"(a)\1"))
+
+
+# ---------------------------------------------------------------------------
+# planner integration
+# ---------------------------------------------------------------------------
+
+def test_backreference_regex_falls_back_to_host():
+    """Device regex rejects backreferences; host `re` handles them — the
+    plan must sandwich a HostFilterExec between transitions."""
+    sess = TpuSession()
+    df = sess.from_pydict(_data(), _schema())
+    q = df.filter(S.RLike(col("s"), r"(a)\1"))  # 'aa-bb' rows match
+    tree = q._exec().tree_string()
+    assert "HostFilterExec" in tree
+    assert "RowToColumnarExec" in tree and "ColumnarToRowExec" in tree
+    got = q.collect()
+    expect = [(s, v) for s, v in zip(_data()["s"], _data()["v"])
+              if s is not None and "aa" in s]
+    assert sorted(got, key=repr) == sorted(expect, key=repr)
+
+
+def test_disabled_expression_falls_back_project():
+    """Disabling a device expression rule (reference
+    spark.rapids.sql.expression.* conf) reroutes the projection through
+    the host engine with identical results."""
+    on = TpuSession()
+    off = TpuSession({"spark.rapids.sql.expression.Upper": "false"})
+    data, sch = _data(), _schema()
+
+    def q(sess):
+        df = sess.from_pydict(data, sch)
+        return df.select(S.Upper(col("s")).alias("u"),
+                         (col("v") + lit(1)).alias("w"))
+
+    tree_off = q(off)._exec().tree_string()
+    assert "HostProjectExec" in tree_off
+    tree_on = q(on)._exec().tree_string()
+    assert "HostProjectExec" not in tree_on
+    assert q(on).collect() == q(off).collect()
+
+
+def test_fallback_disabled_raises_with_report():
+    sess = TpuSession({"spark.rapids.sql.cpuFallback.enabled": "false"})
+    df = sess.from_pydict(_data(), _schema())
+    with pytest.raises(PlanNotSupported) as ei:
+        df.filter(S.RLike(col("s"), r"(a)\1"))._exec()
+    assert "cannot run on TPU" in str(ei.value)
+
+
+def test_explain_marks_host_fallback():
+    sess = TpuSession()
+    df = sess.from_pydict(_data(), _schema())
+    report = df.filter(S.RLike(col("s"), r"(a)\1")).explain()
+    assert "will run on CPU" in report
+
+
+def test_host_engine_mixed_pipeline():
+    """Fallback node in the middle: device scan → host filter → device
+    aggregate keeps running on device above the transition."""
+    from spark_rapids_tpu.api import functions as F
+    sess = TpuSession()
+    data, sch = _data(200), _schema()
+    df = sess.from_pydict(data, sch)
+    q = (df.filter(S.RLike(col("s"), r"(a)\1|(b)\2"))
+           .group_by("s").agg((F.count(), "c")))
+    tree = q._exec().tree_string()
+    assert "HostFilterExec" in tree and "AggregateExec" in tree
+    got = dict((k, c) for k, c in q.collect())
+    import re as _re
+    expect = {}
+    for s in data["s"]:
+        if s is not None and _re.search(r"(a)\1|(b)\2", s):
+            expect[s] = expect.get(s, 0) + 1
+    assert got == expect

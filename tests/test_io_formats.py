@@ -87,7 +87,85 @@ def test_orc_roundtrip(tmp_path):
     assert pruned.collect() == [(v,) for v in data["s"]]
 
 
-def test_avro_gated():
+def test_avro_roundtrip(tmp_path):
+    """Self-contained avro container reader/writer (reference
+    GpuAvroScan.scala + AvroDataFileReader.scala): deflate codec,
+    nullable unions, date/timestamp logical types."""
+    from spark_rapids_tpu.io.avro import write_avro
+    from spark_rapids_tpu.types import (DATE, DOUBLE, LONG, STRING,
+                                        TIMESTAMP, Schema, StructField)
+    sch = Schema((StructField("l", LONG), StructField("d", DOUBLE),
+                  StructField("s", STRING), StructField("dt", DATE),
+                  StructField("ts", TIMESTAMP)))
+    data = {
+        "l": [1, None, -(1 << 40), 7],
+        "d": [1.5, float("inf"), None, -0.0],
+        "s": ["a", None, "värde", ""],
+        "dt": [0, 19000, None, -141427],
+        "ts": [0, None, 1_700_000_000_000_000, -1],
+    }
     sess = TpuSession()
-    with pytest.raises(ImportError):
-        sess.read_avro("/nonexistent.avro")
+    df = sess.from_pydict(data, sch)
+    path = str(tmp_path / "t.avro")
+    write_avro(df, path)
+    got = sess.read_avro(path).collect()
+    assert got == df.collect()
+    # column pruning
+    assert sess.read_avro(path, columns=["s", "l"]).collect() == \
+        [(s, l) for l, s in zip(data["l"], data["s"])]
+
+
+def test_avro_reader_against_hand_built_spec_bytes(tmp_path):
+    """Reader cross-check against a file whose bytes are written out
+    LITERALLY from the Avro 1.11 spec (no shared encoder), so a
+    symmetric encode/decode bug in this module cannot hide."""
+    import json as _json
+
+    schema = {"type": "record", "name": "r", "fields": [
+        {"name": "i", "type": ["null", "int"]},
+        {"name": "s", "type": "string"},
+    ]}
+    schema_b = _json.dumps(schema).encode()
+    sync = bytes(range(16))
+
+    def zz(v):  # zigzag varint, written independently from the spec
+        u = (v << 1) ^ (v >> 63) if v < 0 else v << 1
+        out = b""
+        while True:
+            if u < 0x80:
+                return out + bytes([u])
+            out += bytes([(u & 0x7F) | 0x80])
+            u >>= 7
+
+    header = (b"Obj\x01"
+              + zz(2)                                   # 2 meta entries
+              + zz(len(b"avro.schema")) + b"avro.schema"
+              + zz(len(schema_b)) + schema_b
+              + zz(len(b"avro.codec")) + b"avro.codec"
+              + zz(len(b"null")) + b"null"
+              + zz(0)                                    # end of map
+              + sync)
+    # rows: (7, "hi"), (None, "x"), (-3, "")
+    body = (zz(1) + zz(7) + zz(2) + b"hi"
+            + zz(0) + zz(1) + b"x"
+            + zz(1) + zz(-3) + zz(0))
+    block = zz(3) + zz(len(body)) + body + sync
+    path = str(tmp_path / "spec.avro")
+    with open(path, "wb") as f:
+        f.write(header + block)
+
+    sess = TpuSession()
+    assert sess.read_avro(path).collect() == \
+        [(7, "hi"), (None, "x"), (-3, "")]
+
+
+def test_avro_schema_mismatch_across_files_rejected(tmp_path):
+    from spark_rapids_tpu.io.avro import write_avro
+    from spark_rapids_tpu.types import INT, LONG, Schema, StructField
+    sess = TpuSession()
+    d1 = sess.from_pydict({"i": [1]}, Schema((StructField("i", INT),)))
+    d2 = sess.from_pydict({"j": [2]}, Schema((StructField("j", LONG),)))
+    write_avro(d1, str(tmp_path / "a.avro"))
+    write_avro(d2, str(tmp_path / "b.avro"))
+    with pytest.raises(ValueError, match="schema mismatch"):
+        sess.read_avro(str(tmp_path)).collect()

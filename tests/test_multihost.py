@@ -1,0 +1,52 @@
+"""Multi-host topology tests (SURVEY §2.10: ICI intra-slice + DCN
+inter-slice mapping). Real multi-process isn't available in CI; the
+topology math runs against the 8 virtual devices and fake device
+objects."""
+
+import jax
+import pytest
+
+from spark_rapids_tpu.parallel.multihost import (build_query_mesh,
+                                                 dcn_axis_size,
+                                                 group_devices_by_host,
+                                                 ici_axis_size,
+                                                 initialize_distributed,
+                                                 topology_shape)
+
+
+class _FakeDev:
+    def __init__(self, pid, i):
+        self.process_index = pid
+        self.id = i
+
+    def __repr__(self):
+        return f"dev({self.process_index},{self.id})"
+
+
+def test_single_process_initialize_is_noop():
+    assert initialize_distributed() is False  # no env, no pod metadata
+
+
+def test_group_and_shape_virtual_devices():
+    devs = jax.devices()
+    n_hosts, per_host = topology_shape(devs)
+    assert n_hosts == 1 and per_host == len(devs)
+
+
+def test_mesh_axes_single_host():
+    mesh = build_query_mesh(jax.devices())
+    assert dcn_axis_size(mesh) == 1
+    assert ici_axis_size(mesh) == len(jax.devices())
+
+
+def test_fake_multihost_grid():
+    devs = [_FakeDev(pid, i) for pid in (1, 0, 2) for i in range(4)]
+    groups = group_devices_by_host(devs)
+    assert [g[0].process_index for g in groups] == [0, 1, 2]
+    assert topology_shape(devs) == (3, 4)
+
+
+def test_ragged_topology_rejected():
+    devs = [_FakeDev(0, 0), _FakeDev(0, 1), _FakeDev(1, 0)]
+    with pytest.raises(RuntimeError, match="ragged"):
+        topology_shape(devs)

@@ -1,0 +1,52 @@
+"""Pallas murmur3 kernel parity tests (SURVEY §2.9 #40). Off-TPU the
+kernel runs under the Pallas interpreter; results must be BIT-EXACT
+against the engine's fused-XLA murmur3 (itself parity-tested against an
+independent host oracle in test_hashing.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.ops import hashing as H
+from spark_rapids_tpu.ops.pallas_kernels import (murmur3_int_lanes,
+                                                 murmur3_long_lanes)
+
+
+@pytest.mark.parametrize("n", [1, 7, 128, 1000, 256 * 128 + 3])
+def test_long_lanes_match_xla(n):
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(-(2**62), 2**62, n), jnp.int64)
+    seeds = jnp.full((n,), jnp.uint32(42))
+    xla = H.murmur3_long(data, seeds)
+    pal = murmur3_long_lanes(data, seeds, interpret=True)
+    assert (np.asarray(xla, np.uint32) == np.asarray(pal)).all()
+
+
+def test_long_lanes_edge_values():
+    vals = jnp.asarray([0, -1, 1, 2**63 - 1, -(2**63), 42], jnp.int64)
+    seeds = jnp.full((6,), jnp.uint32(42))
+    xla = H.murmur3_long(vals, seeds)
+    pal = murmur3_long_lanes(vals, seeds, interpret=True)
+    assert (np.asarray(xla, np.uint32) == np.asarray(pal)).all()
+
+
+def test_int_lanes_match_xla():
+    rng = np.random.default_rng(1)
+    data = jnp.asarray(rng.integers(-(2**31), 2**31, 5000), jnp.int32)
+    seeds = jnp.full((5000,), jnp.uint32(42))
+    xla = H.murmur3_int(data, seeds)
+    pal = murmur3_int_lanes(data, seeds, interpret=True)
+    assert (np.asarray(xla, np.uint32) == np.asarray(pal)).all()
+
+
+def test_chained_seeds_match_multi_column_hash():
+    """Column chaining: col2's seed is col1's hash — the per-row seed
+    vector path must stay exact."""
+    rng = np.random.default_rng(2)
+    c1 = jnp.asarray(rng.integers(-(2**62), 2**62, 777), jnp.int64)
+    c2 = jnp.asarray(rng.integers(-(2**31), 2**31, 777), jnp.int32)
+    seeds = jnp.full((777,), jnp.uint32(42))
+    xla = H.murmur3_int(c2, H.murmur3_long(c1, seeds))
+    h1 = murmur3_long_lanes(c1, seeds, interpret=True)
+    pal = murmur3_int_lanes(c2, h1, interpret=True)
+    assert (np.asarray(xla, np.uint32) == np.asarray(pal)).all()

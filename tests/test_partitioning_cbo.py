@@ -1,0 +1,113 @@
+"""Partitioning modes (roundrobin/single/range), repartition/sample API,
+distributed range sort, and the cost-based optimizer (reference:
+GpuRoundRobinPartitioning / GpuSinglePartitioning / GpuRangePartitioner /
+GpuSampleExec / CostBasedOptimizer.scala; SURVEY §2.5 #29, §2.2 #7,
+§2.3 Sample)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.expr.core import lit
+from spark_rapids_tpu.types import DOUBLE, LONG, STRING, Schema, StructField
+
+
+def _sorted(rows):
+    return sorted(rows, key=repr)
+
+
+SCH = Schema((StructField("k", LONG), StructField("s", STRING)))
+
+
+def _data(n=400):
+    rng = np.random.default_rng(0)
+    return {"k": [int(x) for x in rng.integers(-100, 100, n)],
+            "s": [None if x % 7 == 0 else f"v{x}"
+                  for x in rng.integers(0, 60, n)]}
+
+
+def test_repartition_roundrobin_preserves_rows():
+    sess = TpuSession()
+    df = sess.from_pydict(_data(), SCH, batch_rows=64)
+    out = df.repartition(4)
+    tree = out._exec().tree_string()
+    assert "HostShuffleExchangeExec" in tree
+    assert _sorted(out.collect()) == _sorted(df.collect())
+
+
+def test_coalesce_single_partition():
+    sess = TpuSession()
+    df = sess.from_pydict(_data(100), SCH, batch_rows=16)
+    out = df.coalesce(1)
+    exec_node = out._exec()
+    batches = list(exec_node.execute())
+    assert len(batches) == 1  # single partitioning: one output batch
+    assert _sorted(r for b in [batches[0].to_pylist()] for r in b) == \
+        _sorted(df.collect())
+
+
+def test_sample_reproducible_and_fractional():
+    sess = TpuSession()
+    df = sess.from_pydict(_data(2000), SCH, batch_rows=256)
+    s1 = df.sample(0.3, seed=7).collect()
+    s2 = df.sample(0.3, seed=7).collect()
+    assert s1 == s2                      # same seed → same rows
+    s3 = df.sample(0.3, seed=8).collect()
+    assert s1 != s3                      # different seed → different draw
+    frac = len(s1) / 2000
+    assert 0.2 < frac < 0.4              # ~Bernoulli(0.3)
+    assert df.sample(0.0).collect() == []
+    assert _sorted(df.sample(1.0).collect()) == _sorted(df.collect())
+
+
+def test_range_partitioned_global_sort():
+    sess = TpuSession({"spark.rapids.sql.shuffle.partitions": "4",
+                       "spark.rapids.sql.broadcastSizeThreshold": "-1"})
+    data = _data(600)
+    df = sess.from_pydict(data, SCH, batch_rows=64)
+    q = df.sort("k")
+    tree = q._exec().tree_string()
+    assert "PartitionWiseSortExec" in tree
+    assert "HostShuffleExchangeExec" in tree
+    got = [r[0] for r in q.collect()]
+    assert got == sorted(data["k"])
+    # descending too
+    got_d = [r[0] for r in df.sort(("k", False)).collect()]
+    assert got_d == sorted(data["k"], reverse=True)
+
+
+def test_range_sort_with_string_key_and_nulls():
+    sess = TpuSession({"spark.rapids.sql.shuffle.partitions": "3"})
+    data = _data(300)
+    df = sess.from_pydict(data, SCH, batch_rows=64)
+    got = [r[1] for r in df.sort("s").collect()]
+    expect = sorted(data["s"], key=lambda v: (v is not None, v))
+    assert got == expect  # nulls first (Spark asc default)
+
+
+def test_cbo_places_tiny_section_on_host():
+    on = TpuSession({"spark.rapids.sql.optimizer.enabled": "true"})
+    off = TpuSession()
+    data = {"k": [1, 2, 3], "s": ["a", "b", "c"]}
+
+    def q(sess):
+        df = sess.from_pydict(data, SCH)
+        return df.select((col("k") + lit(1)).alias("k2"))
+
+    tree_on = q(on)._exec().tree_string()
+    tree_off = q(off)._exec().tree_string()
+    assert "HostProjectExec" in tree_on       # 3 rows: dispatch dominates
+    assert "HostProjectExec" not in tree_off  # default: stays on device
+    assert q(on).collect() == q(off).collect() == [(2,), (3,), (4,)]
+    assert "cost optimizer" in q(on).explain()
+
+
+def test_cbo_keeps_large_section_on_device():
+    on = TpuSession({"spark.rapids.sql.optimizer.enabled": "true"})
+    df = on.from_pydict(_data(100000 // 250), SCH)  # 400 rows > breakeven
+    big = on.from_pydict(
+        {"k": list(range(5000)), "s": ["x"] * 5000}, SCH)
+    tree = big.select((col("k") + lit(1)).alias("k2"))._exec().tree_string()
+    assert "HostProjectExec" not in tree

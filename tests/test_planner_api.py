@@ -113,12 +113,22 @@ def test_explain_reports_unsupported_expression():
 
 
 def test_conf_disable_expression():
+    """Disabling a device expression moves the node to the host row
+    engine (the reference's convertToCpu per-operator fallback); with
+    fallback off it fails the plan with the explain report."""
     s = session(**{"spark.rapids.sql.expression.Add": "false"})
     d = s.from_pydict(DATA, SCHEMA).select(col("v") + 1)
-    report = d.explain()
-    assert "disabled by spark.rapids.sql.expression.Add" in report
+    assert "will run on CPU" in d.explain()
+    tree = d._exec().tree_string()
+    assert "HostProjectExec" in tree
+    assert [r[0] for r in d.collect()] == \
+        [None if v is None else v + 1 for v in DATA["v"]]
+    strict = session(**{"spark.rapids.sql.expression.Add": "false",
+                        "spark.rapids.sql.cpuFallback.enabled": "false"})
+    d2 = strict.from_pydict(DATA, SCHEMA).select(col("v") + 1)
+    assert "disabled by spark.rapids.sql.expression.Add" in d2.explain()
     with pytest.raises(PlanNotSupported):
-        d.collect()
+        d2.collect()
 
 
 def test_conf_disable_exec():

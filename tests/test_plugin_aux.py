@@ -1,0 +1,220 @@
+"""Plugin lifecycle, heartbeats, bounded host alloc, dump tooling tests
+(reference: Plugin.scala init/fatal-error suites, heartbeat manager
+tests, HostAllocSuite, DumpUtils usage; SURVEY §2.1/§2.4/§2.5/§5)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.memory.host_alloc import HostAlloc, HostOOM
+from spark_rapids_tpu.parallel.heartbeat import (HeartbeatEndpoint,
+                                                 HeartbeatManager)
+from spark_rapids_tpu.plugin import (FatalDeviceError, TpuDriverPlugin,
+                                     TpuExecutorPlugin)
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+def test_register_returns_existing_peers():
+    m = HeartbeatManager()
+    assert m.register("e1") == []
+    peers = m.register("e2")
+    assert [p.executor_id for p in peers] == ["e1"]
+
+
+def test_heartbeat_delta_updates():
+    m = HeartbeatManager()
+    m.register("e1")
+    time.sleep(0.01)
+    assert m.heartbeat("e1") == []
+    time.sleep(0.01)
+    m.register("e2")  # joined after e1's last beat
+    new = m.heartbeat("e1")
+    assert [p.executor_id for p in new] == ["e2"]
+    assert m.heartbeat("e1") == []  # already delivered
+
+
+def test_liveness_timeout():
+    m = HeartbeatManager(timeout_s=0.05)
+    m.register("e1")
+    m.register("e2")
+    time.sleep(0.08)
+    m.heartbeat("e2")
+    assert m.dead_peers() == ["e1"]
+    assert m.live_peers() == ["e2"]
+
+
+def test_endpoint_thread_beats_and_discovers():
+    m = HeartbeatManager(timeout_s=1.0)
+    seen = []
+    ep = HeartbeatEndpoint(m, "e1", interval_s=0.02,
+                           on_new_peer=lambda p: seen.append(p.executor_id))
+    ep.start()
+    try:
+        m.register("e2")
+        deadline = time.monotonic() + 2
+        while "e2" not in seen and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert "e2" in seen
+        assert "e1" in m.live_peers()
+    finally:
+        ep.stop()
+
+
+# ---------------------------------------------------------------------------
+# plugin lifecycle
+# ---------------------------------------------------------------------------
+
+def test_executor_plugin_init_and_peers():
+    driver = TpuDriverPlugin().init()
+    e1 = TpuExecutorPlugin(executor_id="e1", driver=driver,
+                           exit_fn=lambda c: None).init()
+    e2 = TpuExecutorPlugin(executor_id="e2", driver=driver,
+                           exit_fn=lambda c: None).init()
+    try:
+        deadline = time.monotonic() + 2
+        while "e2" not in e1.peers and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert "e2" in e1.peers   # discovered via heartbeat delta
+        assert "e1" in e2.peers   # returned at registration
+    finally:
+        e1.shutdown()
+        e2.shutdown()
+        driver.shutdown()
+
+
+def test_fatal_error_exits_executor():
+    codes = []
+    p = TpuExecutorPlugin(exit_fn=codes.append)
+    p.on_fatal_error(FatalDeviceError("device wedged"))
+    assert codes == [1]
+
+
+def test_retryable_oom_is_not_fatal():
+    from spark_rapids_tpu.memory.retry import TpuRetryOOM
+    codes = []
+    p = TpuExecutorPlugin(exit_fn=codes.append)
+    p.on_task_failed(TpuRetryOOM("retry me"))
+    assert codes == []
+
+
+# ---------------------------------------------------------------------------
+# bounded host alloc
+# ---------------------------------------------------------------------------
+
+def test_host_alloc_pinned_preference_and_bounds():
+    pool = HostAlloc(limit_bytes=1000, pinned_bytes=400)
+    a = pool.alloc(300)             # fits the pinned fast lane
+    assert a.pinned
+    b = pool.alloc(300)             # pinned lane full -> general lane
+    assert not b.pinned
+    assert pool.used_bytes == 600
+    assert pool.try_alloc(400) is None   # general lane cap is 600
+    b.close()
+    c = pool.try_alloc(500, prefer_pinned=False)
+    assert c is not None and not c.pinned
+    a.close()
+    c.close()
+    assert pool.used_bytes == 0
+
+
+def test_host_alloc_blocks_until_release():
+    pool = HostAlloc(limit_bytes=100, pinned_bytes=0)
+    a = pool.alloc(80)
+    got = []
+
+    def waiter():
+        with pool.alloc(50, timeout_s=5):
+            got.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    assert not got          # still blocked
+    a.close()
+    t.join(timeout=5)
+    assert got == [True]
+
+
+def test_host_alloc_timeout_raises():
+    pool = HostAlloc(limit_bytes=100, pinned_bytes=0)
+    a = pool.alloc(90)
+    with pytest.raises(HostOOM):
+        pool.alloc(50, timeout_s=0.05)
+    a.close()
+    with pytest.raises(HostOOM):
+        pool.alloc(101)     # larger than the pool can ever serve
+
+
+# ---------------------------------------------------------------------------
+# dump tooling
+# ---------------------------------------------------------------------------
+
+def test_dump_batch_and_dump_on_error(tmp_path):
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.config import RapidsConf, set_active_conf
+    from spark_rapids_tpu.types import LONG, STRING, Schema, StructField
+    from spark_rapids_tpu.utils.dump import dump_batch, dump_on_error
+
+    sch = Schema((StructField("k", LONG), StructField("s", STRING)))
+    b = ColumnarBatch.from_pydict({"k": [1, None], "s": ["x", None]}, sch)
+    p = dump_batch(b, str(tmp_path / "b.parquet"))
+    assert os.path.exists(p) and os.path.exists(p + ".meta.json")
+    back = ColumnarBatch.from_arrow(
+        __import__("pyarrow.parquet", fromlist=["pq"]).read_table(p))
+    assert back.to_pylist() == b.to_pylist()
+
+    conf = RapidsConf({"spark.rapids.sql.debug.dumpPath": str(tmp_path)})
+    with pytest.raises(RuntimeError, match="boom"):
+        with dump_on_error("TestOp", conf) as scope:
+            scope.observe(b)
+            raise RuntimeError("boom")
+    dirs = [d for d in os.listdir(tmp_path) if d.startswith("TestOp-")]
+    assert len(dirs) == 1
+    files = os.listdir(tmp_path / dirs[0])
+    assert "error.txt" in files and "repro.py" in files
+    assert any(f.startswith("input-") and f.endswith(".parquet")
+               for f in files)
+
+
+def test_operator_failure_dumps_real_exception(tmp_path):
+    """The exec-layer failure hook dumps the failing operator's INPUT
+    batches plus the real exception's traceback (reference DumpUtils
+    dump-failing-batches wiring)."""
+    import glob
+
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.config import RapidsConf, set_active_conf
+    from spark_rapids_tpu.exec.base import TpuExec
+    from spark_rapids_tpu.types import LONG, Schema, StructField
+
+    sch = Schema((StructField("v", LONG),))
+    set_active_conf(RapidsConf(
+        {"spark.rapids.sql.debug.dumpPath": str(tmp_path)}))
+    try:
+        class Src(TpuExec):
+            output_schema = sch
+
+            def internal_execute(self):
+                yield ColumnarBatch.from_pydict({"v": [1, 2]}, sch)
+
+        class Boom(TpuExec):
+            output_schema = sch
+
+            def internal_execute(self):
+                for b in self.children[0].execute():
+                    raise ValueError("kernel exploded here")
+                    yield b  # generator marker (unreachable)
+
+        with pytest.raises(ValueError):
+            list(Boom(Src()).execute())
+        d = glob.glob(str(tmp_path / "Boom-*"))[0]
+        assert "kernel exploded here" in open(os.path.join(d, "error.txt")).read()
+        assert glob.glob(os.path.join(d, "input-*.parquet"))
+    finally:
+        set_active_conf(RapidsConf({}))

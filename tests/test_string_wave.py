@@ -161,16 +161,26 @@ def test_like_vs_python(df, pattern):
 
 
 def test_rlike_unsupported_tags_off_tpu():
-    """Unsupported regex constructs tag the plan off at PLAN time (the
-    reference's transpile-or-fallback), not at expression construction."""
+    """Unsupported regex constructs tag the expression off the DEVICE at
+    PLAN time (the reference's transpile-or-fallback), not at expression
+    construction. With CPU fallback disabled the plan fails; with it on
+    (default) Python-re-compatible patterns run on the host row engine
+    instead."""
     from spark_rapids_tpu.plan.overrides import PlanNotSupported
-    s = TpuSession()
+    strict = TpuSession({"spark.rapids.sql.cpuFallback.enabled": "false"})
     sch = Schema((StructField("s", STRING),))
-    df = s.from_pydict({"s": ["x"]}, sch)
+    df = strict.from_pydict({"s": ["x"]}, sch)
     for bad in (r"(?=x)", r"a*?", r"\1", r"\bw", r"\p{L}", r"x{1,200}"):
         plan = df.select(F.rlike(col("s"), bad).alias("r"))  # no throw
         with pytest.raises(PlanNotSupported):
             plan.collect()
+    # default session: lookahead runs on the host engine (same answers
+    # Java regex would give for this construct)
+    relaxed = TpuSession()
+    df2 = relaxed.from_pydict({"s": ["xy", "zy", None]}, sch)
+    q = df2.select(F.rlike(col("s"), r"(?=x)x").alias("r"))
+    assert "HostProjectExec" in q._exec().tree_string()
+    assert q.collect() == [(True,), (False,), (None,)]
 
 
 def test_string_wave_fuzz():

@@ -1,0 +1,221 @@
+"""Timezone DB + datetime rebase tests. Oracles are independent host
+implementations: Python zoneinfo (IANA rules, fold=0) for zone shifts and
+pure-python JDN formulas cross-checked against datetime for rebase
+(reference analogs: TimeZoneSuite / RebaseDateTimeSuite; SURVEY §2.9/§2.11
+TimeZoneDB.scala:61, datetimeRebaseUtils.scala)."""
+
+import datetime as dt
+from zoneinfo import ZoneInfo
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.ops.rebase import (rebase_gregorian_to_julian_days,
+                                         rebase_julian_to_gregorian_days,
+                                         rebase_julian_to_gregorian_micros)
+from spark_rapids_tpu.ops.timezone import (local_to_utc, timezone_db,
+                                           utc_to_local)
+
+UTC = dt.timezone.utc
+EPOCH = dt.datetime(1970, 1, 1, tzinfo=UTC)
+MICROS = 1_000_000
+
+
+def _utc_micros(y, mo, d, h=0, mi=0, s=0):
+    return int((dt.datetime(y, mo, d, h, mi, s, tzinfo=UTC) - EPOCH)
+               .total_seconds()) * MICROS
+
+
+ZONES = ["America/Los_Angeles", "Europe/Berlin", "Asia/Kolkata",
+         "Australia/Sydney", "+05:30", "UTC"]
+
+
+@pytest.mark.parametrize("tz", ZONES)
+def test_utc_to_local_matches_zoneinfo(tz):
+    zone = ZoneInfo(tz) if "/" in tz or tz == "UTC" else None
+    instants = []
+    rng = np.random.default_rng(0)
+    for y in (1950, 1969, 1987, 2001, 2015, 2023, 2035):
+        for _ in range(8):
+            instants.append(_utc_micros(y, int(rng.integers(1, 13)),
+                                        int(rng.integers(1, 28)),
+                                        int(rng.integers(0, 24)),
+                                        int(rng.integers(0, 60))))
+    # DST boundary minutes for the US zone (2am PST/PDT transitions 2023)
+    instants += [_utc_micros(2023, 3, 12, 9, 59), _utc_micros(2023, 3, 12, 10, 1),
+                 _utc_micros(2023, 11, 5, 8, 59), _utc_micros(2023, 11, 5, 9, 1)]
+    arr = np.array(instants, np.int64)
+    got = np.asarray(utc_to_local(arr, tz))
+    for ts, g in zip(instants, got):
+        when = EPOCH + dt.timedelta(microseconds=ts)
+        if zone is not None:
+            off = when.astimezone(zone).utcoffset()
+        else:
+            off = dt.timedelta(hours=5, minutes=30)
+        assert g == ts + int(off.total_seconds()) * MICROS, (tz, when)
+
+
+@pytest.mark.parametrize("tz", ["America/Los_Angeles", "Europe/Berlin",
+                                "Asia/Kolkata"])
+def test_local_to_utc_roundtrip_unambiguous(tz):
+    zone = ZoneInfo(tz)
+    rng = np.random.default_rng(1)
+    walls = []
+    for y in (1975, 1999, 2020, 2024):
+        for _ in range(10):
+            # mid-month noon: never in a DST gap/overlap
+            walls.append(dt.datetime(y, int(rng.integers(1, 13)), 15, 12,
+                                     int(rng.integers(0, 60))))
+    arr = np.array([int((w - dt.datetime(1970, 1, 1)).total_seconds())
+                    * MICROS for w in walls], np.int64)
+    got = np.asarray(local_to_utc(arr, tz))
+    for w, g in zip(walls, got):
+        expect = int(w.replace(tzinfo=zone, fold=0)
+                     .astimezone(UTC).timestamp()) * MICROS
+        assert g == expect, (tz, w)
+
+
+def test_dst_overlap_uses_earlier_offset():
+    # 2023-11-05 01:30 in LA happens twice; fold=0 = PDT (UTC-7)
+    wall = int((dt.datetime(2023, 11, 5, 1, 30)
+                - dt.datetime(1970, 1, 1)).total_seconds()) * MICROS
+    got = int(np.asarray(local_to_utc(np.array([wall], np.int64),
+                                      "America/Los_Angeles"))[0])
+    expect = int(dt.datetime(2023, 11, 5, 1, 30,
+                             tzinfo=ZoneInfo("America/Los_Angeles"),
+                             fold=0).astimezone(UTC).timestamp()) * MICROS
+    assert got == expect
+
+
+def test_unknown_timezone_rejected():
+    with pytest.raises((ValueError, OSError)):
+        timezone_db().tables("Not/AZone")
+
+
+def test_fixed_offset_zones():
+    arr = np.array([0, 10**15], np.int64)
+    assert list(np.asarray(utc_to_local(arr, "+05:30"))) == \
+        [int(5.5 * 3600) * MICROS, 10**15 + int(5.5 * 3600) * MICROS]
+    assert list(np.asarray(utc_to_local(arr, "UTC"))) == [0, 10**15]
+
+
+# ---------------------------------------------------------------------------
+# rebase
+# ---------------------------------------------------------------------------
+
+def _days(y, m, d):
+    return (dt.date(y, m, d) - dt.date(1970, 1, 1)).days
+
+
+def test_rebase_identity_after_cutover():
+    days = np.array([_days(1582, 10, 15), _days(1600, 1, 1), 0,
+                     _days(2024, 6, 1)], np.int64)
+    out = np.asarray(rebase_julian_to_gregorian_days(days))
+    assert (out == days).all()
+
+
+def test_rebase_known_shifts():
+    """Rebase preserves the WALL DATE (Y-M-D), not the instant: hybrid
+    day for Julian 1582-10-04 (cutover-1) maps to proleptic Gregorian
+    '1582-10-04', 10 days earlier as a day number (Spark
+    RebaseDateTimeSuite semantics)."""
+    cut = _days(1582, 10, 15)
+    out = int(np.asarray(rebase_julian_to_gregorian_days(
+        np.array([cut - 1], np.int64)))[0])
+    assert out == _days(1582, 10, 4)  # same wall date, -10 day number
+
+    # 1000-01-01 Julian = 1000-01-06 proleptic Gregorian (shift +5... check
+    # via formulas): use the module's own host formulas as the oracle and
+    # verify the DEVICE table path agrees day-by-day around breakpoints
+    from spark_rapids_tpu.ops.rebase import _hybrid_to_proleptic
+    probe = []
+    for y in (100, 500, 900, 1100, 1500, 1582):
+        probe.extend(range(_days(2000, 1, 1) - (2000 - y) * 365 - 20,
+                           _days(2000, 1, 1) - (2000 - y) * 365 + 20))
+    arr = np.array(sorted(probe), np.int64)
+    got = np.asarray(rebase_julian_to_gregorian_days(arr))
+    expect = np.array([_hybrid_to_proleptic(int(d)) for d in arr], np.int64)
+    assert (got == expect).all()
+
+
+def test_rebase_roundtrip():
+    rng = np.random.default_rng(2)
+    days = rng.integers(-500000, 20000, 500).astype(np.int64)
+    fwd = np.asarray(rebase_julian_to_gregorian_days(days))
+    back = np.asarray(rebase_gregorian_to_julian_days(fwd))
+    assert (back == days).all()
+
+
+def test_rebase_micros_preserves_time_of_day():
+    base_day = _days(1500, 6, 1) - 9  # hybrid-era day
+    micros = np.array([base_day * 86_400_000_000 + 12 * 3_600_000_000 + 123,
+                       base_day * 86_400_000_000], np.int64)
+    out = np.asarray(rebase_julian_to_gregorian_micros(micros))
+    assert out[0] - out[1] == 12 * 3_600_000_000 + 123
+    assert out[1] % 86_400_000_000 == 0
+
+
+# ---------------------------------------------------------------------------
+# expression + planner integration
+# ---------------------------------------------------------------------------
+
+def test_from_utc_timestamp_through_session():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import TIMESTAMP, Schema, StructField
+    sess = TpuSession()
+    vals = [_utc_micros(2023, 3, 12, 9, 59), _utc_micros(2023, 7, 1, 0, 0),
+            None]
+    sch = Schema((StructField("ts", TIMESTAMP),))
+    df = sess.from_pydict({"ts": vals}, sch)
+    rows = df.select(F.from_utc_timestamp(col("ts"), "America/Los_Angeles")
+                     .alias("lts")).collect()
+    zone = ZoneInfo("America/Los_Angeles")
+    for v, (got,) in zip(vals, rows):
+        if v is None:
+            assert got is None
+            continue
+        when = EPOCH + dt.timedelta(microseconds=v)
+        off = when.astimezone(zone).utcoffset()
+        assert got == v + int(off.total_seconds()) * MICROS
+
+
+def test_unknown_zone_tags_off_device():
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.expr.datetimeexprs import FromUTCTimestamp
+    from spark_rapids_tpu.plan.overrides import PlanNotSupported
+    from spark_rapids_tpu.types import TIMESTAMP, Schema, StructField
+    sess = TpuSession({"spark.rapids.sql.cpuFallback.enabled": "false"})
+    sch = Schema((StructField("ts", TIMESTAMP),))
+    df = sess.from_pydict({"ts": [0]}, sch)
+    with pytest.raises(PlanNotSupported, match="timezone"):
+        df.select(FromUTCTimestamp(col("ts"), "Mars/Olympus").alias("x")
+                  )._exec()
+
+
+def test_parquet_legacy_rebase_mode(tmp_path):
+    """LEGACY datetimeRebaseModeInRead rebases DATE columns on scan
+    (reference GpuParquetScan rebase handling)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.ops.rebase import _hybrid_to_proleptic
+
+    hybrid_days = [-150000, -141428, -141427, 0, 19000]
+    table = pa.table({"d": pa.array(hybrid_days, pa.int32()).cast(
+        pa.date32())})
+    path = str(tmp_path / "legacy.parquet")
+    pq.write_table(table, path)
+
+    legacy = TpuSession({
+        "spark.rapids.sql.format.parquet.datetimeRebaseModeInRead":
+            "LEGACY"})
+    rows = [r[0] for r in legacy.read_parquet(path).collect()]
+    assert rows == [_hybrid_to_proleptic(d) for d in hybrid_days]
+
+    corrected = TpuSession()
+    rows2 = [r[0] for r in corrected.read_parquet(path).collect()]
+    assert rows2 == hybrid_days
