@@ -424,3 +424,146 @@ def from_utc_timestamp(x, tz):
 def to_utc_timestamp(x, tz):
     from ..expr.datetimeexprs import ToUTCTimestamp
     return ToUTCTimestamp(_e(x), tz)
+
+
+# bitwise / shifts --------------------------------------------------------
+def shiftleft(x, n):
+    from ..expr.bitwise import ShiftLeft
+    return ShiftLeft(_e(x), _e(n))
+
+
+def shiftright(x, n):
+    from ..expr.bitwise import ShiftRight
+    return ShiftRight(_e(x), _e(n))
+
+
+def shiftrightunsigned(x, n):
+    from ..expr.bitwise import ShiftRightUnsigned
+    return ShiftRightUnsigned(_e(x), _e(n))
+
+
+def bitwise_not(x):
+    from ..expr.bitwise import BitwiseNot
+    return BitwiseNot(_e(x))
+
+
+# JSON / URL / string long tail (host-tier expressions) -------------------
+def get_json_object(x, path):
+    from ..expr.jsonexprs import GetJsonObject
+    return GetJsonObject(_e(x), path)
+
+
+def parse_url(x, part, key=None):
+    from ..expr.urlexprs import ParseUrl
+    return ParseUrl(_e(x), part, key)
+
+
+def split(x, pattern, limit=-1):
+    from ..expr.stringexprs import StringSplit
+    return StringSplit(_e(x), pattern, limit)
+
+
+def substring_index(x, delim, count):
+    from ..expr.stringexprs import SubstringIndex
+    return SubstringIndex(_e(x), delim, count)
+
+
+def find_in_set(needle, s):
+    from ..expr.stringexprs import FindInSet
+    return FindInSet(_e(needle), _e(s))
+
+
+def regexp_extract(x, pattern, idx=1):
+    from ..expr.stringexprs import RegExpExtract
+    return RegExpExtract(_e(x), pattern, idx)
+
+
+def regexp_replace(x, pattern, replacement):
+    from ..expr.stringexprs import RegExpReplace
+    return RegExpReplace(_e(x), pattern, replacement)
+
+
+def format_number(x, d):
+    from ..expr.stringexprs import FormatNumber
+    return FormatNumber(_e(x), d)
+
+
+def levenshtein(a, b):
+    from ..expr.stringexprs import Levenshtein
+    return Levenshtein(_e(a), _e(b))
+
+
+# higher-order functions + collection long tail ---------------------------
+def _lambda_body(fn, *var_names):
+    """Build the body expression from a Python lambda over LambdaVar
+    placeholders: F.transform(c, lambda x: x + 1)."""
+    from ..expr.collectionexprs import LambdaVar
+    return fn(*[LambdaVar(n) for n in var_names])
+
+
+def transform(x, fn):
+    from ..expr.collectionexprs import ArrayTransform
+    return ArrayTransform(_e(x), _lambda_body(fn, "x"), "x")
+
+
+def filter_(x, fn):
+    from ..expr.collectionexprs import ArrayFilter
+    return ArrayFilter(_e(x), _lambda_body(fn, "x"), "x")
+
+
+def exists(x, fn):
+    from ..expr.collectionexprs import ArrayExists
+    return ArrayExists(_e(x), _lambda_body(fn, "x"), "x")
+
+
+def forall(x, fn):
+    from ..expr.collectionexprs import ArrayForAll
+    return ArrayForAll(_e(x), _lambda_body(fn, "x"), "x")
+
+
+def aggregate(x, zero, merge, finish=None):
+    from ..expr.collectionexprs import ArrayAggregate, LambdaVar
+    merge_body = merge(LambdaVar("acc"), LambdaVar("x"))
+    finish_body = finish(LambdaVar("acc")) if finish is not None else None
+    return ArrayAggregate(_e(x), _e(zero), merge_body, finish_body)
+
+
+def array_position(x, v):
+    from ..expr.collectionexprs import ArrayPosition
+    return ArrayPosition(_e(x), _e(v))
+
+
+def array_remove(x, v):
+    from ..expr.collectionexprs import ArrayRemove
+    return ArrayRemove(_e(x), _e(v))
+
+
+def array_distinct(x):
+    from ..expr.collectionexprs import ArrayDistinct
+    return ArrayDistinct(_e(x))
+
+
+def slice(x, start, length):  # noqa: A001 - Spark name
+    from ..expr.collectionexprs import Slice
+    return Slice(_e(x), _e(start), _e(length))
+
+
+def flatten(x):
+    from ..expr.collectionexprs import Flatten
+    return Flatten(_e(x))
+
+
+def arrays_overlap(a, b):
+    from ..expr.collectionexprs import ArraysOverlap
+    return ArraysOverlap(_e(a), _e(b))
+
+
+def array_join(x, delim, null_replacement=None):
+    from ..expr.collectionexprs import ArrayJoin
+    return ArrayJoin(_e(x), delim, null_replacement)
+
+
+def sequence(start, stop, step=None):
+    from ..expr.collectionexprs import Sequence
+    return Sequence(_e(start), _e(stop),
+                    _e(step) if step is not None else None)

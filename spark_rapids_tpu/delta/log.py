@@ -112,11 +112,11 @@ class AddFile:
         self.stats = stats
         self.modification_time = modification_time
 
-    def to_action(self) -> dict:
+    def to_action(self, data_change: bool = True) -> dict:
         return {"add": {
             "path": self.path, "partitionValues": self.partition_values,
             "size": self.size, "modificationTime": self.modification_time,
-            "dataChange": True,
+            "dataChange": data_change,
             **({"stats": self.stats} if self.stats else {})}}
 
     def parsed_stats(self) -> Optional[dict]:

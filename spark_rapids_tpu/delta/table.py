@@ -443,6 +443,39 @@ class DeltaTable:
             self.log.commit(actions, snap.version + 1)
         return updated
 
+    # -- OPTIMIZE ----------------------------------------------------------
+    def optimize(self, zorder_by: Optional[Sequence[str]] = None) -> int:
+        """OPTIMIZE [ZORDER BY cols]: rewrite the table's files as one
+        compacted file per partition tuple, z-order-clustered when keys
+        are given (reference delta-lake OPTIMIZE + zorder/ZOrderRules:
+        sort by GpuInterleaveBits of the keys so file-level min/max
+        stats skip aggressively on those columns). Returns the number of
+        files removed."""
+        from ..api.functions import col
+        from ..expr.zorder import InterleaveBits
+        snap = self.log.snapshot()
+        if not snap.files:
+            return 0
+        df = self.to_df()
+        if zorder_by:
+            code = InterleaveBits(*[col(c) for c in zorder_by])
+            df = (df.with_column("__zorder", code)
+                    .sort("__zorder")
+                    .select(*[col(n) for n in snap.schema.names]))
+        adds = _write_data_files(df, self.log.table_path,
+                                 snap.partition_columns)
+        actions: List[dict] = [DeltaLog.commit_info(
+            "OPTIMIZE", zOrderBy=json.dumps(list(zorder_by or [])))]
+        for f in snap.files:
+            actions.append({"remove": {"path": f.path, "dataChange": False,
+                                       "deletionTimestamp": 0}})
+        # rearrangement-only: adds must be dataChange=false too, or CDC/
+        # streaming readers reprocess every compacted row (Delta OPTIMIZE
+        # contract)
+        actions.extend(a.to_action(data_change=False) for a in adds)
+        self.log.commit(actions, snap.version + 1)
+        return len(snap.files)
+
     # -- MERGE -------------------------------------------------------------
     def merge(self, source_df, on: Sequence[str]) -> "_MergeBuilder":
         """MERGE INTO t USING source ON t.k = s.k (equi-merge; reference

@@ -186,6 +186,43 @@ _reg(C.NaNvl, lambda e, a, b: b
      if isinstance(a, float) and math.isnan(a) else a)
 
 
+# collection family (device kernels exist; host evals let them ride the
+# fallback tier when they appear beside host-only expressions) ------------
+
+def _reg_collections():
+    from ..expr import collectionexprs as ce
+
+    def _contains(e, a):
+        # the needle is an expression ATTRIBUTE (e.value), not a child
+        v = e.value
+        if v is None:
+            return None
+        if any(x == v for x in a if x is not None):
+            return True
+        return None if None in a else False
+
+    def _sort_array(e, a):
+        # Spark/device kernel (ops/collection.py): asc => nulls FIRST,
+        # desc => nulls LAST
+        nulls = [None] * sum(1 for x in a if x is None)
+        vals = [x for x in a if x is not None]
+        if getattr(e, "ascending", True):
+            return nulls + sorted(vals)
+        return sorted(vals, reverse=True) + nulls
+
+    _reg(ce.CreateArray, lambda e, *vs: list(vs), null_intolerant=False)
+    _reg(ce.Size, lambda e, a: len(a))
+    _reg(ce.ArrayContains, _contains)
+    _reg(ce.SortArray, _sort_array)
+    _reg(ce.ArrayMin, lambda e, a: min(
+        (x for x in a if x is not None), default=None))
+    _reg(ce.ArrayMax, lambda e, a: max(
+        (x for x in a if x is not None), default=None))
+
+
+_reg_collections()
+
+
 # string family ------------------------------------------------------------
 
 _reg(S.Length, lambda e, s: len(s))
@@ -359,6 +396,17 @@ def row_eval(expr: Expression, row) -> object:
         return row_eval(expr.children[0], row)
     if isinstance(expr, _SPECIAL):
         return _host_eval_special(expr, row)
+    # extension points: host-tier expressions implement their own scalar
+    # semantics (expr/jsonexprs.py etc. — families the reference keeps
+    # off-GPU or that have no device kernel yet). The _with_row variant
+    # drives sub-evaluation itself (higher-order functions binding
+    # lambda variables per element).
+    rich_fn = getattr(expr, "host_eval_with_row", None)
+    if rich_fn is not None:
+        return rich_fn(row, row_eval)
+    host_fn = getattr(expr, "host_eval_row", None)
+    if host_fn is not None:
+        return host_fn(*[row_eval(c, row) for c in expr.children])
     fn = _EVALS.get(type(expr))
     if fn is None:
         raise HostEvalUnsupported(type(expr).__name__)
@@ -408,7 +456,28 @@ def supports_host_eval(expr: Expression) -> bool:
         if not isinstance(expr.data_type, _HOST_CASTABLE):
             return False
         return supports_host_eval(expr.children[0])
-    if isinstance(expr, _SPECIAL) or type(expr) in _EVALS:
+    if isinstance(expr, (S.StringSplit, S.RegExpExtract, S.RegExpReplace)):
+        # regex-bearing host-tier expressions: the pattern must compile
+        # under Python re, or the fallback would crash mid-query
+        if not isinstance(expr.pattern, str):
+            return False
+        try:
+            re.compile(expr.pattern)
+        except re.error:
+            return False
+        return all(supports_host_eval(c) for c in expr.children)
+    from ..expr.collectionexprs import LambdaVar, _HostHOF, ArrayAggregate
+    if isinstance(expr, LambdaVar):
+        return True  # bound per element by the enclosing HOF
+    if isinstance(expr, _HostHOF):
+        return supports_host_eval(expr.children[0]) \
+            and supports_host_eval(expr.body)
+    if isinstance(expr, ArrayAggregate):
+        return all(supports_host_eval(c) for c in expr.children) \
+            and supports_host_eval(expr.merge) \
+            and (expr.finish is None or supports_host_eval(expr.finish))
+    if isinstance(expr, _SPECIAL) or type(expr) in _EVALS \
+            or getattr(expr, "host_eval_row", None) is not None:
         return all(supports_host_eval(c) for c in expr.children)
     return False
 

@@ -1,0 +1,128 @@
+"""Bitwise + shift expressions (reference: the bitwise rules in
+GpuOverrides.scala:919 over cuDF bitwise kernels; Spark's
+BitwiseAnd/Or/Xor/Not and ShiftLeft/ShiftRight/ShiftRightUnsigned).
+
+Spark semantics carried over exactly:
+- bitwise ops promote to the wider integral type (Add's promotion);
+- shifts take an INT shift amount, keep the VALUE's type, and mask the
+  distance to the type width (Java << / >> / >>>: `x << (n & 31|63)`);
+- >>> is logical (zero-fill), >> arithmetic (sign-fill).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column
+from ..types import DataType, IntegerType, LongType
+from .arithmetic import _promote, numeric_promote
+from .core import Expression
+
+
+class _BitwiseBinary(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def with_children(self, cs):
+        return type(self)(cs[0], cs[1])
+
+    @property
+    def data_type(self) -> DataType:
+        lt = self.children[0].data_type
+        rt = self.children[1].data_type
+        return lt if lt == rt else numeric_promote(lt, rt)
+
+    def columnar_eval(self, batch) -> Column:
+        l = self.children[0].columnar_eval(batch)
+        r = self.children[1].columnar_eval(batch)
+        out_t = self.data_type
+        ld, rd = _promote(l, r, out_t)
+        valid = l.validity & r.validity
+        data = self._op(ld, rd)
+        data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+        return Column(data, valid, out_t)
+
+
+class BitwiseAnd(_BitwiseBinary):
+    @staticmethod
+    def _op(a, b):
+        return jnp.bitwise_and(a, b)
+
+
+class BitwiseOr(_BitwiseBinary):
+    @staticmethod
+    def _op(a, b):
+        return jnp.bitwise_or(a, b)
+
+
+class BitwiseXor(_BitwiseBinary):
+    @staticmethod
+    def _op(a, b):
+        return jnp.bitwise_xor(a, b)
+
+
+class BitwiseNot(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, cs):
+        return type(self)(cs[0])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def columnar_eval(self, batch) -> Column:
+        c = self.children[0].columnar_eval(batch)
+        data = jnp.where(c.validity, jnp.invert(c.data),
+                         jnp.zeros((), c.data.dtype))
+        return Column(data, c.validity, self.data_type)
+
+
+class _ShiftBase(Expression):
+    """value SHIFT amount: result keeps the value's type; the distance is
+    masked to the type width like Java (x << 65 == x << 1 for int64)."""
+
+    def __init__(self, value: Expression, amount: Expression):
+        self.children = (value, amount)
+
+    def with_children(self, cs):
+        return type(self)(cs[0], cs[1])
+
+    @property
+    def data_type(self):
+        dt = self.children[0].data_type
+        # Spark: byte/short promote to int for shifts
+        return dt if isinstance(dt, LongType) else IntegerType()
+
+    def columnar_eval(self, batch) -> Column:
+        v = self.children[0].columnar_eval(batch)
+        n = self.children[1].columnar_eval(batch)
+        out_t = self.data_type
+        bits = 64 if isinstance(out_t, LongType) else 32
+        data = v.data.astype(out_t.jnp_dtype)
+        dist = jnp.bitwise_and(n.data.astype(jnp.int32),
+                               jnp.int32(bits - 1))
+        valid = v.validity & n.validity
+        out = self._op(data, dist.astype(data.dtype))
+        out = jnp.where(valid, out, jnp.zeros((), out.dtype))
+        return Column(out, valid, out_t)
+
+
+class ShiftLeft(_ShiftBase):
+    @staticmethod
+    def _op(x, d):
+        return jax.lax.shift_left(x, d)
+
+
+class ShiftRight(_ShiftBase):
+    @staticmethod
+    def _op(x, d):
+        return jax.lax.shift_right_arithmetic(x, d)
+
+
+class ShiftRightUnsigned(_ShiftBase):
+    @staticmethod
+    def _op(x, d):
+        return jax.lax.shift_right_logical(x, d)

@@ -138,3 +138,459 @@ class CreateArray(Expression):
     def columnar_eval(self, batch):
         cols = [c.columnar_eval(batch) for c in self.children]
         return C.create_array(cols, self.data_type)
+
+
+# ---------------------------------------------------------------------------
+# higher-order functions + collection long tail (reference
+# higherOrderFunctions.scala / collectionOperations.scala). Host-tier:
+# these evaluate through the CPU fallback transitions (exec/fallback.py)
+# — ragged per-element lambdas have no static-shape device kernel yet.
+# Lambdas are expression trees over LambdaVar placeholders, mirroring
+# Catalyst's LambdaFunction/NamedLambdaVariable.
+# ---------------------------------------------------------------------------
+
+class LambdaVar(Expression):
+    """Catalyst NamedLambdaVariable analog: a placeholder the HOF binds
+    per element at evaluation time."""
+
+    children = ()
+
+    def __init__(self, name: str = "x"):
+        self.name = name
+
+    def with_children(self, cs):
+        return self
+
+    def _semantic_args(self):
+        return (self.name,)
+
+    @property
+    def data_type(self):
+        raise TypeError(f"unbound lambda variable {self.name!r}")
+
+    def __repr__(self):
+        return f"λ{self.name}"
+
+
+def _subst(body: Expression, mapping):
+    from .core import lit
+
+    def fn(node):
+        if isinstance(node, LambdaVar) and node.name in mapping:
+            return lit(mapping[node.name])
+        return node
+    return body.transform_up(fn)
+
+
+class _HostHOF(Expression):
+    """Base: children = (array,); `body` is the lambda expression over
+    LambdaVar(var) [and optionally LambdaVar(idx_var)]."""
+
+    def __init__(self, child: Expression, body: Expression,
+                 var: str = "x"):
+        self.children = (child,)
+        self.body = body
+        self.var = var
+
+    def with_children(self, cs):
+        return type(self)(cs[0], self.body, self.var)
+
+    def transform_up(self, fn):
+        # the lambda body must see tree rewrites too (column resolution
+        # binds outer references inside the body; LambdaVars pass
+        # through untouched)
+        child = self.children[0].transform_up(fn)
+        body = self.body.transform_up(fn)
+        return fn(type(self)(child, body, self.var))
+
+    def _semantic_args(self):
+        return (self.body.semantic_key(), self.var)
+
+    def columnar_eval(self, batch):
+        raise NotImplementedError(
+            f"{type(self).__name__} runs on the host tier (CPU fallback)")
+
+    def _elem(self, row, eval_fn, v):
+        return eval_fn(_subst(self.body, {self.var: v}), row)
+
+    def _body_type(self):
+        """Body type with the lambda var bound to the element type (the
+        Catalyst bind step that gives NamedLambdaVariable its type)."""
+        from ..types import ArrayType
+        from .core import Literal
+        arr_t = self.children[0].data_type
+        elem = arr_t.element_type if isinstance(arr_t, ArrayType) else arr_t
+
+        def fn(node):
+            if isinstance(node, LambdaVar) and node.name == self.var:
+                return Literal(None, elem)
+            return node
+        return self.body.transform_up(fn).data_type
+
+
+class ArrayTransform(_HostHOF):
+    """transform(arr, x -> expr)"""
+
+    @property
+    def data_type(self):
+        from ..types import NULL, ArrayType
+        try:
+            return ArrayType(self._body_type())
+        except TypeError:
+            return ArrayType(NULL)
+
+    def host_eval_with_row(self, row, eval_fn):
+        arr = eval_fn(self.children[0], row)
+        if arr is None:
+            return None
+        return [self._elem(row, eval_fn, v) for v in arr]
+
+
+class ArrayFilter(_HostHOF):
+    """filter(arr, x -> predicate)"""
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def host_eval_with_row(self, row, eval_fn):
+        arr = eval_fn(self.children[0], row)
+        if arr is None:
+            return None
+        return [v for v in arr if self._elem(row, eval_fn, v) is True]
+
+
+class ArrayExists(_HostHOF):
+    """exists(arr, x -> predicate): Spark 3-valued semantics."""
+
+    @property
+    def data_type(self):
+        from ..types import BOOLEAN
+        return BOOLEAN
+
+    def host_eval_with_row(self, row, eval_fn):
+        arr = eval_fn(self.children[0], row)
+        if arr is None:
+            return None
+        saw_null = False
+        for v in arr:
+            r = self._elem(row, eval_fn, v)
+            if r is True:
+                return True
+            if r is None:
+                saw_null = True
+        return None if saw_null else False
+
+
+class ArrayForAll(_HostHOF):
+    """forall(arr, x -> predicate)"""
+
+    @property
+    def data_type(self):
+        from ..types import BOOLEAN
+        return BOOLEAN
+
+    def host_eval_with_row(self, row, eval_fn):
+        arr = eval_fn(self.children[0], row)
+        if arr is None:
+            return None
+        saw_null = False
+        for v in arr:
+            r = self._elem(row, eval_fn, v)
+            if r is False:
+                return False
+            if r is None:
+                saw_null = True
+        return None if saw_null else True
+
+
+class ArrayAggregate(Expression):
+    """aggregate(arr, zero, (acc, x) -> merge[, acc -> finish])"""
+
+    def __init__(self, child: Expression, zero: Expression,
+                 merge: Expression, finish: Expression = None,
+                 acc_var: str = "acc", var: str = "x"):
+        self.children = (child, zero)
+        self.merge = merge
+        self.finish = finish
+        self.acc_var = acc_var
+        self.var = var
+
+    def with_children(self, cs):
+        return ArrayAggregate(cs[0], cs[1], self.merge, self.finish,
+                              self.acc_var, self.var)
+
+    def transform_up(self, fn):
+        cs = [c.transform_up(fn) for c in self.children]
+        merge = self.merge.transform_up(fn)
+        finish = self.finish.transform_up(fn) \
+            if self.finish is not None else None
+        return fn(ArrayAggregate(cs[0], cs[1], merge, finish,
+                                 self.acc_var, self.var))
+
+    def _semantic_args(self):
+        return (self.merge.semantic_key(),
+                self.finish.semantic_key() if self.finish else None,
+                self.acc_var, self.var)
+
+    @property
+    def data_type(self):
+        from ..types import ArrayType
+        from .core import Literal
+        try:
+            zero_t = self.children[1].data_type
+            arr_t = self.children[0].data_type
+            elem = arr_t.element_type if isinstance(arr_t, ArrayType) \
+                else arr_t
+
+            def bind(node):
+                if isinstance(node, LambdaVar):
+                    if node.name == self.acc_var:
+                        return Literal(None, zero_t)
+                    if node.name == self.var:
+                        return Literal(None, elem)
+                return node
+            merged_t = self.merge.transform_up(bind).data_type
+            if self.finish is None:
+                return merged_t
+
+            def bind_f(node):
+                if isinstance(node, LambdaVar) \
+                        and node.name == self.acc_var:
+                    return Literal(None, merged_t)
+                return node
+            return self.finish.transform_up(bind_f).data_type
+        except TypeError:
+            return self.children[1].data_type
+
+    def columnar_eval(self, batch):
+        raise NotImplementedError(
+            "aggregate() runs on the host tier (CPU fallback)")
+
+    def host_eval_with_row(self, row, eval_fn):
+        arr = eval_fn(self.children[0], row)
+        if arr is None:
+            return None
+        acc = eval_fn(self.children[1], row)
+        for v in arr:
+            acc = eval_fn(_subst(self.merge,
+                                 {self.acc_var: acc, self.var: v}), row)
+        if self.finish is not None:
+            acc = eval_fn(_subst(self.finish, {self.acc_var: acc}), row)
+        return acc
+
+
+class _HostCollection(Expression):
+    def columnar_eval(self, batch):
+        raise NotImplementedError(
+            f"{type(self).__name__} runs on the host tier (CPU fallback)")
+
+
+class ArrayPosition(_HostCollection):
+    """array_position(arr, v): 1-based first index, 0 if absent."""
+
+    def __init__(self, child: Expression, value: Expression):
+        self.children = (child, value)
+
+    def with_children(self, cs):
+        return ArrayPosition(cs[0], cs[1])
+
+    @property
+    def data_type(self):
+        from ..types import LONG
+        return LONG
+
+    def host_eval_row(self, arr, v):
+        if arr is None or v is None:
+            return None
+        for i, item in enumerate(arr):
+            if item is not None and item == v:
+                return i + 1
+        return 0
+
+
+class ArrayRemove(_HostCollection):
+    def __init__(self, child: Expression, value: Expression):
+        self.children = (child, value)
+
+    def with_children(self, cs):
+        return ArrayRemove(cs[0], cs[1])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def host_eval_row(self, arr, v):
+        if arr is None or v is None:
+            return None
+        return [x for x in arr if x is None or x != v]
+
+
+class ArrayDistinct(_HostCollection):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, cs):
+        return ArrayDistinct(cs[0])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def host_eval_row(self, arr):
+        if arr is None:
+            return None
+        out = []
+        saw_null = False
+        for x in arr:
+            if x is None:
+                if not saw_null:
+                    out.append(None)
+                    saw_null = True
+            elif x not in out:
+                out.append(x)
+        return out
+
+
+class Slice(_HostCollection):
+    """slice(arr, start, length): 1-based; negative start from end."""
+
+    def __init__(self, child: Expression, start: Expression,
+                 length: Expression):
+        self.children = (child, start, length)
+
+    def with_children(self, cs):
+        return Slice(cs[0], cs[1], cs[2])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def host_eval_row(self, arr, start, length):
+        if arr is None or start is None or length is None:
+            return None
+        if start == 0:
+            raise ValueError("slice(): start must not be 0")
+        if length < 0:
+            raise ValueError("slice(): length must be >= 0")
+        i = start - 1 if start > 0 else len(arr) + start
+        if i < 0:
+            return []
+        return arr[i: i + length]
+
+
+class Flatten(_HostCollection):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, cs):
+        return Flatten(cs[0])
+
+    @property
+    def data_type(self):
+        from ..types import ArrayType
+        dt = self.children[0].data_type
+        return dt.element_type if isinstance(dt, ArrayType) else dt
+
+    def host_eval_row(self, arr):
+        if arr is None:
+            return None
+        out = []
+        for sub in arr:
+            if sub is None:
+                return None  # Spark: null inner array -> null result
+            out.extend(sub)
+        return out
+
+
+class ArraysOverlap(_HostCollection):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def with_children(self, cs):
+        return ArraysOverlap(cs[0], cs[1])
+
+    @property
+    def data_type(self):
+        from ..types import BOOLEAN
+        return BOOLEAN
+
+    def host_eval_row(self, a, b):
+        if a is None or b is None:
+            return None
+        bs = {x for x in b if x is not None}
+        if any(x in bs for x in a if x is not None):
+            return True
+        # Spark: NULL only when BOTH arrays are non-empty and either has
+        # a null element; an empty side always gives false
+        if a and b and (None in a or None in b):
+            return None
+        return False
+
+
+class ArrayJoin(_HostCollection):
+    def __init__(self, child: Expression, delim, null_replacement=None):
+        from .core import Literal
+        self.children = (child,)
+        self.delim = delim.value if isinstance(delim, Literal) else delim
+        self.null_replacement = null_replacement.value \
+            if isinstance(null_replacement, Literal) else null_replacement
+
+    def with_children(self, cs):
+        return ArrayJoin(cs[0], self.delim, self.null_replacement)
+
+    def _semantic_args(self):
+        return (self.delim, self.null_replacement)
+
+    @property
+    def data_type(self):
+        from ..types import STRING
+        return STRING
+
+    def host_eval_row(self, arr):
+        if arr is None:
+            return None
+        parts = []
+        for x in arr:
+            if x is None:
+                if self.null_replacement is not None:
+                    parts.append(self.null_replacement)
+            else:
+                parts.append(str(x))
+        return self.delim.join(parts)
+
+
+class Sequence(_HostCollection):
+    """sequence(start, stop[, step]) -> array<long>"""
+
+    def __init__(self, start: Expression, stop: Expression,
+                 step: Expression = None):
+        self.children = (start, stop) + ((step,) if step is not None
+                                         else ())
+
+    def with_children(self, cs):
+        return Sequence(*cs)
+
+    @property
+    def data_type(self):
+        from ..types import ArrayType
+        return ArrayType(self.children[0].data_type)
+
+    def host_eval_row(self, start, stop, step=None):
+        if start is None or stop is None:
+            return None
+        if step is None:
+            step = 1 if stop >= start else -1
+        if step == 0:
+            raise ValueError("sequence(): step must not be 0")
+        out = []
+        v = start
+        if step > 0:
+            while v <= stop:
+                out.append(v)
+                v += step
+        else:
+            while v >= stop:
+                out.append(v)
+                v += step
+        return out

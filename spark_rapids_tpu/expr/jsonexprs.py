@@ -1,0 +1,168 @@
+"""JSON expressions (reference GpuGetJsonObject.scala + JNI JSONUtils,
+GpuJsonTuple.scala; SURVEY §2.3 expression families). These run on the
+HOST row-engine tier: the reference offloads them through a dedicated
+CUDA JSON parser; this engine routes them through the CPU fallback
+transitions (exec/fallback.py) until a device JSON kernel exists — the
+rules tag them host-tier so plans stay runnable and explain output says
+where they execute.
+
+JSONPath subset (same as Spark's get_json_object): `$` root, `.field`,
+`['field']`, `[n]` array index, `[*]` wildcard over arrays.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import List, Optional
+
+from ..types import STRING
+from .core import Expression, Literal
+
+_TOKEN = re.compile(r"""
+    \.(?P<field>[A-Za-z_][A-Za-z0-9_\- ]*)   |
+    \[\s*'(?P<qfield>[^']*)'\s*\]            |
+    \[\s*(?P<index>\d+)\s*\]                 |
+    \[\s*\*\s*\](?P<star>)
+""", re.X)
+
+
+def parse_json_path(path: str) -> Optional[List]:
+    """'$.a.b[0]' → ['a', 'b', 0]; None for malformed paths (Spark
+    returns NULL for them)."""
+    if not path or path[0] != "$":
+        return None
+    out: List = []
+    pos = 1
+    while pos < len(path):
+        m = _TOKEN.match(path, pos)
+        if m is None:
+            return None
+        if m.group("field") is not None:
+            out.append(m.group("field"))
+        elif m.group("qfield") is not None:
+            out.append(m.group("qfield"))
+        elif m.group("index") is not None:
+            out.append(int(m.group("index")))
+        else:
+            out.append("*")
+        pos = m.end()
+    return out
+
+
+def _walk(node, steps, i):
+    if i == len(steps):
+        yield node
+        return
+    step = steps[i]
+    if step == "*":
+        if isinstance(node, list):
+            for item in node:
+                yield from _walk(item, steps, i + 1)
+        return
+    if isinstance(step, int):
+        if isinstance(node, list) and 0 <= step < len(node):
+            yield from _walk(node[step], steps, i + 1)
+        return
+    if isinstance(node, dict) and step in node:
+        yield from _walk(node[step], steps, i + 1)
+
+
+def _render(v) -> Optional[str]:
+    """Spark's scalar rendering: strings bare, others as JSON text."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v
+    return json.dumps(v, separators=(",", ":"))
+
+
+class GetJsonObject(Expression):
+    """get_json_object(json, path) — host tier (reference
+    GpuGetJsonObject over the JNI JSON parser)."""
+
+    def __init__(self, child: Expression, path):
+        self.children = (child,)
+        self.path = path.value if isinstance(path, Literal) else path
+
+    def with_children(self, cs):
+        return GetJsonObject(cs[0], self.path)
+
+    def _semantic_args(self):
+        return (self.path,)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def host_eval_row(self, s):
+        if s is None or not isinstance(self.path, str):
+            return None
+        steps = parse_json_path(self.path)
+        if steps is None:
+            return None
+        try:
+            doc = json.loads(s)
+        except ValueError:
+            return None
+        hits = [h for h in _walk(doc, steps, 0)]
+        if not hits:
+            return None
+        if len(hits) == 1:
+            return _render(hits[0])
+        # wildcard with multiple matches renders as a JSON array
+        return json.dumps(hits, separators=(",", ":"))
+
+    def columnar_eval(self, batch):
+        raise NotImplementedError(
+            "get_json_object runs on the host tier (CPU fallback)")
+
+
+class JsonToStructsField(Expression):
+    """from_json limited to extracting ONE typed field (the common
+    `from_json(col, schema).field` shape; reference GpuJsonToStructs is
+    the full version). Host tier."""
+
+    def __init__(self, child: Expression, field: str, dtype):
+        self.children = (child,)
+        self.field = field
+        self._dtype = dtype
+
+    def with_children(self, cs):
+        return JsonToStructsField(cs[0], self.field, self._dtype)
+
+    def _semantic_args(self):
+        return (self.field, repr(self._dtype))
+
+    @property
+    def data_type(self):
+        return self._dtype
+
+    def host_eval_row(self, s):
+        if s is None:
+            return None
+        try:
+            doc = json.loads(s)
+        except ValueError:
+            return None
+        if not isinstance(doc, dict) or self.field not in doc:
+            return None
+        v = doc[self.field]
+        from ..types import (BooleanType, DoubleType, FloatType,
+                             IntegerType, LongType, StringType)
+        try:
+            if isinstance(self._dtype, (LongType, IntegerType)):
+                return int(v)
+            if isinstance(self._dtype, (DoubleType, FloatType)):
+                return float(v)
+            if isinstance(self._dtype, BooleanType):
+                return bool(v)
+            if isinstance(self._dtype, StringType):
+                return v if isinstance(v, str) else json.dumps(v)
+        except (TypeError, ValueError):
+            return None
+        return None
+
+    def columnar_eval(self, batch):
+        raise NotImplementedError(
+            "from_json runs on the host tier (CPU fallback)")

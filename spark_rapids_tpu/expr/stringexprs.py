@@ -483,3 +483,237 @@ class Like(Expression):
         from ..regex import regex_find
         return regex_find(self.children[0].columnar_eval(batch),
                           self.program)
+
+
+# ---------------------------------------------------------------------------
+# host-tier string long tail (reference stringFunctions.scala families the
+# engine has no device kernel for yet; they run through the CPU fallback
+# transitions, exec/fallback.py, and are tagged host-tier at plan time)
+# ---------------------------------------------------------------------------
+
+class _HostString(Expression):
+    """Base for host-tier string expressions: scalar semantics in
+    host_eval_row; no columnar kernel (the rule tags them off-device)."""
+
+    def columnar_eval(self, batch):
+        raise NotImplementedError(
+            f"{type(self).__name__} runs on the host tier (CPU fallback)")
+
+    def with_children(self, cs):
+        raise NotImplementedError  # overridden per class
+
+
+class StringSplit(_HostString):
+    """split(str, regex[, limit]) -> array<string> (reference
+    GpuStringSplit; Java split semantics incl. trailing-empty removal
+    when limit == 0 and the literal fast path)."""
+
+    def __init__(self, child: Expression, pattern, limit=-1):
+        self.children = (child,)
+        self.pattern = pattern.value if isinstance(pattern, Literal) \
+            else pattern
+        self.limit = limit.value if isinstance(limit, Literal) else limit
+
+    def with_children(self, cs):
+        return StringSplit(cs[0], self.pattern, self.limit)
+
+    def _semantic_args(self):
+        return (self.pattern, self.limit)
+
+    @property
+    def data_type(self):
+        from ..types import ArrayType
+        return ArrayType(STRING)
+
+    def host_eval_row(self, s):
+        import re as _re
+        if s is None or not isinstance(self.pattern, str):
+            return None
+        limit = self.limit if isinstance(self.limit, int) else -1
+        parts = _re.split(self.pattern, s, maxsplit=limit - 1
+                          if limit > 0 else 0)
+        # Java split: ONLY limit == 0 strips trailing empties; negative
+        # limits keep them (Spark's default limit is -1)
+        if limit == 0:
+            while parts and parts[-1] == "":
+                parts.pop()
+        return parts
+
+
+class SubstringIndex(_HostString):
+    """substring_index(str, delim, count) (reference
+    GpuSubstringIndex)."""
+
+    def __init__(self, child: Expression, delim, count):
+        self.children = (child,)
+        self.delim = delim.value if isinstance(delim, Literal) else delim
+        self.count = count.value if isinstance(count, Literal) else count
+
+    def with_children(self, cs):
+        return SubstringIndex(cs[0], self.delim, self.count)
+
+    def _semantic_args(self):
+        return (self.delim, self.count)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def host_eval_row(self, s):
+        if s is None:
+            return None
+        d, c = self.delim, self.count
+        if not d or c == 0:
+            return ""
+        if c > 0:
+            parts = s.split(d)
+            return d.join(parts[:c]) if len(parts) > c else s
+        parts = s.split(d)
+        return d.join(parts[c:]) if len(parts) > -c else s
+
+
+class FindInSet(_HostString):
+    """find_in_set(str, comma_list) -> 1-based index or 0."""
+
+    def __init__(self, needle: Expression, set_col: Expression):
+        self.children = (needle, set_col)
+
+    def with_children(self, cs):
+        return FindInSet(cs[0], cs[1])
+
+    @property
+    def data_type(self):
+        from ..types import INT
+        return INT
+
+    def host_eval_row(self, needle, s):
+        if needle is None or s is None:
+            return None
+        if "," in needle:
+            return 0
+        items = s.split(",")
+        return items.index(needle) + 1 if needle in items else 0
+
+
+class RegExpExtract(_HostString):
+    """regexp_extract(str, pattern, idx) (reference GpuRegExpExtract over
+    the transpiled device regex; host tier here — Python re)."""
+
+    def __init__(self, child: Expression, pattern, idx=1):
+        self.children = (child,)
+        self.pattern = pattern.value if isinstance(pattern, Literal) \
+            else pattern
+        self.idx = idx.value if isinstance(idx, Literal) else idx
+
+    def with_children(self, cs):
+        return RegExpExtract(cs[0], self.pattern, self.idx)
+
+    def _semantic_args(self):
+        return (self.pattern, self.idx)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def host_eval_row(self, s):
+        import re as _re
+        if s is None or not isinstance(self.pattern, str):
+            return None
+        m = _re.search(self.pattern, s)
+        if m is None:
+            return ""
+        try:
+            g = m.group(self.idx)
+        except (IndexError, _re.error):
+            # Spark raises for an out-of-range group index — a typo must
+            # fail the query, not silently yield an all-null column
+            raise ValueError(
+                f"regexp_extract: group {self.idx} out of range for "
+                f"pattern {self.pattern!r} "
+                f"({_re.compile(self.pattern).groups} groups)")
+        return g if g is not None else ""
+
+
+class RegExpReplace(_HostString):
+    """regexp_replace(str, pattern, replacement) (reference
+    GpuRegExpReplace; host tier — Python re with Java-style $n rewritten
+    to \\n backrefs)."""
+
+    def __init__(self, child: Expression, pattern, replacement):
+        self.children = (child,)
+        self.pattern = pattern.value if isinstance(pattern, Literal) \
+            else pattern
+        self.replacement = replacement.value \
+            if isinstance(replacement, Literal) else replacement
+
+    def with_children(self, cs):
+        return RegExpReplace(cs[0], self.pattern, self.replacement)
+
+    def _semantic_args(self):
+        return (self.pattern, self.replacement)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def host_eval_row(self, s):
+        import re as _re
+        if s is None or not isinstance(self.pattern, str):
+            return None
+        # Java replacement dialect: $1 group refs, \$ literal dollar
+        rep = _re.sub(r"(?<!\\)\$(\d)", r"\\\1", self.replacement)
+        rep = rep.replace(r"\$", "$")
+        return _re.sub(self.pattern, rep, s)
+
+
+class FormatNumber(_HostString):
+    """format_number(x, d): thousands separators + d decimals."""
+
+    def __init__(self, child: Expression, decimals):
+        self.children = (child,)
+        self.decimals = decimals.value if isinstance(decimals, Literal) \
+            else decimals
+
+    def with_children(self, cs):
+        return FormatNumber(cs[0], self.decimals)
+
+    def _semantic_args(self):
+        return (self.decimals,)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def host_eval_row(self, v):
+        if v is None or self.decimals is None or self.decimals < 0:
+            return None
+        return f"{v:,.{int(self.decimals)}f}"
+
+
+class Levenshtein(_HostString):
+    """levenshtein(a, b) edit distance (reference GpuLevenshtein)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def with_children(self, cs):
+        return Levenshtein(cs[0], cs[1])
+
+    @property
+    def data_type(self):
+        from ..types import INT
+        return INT
+
+    def host_eval_row(self, a, b):
+        if a is None or b is None:
+            return None
+        if len(a) < len(b):
+            a, b = b, a
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(min(prev[j] + 1, cur[-1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return prev[-1]

@@ -1,0 +1,71 @@
+"""URL expressions (reference GpuParseUrl.scala + JNI ParseURI). Host
+row-engine tier, like the JSON family — the reference uses a dedicated
+CUDA URI parser; this engine routes parse_url through the CPU fallback
+transitions until a device kernel exists."""
+
+from __future__ import annotations
+
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..types import STRING
+from .core import Expression, Literal
+
+_PARTS = ("HOST", "PATH", "QUERY", "REF", "PROTOCOL", "FILE",
+          "AUTHORITY", "USERINFO")
+
+
+class ParseUrl(Expression):
+    """parse_url(url, part[, key]) with Spark's part names."""
+
+    def __init__(self, child: Expression, part, key=None):
+        self.children = (child,)
+        self.part = (part.value if isinstance(part, Literal)
+                     else part).upper()
+        self.key = key.value if isinstance(key, Literal) else key
+
+    def with_children(self, cs):
+        return ParseUrl(cs[0], self.part, self.key)
+
+    def _semantic_args(self):
+        return (self.part, self.key)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def host_eval_row(self, url) -> Optional[str]:
+        if url is None or self.part not in _PARTS:
+            return None
+        try:
+            p = urlparse(url)
+        except ValueError:
+            return None
+        if self.part == "HOST":
+            return p.hostname
+        if self.part == "PROTOCOL":
+            return p.scheme or None
+        if self.part == "PATH":
+            return p.path
+        if self.part == "QUERY":
+            if self.key is not None:
+                vals = parse_qs(p.query, keep_blank_values=True
+                                ).get(self.key)
+                return vals[0] if vals else None
+            return p.query or None
+        if self.part == "REF":
+            return p.fragment or None
+        if self.part == "FILE":
+            return p.path + ("?" + p.query if p.query else "")
+        if self.part == "AUTHORITY":
+            return p.netloc or None
+        if self.part == "USERINFO":
+            if p.username is None:
+                return None
+            return p.username + (f":{p.password}"
+                                 if p.password is not None else "")
+        return None
+
+    def columnar_eval(self, batch):
+        raise NotImplementedError(
+            "parse_url runs on the host tier (CPU fallback)")

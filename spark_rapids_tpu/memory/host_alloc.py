@@ -100,9 +100,14 @@ class HostAlloc:
         """Blocking: waits for releases like the reference's synchronous
         host alloc; HostOOM after timeout_s (callers' retry/split logic
         then shrinks the request)."""
-        if nbytes > self.limit_bytes:
+        # a request can only ever fit in ONE lane; waiting on a larger
+        # one would stall the full timeout against an empty pool
+        serveable = max(self.pinned_bytes,
+                        self.limit_bytes - self.pinned_bytes)
+        if nbytes > serveable:
             raise HostOOM(
-                f"request {nbytes} exceeds host limit {self.limit_bytes}")
+                f"request {nbytes} exceeds the largest host lane "
+                f"({serveable} of {self.limit_bytes} total)")
         deadline = None
         with self._lock:
             while True:

@@ -190,6 +190,74 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
        stringlike, BOOLEAN, tag_fn=_tag_regex)
     _r(rules, stringexprs.Like, "SQL LIKE pattern", stringlike, BOOLEAN,
        tag_fn=_tag_regex)
+    # bitwise + shifts (device kernels, expr/bitwise.py)
+    from ..expr import bitwise as bw
+    for c, d in ((bw.BitwiseAnd, "bitwise AND"),
+                 (bw.BitwiseOr, "bitwise OR"),
+                 (bw.BitwiseXor, "bitwise XOR"),
+                 (bw.BitwiseNot, "bitwise NOT")):
+        _r(rules, c, d, integral, integral)
+    for c, d in ((bw.ShiftLeft, "left shift"),
+                 (bw.ShiftRight, "arithmetic right shift"),
+                 (bw.ShiftRightUnsigned, "logical right shift")):
+        _r(rules, c, d, integral, integral)
+
+    # host-tier families: no device kernel yet — the rule exists so the
+    # operator is documented/type-checked, and the tag routes the node
+    # through the CPU fallback transitions (reference keeps several of
+    # these off-GPU in configurations too)
+    def _tag_host_tier(meta):
+        meta.will_not_work_on_tpu(
+            f"{type(meta.expr).__name__} is a host-tier expression "
+            "(runs via CPU fallback; no device kernel)")
+
+    from ..expr.jsonexprs import GetJsonObject, JsonToStructsField
+    from ..expr.urlexprs import ParseUrl
+    _r(rules, GetJsonObject, "JSON path extraction (host tier)",
+       stringlike, stringlike, tag_fn=_tag_host_tier)
+    _r(rules, JsonToStructsField, "from_json single field (host tier)",
+       stringlike, commonly_supported, tag_fn=_tag_host_tier)
+    _r(rules, ParseUrl, "URL part extraction (host tier)", stringlike,
+       stringlike, tag_fn=_tag_host_tier)
+    arrstr = TypeSig.of("ARRAY")
+    _r(rules, stringexprs.StringSplit, "regex split (host tier)",
+       stringlike, arrstr, tag_fn=_tag_host_tier)
+    _r(rules, stringexprs.SubstringIndex, "substring_index (host tier)",
+       stringlike, stringlike, tag_fn=_tag_host_tier)
+    _r(rules, stringexprs.FindInSet, "find_in_set (host tier)",
+       stringlike, integral, tag_fn=_tag_host_tier)
+    _r(rules, stringexprs.RegExpExtract, "regex group extract (host tier)",
+       stringlike, stringlike, tag_fn=_tag_host_tier)
+    _r(rules, stringexprs.RegExpReplace, "regex replace (host tier)",
+       stringlike, stringlike, tag_fn=_tag_host_tier)
+    _r(rules, stringexprs.FormatNumber, "format_number (host tier)",
+       numeric, stringlike, tag_fn=_tag_host_tier)
+    _r(rules, stringexprs.Levenshtein, "edit distance (host tier)",
+       stringlike, integral, tag_fn=_tag_host_tier)
+
+    # higher-order functions + collection long tail (host tier)
+    ce = collectionexprs
+    for c, d in ((ce.ArrayTransform, "transform() HOF"),
+                 (ce.ArrayFilter, "filter() HOF"),
+                 (ce.ArrayExists, "exists() HOF"),
+                 (ce.ArrayForAll, "forall() HOF"),
+                 (ce.ArrayAggregate, "aggregate() HOF"),
+                 (ce.ArrayPosition, "array_position"),
+                 (ce.ArrayRemove, "array_remove"),
+                 (ce.ArrayDistinct, "array_distinct"),
+                 (ce.Slice, "slice"),
+                 (ce.Flatten, "flatten"),
+                 (ce.ArraysOverlap, "arrays_overlap"),
+                 (ce.ArrayJoin, "array_join"),
+                 (ce.Sequence, "sequence")):
+        _r(rules, c, d + " (host tier)", commonly_supported,
+           commonly_supported, tag_fn=_tag_host_tier)
+
+    from ..expr.zorder import InterleaveBits
+    _r(rules, InterleaveBits,
+       "z-order bit interleave (device; reference GpuInterleaveBits)",
+       integral, integral)
+
     # null handling / misc
     from ..expr.udf import PythonUDF
     # inputs/outputs limited to the types the host boundary actually

@@ -1,0 +1,81 @@
+"""API validation — the reference's api_validation module
+(ApiValidation.scala: reflection-diff of Gpu exec signatures against each
+Spark version, catching registry drift; SURVEY §2.11). This engine's
+analog validates the rule registries against the expression classes by
+reflection, so a rule pointing at a renamed/missing surface fails CI
+instead of exploding at plan time."""
+
+import inspect
+
+import pytest
+
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.plan.overrides import expression_rules
+
+
+def test_every_rule_class_is_an_expression():
+    for cls in expression_rules():
+        assert issubclass(cls, Expression), cls
+
+
+def test_every_rule_class_has_eval_surface():
+    """Each registered expression must be evaluable SOMEWHERE: a real
+    columnar_eval override (device tier) or a host interpreter hook
+    (host tier / _EVALS / _SPECIAL)."""
+    from spark_rapids_tpu.exec.fallback import _EVALS, _SPECIAL
+    from spark_rapids_tpu.expr.core import (Alias, BoundReference, Literal,
+                                            UnresolvedAttribute)
+    leaves = (Alias, BoundReference, Literal, UnresolvedAttribute)
+    for cls in expression_rules():
+        if issubclass(cls, leaves):
+            continue
+        has_device = cls.columnar_eval is not Expression.columnar_eval \
+            and "NotImplementedError" not in (
+                inspect.getsource(cls.columnar_eval)
+                if cls.columnar_eval.__qualname__.startswith(cls.__name__)
+                else "x")
+        has_host = (cls in _EVALS or cls in _SPECIAL
+                    or hasattr(cls, "host_eval_row")
+                    or hasattr(cls, "host_eval_with_row"))
+        assert has_device or has_host, \
+            f"{cls.__name__} registered but not evaluable on any tier"
+
+
+def test_rule_descriptions_and_signatures_present():
+    for cls, rule in expression_rules().items():
+        assert rule.desc, cls
+        assert rule.input_sig.tags and rule.output_sig.tags, cls
+
+
+def test_with_children_reconstructs():
+    """Every non-leaf expression's with_children must round-trip its
+    children (the transform_up contract that resolution and the UDF
+    rewriter rely on)."""
+    from spark_rapids_tpu.expr.arithmetic import Add
+    from spark_rapids_tpu.expr.core import col, lit
+    from spark_rapids_tpu.expr.predicates import And, EqualTo
+    from spark_rapids_tpu.expr.stringexprs import RegExpReplace, Upper
+    for e in (Add(col("a"), lit(1)),
+              And(EqualTo(col("a"), lit(1)), EqualTo(col("b"), lit(2))),
+              Upper(col("s")),
+              RegExpReplace(col("s"), "a", "b")):
+        rebuilt = e.with_children(list(e.children))
+        assert type(rebuilt) is type(e)
+        assert len(rebuilt.children) == len(e.children)
+
+
+def test_exec_conversion_covers_all_logical_nodes():
+    """Every LogicalPlan node class must have a conversion in
+    PlanMeta.convert (the analog of 'every Spark exec has a Gpu
+    replacement or an explicit fallback')."""
+    import inspect as _i
+
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.overrides import PlanMeta
+    src = _i.getsource(PlanMeta.convert) \
+        + _i.getsource(PlanMeta._convert_join)
+    for name, cls in vars(L).items():
+        if (_i.isclass(cls) and issubclass(cls, L.LogicalPlan)
+                and cls is not L.LogicalPlan):
+            assert f"L.{name}" in src, \
+                f"{name} has no conversion in PlanMeta.convert"

@@ -1,0 +1,169 @@
+"""Second expression wave: device bitwise/shifts; host-tier JSON, URL,
+and string long tail routed through CPU fallback (reference families:
+bitwise rules, GpuGetJsonObject/JSONUtils, GpuParseUrl/ParseURI,
+GpuStringSplit/GpuSubstringIndex/GpuRegExpExtract/GpuRegExpReplace)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.expr.core import lit
+from spark_rapids_tpu.types import LONG, STRING, Schema, StructField
+
+
+def _run1(sess, data, sch, expr):
+    df = sess.from_pydict(data, sch)
+    return [r[0] for r in df.select(expr.alias("out")).collect()]
+
+
+# ---------------------------------------------------------------------------
+# device bitwise / shifts
+# ---------------------------------------------------------------------------
+
+def test_bitwise_ops_match_python():
+    from spark_rapids_tpu.expr.bitwise import (BitwiseAnd, BitwiseNot,
+                                               BitwiseOr, BitwiseXor)
+    sess = TpuSession()
+    sch = Schema((StructField("a", LONG), StructField("b", LONG)))
+    data = {"a": [0b1100, -7, None, 2**40], "b": [0b1010, 3, 5, -1]}
+    for cls, op in ((BitwiseAnd, lambda a, b: a & b),
+                    (BitwiseOr, lambda a, b: a | b),
+                    (BitwiseXor, lambda a, b: a ^ b)):
+        got = _run1(sess, data, sch, cls(col("a"), col("b")))
+        expect = [None if a is None or b is None else op(a, b)
+                  for a, b in zip(data["a"], data["b"])]
+        assert got == expect, cls.__name__
+    got = _run1(sess, data, sch, BitwiseNot(col("a")))
+    assert got == [~a if a is not None else None for a in data["a"]]
+
+
+def test_shifts_java_semantics():
+    sess = TpuSession()
+    sch = Schema((StructField("a", LONG), StructField("n", LONG)))
+    data = {"a": [1, -8, 2**62, 5], "n": [3, 1, 65, 70]}
+    got = _run1(sess, data, sch, F.shiftleft(col("a"), col("n")))
+    # Java: distance masked to 63 for longs
+    expect = []
+    for a, n in zip(data["a"], data["n"]):
+        v = (a << (n & 63)) & ((1 << 64) - 1)
+        expect.append(v - (1 << 64) if v >= (1 << 63) else v)
+    assert got == expect
+    got = _run1(sess, data, sch, F.shiftright(col("a"), col("n")))
+    assert got == [a >> (n & 63) for a, n in zip(data["a"], data["n"])]
+    got = _run1(sess, data, sch,
+                F.shiftrightunsigned(col("a"), col("n")))
+    assert got == [(a & ((1 << 64) - 1)) >> (n & 63)
+                   for a, n in zip(data["a"], data["n"])]
+
+
+# ---------------------------------------------------------------------------
+# host-tier JSON / URL
+# ---------------------------------------------------------------------------
+
+STR_SCH = Schema((StructField("s", STRING),))
+
+
+def test_get_json_object():
+    sess = TpuSession()
+    data = {"s": ['{"a":{"b":[1,2,3]},"x":"y"}', '{"a":1}',
+                  "not json", None]}
+    q = sess.from_pydict(data, STR_SCH).select(
+        F.get_json_object(col("s"), "$.a.b[1]").alias("o"))
+    assert "HostProjectExec" in q._exec().tree_string()
+    assert [r[0] for r in q.collect()] == ["2", None, None, None]
+    # string scalar renders bare; object renders as JSON
+    got = _run1(sess, data, STR_SCH,
+                F.get_json_object(col("s"), "$.x"))
+    assert got == ["y", None, None, None]
+    got = _run1(sess, data, STR_SCH, F.get_json_object(col("s"), "$.a"))
+    assert got == ['{"b":[1,2,3]}', "1", None, None]
+
+
+def test_get_json_object_wildcard():
+    sess = TpuSession()
+    data = {"s": ['{"a":[{"b":1},{"b":2}]}']}
+    got = _run1(sess, data, STR_SCH,
+                F.get_json_object(col("s"), "$.a[*].b"))
+    assert got == ["[1,2]"]
+
+
+def test_parse_url_parts():
+    sess = TpuSession()
+    url = "https://user:pw@example.com:8443/p/a?x=1&y=2#frag"
+    data = {"s": [url, None]}
+    cases = {"HOST": "example.com", "PATH": "/p/a", "QUERY": "x=1&y=2",
+             "REF": "frag", "PROTOCOL": "https",
+             "FILE": "/p/a?x=1&y=2", "AUTHORITY": "user:pw@example.com:8443",
+             "USERINFO": "user:pw"}
+    for part, expect in cases.items():
+        got = _run1(sess, data, STR_SCH, F.parse_url(col("s"), part))
+        assert got == [expect, None], part
+    got = _run1(sess, data, STR_SCH, F.parse_url(col("s"), "QUERY", "y"))
+    assert got == ["2", None]
+
+
+# ---------------------------------------------------------------------------
+# host-tier string long tail
+# ---------------------------------------------------------------------------
+
+def test_split_and_substring_index():
+    sess = TpuSession()
+    data = {"s": ["a,b,,c,,", "nodelim", None]}
+    # default limit -1 KEEPS trailing empties (Java split semantics)
+    got = _run1(sess, data, STR_SCH, F.split(col("s"), ","))
+    assert got == [["a", "b", "", "c", "", ""], ["nodelim"], None]
+    # limit 0 strips them
+    got = _run1(sess, data, STR_SCH, F.split(col("s"), ",", 0))
+    assert got == [["a", "b", "", "c"], ["nodelim"], None]
+    got = _run1(sess, data, STR_SCH,
+                F.substring_index(col("s"), ",", 2))
+    assert got == ["a,b", "nodelim", None]
+    got = _run1(sess, data, STR_SCH,
+                F.substring_index(col("s"), ",", -2))
+    assert got == [",", "nodelim", None]
+
+
+def test_regexp_extract_and_replace():
+    sess = TpuSession()
+    data = {"s": ["ab123cd", "xyz", None]}
+    got = _run1(sess, data, STR_SCH,
+                F.regexp_extract(col("s"), r"([a-z]+)(\d+)", 2))
+    assert got == ["123", "", None]
+    got = _run1(sess, data, STR_SCH,
+                F.regexp_replace(col("s"), r"(\d+)", r"<$1>"))
+    assert got == ["ab<123>cd", "xyz", None]
+
+
+def test_find_in_set_format_number_levenshtein():
+    sess = TpuSession()
+    sch2 = Schema((StructField("a", STRING), StructField("b", STRING)))
+    data = {"a": ["b", "x", "a,b", None],
+            "b": ["a,b,c", "a,b,c", "a,b,c", "a"]}
+    df = sess.from_pydict(data, sch2)
+    got = [r[0] for r in df.select(
+        F.find_in_set(col("a"), col("b")).alias("o")).collect()]
+    assert got == [2, 0, 0, None]
+
+    num_sch = Schema((StructField("v", LONG),))
+    got = _run1(sess, {"v": [1234567, -42, None]}, num_sch,
+                F.format_number(col("v"), 2))
+    assert got == ["1,234,567.00", "-42.00", None]
+
+    got = [r[0] for r in df.select(
+        F.levenshtein(col("a"), col("b")).alias("o")).collect()]
+    assert got == [4, 5, 2, None]  # lev("a,b","a,b,c") = 2
+
+
+def test_bad_regex_pattern_fails_plan_not_midquery():
+    sess = TpuSession({"spark.rapids.sql.cpuFallback.enabled": "false"})
+    df = sess.from_pydict({"s": ["x"]}, STR_SCH)
+    from spark_rapids_tpu.plan.overrides import PlanNotSupported
+    with pytest.raises(PlanNotSupported):
+        df.select(F.regexp_extract(col("s"), r"(", 1).alias("o"))._exec()
+    # even with fallback on: unparseable pattern cannot run anywhere
+    relaxed = TpuSession()
+    df2 = relaxed.from_pydict({"s": ["x"]}, STR_SCH)
+    with pytest.raises(PlanNotSupported):
+        df2.select(F.regexp_extract(col("s"), r"(", 1).alias("o"))._exec()

@@ -1,0 +1,99 @@
+"""Higher-order functions + collection long tail (reference
+higherOrderFunctions.scala / collectionOperations.scala; host-tier
+through CPU fallback)."""
+
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.expr.core import lit
+from spark_rapids_tpu.types import (LONG, STRING, ArrayType, Schema,
+                                    StructField)
+
+ARR_SCH = Schema((StructField("a", ArrayType(LONG)),
+                  StructField("k", LONG)))
+
+
+def _df(sess):
+    return sess.from_pydict(
+        {"a": [[1, 2, 3], [], None, [4, None, 6]],
+         "k": [10, 20, 30, 40]}, ARR_SCH)
+
+
+def _run(sess, expr):
+    return [r[0] for r in _df(sess).select(expr.alias("o")).collect()]
+
+
+def test_transform_with_outer_column():
+    sess = TpuSession()
+    got = _run(sess, F.transform(col("a"), lambda x: x + col("k")))
+    assert got == [[11, 12, 13], [], None, [44, None, 46]]
+
+
+def test_filter_exists_forall():
+    sess = TpuSession()
+    assert _run(sess, F.filter_(col("a"), lambda x: x > lit(1))) == \
+        [[2, 3], [], None, [4, 6]]
+    assert _run(sess, F.exists(col("a"), lambda x: x > lit(5))) == \
+        [False, False, None, True]
+    # forall with a NULL element and no False → NULL (3-valued)
+    assert _run(sess, F.forall(col("a"), lambda x: x > lit(0))) == \
+        [True, True, None, None]
+
+
+def test_aggregate_hof():
+    sess = TpuSession()
+    got = _run(sess, F.aggregate(col("a"), lit(0),
+                                 lambda acc, x: acc + x))
+    assert got == [6, 0, None, None]  # null element poisons the sum
+    got = _run(sess, F.aggregate(col("a"), lit(1),
+                                 lambda acc, x: acc * lit(2),
+                                 finish=lambda acc: acc + lit(100)))
+    assert got == [108, 101, None, 108]
+
+
+def test_collection_long_tail():
+    sess = TpuSession()
+    assert _run(sess, F.array_position(col("a"), lit(2))) == \
+        [1 + 1, 0, None, 0]
+    assert _run(sess, F.array_remove(col("a"), lit(2))) == \
+        [[1, 3], [], None, [4, None, 6]]
+    assert _run(sess, F.slice(col("a"), lit(2), lit(2))) == \
+        [[2, 3], [], None, [None, 6]]
+    assert _run(sess, F.arrays_overlap(col("a"), F.array(lit(3), lit(9)))) \
+        == [True, False, None, None]
+    assert _run(sess, F.array_join(col("a"), ",", "NULL")) == \
+        ["1,2,3", "", None, "4,NULL,6"]
+    assert _run(sess, F.sequence(lit(1), col("k"), lit(7))) == \
+        [[1, 8], [1, 8, 15], [1, 8, 15, 22, 29], [1, 8, 15, 22, 29, 36]]
+
+
+def test_array_distinct():
+    sess = TpuSession()
+    sch = Schema((StructField("a", ArrayType(LONG)),))
+    df = sess.from_pydict({"a": [[1, 2, 1, None, None, 2], None]}, sch)
+    got = [r[0] for r in df.select(
+        F.array_distinct(col("a")).alias("o")).collect()]
+    assert got == [[1, 2, None], None]
+
+
+def test_flatten_scalar_semantics():
+    """The columnar substrate has no nested-array ingestion yet, so
+    flatten is exercised at the host-interpreter level (its planner path
+    activates once nested array columns exist)."""
+    from spark_rapids_tpu.expr.collectionexprs import Flatten
+    from spark_rapids_tpu.expr.core import col as c_
+    f = Flatten(c_("a"))
+    assert f.host_eval_row([[1, 2], [3]]) == [1, 2, 3]
+    assert f.host_eval_row([[1], None]) is None
+    assert f.host_eval_row(None) is None
+
+
+def test_hof_plans_through_host_tier():
+    sess = TpuSession()
+    q = _df(sess).select(F.transform(col("a"), lambda x: x * 2).alias("o"))
+    tree = q._exec().tree_string()
+    assert "HostProjectExec" in tree
+    assert "will run on CPU" in _df(sess).select(
+        F.transform(col("a"), lambda x: x * 2).alias("o")).explain()
