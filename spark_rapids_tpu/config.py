@@ -196,6 +196,16 @@ CPU_FALLBACK_ENABLED = conf_bool(
     "the host interpreter implements fall back; others still fail with "
     "the full explain report.", commonly_used=True)
 
+JOIN_SUBPARTITION_THRESHOLD = conf_bytes(
+    "spark.rapids.sql.join.subPartitionThreshold", 1 << 30,
+    "When a join BUILD side's estimated size exceeds this, the planner "
+    "splits the join into hash sub-partitions via the host shuffle so "
+    "each sub-partition's build side fits device memory — the "
+    "reference's GpuSubPartitionHashJoin.scala:547 big-build-side "
+    "strategy. Requires shuffle mode MULTITHREADED; raises (never "
+    "lowers) spark.rapids.sql.shuffle.partitions. -1 disables.",
+    commonly_used=True)
+
 SHUFFLE_PARTITIONS = conf_int(
     "spark.rapids.sql.shuffle.partitions", 1,
     "Partition count for host-shuffled stages (Spark's "

@@ -84,6 +84,9 @@ class GetJsonObject(Expression):
     def __init__(self, child: Expression, path):
         self.children = (child,)
         self.path = path.value if isinstance(path, Literal) else path
+        # parse the constant path ONCE, not per row in the hot loop
+        self._steps = parse_json_path(self.path) \
+            if isinstance(self.path, str) else None
 
     def with_children(self, cs):
         return GetJsonObject(cs[0], self.path)
@@ -96,10 +99,8 @@ class GetJsonObject(Expression):
         return STRING
 
     def host_eval_row(self, s):
-        if s is None or not isinstance(self.path, str):
-            return None
-        steps = parse_json_path(self.path)
-        if steps is None:
+        steps = self._steps
+        if s is None or steps is None:
             return None
         try:
             doc = json.loads(s)

@@ -530,6 +530,8 @@ class StringSplit(_HostString):
         if s is None or not isinstance(self.pattern, str):
             return None
         limit = self.limit if isinstance(self.limit, int) else -1
+        if limit == 1:
+            return [s]  # Java: at most 1 element = no split at all
         parts = _re.split(self.pattern, s, maxsplit=limit - 1
                           if limit > 0 else 0)
         # Java split: ONLY limit == 0 strips trailing empties; negative
@@ -660,8 +662,10 @@ class RegExpReplace(_HostString):
         import re as _re
         if s is None or not isinstance(self.pattern, str):
             return None
-        # Java replacement dialect: $1 group refs, \$ literal dollar
-        rep = _re.sub(r"(?<!\\)\$(\d)", r"\\\1", self.replacement)
+        # Java replacement dialect: $1 group refs, \$ literal dollar.
+        # \g<1> (not \1) so a digit FOLLOWING the reference stays literal
+        # ('<$10>' with one group = group 1 then '0', like Java)
+        rep = _re.sub(r"(?<!\\)\$(\d)", r"\\g<\1>", self.replacement)
         rep = rep.replace(r"\$", "$")
         return _re.sub(self.pattern, rep, s)
 

@@ -20,8 +20,9 @@ class ParseUrl(Expression):
 
     def __init__(self, child: Expression, part, key=None):
         self.children = (child,)
-        self.part = (part.value if isinstance(part, Literal)
-                     else part).upper()
+        # Spark's parse_url is CASE-SENSITIVE: 'host' is an unknown part
+        # and yields NULL, only 'HOST' extracts
+        self.part = part.value if isinstance(part, Literal) else part
         self.key = key.value if isinstance(key, Literal) else key
 
     def with_children(self, cs):

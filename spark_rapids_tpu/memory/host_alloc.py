@@ -100,10 +100,12 @@ class HostAlloc:
         """Blocking: waits for releases like the reference's synchronous
         host alloc; HostOOM after timeout_s (callers' retry/split logic
         then shrinks the request)."""
-        # a request can only ever fit in ONE lane; waiting on a larger
-        # one would stall the full timeout against an empty pool
-        serveable = max(self.pinned_bytes,
-                        self.limit_bytes - self.pinned_bytes)
+        # a request can only ever fit in a lane it is ALLOWED to use;
+        # waiting on a larger one would stall the full timeout against
+        # an empty pool (non-pinned requests never enter the fast lane)
+        general_cap = self.limit_bytes - self.pinned_bytes
+        serveable = max(general_cap,
+                        self.pinned_bytes if prefer_pinned else 0)
         if nbytes > serveable:
             raise HostOOM(
                 f"request {nbytes} exceeds the largest host lane "

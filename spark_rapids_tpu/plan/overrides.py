@@ -710,6 +710,33 @@ class PlanMeta(BaseMeta):
             if out is not None:
                 return out
         n_parts = self._host_shuffle_partitions()
+        # sub-partitioned join (reference GpuSubPartitionHashJoin.scala
+        # :547): a BUILD side too big for device memory splits the join
+        # into hash sub-partitions — same-key rows colocate, so the
+        # union of per-sub-partition joins is exact. Folded into the
+        # host-shuffle partition count so an explicit shuffle.partitions
+        # setting can only RAISE the split, never bypass the memory
+        # bound; gated on the same MULTITHREADED mode as every other
+        # host-shuffle path (_host_shuffle_partitions returns 1
+        # otherwise, and the threshold respects that).
+        from ..config import JOIN_SUBPARTITION_THRESHOLD, SHUFFLE_MODE
+        thr_sub = self.conf.get(JOIN_SUBPARTITION_THRESHOLD)
+        if mesh is None and thr_sub >= 0 and size_r is not None \
+                and size_r > thr_sub \
+                and self.conf.get(SHUFFLE_MODE).upper() == "MULTITHREADED":
+            # size from the BUILD side (ShuffledHashJoinExec builds
+            # right); cap guards runaway partition-file counts — the
+            # reference re-splits recursively instead, so log when the
+            # cap leaves sub-builds over the threshold
+            k = -(-size_r // max(thr_sub, 1))
+            if k > 256:
+                import logging
+                logging.getLogger("spark_rapids_tpu.plan").warning(
+                    "sub-partitioned join capped at 256 partitions; "
+                    "build side ~%d bytes still exceeds %d per "
+                    "sub-partition", size_r, thr_sub)
+                k = 256
+            n_parts = max(n_parts, int(k))
         if mesh is None and n_parts > 1:
             out = self._convert_host_shuffled_join(p, kids[0], kids[1],
                                                    n_parts)

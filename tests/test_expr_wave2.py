@@ -167,3 +167,21 @@ def test_bad_regex_pattern_fails_plan_not_midquery():
     df2 = relaxed.from_pydict({"s": ["x"]}, STR_SCH)
     with pytest.raises(PlanNotSupported):
         df2.select(F.regexp_extract(col("s"), r"(", 1).alias("o"))._exec()
+
+
+def test_split_limit_one_and_dollar_digit_replacement():
+    """Java semantics edge cases: limit=1 means NO split; '$1' followed
+    by a digit in the replacement stays group-1 + literal digit."""
+    sess = TpuSession()
+    got = _run1(sess, {"s": ["a,b,c"]}, STR_SCH, F.split(col("s"), ",", 1))
+    assert got == [["a,b,c"]]
+    got = _run1(sess, {"s": ["x42y"]}, STR_SCH,
+                F.regexp_replace(col("s"), r"(\d+)", "<$10>"))
+    assert got == ["x<420>y"]
+
+
+def test_parse_url_part_is_case_sensitive():
+    sess = TpuSession()
+    got = _run1(sess, {"s": ["https://e.com/p"]}, STR_SCH,
+                F.parse_url(col("s"), "host"))
+    assert got == [None]  # Spark: unknown (lowercase) part -> NULL

@@ -111,3 +111,35 @@ def test_cbo_keeps_large_section_on_device():
         {"k": list(range(5000)), "s": ["x"] * 5000}, SCH)
     tree = big.select((col("k") + lit(1)).alias("k2"))._exec().tree_string()
     assert "HostProjectExec" not in tree
+
+
+def test_subpartitioned_join_for_big_build_side():
+    """Both sides over the sub-partition threshold: the planner splits
+    the join into hash sub-partitions through the host shuffle
+    (reference GpuSubPartitionHashJoin.scala:547) — results identical to
+    the in-memory join."""
+    rng = np.random.default_rng(9)
+    n = 800
+    ldata = {"k": [int(x) for x in rng.integers(0, 40, n)],
+             "v": [int(x) for x in rng.integers(0, 100, n)]}
+    rdata = {"k": [int(x) for x in rng.integers(0, 40, n)],
+             "w": [int(x) for x in rng.integers(0, 100, n)]}
+    lsch = Schema((StructField("k", LONG), StructField("v", LONG)))
+    rsch = Schema((StructField("k", LONG), StructField("w", LONG)))
+
+    def q(sess):
+        l = sess.from_pydict(ldata, lsch, batch_rows=128)
+        r = sess.from_pydict(rdata, rsch, batch_rows=128)
+        return l.join(r, on="k")
+
+    sub = TpuSession({
+        # tiny threshold: both sides "exceed device memory"
+        "spark.rapids.sql.join.subPartitionThreshold": "1024",
+        "spark.rapids.sql.broadcastSizeThreshold": "-1"})
+    plain = TpuSession({
+        "spark.rapids.sql.join.subPartitionThreshold": "-1",
+        "spark.rapids.sql.broadcastSizeThreshold": "-1"})
+    tree = q(sub)._exec().tree_string()
+    assert "ShuffledHashJoinExec" in tree
+    assert "HostShuffleExchangeExec" in tree
+    assert _sorted(q(sub).collect()) == _sorted(q(plain).collect())

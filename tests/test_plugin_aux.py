@@ -218,3 +218,17 @@ def test_operator_failure_dumps_real_exception(tmp_path):
         assert glob.glob(os.path.join(d, "input-*.parquet"))
     finally:
         set_active_conf(RapidsConf({}))
+
+
+def test_host_alloc_unserveable_nonpinned_fast_fails():
+    """A non-pinned request larger than the general lane must fail
+    immediately, not stall the timeout (the pinned lane is not an
+    option for it)."""
+    import time as _t
+    pool = HostAlloc(limit_bytes=100, pinned_bytes=80)
+    t0 = _t.monotonic()
+    with pytest.raises(HostOOM):
+        pool.alloc(50, prefer_pinned=False, timeout_s=10)
+    assert _t.monotonic() - t0 < 1
+    a = pool.alloc(50, prefer_pinned=True)   # pinned lane fits it
+    a.close()

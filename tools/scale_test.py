@@ -5,7 +5,7 @@ timing report.
 
 Usage:
     python tools/scale_test.py [--scale 1.0] [--out report.json]
-                               [--queries q1,q3,...] [--platform cpu|tpu]
+                               [--queries q1,q3,...] [--platform cpu|default]
 
 Tables (scaled by --scale, base ~1M rows):
     facts(k long, cat string, v double, ts timestamp)
@@ -82,7 +82,9 @@ def main():
     ap.add_argument("--out", default="scale_report.json")
     ap.add_argument("--queries", default="")
     ap.add_argument("--platform", default="cpu",
-                    choices=("cpu", "default"))
+                    choices=("cpu", "default"),
+                    help="cpu pins the CPU backend; default uses whatever "
+                         "jax selects (the TPU under axon)")
     args = ap.parse_args()
 
     sess = build_session(args.platform)
