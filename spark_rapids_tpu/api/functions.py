@@ -567,3 +567,33 @@ def sequence(start, stop, step=None):
     from ..expr.collectionexprs import Sequence
     return Sequence(_e(start), _e(stop),
                     _e(step) if step is not None else None)
+
+
+def base64(x):
+    from ..expr.stringexprs import Base64Encode
+    return Base64Encode(_e(x))
+
+
+def unbase64(x):
+    from ..expr.stringexprs import UnBase64
+    return UnBase64(_e(x))
+
+
+def hex(x):  # noqa: A001 - Spark name
+    from ..expr.stringexprs import Hex
+    return Hex(_e(x))
+
+
+def unhex(x):
+    from ..expr.stringexprs import Unhex
+    return Unhex(_e(x))
+
+
+def encode(x, charset):
+    from ..expr.stringexprs import Encode
+    return Encode(_e(x), charset)
+
+
+def decode(x, charset):
+    from ..expr.stringexprs import Decode
+    return Decode(_e(x), charset)

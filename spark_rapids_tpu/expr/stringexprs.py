@@ -721,3 +721,174 @@ class Levenshtein(_HostString):
                                prev[j - 1] + (ca != cb)))
             prev = cur
         return prev[-1]
+
+
+class Base64Encode(_HostString):
+    """base64(bin) (reference GpuBase64): input str is encoded utf-8."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, cs):
+        return Base64Encode(cs[0])
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def host_eval_row(self, v):
+        import base64 as _b
+        if v is None:
+            return None
+        raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+        return _b.b64encode(raw).decode("ascii")
+
+
+class UnBase64(_HostString):
+    """unbase64(str) -> binary (reference GpuUnBase64)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, cs):
+        return UnBase64(cs[0])
+
+    @property
+    def data_type(self):
+        from ..types import BINARY
+        return BINARY
+
+    def host_eval_row(self, v):
+        import base64 as _b
+        import binascii
+        if v is None:
+            return None
+        if isinstance(v, (bytes, bytearray)):
+            v = bytes(v).decode("ascii", errors="ignore")
+        # java.util.Base64 is lenient about missing padding; Python is
+        # not — pad up before decoding
+        v = v + "=" * (-len(v) % 4)
+        try:
+            return _b.b64decode(v, validate=False)
+        except (ValueError, binascii.Error):
+            return None
+
+
+class Hex(_HostString):
+    """hex(long | str): uppercase hex, Spark's minimal-width long form."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, cs):
+        return Hex(cs[0])
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def host_eval_row(self, v):
+        if v is None:
+            return None
+        if isinstance(v, str):
+            return v.encode("utf-8").hex().upper()
+        if isinstance(v, (bytes, bytearray)):
+            return bytes(v).hex().upper()
+        return format(v & ((1 << 64) - 1), "X")
+
+
+class Unhex(_HostString):
+    """unhex(str) -> binary; NULL on malformed input (odd-length input
+    gets a leading 0, like Spark)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, cs):
+        return Unhex(cs[0])
+
+    @property
+    def data_type(self):
+        from ..types import BINARY
+        return BINARY
+
+    def host_eval_row(self, v):
+        import re as _re
+        if v is None:
+            return None
+        if isinstance(v, (bytes, bytearray)):
+            v = bytes(v).decode("ascii", errors="ignore")
+        # Spark rejects ANY non-hex character incl. whitespace (Python's
+        # bytes.fromhex would silently skip spaces)
+        if not _re.fullmatch(r"[0-9A-Fa-f]*", v):
+            return None
+        if len(v) % 2:
+            v = "0" + v
+        try:
+            return bytes.fromhex(v)
+        except ValueError:
+            return None
+
+
+class Encode(_HostString):
+    """encode(str, charset) -> binary."""
+
+    _CHARSETS = ("US-ASCII", "ISO-8859-1", "UTF-8", "UTF-16BE",
+                 "UTF-16LE", "UTF-16")
+
+    def __init__(self, child: Expression, charset):
+        self.children = (child,)
+        self.charset = charset.value if isinstance(charset, Literal) \
+            else charset
+        # Spark raises for an unknown charset at analysis time — a typo
+        # must not silently NULL the whole column
+        if isinstance(self.charset, str) \
+                and self.charset.upper() not in self._CHARSETS:
+            raise ValueError(f"unsupported charset {self.charset!r}")
+
+    def with_children(self, cs):
+        return Encode(cs[0], self.charset)
+
+    def _semantic_args(self):
+        return (self.charset,)
+
+    @property
+    def data_type(self):
+        from ..types import BINARY
+        return BINARY
+
+    def host_eval_row(self, v):
+        if v is None:
+            return None
+        # Java String.getBytes replaces unmappable chars with '?'
+        return v.encode(self.charset.replace("-", "_"), errors="replace")
+
+
+class Decode(_HostString):
+    """decode(bin, charset) -> string."""
+
+    def __init__(self, child: Expression, charset):
+        self.children = (child,)
+        self.charset = charset.value if isinstance(charset, Literal) \
+            else charset
+        if isinstance(self.charset, str) \
+                and self.charset.upper() not in Encode._CHARSETS:
+            raise ValueError(f"unsupported charset {self.charset!r}")
+
+    def with_children(self, cs):
+        return Decode(cs[0], self.charset)
+
+    def _semantic_args(self):
+        return (self.charset,)
+
+    @property
+    def data_type(self):
+        return STRING
+
+    def host_eval_row(self, v):
+        if v is None:
+            return None
+        raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+        # Java new String(bytes, cs) substitutes U+FFFD for bad bytes
+        return raw.decode(self.charset.replace("-", "_"),
+                          errors="replace")

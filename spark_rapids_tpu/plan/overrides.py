@@ -234,6 +234,19 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
        numeric, stringlike, tag_fn=_tag_host_tier)
     _r(rules, stringexprs.Levenshtein, "edit distance (host tier)",
        stringlike, integral, tag_fn=_tag_host_tier)
+    # per-expression input signatures: only types the host evaluators
+    # actually handle may reach them
+    strbin = stringlike
+    stronly = TypeSig.of("STRING")
+    for c, d, in_sig in (
+            (stringexprs.Base64Encode, "base64 encode", strbin),
+            (stringexprs.UnBase64, "base64 decode", strbin),
+            (stringexprs.Hex, "hex encode", strbin + integral),
+            (stringexprs.Unhex, "hex decode", strbin),
+            (stringexprs.Encode, "charset encode", stronly),
+            (stringexprs.Decode, "charset decode", strbin)):
+        _r(rules, c, d + " (host tier)", in_sig, strbin,
+           tag_fn=_tag_host_tier)
 
     # higher-order functions + collection long tail (host tier)
     ce = collectionexprs

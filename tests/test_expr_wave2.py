@@ -185,3 +185,38 @@ def test_parse_url_part_is_case_sensitive():
     got = _run1(sess, {"s": ["https://e.com/p"]}, STR_SCH,
                 F.parse_url(col("s"), "host"))
     assert got == [None]  # Spark: unknown (lowercase) part -> NULL
+
+
+def test_base64_hex_encode_family():
+    sess = TpuSession()
+    data = {"s": ["hello", "", None]}
+    assert _run1(sess, data, STR_SCH, F.base64(col("s"))) == \
+        ["aGVsbG8=", "", None]
+    assert _run1(sess, data, STR_SCH,
+                 F.decode(F.unbase64(F.base64(col("s"))), "UTF-8")) == \
+        ["hello", "", None]
+    assert _run1(sess, data, STR_SCH, F.hex(col("s"))) == \
+        ["68656C6C6F", "", None]
+    num_sch = Schema((StructField("v", LONG),))
+    assert _run1(sess, {"v": [255, -1, None]}, num_sch,
+                 F.hex(col("v"))) == ["FF", "FFFFFFFFFFFFFFFF", None]
+    assert _run1(sess, {"s": ["4A4B", "XYZ", None]}, STR_SCH,
+                 F.decode(F.unhex(col("s")), "UTF-8")) == \
+        ["JK", None, None]
+
+
+def test_base64_hex_spark_edge_semantics():
+    """Review-driven edge cases: unpadded base64 decodes leniently,
+    whitespace in hex is rejected (NULL), unmappable chars encode as
+    '?', bad bytes decode as U+FFFD, unknown charsets fail loudly."""
+    sess = TpuSession()
+    assert _run1(sess, {"s": ["YWJj", "YWJjZA", None]}, STR_SCH,
+                 F.decode(F.unbase64(col("s")), "UTF-8")) == \
+        ["abc", "abcd", None]                    # no-padding accepted
+    assert _run1(sess, {"s": ["4A 4B"]}, STR_SCH,
+                 F.unhex(col("s"))) == [None]    # whitespace -> NULL
+    assert _run1(sess, {"s": ["héllo"]}, STR_SCH,
+                 F.decode(F.encode(col("s"), "US-ASCII"), "US-ASCII")) \
+        == ["h?llo"]                             # '?' substitution
+    with pytest.raises(ValueError, match="charset"):
+        F.encode(col("s"), "KOI8-R")             # analysis-time error
