@@ -187,18 +187,17 @@ class DeltaLog:
         import pyarrow.parquet as pq
         table = pq.read_table(self._checkpoint_file(v))
         if "action" in table.column_names:
-            # this engine's checkpoint layout: one JSON action per row
-            # (delta's struct-typed checkpoint needs map<string,string>
-            # columns the arrow→parquet writer can't express empty)
+            # pre-round-3 layout of this engine: one JSON action per row
             for s in table.column("action").to_pylist():
                 yield json.loads(s)
             return
         for row in table.to_pylist():
-            # delta-spark checkpoint: one struct column per action type
+            # delta-spark checkpoint: one struct column per action type;
+            # arrow map<string,string> cells surface as [(k, v), ...]
             for key in ("metaData", "add", "remove", "protocol", "txn"):
                 val = row.get(key)
                 if val is not None:
-                    yield {key: _strip_nones(val)}
+                    yield {key: _strip_nones(_maps_to_dicts(val))}
 
     def snapshot(self, version: Optional[int] = None) -> Snapshot:
         latest = self.latest_version()
@@ -232,7 +231,7 @@ class DeltaLog:
             elif "add" in a:
                 ad = a["add"]
                 adds[ad["path"]] = AddFile(
-                    ad["path"], ad.get("partitionValues", {}),
+                    ad["path"], dict(ad.get("partitionValues") or {}),
                     ad.get("size", 0), ad.get("stats"),
                     ad.get("modificationTime", 0))
             elif "remove" in a:
@@ -262,17 +261,69 @@ class DeltaLog:
         return expected_version
 
     def _write_checkpoint(self, v: int) -> None:
+        """Write the Delta-protocol struct-typed checkpoint: one parquet
+        file with nullable `protocol`/`metaData`/`add` struct columns, one
+        action per row (delta-spark's classic checkpoint layout), so an
+        external delta reader can load the table past the checkpoint.
+        Reference behavior: delta-core Checkpoints.writeCheckpoint used via
+        /root/reference/delta-lake (GpuOptimisticTransaction commits)."""
         import pyarrow as pa
         import pyarrow.parquet as pq
         snap = self.snapshot(v)
-        rows = [json.dumps({"metaData": snap.metadata})]
+        strmap = pa.map_(pa.string(), pa.string())
+        protocol_t = pa.struct([
+            ("minReaderVersion", pa.int32()),
+            ("minWriterVersion", pa.int32())])
+        metadata_t = pa.struct([
+            ("id", pa.string()),
+            ("name", pa.string()),
+            ("description", pa.string()),
+            ("format", pa.struct([("provider", pa.string()),
+                                  ("options", strmap)])),
+            ("schemaString", pa.string()),
+            ("partitionColumns", pa.list_(pa.string())),
+            ("configuration", strmap),
+            ("createdTime", pa.int64())])
+        add_t = pa.struct([
+            ("path", pa.string()),
+            ("partitionValues", strmap),
+            ("size", pa.int64()),
+            ("modificationTime", pa.int64()),
+            ("dataChange", pa.bool_()),
+            ("stats", pa.string())])
+        md = dict(snap.metadata)
+        fmt = md.get("format") or {}
+        md_row = {
+            "id": md.get("id"),
+            "name": md.get("name"),
+            "description": md.get("description"),
+            "format": {"provider": fmt.get("provider", "parquet"),
+                       "options": dict(fmt.get("options") or {})},
+            "schemaString": md.get("schemaString"),
+            "partitionColumns": list(md.get("partitionColumns") or []),
+            "configuration": dict(md.get("configuration") or {}),
+            "createdTime": md.get("createdTime")}
+        proto = self.protocol_action()["protocol"]
+        n_actions = 2 + len(snap.files)
+        protocol_col = [proto] + [None] * (n_actions - 1)
+        metadata_col = [None, md_row] + [None] * len(snap.files)
+        add_col: List[Optional[dict]] = [None, None]
         for f in snap.files:
-            rows.append(json.dumps(f.to_action()))
-        pq.write_table(pa.table({"action": pa.array(rows, pa.string())}),
-                       self._checkpoint_file(v))
+            add_col.append({
+                "path": f.path,
+                "partitionValues": dict(f.partition_values or {}),
+                "size": f.size,
+                "modificationTime": f.modification_time,
+                "dataChange": False,
+                "stats": f.stats})
+        table = pa.table({
+            "protocol": pa.array(protocol_col, protocol_t),
+            "metaData": pa.array(metadata_col, metadata_t),
+            "add": pa.array(add_col, add_t)})
+        pq.write_table(table, self._checkpoint_file(v))
         with open(os.path.join(self.log_path, "_last_checkpoint"),
                   "w") as f:
-            json.dump({"version": v, "size": len(snap.files)}, f)
+            json.dump({"version": v, "size": n_actions}, f)
 
     def metadata_action(self, schema: Schema, partition_columns: List[str],
                         table_id: str) -> dict:
@@ -299,3 +350,14 @@ class DeltaLog:
 
 def _strip_nones(d: dict) -> dict:
     return {k: v for k, v in d.items() if v is not None}
+
+
+def _maps_to_dicts(v):
+    """Recursively turn arrow map cells ([(k, v), ...]) into dicts."""
+    if isinstance(v, dict):
+        return {k: _maps_to_dicts(x) for k, x in v.items()}
+    if isinstance(v, list):
+        if v and all(isinstance(e, tuple) and len(e) == 2 for e in v):
+            return {k: _maps_to_dicts(x) for k, x in v}
+        return [_maps_to_dicts(e) for e in v]
+    return v

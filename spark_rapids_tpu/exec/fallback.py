@@ -37,7 +37,7 @@ from ..expr.core import (Alias, BoundReference, Expression, Literal,
                          UnresolvedAttribute, output_name, resolve)
 from ..types import (BooleanType, ByteType, DataType, DoubleType, FloatType,
                      IntegerType, LongType, Schema, ShortType, StringType,
-                     StructField)
+                     StructField, TimestampType)
 from .base import NUM_INPUT_BATCHES, OP_TIME, TpuExec
 
 _I64 = (1 << 64)
@@ -330,6 +330,66 @@ def _host_eval_special(expr: Expression, row) -> object:
     raise HostEvalUnsupported(type(expr).__name__)
 
 
+def _java_double_str(v: float, repr_fn=repr) -> str:
+    """Java Double.toString rendering (what Spark's double→string cast
+    emits): plain decimal for 1e-3 <= |v| < 1e7, otherwise d.dddE±n
+    scientific notation; shortest round-trip mantissa; always at least one
+    fraction digit ('1.0', '1.0E-4')."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    neg = math.copysign(1.0, v) < 0
+    a = abs(v)
+    if a == 0.0:
+        return "-0.0" if neg else "0.0"
+    if a == 5e-324:
+        return "-4.9E-324" if neg else "4.9E-324"  # Java's MIN_VALUE digits
+    s = repr_fn(a)  # shortest round-trip decimal
+    mant, _, es = s.partition("e")
+    exp = int(es) if es else 0
+    ip, _, fp = mant.partition(".")
+    digits = (ip + fp).lstrip("0")
+    if ip.strip("0"):
+        dec_exp = len(ip) + exp          # value = 0.<digits> * 10**dec_exp
+    else:
+        lead_zeros = len(fp) - len(fp.lstrip("0"))
+        dec_exp = -lead_zeros + exp
+    digits = digits.rstrip("0") or "0"
+    if 1e-3 <= a < 1e7:
+        if dec_exp <= 0:
+            body = "0." + "0" * (-dec_exp) + digits
+        elif dec_exp >= len(digits):
+            body = digits + "0" * (dec_exp - len(digits)) + ".0"
+        else:
+            body = digits[:dec_exp] + "." + digits[dec_exp:]
+    else:
+        body = digits[0] + "." + (digits[1:] or "0") + "E" + str(dec_exp - 1)
+    return ("-" if neg else "") + body
+
+
+def _java_float_str(v: float) -> str:
+    """Java Float.toString: same rules as Double.toString but with the
+    shortest decimal that round-trips at FLOAT precision ('0.1', not
+    '0.10000000149011612')."""
+    import numpy as np
+    v = float(np.float32(v))  # snap first: thresholds act on the f32 value
+    if abs(v) == 1.401298464324817e-45:  # Float.MIN_VALUE digits in Java
+        return "-1.4E-45" if v < 0 else "1.4E-45"
+    return _java_double_str(v, repr_fn=lambda a: str(np.float32(a)))
+
+
+def _timestamp_str(micros: int) -> str:
+    """Spark's timestamp→string: 'yyyy-MM-dd HH:mm:ss' plus fractional
+    seconds with trailing zeros trimmed (no trailing dot)."""
+    import datetime as _dt
+    d = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(micros))
+    base = d.strftime("%Y-%m-%d %H:%M:%S")
+    if d.microsecond:
+        base += (".%06d" % d.microsecond).rstrip("0")
+    return base
+
+
 def _host_cast(expr: Cast, v):
     if v is None:
         return None
@@ -337,14 +397,16 @@ def _host_cast(expr: Cast, v):
     if isinstance(to, StringType):
         if isinstance(v, bool):
             return "true" if v else "false"
+        try:
+            src = expr.children[0].data_type
+        except (TypeError, NotImplementedError):
+            src = None
         if isinstance(v, float):
-            if math.isnan(v):
-                return "NaN"
-            if math.isinf(v):
-                return "Infinity" if v > 0 else "-Infinity"
-            if v == int(v) and abs(v) < 1e16:
-                return f"{v:.1f}"
-            return repr(v)
+            if isinstance(src, FloatType):
+                return _java_float_str(v)
+            return _java_double_str(v)
+        if isinstance(src, TimestampType):
+            return _timestamp_str(v)
         return str(v)
     if isinstance(to, _INT_TYPES):
         bits = {ByteType: 8, ShortType: 16, IntegerType: 32,

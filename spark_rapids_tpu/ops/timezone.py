@@ -146,8 +146,16 @@ def utc_to_local(ts_micros, tz: str):
 
 def local_to_utc(ts_micros, tz: str):
     """to_utc_timestamp kernel: wall clock in `tz` → UTC instants
-    (fold=0: the earlier offset for ambiguous overlap times)."""
-    _, offs, ends = _DB.tables(tz)
+    (fold=0: the earlier offset for ambiguous overlap times; nonexistent
+    gap times follow Java's ZonedDateTime rule — shift forward by the
+    gap, i.e. resolve with the PRE-transition offset)."""
+    inst, offs, ends = _DB.tables(tz)
     i = jnp.searchsorted(ends, ts_micros, side="right")
     i = jnp.clip(i, 0, offs.shape[0] - 1)
-    return ts_micros - offs[i]
+    # A wall time earlier than the matched interval's own wall start is in
+    # a DST gap: no interval contains it. Java resolves it with the offset
+    # BEFORE the transition (local − offsetBefore shifts forward by the gap).
+    in_gap = ts_micros < inst[i] + offs[i]
+    prev = jnp.clip(i - 1, 0, offs.shape[0] - 1)
+    off = jnp.where(in_gap, offs[prev], offs[i])
+    return ts_micros - off

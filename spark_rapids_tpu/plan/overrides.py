@@ -97,8 +97,30 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
     _r(rules, conditional.Coalesce, "first non-null", commonly_supported)
     _r(rules, conditional.IsNaN, "NaN check", fp, BOOLEAN)
     _r(rules, conditional.NaNvl, "NaN replacement", fp, fp)
-    # cast
-    _r(rules, cast.Cast, "type cast")
+    # cast — combos without a device kernel are tagged off-device at plan
+    # time instead of raising inside the compiled projection (reference
+    # GpuCast tags unsupported from/to pairs off-GPU the same way). The
+    # host row tier covers some of them (float/double/timestamp→string);
+    # the rest fail loudly at plan time.
+    def _tag_cast(meta):
+        from ..types import (DecimalType as _Dec, DoubleType as _Dbl,
+                             FloatType as _Flt, StringType as _Str,
+                             TimestampType as _Ts)
+        c = meta.expr
+        try:
+            src = c.children[0].data_type
+            dst = c.data_type
+        except (TypeError, NotImplementedError):
+            return  # unresolved; re-checked post-bind
+        off = (isinstance(dst, _Str)
+               and isinstance(src, (_Flt, _Dbl, _Ts))) \
+            or (isinstance(src, _Str) and isinstance(dst, (_Ts, _Dec)))
+        if off:
+            meta.will_not_work_on_tpu(
+                f"cast {src.simple_name()} -> {dst.simple_name()} has no "
+                "device kernel")
+
+    _r(rules, cast.Cast, "type cast", tag_fn=_tag_cast)
     # datetime
     dtsig = TypeSig.of("DATE", "TIMESTAMP", "TIMESTAMP_NTZ")
     for c in (datetimeexprs.Year, datetimeexprs.Month,

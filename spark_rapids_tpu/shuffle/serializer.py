@@ -58,34 +58,37 @@ def _np(x) -> np.ndarray:
     return np.asarray(x)
 
 
-def _rebase_offsets(off: np.ndarray, n: int) -> np.ndarray:
-    out = off[: n + 1].astype(np.int32, copy=True)
+def _rebase_offsets(off: np.ndarray, n: int, start: int = 0) -> np.ndarray:
+    out = off[start: start + n + 1].astype(np.int32, copy=True)
     return out - out[0]
 
 
-def _encode_column(col: Column, n: int, out: List[np.ndarray]) -> None:
-    out.append(np.packbits(_np(col.validity)[:n].astype(np.bool_),
-                           bitorder="little"))
+def _encode_column(col: Column, n: int, out: List[np.ndarray],
+                   start: int = 0) -> None:
+    """Encode rows [start, start+n) of `col` into trimmed buffers. The
+    `start` base makes non-compacted children (array-of-X whose referenced
+    span begins past element 0) encode correctly instead of asserting."""
+    out.append(np.packbits(
+        _np(col.validity)[start: start + n].astype(np.bool_),
+        bitorder="little"))
     if isinstance(col, StringColumn):
         off = _np(col.offsets)
-        reb = _rebase_offsets(off, n)
-        out.append(reb)
-        lo, hi = int(off[0]), int(off[n] if n else off[0])
+        out.append(_rebase_offsets(off, n, start))
+        lo = int(off[start])
+        hi = int(off[start + n]) if n else lo
         out.append(_np(col.data)[lo:hi].astype(np.uint8, copy=False))
     elif isinstance(col, ArrayColumn):
         off = _np(col.offsets)
-        reb = _rebase_offsets(off, n)
-        out.append(reb)
-        # the child is encoded for exactly the referenced element span;
-        # shuffle rows are compacted so the span starts at offsets[0]
-        lo, hi = int(off[0]), int(off[n] if n else off[0])
-        assert lo == 0, "array columns must be compacted before serialize"
-        _encode_column(col.child, hi, out)
+        out.append(_rebase_offsets(off, n, start))
+        # the child is encoded for exactly the referenced element span
+        lo = int(off[start])
+        hi = int(off[start + n]) if n else lo
+        _encode_column(col.child, hi - lo, out, start=lo)
     elif isinstance(col, StructColumn):
         for ch in col.children:
-            _encode_column(ch, n, out)
+            _encode_column(ch, n, out, start=start)
     else:
-        out.append(np.ascontiguousarray(_np(col.data)[:n]))
+        out.append(np.ascontiguousarray(_np(col.data)[start: start + n]))
 
 
 def _decode_column(dtype, n: int, bufs: List[bytes], pos: int,

@@ -322,8 +322,13 @@ class _Compiler:
                     cond = P.Not(_boolify(cond))
                 else:
                     cond = _boolify(cond)
+                # Fork locals per path like the operand stack: STORE_FAST in
+                # the then-branch must not leak into the else-branch.
+                saved_locals = dict(self.locals)
                 then_r = self._run(idx + 1, list(stack))
+                self.locals = dict(saved_locals)
                 else_r = self._run(target, list(stack))
+                self.locals = saved_locals
                 return C.If(cond, then_r, else_r)
             if op in ("JUMP_FORWARD",):
                 idx = self.by_offset[ins.argval]

@@ -1,0 +1,165 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md)."""
+import datetime as dt
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import ArrayColumn, ColumnarBatch
+from spark_rapids_tpu.delta.log import DeltaLog
+from spark_rapids_tpu.exec.fallback import _java_double_str
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.ops.timezone import local_to_utc
+from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
+                                                 serialize_batch)
+from spark_rapids_tpu.types import (ArrayType, IntegerType, Schema,
+                                    StructField)
+from spark_rapids_tpu.udf_compiler import compile_udf
+
+
+def test_udf_branch_locals_do_not_leak():
+    # STORE_FAST in the then-branch must not leak into the else-branch
+    def f(a):
+        b = 1
+        if a > 0:
+            b = 2
+        return b
+
+    e = compile_udf(f, [col("a")])
+    s = str(e)
+    assert "lit(2)" in s and "lit(1)" in s
+
+
+def test_udf_nested_branch_locals():
+    def f(a):
+        x = 10
+        if a > 0:
+            x = 20
+            if a > 5:
+                x = 30
+        return x
+
+    e = compile_udf(f, [col("a")])
+    s = str(e)
+    assert "lit(10)" in s and "lit(20)" in s and "lit(30)" in s
+
+
+@pytest.mark.parametrize("v,expect", [
+    (0.0001, "1.0E-4"),
+    (1e16, "1.0E16"),
+    (1.0, "1.0"),
+    (0.001, "0.001"),
+    (1234.5, "1234.5"),
+    (100.0, "100.0"),
+    (1e7, "1.0E7"),
+    (9999999.0, "9999999.0"),
+    (-0.5, "-0.5"),
+    (0.0, "0.0"),
+    (-0.0, "-0.0"),
+    (1.5e-5, "1.5E-5"),
+    (123456789.0, "1.23456789E8"),
+    (float("nan"), "NaN"),
+    (float("inf"), "Infinity"),
+    (float("-inf"), "-Infinity"),
+])
+def test_java_double_to_string(v, expect):
+    assert _java_double_str(v) == expect
+
+
+def test_java_float_to_string():
+    from spark_rapids_tpu.exec.fallback import _java_float_str
+    assert _java_float_str(0.10000000149011612) == "0.1"
+    assert _java_float_str(12345678.0) == "1.2345678E7"
+    assert _java_float_str(1.401298464324817e-45) == "1.4E-45"
+
+
+def test_double_min_value_java_digits():
+    assert _java_double_str(5e-324) == "4.9E-324"
+
+
+def test_cast_double_to_string_routes_to_host_tier():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import DOUBLE, STRING
+    sess = TpuSession()
+    df = sess.from_pydict({"d": [0.0001, 1e16, 1.5, None]},
+                          schema=Schema((StructField("d", DOUBLE),)))
+    q = df.select(F.col("d").cast(STRING).alias("s"))
+    assert "host" in q.explain()
+    assert [r[0] for r in q.collect()] == ["1.0E-4", "1.0E16", "1.5", None]
+
+
+def _us(d: dt.datetime) -> int:
+    return int((d - dt.datetime(1970, 1, 1)).total_seconds() * 1e6)
+
+
+def test_dst_gap_uses_pre_transition_offset():
+    # 2025-03-09 02:30 America/Los_Angeles does not exist; Java resolves
+    # it with the offset before the transition → 10:30 UTC
+    out = int(np.asarray(local_to_utc(
+        np.array([_us(dt.datetime(2025, 3, 9, 2, 30))], np.int64),
+        "America/Los_Angeles"))[0])
+    assert dt.datetime(1970, 1, 1) + dt.timedelta(microseconds=out) == \
+        dt.datetime(2025, 3, 9, 10, 30)
+
+
+def test_dst_overlap_still_earlier_offset():
+    out = int(np.asarray(local_to_utc(
+        np.array([_us(dt.datetime(2025, 11, 2, 1, 30))], np.int64),
+        "America/Los_Angeles"))[0])
+    assert dt.datetime(1970, 1, 1) + dt.timedelta(microseconds=out) == \
+        dt.datetime(2025, 11, 2, 8, 30)
+
+
+def test_serialize_non_compacted_array_column():
+    at = ArrayType(IntegerType())
+    base = ArrayColumn.from_pylist(
+        [[1, 2], [3], [4, 5, 6], [7], None, [8, 9]], at)
+    off = np.asarray(base.offsets)
+    n = 4
+    sl_off = np.zeros(len(off), np.int32)
+    sl_off[:n + 1] = off[2:2 + n + 1]
+    sl_off[n + 1:] = sl_off[n]
+    val = np.zeros(base.capacity, np.bool_)
+    val[:n] = [True, True, False, True]
+    sliced = ArrayColumn(base.child, jnp.asarray(sl_off),
+                         jnp.asarray(val), at)
+    assert int(sl_off[0]) != 0  # genuinely non-compacted
+    sch = Schema([StructField("a", at)])
+    rt = deserialize_batch(
+        serialize_batch(ColumnarBatch([sliced], n, sch)), sch)
+    assert rt.columns[0].to_pylist(n) == [[4, 5, 6], [7], None, [8, 9]]
+
+
+def test_delta_checkpoint_struct_typed(tmp_path):
+    d = str(tmp_path / "tbl")
+    log = DeltaLog(d)
+    sch = Schema([StructField("x", IntegerType())])
+    log.commit([log.protocol_action(),
+                log.metadata_action(sch, [], "tid-1")], 0)
+    for v in range(1, 13):
+        log.commit([{"add": {
+            "path": f"f{v}.parquet", "partitionValues": {"p": str(v)},
+            "size": 10, "dataChange": True, "modificationTime": 123,
+            "stats": '{"numRecords": 1}'}}], v)
+    import pyarrow.parquet as pq
+    cp = log.last_checkpoint()
+    assert cp == 10
+    t = pq.read_table(
+        os.path.join(d, "_delta_log", f"{cp:020d}.checkpoint.parquet"))
+    # protocol-required struct columns, not the old JSON-blob layout
+    assert {"protocol", "metaData", "add"} <= set(t.column_names)
+    assert "action" not in t.column_names
+    acts = list(log._read_checkpoint(cp))
+    kinds = sorted({list(a)[0] for a in acts})
+    assert kinds == ["add", "metaData", "protocol"]
+    with open(os.path.join(d, "_delta_log", "_last_checkpoint")) as f:
+        lc = json.load(f)
+    assert lc["size"] == len(acts)
+    # replay from checkpoint in a fresh log object
+    snap = DeltaLog(d).snapshot()
+    assert len(snap.files) == 12
+    assert snap.files[0].partition_values == {"p": "1"}
