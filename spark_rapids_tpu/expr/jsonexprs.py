@@ -50,6 +50,14 @@ def parse_json_path(path: str) -> Optional[List]:
     return out
 
 
+def _first_key_wins(pairs):
+    d = {}
+    for k, v in pairs:
+        if k not in d:
+            d[k] = v
+    return d
+
+
 def _walk(node, steps, i):
     if i == len(steps):
         yield node
@@ -103,7 +111,11 @@ class GetJsonObject(Expression):
         if s is None or steps is None:
             return None
         try:
-            doc = json.loads(s)
+            # first duplicate key wins, matching Jackson's streaming
+            # get_json_object (and the device scanner); plain json.loads
+            # would keep the LAST duplicate
+            doc = json.loads(
+                s, object_pairs_hook=_first_key_wins)
         except ValueError:
             return None
         hits = [h for h in _walk(doc, steps, 0)]
@@ -114,9 +126,28 @@ class GetJsonObject(Expression):
         # wildcard with multiple matches renders as a JSON array
         return json.dumps(hits, separators=(",", ":"))
 
+    @property
+    def device_supported(self) -> bool:
+        """Literal wildcard-free paths run the byte-parallel device
+        scanner (ops/json_device.py); '[*]' paths stay on the host tier."""
+        return self._steps is None or "*" not in [
+            s for s in self._steps if isinstance(s, str)]
+
     def columnar_eval(self, batch):
-        raise NotImplementedError(
-            "get_json_object runs on the host tier (CPU fallback)")
+        from ..columnar.column import StringColumn
+        from ..ops.json_device import json_extract
+        import jax.numpy as jnp
+        c = self.children[0].columnar_eval(batch)
+        if self._steps is None:
+            # malformed/non-literal path: NULL for every row
+            valid = jnp.zeros((c.capacity,), jnp.bool_)
+            return StringColumn(
+                jnp.zeros((1,), jnp.uint8),
+                jnp.zeros((c.capacity + 1,), jnp.int32), valid, STRING)
+        if not self.device_supported:
+            raise NotImplementedError(
+                "wildcard JSON paths run on the host tier")
+        return json_extract(c, self._steps)
 
 
 class JsonToStructsField(Expression):

@@ -4,10 +4,15 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+import re as _re_mod
+
 from ..columnar.column import Column, StringColumn
 from ..types import BOOLEAN, INT, STRING, DataType
 from .core import Expression, Literal
 from ..ops import strings as S
+
+# a split/replace pattern with none of these is a literal string
+_REGEX_META = _re_mod.compile(r"[\\.\[\]{}()*+?^$|]")
 
 
 class _UnaryString(Expression):
@@ -541,6 +546,24 @@ class StringSplit(_HostString):
                 parts.pop()
         return parts
 
+    @property
+    def device_supported(self) -> bool:
+        """Metacharacter-free literal patterns take the device kernel
+        (the reference's GpuStringSplit literal fast path); regex
+        patterns stay on the host tier until the Glushkov matcher grows
+        split support."""
+        return (isinstance(self.pattern, str) and len(self.pattern) > 0
+                and not _REGEX_META.search(self.pattern)
+                and isinstance(self.limit, int))
+
+    def columnar_eval(self, batch):
+        from ..ops.string_split import split_literal
+        if not self.device_supported:
+            raise NotImplementedError(
+                "regex split runs on the host tier (CPU fallback)")
+        c = self.children[0].columnar_eval(batch)
+        return split_literal(c, self.pattern.encode("utf-8"), self.limit)
+
 
 class SubstringIndex(_HostString):
     """substring_index(str, delim, count) (reference
@@ -573,6 +596,18 @@ class SubstringIndex(_HostString):
         parts = s.split(d)
         return d.join(parts[c:]) if len(parts) > -c else s
 
+    @property
+    def device_supported(self) -> bool:
+        return isinstance(self.delim, str) and isinstance(self.count, int)
+
+    def columnar_eval(self, batch):
+        from ..ops.string_split import substring_index
+        if not self.device_supported:
+            raise NotImplementedError(
+                "non-literal substring_index runs on the host tier")
+        c = self.children[0].columnar_eval(batch)
+        return substring_index(c, self.delim.encode("utf-8"), self.count)
+
 
 class FindInSet(_HostString):
     """find_in_set(str, comma_list) -> 1-based index or 0."""
@@ -595,6 +630,12 @@ class FindInSet(_HostString):
             return 0
         items = s.split(",")
         return items.index(needle) + 1 if needle in items else 0
+
+    def columnar_eval(self, batch):
+        from ..ops.string_split import find_in_set
+        n = self.children[0].columnar_eval(batch)
+        s = self.children[1].columnar_eval(batch)
+        return find_in_set(n, s)
 
 
 class RegExpExtract(_HostString):
@@ -635,6 +676,41 @@ class RegExpExtract(_HostString):
                 f"({_re.compile(self.pattern).groups} groups)")
         return g if g is not None else ""
 
+    def _device_plan(self):
+        # the (pattern, idx) pair is constant: compile and probe ONCE
+        got = getattr(self, "_span_plan", False)
+        if got is not False:
+            return got
+        from ..regex import RegexUnsupported
+        from ..regex.spans import compile_spans, regexp_extract_device
+        plan = None
+        if isinstance(self.pattern, str) and isinstance(self.idx, int):
+            try:
+                p = compile_spans(self.pattern)
+                if 0 <= self.idx <= p.n_groups:
+                    # probe group-window support on an empty column
+                    from ..columnar.column import StringColumn
+                    regexp_extract_device(StringColumn.from_pylist([]), p,
+                                          self.idx)
+                    plan = p
+            except RegexUnsupported:
+                plan = None
+        self._span_plan = plan
+        return plan
+
+    @property
+    def device_supported(self) -> bool:
+        return self._device_plan() is not None
+
+    def columnar_eval(self, batch):
+        from ..regex.spans import regexp_extract_device
+        plan = self._device_plan()
+        if plan is None:
+            raise NotImplementedError(
+                "regexp_extract pattern runs on the host tier")
+        c = self.children[0].columnar_eval(batch)
+        return regexp_extract_device(c, plan, self.idx)
+
 
 class RegExpReplace(_HostString):
     """regexp_replace(str, pattern, replacement) (reference
@@ -668,6 +744,39 @@ class RegExpReplace(_HostString):
         rep = _re.sub(r"(?<!\\)\$(\d)", r"\\g<\1>", self.replacement)
         rep = rep.replace(r"\$", "$")
         return _re.sub(self.pattern, rep, s)
+
+    def _device_plan(self):
+        # the (pattern, replacement) pair is constant: compile ONCE
+        got = getattr(self, "_span_plan", False)
+        if got is not False:
+            return got
+        from ..regex import RegexUnsupported
+        from ..regex.spans import compile_spans
+        plan = None
+        if isinstance(self.pattern, str) \
+                and isinstance(self.replacement, str) \
+                and "$" not in self.replacement \
+                and "\\" not in self.replacement:
+            try:
+                plan = compile_spans(self.pattern)
+            except RegexUnsupported:
+                plan = None
+        self._span_plan = plan
+        return plan
+
+    @property
+    def device_supported(self) -> bool:
+        return self._device_plan() is not None
+
+    def columnar_eval(self, batch):
+        from ..regex.spans import regexp_replace_device
+        plan = self._device_plan()
+        if plan is None:
+            raise NotImplementedError(
+                "regexp_replace pattern runs on the host tier")
+        c = self.children[0].columnar_eval(batch)
+        return regexp_replace_device(c, plan,
+                                     self.replacement.encode("utf-8"))
 
 
 class FormatNumber(_HostString):

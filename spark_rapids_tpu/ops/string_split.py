@@ -1,0 +1,231 @@
+"""Device kernels for the delimiter-driven string family:
+find_in_set, substring_index, split (literal patterns).
+
+Reference analogs: GpuSubstringIndex / GpuStringSplit / find_in_set in
+stringFunctions.scala over cuDF string kernels. The TPU formulation is
+byte-parallel over the (offsets, bytes) layout: delimiter occurrences are
+a byte mask (greedy non-overlapping via ops/strings.select_literal_hits),
+per-row ordinal ranks come from segment cumsums, and outputs are emitted
+with the same searchsorted-gather used by every other varlen kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import (ArrayColumn, Column, StringColumn,
+                               bucket_capacity)
+from ..types import INT, STRING, ArrayType
+from .strings import (_rebuild_offsets, _row_of_byte, _substring_gather,
+                      seg_incl_cumsum as _seg_incl_cumsum,
+                      select_literal_hits, string_lengths)
+
+_BIG = jnp.int32(1 << 30)
+
+
+def find_in_set(needle: StringColumn, sets: StringColumn) -> Column:
+    """1-based index of `needle` among the comma-separated elements of
+    `sets`; 0 when absent or when the needle contains a comma."""
+    cap = sets.capacity
+    byte_cap = sets.byte_capacity
+    data = sets.data
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(sets, pos)
+    row_start = sets.offsets[row]
+    row_end = sets.offsets[row + 1]
+    in_use = pos < sets.offsets[-1]
+
+    nlen = string_lengths(needle)
+    nstart = needle.offsets[:-1]
+    set_len = string_lengths(sets)
+
+    comma = (data == jnp.uint8(ord(","))) & in_use
+    # element index of each byte = #commas before it in the row
+    n_comma_incl = _seg_incl_cumsum(comma.astype(jnp.int32), row_start)
+    elem_idx = n_comma_incl - comma.astype(jnp.int32)
+    # start of the element owning each byte
+    last_comma = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(comma, pos, jnp.int32(-1)))
+    last_comma = jnp.concatenate(
+        [jnp.full((1,), -1, jnp.int32), last_comma[:-1]])
+    elem_start = jnp.maximum(row_start, last_comma + 1)
+    off = pos - elem_start
+
+    # per-byte compare against the row's needle at the same offset
+    np_idx = jnp.clip(nstart[row] + off, 0, needle.byte_capacity - 1)
+    nb = needle.data[np_idx]
+    in_nlen = off < nlen[row]
+    bad = in_use & ~comma & (~in_nlen | (data != nb))
+    bad_csum = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(bad.astype(jnp.int32))])
+
+    # element starts: first byte of row, or the byte after a comma (which
+    # for an empty element is the next comma itself)
+    prev = jnp.clip(pos - 1, 0, byte_cap - 1)
+    es = in_use & ((pos == row_start) | (comma[prev] & (pos > row_start)))
+    next_comma = jnp.flip(jax.lax.associative_scan(
+        jnp.minimum, jnp.flip(jnp.where(comma, pos, _BIG))))
+    elem_end = jnp.minimum(next_comma, row_end)
+    elen = elem_end - pos
+    ok = es & (elen == nlen[row]) \
+        & (bad_csum[jnp.clip(elem_end, 0, byte_cap)]
+           - bad_csum[jnp.clip(pos, 0, byte_cap)] == 0)
+    best = jax.ops.segment_min(jnp.where(ok, elem_idx, _BIG), row,
+                               num_segments=cap)
+
+    # trailing empty element ("a," has elements a and ''): exists when the
+    # row ends with a comma; its index is the row's comma count
+    lastb = jnp.clip(sets.offsets[1:] - 1, 0, byte_cap - 1)
+    ends_comma = (set_len > 0) & (sets.data[lastb] == jnp.uint8(ord(",")))
+    commas_per_row = jax.ops.segment_sum(comma.astype(jnp.int32),
+                                         row, num_segments=cap)
+    best = jnp.where((nlen == 0) & ends_comma,
+                     jnp.minimum(best, commas_per_row), best)
+    # empty set string holds exactly one empty element
+    best = jnp.where((set_len == 0) & (nlen == 0), jnp.int32(0), best)
+
+    res = jnp.where(best < _BIG, best + 1, jnp.int32(0)).astype(jnp.int32)
+    valid = needle.validity & sets.validity
+    return Column(jnp.where(valid, res, 0), valid, INT)
+
+
+def substring_index(col: StringColumn, delim: bytes,
+                    count: int) -> StringColumn:
+    """substring_index(str, delim, count): prefix before the count-th
+    delimiter (count > 0) / suffix after the |count|-th-from-last
+    (count < 0); the whole string when there are not enough delimiters."""
+    cap = col.capacity
+    byte_cap = col.byte_capacity
+    if not delim or count == 0:
+        lens = jnp.zeros((cap,), jnp.int32)
+        return _substring_gather(col, col.offsets[:-1], lens)
+    ld = len(delim)
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    row_start = col.offsets[row]
+    sel = select_literal_hits(col, delim)
+    rank = _seg_incl_cumsum(sel.astype(jnp.int32), row_start)  # 1-based
+    m = jax.ops.segment_sum(sel.astype(jnp.int32), row, num_segments=cap)
+    starts = col.offsets[:-1]
+    lens = string_lengths(col)
+    if count > 0:
+        cut = jax.ops.segment_min(
+            jnp.where(sel & (rank == count), pos, _BIG), row,
+            num_segments=cap)
+        out_start = starts
+        out_len = jnp.where(m >= count, cut - starts, lens)
+    else:
+        want = m + count + 1  # 1-based rank of the delimiter to cut AFTER
+        cut = jax.ops.segment_min(
+            jnp.where(sel & (rank == want[row]), pos, _BIG), row,
+            num_segments=cap)
+        enough = m >= -count
+        out_start = jnp.where(enough, jnp.clip(cut + ld, 0, byte_cap),
+                              starts)
+        out_len = jnp.where(enough, col.offsets[1:] - out_start, lens)
+    return _substring_gather(col, out_start.astype(jnp.int32),
+                             out_len.astype(jnp.int32))
+
+
+def split_literal(col: StringColumn, delim: bytes,
+                  limit: int) -> ArrayColumn:
+    """split(str, delim, limit) for a literal delimiter — Java semantics:
+    limit > 0 caps the part count; limit == 0 strips trailing empty parts;
+    negative limits keep everything."""
+    cap = col.capacity
+    byte_cap = col.byte_capacity
+    out_t = ArrayType(STRING)
+    lens = string_lengths(col)
+
+    if not delim or limit == 1:
+        # no splitting: every row becomes the 1-element array [s]; child
+        # row i IS source row i so offsets are the identity ramp
+        arr_off = jnp.arange(cap + 1, dtype=jnp.int32)
+        child = StringColumn(col.data, col.offsets,
+                             col.validity, col.dtype)
+        return ArrayColumn(child, arr_off, col.validity, out_t)
+
+    ld = len(delim)
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    row_start = col.offsets[row]
+    sel = select_literal_hits(col, delim) & col.validity[row]
+    rank = _seg_incl_cumsum(sel.astype(jnp.int32), row_start)  # 1-based
+    if limit > 0:
+        sel = sel & (rank <= limit - 1)
+        rank = jnp.minimum(rank, limit - 1)
+    m = jax.ops.segment_sum(sel.astype(jnp.int32), row, num_segments=cap)
+    n_parts = jnp.where(col.validity, m + 1, 0).astype(jnp.int32)
+
+    # provisional array offsets (before trailing-empty stripping)
+    arr_off = _rebuild_offsets(n_parts)
+    total_parts = arr_off[cap]
+    part_cap = byte_cap + cap  # m+1 parts per row, m <= bytes in row
+
+    # per-part start/end byte positions (absolute), scattered by part id
+    p_start = jnp.zeros((part_cap,), jnp.int32)
+    p_end = jnp.zeros((part_cap,), jnp.int32)
+    # part k (k >= 1) starts after the k-th delimiter
+    gid_for_hit = jnp.where(sel, arr_off[row] + rank, part_cap)
+    p_start = p_start.at[gid_for_hit].set(pos + ld, mode="drop")
+    # part k-1 ends at the k-th delimiter start
+    gid_prev = jnp.where(sel, arr_off[row] + rank - 1, part_cap)
+    p_end = p_end.at[gid_prev].set(pos, mode="drop")
+    # part 0 starts at row start; last part ends at row end
+    first_gid = jnp.where(col.validity, arr_off[:-1], part_cap)
+    p_start = p_start.at[first_gid].set(col.offsets[:-1], mode="drop")
+    last_gid = jnp.where(col.validity, arr_off[:-1] + m, part_cap)
+    p_end = p_end.at[last_gid].set(col.offsets[1:], mode="drop")
+
+    p_len = jnp.maximum(p_end - p_start, 0)
+
+    if limit == 0:
+        # strip trailing empty parts per row
+        pids = jnp.arange(part_cap, dtype=jnp.int32)
+        prow = jnp.searchsorted(arr_off, pids,
+                                side="right").astype(jnp.int32) - 1
+        prow = jnp.clip(prow, 0, cap - 1)
+        pidx = pids - arr_off[prow]
+        in_parts = pids < total_parts
+        nonempty = in_parts & (p_len > 0)
+        last_ne = jax.ops.segment_max(
+            jnp.where(nonempty, pidx, jnp.int32(-1)), prow,
+            num_segments=cap)
+        n_parts = jnp.where(col.validity, last_ne + 1, 0).astype(jnp.int32)
+        # re-pack: parts keep their gid ordering, rows just shorten, so
+        # rebuild offsets and gather part info through old gids
+        new_off = _rebuild_offsets(n_parts)
+        newp = jnp.arange(part_cap, dtype=jnp.int32)
+        nrow = jnp.searchsorted(new_off, newp,
+                                side="right").astype(jnp.int32) - 1
+        nrow = jnp.clip(nrow, 0, cap - 1)
+        old_gid = jnp.clip(arr_off[nrow] + (newp - new_off[nrow]), 0,
+                           part_cap - 1)
+        p_start = p_start[old_gid]
+        p_len = p_len[old_gid]
+        arr_off = new_off
+        total_parts = arr_off[cap]
+
+    # child string column: emit part bytes in gid order
+    child_off = jnp.concatenate([
+        jnp.zeros(1, jnp.int32),
+        jnp.cumsum(p_len, dtype=jnp.int32)])[: part_cap + 1]
+    opos = jnp.arange(byte_cap, dtype=jnp.int32)
+    src_part = jnp.clip(jnp.searchsorted(child_off, opos, side="right")
+                        .astype(jnp.int32) - 1, 0, part_cap - 1)
+    intra = opos - child_off[src_part]
+    src = jnp.clip(p_start[src_part] + intra, 0, byte_cap - 1)
+    child_in_use = opos < child_off[jnp.clip(total_parts, 0, part_cap)]
+    cdata = jnp.where(child_in_use, col.data[src], jnp.uint8(0))
+
+    # child columns are sized by bucket: part_cap entries of offsets
+    ccap = bucket_capacity(max(part_cap, 1))
+    c_off = jnp.zeros((ccap + 1,), jnp.int32)
+    c_off = c_off.at[: part_cap + 1].set(child_off)
+    total_bytes = child_off[jnp.clip(total_parts, 0, part_cap)]
+    c_off = jnp.where(jnp.arange(ccap + 1, dtype=jnp.int32) > total_parts,
+                      total_bytes, c_off)
+    c_valid = jnp.arange(ccap, dtype=jnp.int32) < total_parts
+    child = StringColumn(cdata, c_off, c_valid, STRING)
+    return ArrayColumn(child, arr_off, col.validity, out_t)

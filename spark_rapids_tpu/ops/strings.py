@@ -21,6 +21,13 @@ def string_lengths(col: StringColumn):
     return col.offsets[1:] - col.offsets[:-1]
 
 
+def seg_incl_cumsum(x, row_start_pos):
+    """Per-row inclusive cumsum of int32 x over a flat byte buffer:
+    global cumsum minus the exclusive cumsum at each byte's row start."""
+    c = jnp.cumsum(x, dtype=jnp.int32)
+    return c - (c - x)[row_start_pos]
+
+
 def _rebuild_offsets(lengths):
     """Exclusive-scan lengths into (capacity+1,) offsets."""
     return jnp.concatenate([
@@ -370,20 +377,16 @@ def _needle_has_border(needle: bytes) -> bool:
                for k in range(1, len(needle)))
 
 
-def str_replace(col: StringColumn, search: bytes,
-                replacement: bytes) -> StringColumn:
-    """replace(str, search, replace): non-overlapping left-to-right literal
-    replacement (reference GpuStringReplace).
+def select_literal_hits(col: StringColumn, search: bytes):
+    """Byte mask of the greedy non-overlapping left-to-right occurrences
+    of literal `search` (Java String.split/replace hit set).
 
     Fast path: a needle with no proper border cannot overlap itself, so
     every raw hit is automatically part of the greedy non-overlapping set.
     Bordered needles (e.g. "aa") run a device while_loop that advances
     per-row cursors hit by hit — exact Java semantics, vectorized across
     rows."""
-    from ..columnar.column import bucket_capacity
-    if not search:
-        return col
-    ls, lr = len(search), len(replacement)
+    ls = len(search)
     byte_cap = col.byte_capacity
     pos = jnp.arange(byte_cap, dtype=jnp.int32)
     row = _row_of_byte(col, pos)
@@ -423,9 +426,23 @@ def str_replace(col: StringColumn, search: bytes,
         cursor0 = jnp.zeros(col.capacity, jnp.int32)
         sel0 = jnp.zeros(byte_cap, jnp.bool_)
         _, selected = jax.lax.while_loop(cond, body, (cursor0, sel0))
-        selected = selected & hit
-    else:
-        selected = hit
+        return selected & hit
+    return hit
+
+
+def str_replace(col: StringColumn, search: bytes,
+                replacement: bytes) -> StringColumn:
+    """replace(str, search, replace): non-overlapping left-to-right literal
+    replacement (reference GpuStringReplace)."""
+    from ..columnar.column import bucket_capacity
+    if not search:
+        return col
+    ls, lr = len(search), len(replacement)
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    in_use = pos < col.offsets[-1]
+    selected = select_literal_hits(col, search)
 
     # emit lengths: 1 per plain byte, lr at a match start, 0 inside a match
     sel_csum = jnp.cumsum(selected.astype(jnp.int32))

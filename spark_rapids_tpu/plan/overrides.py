@@ -235,23 +235,37 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
 
     from ..expr.jsonexprs import GetJsonObject, JsonToStructsField
     from ..expr.urlexprs import ParseUrl
-    _r(rules, GetJsonObject, "JSON path extraction (host tier)",
-       stringlike, stringlike, tag_fn=_tag_host_tier)
+
+    def _tag_get_json(meta):
+        # device byte-parallel scanner handles literal wildcard-free
+        # paths; '[*]' falls back to the host row tier
+        if not meta.expr.device_supported:
+            _tag_host_tier(meta)
+
+    _r(rules, GetJsonObject, "JSON path extraction",
+       stringlike, stringlike, tag_fn=_tag_get_json)
     _r(rules, JsonToStructsField, "from_json single field (host tier)",
        stringlike, commonly_supported, tag_fn=_tag_host_tier)
     _r(rules, ParseUrl, "URL part extraction (host tier)", stringlike,
        stringlike, tag_fn=_tag_host_tier)
     arrstr = TypeSig.of("ARRAY")
-    _r(rules, stringexprs.StringSplit, "regex split (host tier)",
-       stringlike, arrstr, tag_fn=_tag_host_tier)
-    _r(rules, stringexprs.SubstringIndex, "substring_index (host tier)",
-       stringlike, stringlike, tag_fn=_tag_host_tier)
-    _r(rules, stringexprs.FindInSet, "find_in_set (host tier)",
-       stringlike, integral, tag_fn=_tag_host_tier)
-    _r(rules, stringexprs.RegExpExtract, "regex group extract (host tier)",
-       stringlike, stringlike, tag_fn=_tag_host_tier)
-    _r(rules, stringexprs.RegExpReplace, "regex replace (host tier)",
-       stringlike, stringlike, tag_fn=_tag_host_tier)
+
+    def _tag_device_when_supported(meta):
+        # expressions with a partial device kernel expose
+        # `device_supported`; unsupported shapes drop to the host tier
+        if not getattr(meta.expr, "device_supported", True):
+            _tag_host_tier(meta)
+
+    _r(rules, stringexprs.StringSplit, "string split",
+       stringlike, arrstr, tag_fn=_tag_device_when_supported)
+    _r(rules, stringexprs.SubstringIndex, "substring_index",
+       stringlike, stringlike, tag_fn=_tag_device_when_supported)
+    _r(rules, stringexprs.FindInSet, "find_in_set",
+       stringlike, integral)
+    _r(rules, stringexprs.RegExpExtract, "regex group extract",
+       stringlike, stringlike, tag_fn=_tag_device_when_supported)
+    _r(rules, stringexprs.RegExpReplace, "regex replace",
+       stringlike, stringlike, tag_fn=_tag_device_when_supported)
     _r(rules, stringexprs.FormatNumber, "format_number (host tier)",
        numeric, stringlike, tag_fn=_tag_host_tier)
     _r(rules, stringexprs.Levenshtein, "edit distance (host tier)",

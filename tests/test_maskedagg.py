@@ -209,3 +209,19 @@ def test_first_last_in_masked_path():
     got = {r[0]: r[1:] for r in plan.collect()}
     assert got[1] == (10, 30)
     assert got[2] == (20, 20)
+
+
+def test_more_than_16_key_columns():
+    # beyond the 16-column packed-stats code word: the per-column boolean
+    # reductions path must kick in, not an assert/overflow
+    n_keys = 17
+    sch = Schema(tuple(StructField(f"k{i}", LONG) for i in range(n_keys))
+                 + (StructField("v", LONG),))
+    data = {f"k{i}": [1, 1, 2, None] for i in range(n_keys)}
+    data["v"] = [10, 20, 30, 40]
+    b = ColumnarBatch.from_pydict(data, sch)
+    plan = AggregateExec(
+        [col(f"k{i}") for i in range(n_keys)],
+        [(Sum(col("v")), "s")], InMemoryScanExec([b], sch))
+    got = sorted(plan.collect(), key=lambda r: (r[0] is None, r[0] or 0))
+    assert [r[-1] for r in got] == [30, 30, 40]

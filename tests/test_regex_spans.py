@@ -1,0 +1,122 @@
+"""Device regexp_replace/regexp_extract span kernels vs Python re."""
+import random
+import re
+
+import pytest
+
+from spark_rapids_tpu.columnar.column import StringColumn
+from spark_rapids_tpu.regex import RegexUnsupported
+from spark_rapids_tpu.regex.spans import (compile_spans,
+                                          regexp_extract_device,
+                                          regexp_replace_device)
+
+
+def host_replace(s, pattern, repl):
+    if s is None:
+        return None
+    return re.sub(pattern, repl, s)
+
+
+def host_extract(s, pattern, idx):
+    if s is None:
+        return None
+    m = re.search(pattern, s)
+    if m is None:
+        return ""
+    g = m.group(idx)
+    return g if g is not None else ""
+
+
+ROWS = ["abc123def456", "", None, "999", "a1b2c3", "no digits here",
+        "   spaces  ", "x", "aaa", "12.34.56", "cat and dog", "catdog"]
+
+
+@pytest.mark.parametrize("pattern,repl", [
+    ("[0-9]", "#"),
+    ("[0-9]+", "#"),
+    ("[0-9]+", ""),
+    ("[0-9]+", "NUM"),
+    (r"\s+", "_"),
+    ("a", "XY"),
+    ("cat|dog", "pet"),
+    ("[a-c][0-9]", "*"),
+    ("a{2}", "Z"),
+])
+def test_replace_differential(pattern, repl):
+    col = StringColumn.from_pylist(ROWS)
+    plan = compile_spans(pattern)
+    got = regexp_replace_device(col, plan,
+                                repl.encode()).to_pylist(len(ROWS))
+    assert got == [host_replace(s, pattern, repl) for s in ROWS], pattern
+
+
+@pytest.mark.parametrize("pattern,idx", [
+    ("[0-9]+", 0),
+    ("([0-9]+)", 1),
+    (r"([a-z])([0-9])", 2),
+    (r"([a-z])([0-9])", 1),
+    ("cat|dog", 0),
+    ("a([0-9])c", 1),
+])
+def test_extract_differential(pattern, idx):
+    col = StringColumn.from_pylist(ROWS)
+    plan = compile_spans(pattern)
+    got = regexp_extract_device(col, plan, idx).to_pylist(len(ROWS))
+    assert got == [host_extract(s, pattern, idx) for s in ROWS], pattern
+
+
+def test_anchored_spans():
+    rows = ["123abc", "abc123", "123", "abc", None]
+    col = StringColumn.from_pylist(rows)
+    got = regexp_replace_device(col, compile_spans("^[0-9]+"),
+                                b"#").to_pylist(len(rows))
+    assert got == [host_replace(s, "^[0-9]+", "#") for s in rows]
+    got = regexp_replace_device(col, compile_spans("[0-9]+$"),
+                                b"#").to_pylist(len(rows))
+    assert got == [host_replace(s, "[0-9]+$", "#") for s in rows]
+
+
+def test_unsupported_shapes_raise():
+    for p in ("a+b", "(ab|c)", "a*", "a.*b"):
+        with pytest.raises(RegexUnsupported):
+            compile_spans(p)
+    # group under a repeat: Java keeps the LAST iteration; reject
+    plan = compile_spans("([0-9])+") if True else None
+    # ([0-9])+ is classplus after group stripping; extract must reject
+    with pytest.raises(RegexUnsupported):
+        regexp_extract_device(StringColumn.from_pylist(["1"]), plan, 1)
+
+
+def test_fuzz_differential():
+    rng = random.Random(3)
+    alphabet = "ab1 2xy."
+    rows = [None if rng.random() < 0.1 else
+            "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 14)))
+            for _ in range(80)]
+    col = StringColumn.from_pylist(rows)
+    n = len(rows)
+    for pattern in ("[0-9]+", "[ab]", "x|y", "[a-z][0-9]", r"\.", " +"):
+        plan = compile_spans(pattern)
+        got = regexp_replace_device(col, plan, b"<>").to_pylist(n)
+        assert got == [host_replace(s, pattern, "<>") for s in rows], \
+            pattern
+        got = regexp_extract_device(col, plan, 0).to_pylist(n)
+        assert got == [host_extract(s, pattern, 0) for s in rows], pattern
+
+
+def test_planner_routing():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import STRING, Schema, StructField
+    sess = TpuSession()
+    df = sess.from_pydict(
+        {"s": ["a1b22", None, "xyz"]},
+        schema=Schema((StructField("s", STRING),)))
+    q = df.select(F.regexp_replace(F.col("s"), "[0-9]+", "#").alias("r"),
+                  F.regexp_extract(F.col("s"), "([0-9]+)", 1).alias("e"))
+    assert "host" not in q.explain()
+    assert q.collect() == [("a#b#", "1"), (None, None), ("xyz", "")]
+    # variable-length alternation stays host
+    q2 = df.select(F.regexp_replace(F.col("s"), "a+|b", "#").alias("r"))
+    assert "host" in q2.explain()
+    assert [r[0] for r in q2.collect()] == ["#1#22", None, "xyz"]

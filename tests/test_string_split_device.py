@@ -1,0 +1,128 @@
+"""Device split/substring_index/find_in_set vs host-tier semantics."""
+import random
+import re
+
+import pytest
+
+from spark_rapids_tpu.columnar.column import StringColumn
+from spark_rapids_tpu.ops.string_split import (find_in_set,
+                                               split_literal,
+                                               substring_index)
+
+
+def host_fis(needle, s):
+    if needle is None or s is None:
+        return None
+    if "," in needle:
+        return 0
+    items = s.split(",")
+    return items.index(needle) + 1 if needle in items else 0
+
+
+def host_ssi(s, d, c):
+    if s is None:
+        return None
+    if not d or c == 0:
+        return ""
+    parts = s.split(d)
+    if c > 0:
+        return d.join(parts[:c]) if len(parts) > c else s
+    return d.join(parts[c:]) if len(parts) > -c else s
+
+
+def host_split(s, d, limit):
+    if s is None:
+        return None
+    if limit == 1:
+        return [s]
+    parts = re.split(re.escape(d), s,
+                     maxsplit=limit - 1 if limit > 0 else 0)
+    if limit == 0:
+        while parts and parts[-1] == "":
+            parts.pop()
+    return parts
+
+
+def test_find_in_set_battery():
+    needles = ["b", "", "a", "x,y", "ab", None, "c", "", "a", "ég"]
+    sets_ = ["a,b,c", "a,,b", "", "x,y", "ab", "a", None, "a,", "a,a",
+             "x,ég,z"]
+    got = find_in_set(StringColumn.from_pylist(needles),
+                      StringColumn.from_pylist(sets_)).to_pylist(
+        len(needles))
+    assert got == [host_fis(n, s) for n, s in zip(needles, sets_)]
+
+
+@pytest.mark.parametrize("d,c", [
+    (".", 2), (".", 1), (".", -2), (".", -1), (".", 3), (".", -5),
+    (".", 0), ("aa", 1), ("aa", -1), ("", 2),
+])
+def test_substring_index_battery(d, c):
+    rows = ["www.apache.org", "a.b", "abc", "", "a..b", None, "aaaa",
+            ".x.", "aaaa.aaaa"]
+    got = substring_index(StringColumn.from_pylist(rows), d.encode(),
+                          c).to_pylist(len(rows))
+    assert got == [host_ssi(s, d, c) for s in rows]
+
+
+@pytest.mark.parametrize("d,lim", [
+    (",", -1), (",", 0), (",", 2), (",", 1), (",", 4), ("a", -1),
+    ("a", 0), ("ab", -1),
+])
+def test_split_battery(d, lim):
+    rows = ["a,b,c", "a,,", ",,", "", "abc", None, ",a", "aa",
+            "a,b,c,d,e", "abab"]
+    got = split_literal(StringColumn.from_pylist(rows), d.encode(),
+                        lim).to_pylist(len(rows))
+    assert got == [host_split(s, d, lim) for s in rows]
+
+
+def test_fuzz_differential():
+    rng = random.Random(11)
+    alphabet = "ab,.x "
+    rows = [None if rng.random() < 0.1 else
+            "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 12)))
+            for _ in range(80)]
+    col = StringColumn.from_pylist(rows)
+    n = len(rows)
+    for d in (",", ".", "ab", " "):
+        for lim in (-1, 0, 2, 3):
+            got = split_literal(col, d.encode(), lim).to_pylist(n)
+            assert got == [host_split(s, d, lim) for s in rows], (d, lim)
+        for c in (-3, -1, 1, 2):
+            got = substring_index(col, d.encode(), c).to_pylist(n)
+            assert got == [host_ssi(s, d, c) for s in rows], (d, c)
+    needles = [None if rng.random() < 0.1 else
+               "".join(rng.choice("abx") for _ in range(rng.randint(0, 3)))
+               for _ in range(n)]
+    got = find_in_set(StringColumn.from_pylist(needles), col).to_pylist(n)
+    assert got == [host_fis(a, b) for a, b in zip(needles, rows)]
+
+
+def test_planner_routes_to_device():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import STRING, Schema, StructField
+    sess = TpuSession()
+    df = sess.from_pydict(
+        {"s": ["a,b,c", "x", None]},
+        schema=Schema((StructField("s", STRING),)))
+    q = df.select(F.split(F.col("s"), ",").alias("p"),
+                  F.substring_index(F.col("s"), ",", 2).alias("i"))
+    assert "host" not in q.explain()
+    rows = q.collect()
+    assert rows[0] == (["a", "b", "c"], "a,b")
+    assert rows[1] == (["x"], "x")
+    assert rows[2] == (None, None)
+
+
+def test_planner_keeps_regex_split_on_host():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import STRING, Schema, StructField
+    sess = TpuSession()
+    df = sess.from_pydict(
+        {"s": ["a1b22c"]}, schema=Schema((StructField("s", STRING),)))
+    q = df.select(F.split(F.col("s"), "[0-9]+").alias("p"))
+    assert "host" in q.explain()
+    assert q.collect()[0][0] == ["a", "b", "c"]
