@@ -206,9 +206,63 @@ class _HostHOF(Expression):
     def _semantic_args(self):
         return (self.body.semantic_key(), self.var)
 
+    #: device kernel per subclass (ops/array_hof.py); None = host only
+    _device_kernel = None
+
+    @property
+    def device_supported(self) -> bool:
+        """Lambda bodies whose leaves are just the lambda variable and
+        literals — and whose every interior operator has a device kernel
+        — run on the device as one flat pass over the child column;
+        everything else stays host tier."""
+        cached = getattr(self, "_dev_ok", None)
+        if cached is None:
+            cached = self._compute_device_supported()
+            self._dev_ok = cached
+        return cached
+
+    def _compute_device_supported(self) -> bool:
+        if self._device_kernel is None:
+            return False
+        from ..types import ArrayType
+        from .core import Literal
+
+        def node_ok(node) -> bool:
+            if isinstance(node, LambdaVar):
+                return node.name == self.var
+            if isinstance(node, Literal):
+                return True
+            # interior operators must be device-evaluable themselves:
+            # a partial device kernel exposes device_supported; pure
+            # host-tier classes carry the HOST_ONLY marker
+            ds = getattr(node, "device_supported", None)
+            if ds is not None and not ds:
+                return False
+            if ds is None and getattr(node, "HOST_ONLY", False):
+                return False
+            kids = getattr(node, "children", ())
+            if not kids:
+                return False  # column refs / unknown leaves
+            return all(node_ok(c) for c in kids)
+
+        try:
+            arr_t = self.children[0].data_type
+        except (TypeError, NotImplementedError):
+            return False
+        if not isinstance(arr_t, ArrayType):
+            return False
+        if isinstance(arr_t.element_type, ArrayType):
+            return False  # nested arrays await the nested-column work
+        return node_ok(self.body)
+
     def columnar_eval(self, batch):
-        raise NotImplementedError(
-            f"{type(self).__name__} runs on the host tier (CPU fallback)")
+        from ..ops import array_hof
+        if not self.device_supported:
+            raise NotImplementedError(
+                f"{type(self).__name__} lambda runs on the host tier")
+        arr = self.children[0].columnar_eval(batch)
+        return getattr(array_hof, self._device_kernel)(arr, self.body,
+                                                       self.var)
 
     def _elem(self, row, eval_fn, v):
         return eval_fn(_subst(self.body, {self.var: v}), row)
@@ -231,6 +285,8 @@ class _HostHOF(Expression):
 class ArrayTransform(_HostHOF):
     """transform(arr, x -> expr)"""
 
+    _device_kernel = "array_transform"
+
     @property
     def data_type(self):
         from ..types import NULL, ArrayType
@@ -249,6 +305,8 @@ class ArrayTransform(_HostHOF):
 class ArrayFilter(_HostHOF):
     """filter(arr, x -> predicate)"""
 
+    _device_kernel = "array_filter"
+
     @property
     def data_type(self):
         return self.children[0].data_type
@@ -262,6 +320,8 @@ class ArrayFilter(_HostHOF):
 
 class ArrayExists(_HostHOF):
     """exists(arr, x -> predicate): Spark 3-valued semantics."""
+
+    _device_kernel = "array_exists"
 
     @property
     def data_type(self):
@@ -284,6 +344,8 @@ class ArrayExists(_HostHOF):
 
 class ArrayForAll(_HostHOF):
     """forall(arr, x -> predicate)"""
+
+    _device_kernel = "array_forall"
 
     @property
     def data_type(self):
@@ -381,6 +443,7 @@ class ArrayAggregate(Expression):
 
 
 class _HostCollection(Expression):
+    HOST_ONLY = True
     def columnar_eval(self, batch):
         raise NotImplementedError(
             f"{type(self).__name__} runs on the host tier (CPU fallback)")

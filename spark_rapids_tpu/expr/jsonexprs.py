@@ -155,6 +155,8 @@ class JsonToStructsField(Expression):
     `from_json(col, schema).field` shape; reference GpuJsonToStructs is
     the full version). Host tier."""
 
+    HOST_ONLY = True
+
     def __init__(self, child: Expression, field: str, dtype):
         self.children = (child,)
         self.field = field

@@ -498,7 +498,11 @@ class Like(Expression):
 
 class _HostString(Expression):
     """Base for host-tier string expressions: scalar semantics in
-    host_eval_row; no columnar kernel (the rule tags them off-device)."""
+    host_eval_row; no columnar kernel (the rule tags them off-device).
+    Subclasses that grow a device kernel override `device_supported`,
+    which takes precedence over this marker."""
+
+    HOST_ONLY = True
 
     def columnar_eval(self, batch):
         raise NotImplementedError(

@@ -18,6 +18,8 @@ _PARTS = ("HOST", "PATH", "QUERY", "REF", "PROTOCOL", "FILE",
 class ParseUrl(Expression):
     """parse_url(url, part[, key]) with Spark's part names."""
 
+    HOST_ONLY = True
+
     def __init__(self, child: Expression, part, key=None):
         self.children = (child,)
         # Spark's parse_url is CASE-SENSITIVE: 'host' is an unknown part

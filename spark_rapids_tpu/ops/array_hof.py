@@ -1,0 +1,159 @@
+"""Device higher-order functions over array columns.
+
+Reference analog: higherOrderFunctions.scala (GpuArrayTransform etc.)
+over cuDF segmented kernels. The TPU formulation exploits the
+offsets+child layout directly: a lambda over elements is just the body
+expression evaluated on the CHILD column (one flat vectorized pass over
+all elements of all rows), and per-row reductions (exists/forall) are
+segment reductions keyed by each element's owning row.
+
+Scope: lambda bodies whose leaves are the lambda variable and literals
+(no outer-row column references — those need per-element row broadcast
+and stay on the host tier; the planner tags them via device_supported).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import ArrayColumn, Column, StringColumn
+from ..types import BOOLEAN, ArrayType
+
+_BIG = jnp.int32(1 << 30)
+
+
+class _ElemBatch:
+    """Minimal batch facade for evaluating a lambda body over the child
+    column: expressions only touch num_rows/capacity here."""
+
+    def __init__(self, num_rows, capacity: int):
+        self.num_rows = num_rows
+        self.capacity = capacity
+
+
+def _elem_row_map(arr: ArrayColumn):
+    """(child_capacity,) int32: owning ROW of each child element, and the
+    in-use mask of child elements."""
+    ccap = arr.child.capacity
+    epos = jnp.arange(ccap, dtype=jnp.int32)
+    erow = jnp.searchsorted(arr.offsets, epos,
+                            side="right").astype(jnp.int32) - 1
+    erow = jnp.clip(erow, 0, arr.capacity - 1)
+    in_use = epos < arr.offsets[arr.capacity]
+    return erow, in_use
+
+
+def eval_lambda(body, var: str, arr: ArrayColumn) -> Column:
+    """Evaluate `body` (over LambdaVar `var`) elementwise on the child."""
+    from ..expr.collectionexprs import LambdaVar
+
+    child = arr.child
+    bound_holder = _BoundElem(child)
+
+    def fn(node):
+        if isinstance(node, LambdaVar) and node.name == var:
+            return bound_holder
+        return node
+
+    bound = body.transform_up(fn)
+    n_elems = arr.offsets[arr.capacity]
+    return bound.columnar_eval(_ElemBatch(n_elems, child.capacity))
+
+
+class _BoundElem:
+    """Expression leaf yielding the child column (the bound lambda var)."""
+
+    children = ()
+
+    def __init__(self, col: Column):
+        self._col = col
+
+    def with_children(self, cs):
+        return self
+
+    def transform_up(self, fn):
+        return fn(self)
+
+    @property
+    def data_type(self):
+        return self._col.dtype
+
+    def columnar_eval(self, batch):
+        return self._col
+
+    def semantic_key(self):
+        return ("_BoundElem", id(self._col))
+
+
+def array_transform(arr: ArrayColumn, body, var: str) -> ArrayColumn:
+    out = eval_lambda(body, var, arr)
+    return ArrayColumn(out, arr.offsets, arr.validity,
+                       ArrayType(out.dtype))
+
+
+def array_filter(arr: ArrayColumn, body, var: str) -> ArrayColumn:
+    pred = eval_lambda(body, var, arr)
+    erow, in_use = _elem_row_map(arr)
+    keep = pred.data & pred.validity & in_use  # Spark: only TRUE keeps
+    ccap = arr.child.capacity
+
+    # new element counts per row -> new offsets
+    counts = jax.ops.segment_sum(keep.astype(jnp.int32), erow,
+                                 num_segments=arr.capacity)
+    counts = jnp.where(arr.validity, counts, 0)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(counts, dtype=jnp.int32)])
+    # compaction gather: kept element k (in order) -> its source index
+    kpos = jnp.cumsum(keep.astype(jnp.int32)) - 1   # target idx per kept
+    src = jnp.zeros((ccap,), jnp.int32)
+    tgt = jnp.where(keep, kpos, ccap)
+    src = src.at[tgt].set(jnp.arange(ccap, dtype=jnp.int32), mode="drop")
+    total = new_off[arr.capacity]
+    out_valid = jnp.arange(ccap, dtype=jnp.int32) < total
+    child = _gather_child(arr.child, src, out_valid)
+    return ArrayColumn(child, new_off, arr.validity, arr.dtype)
+
+
+def _gather_child(child: Column, idx, out_in_use) -> Column:
+    from ..ops.strings import gather_string
+    if isinstance(child, StringColumn):
+        valid = jnp.where(out_in_use, child.validity[idx], False)
+        return gather_string(child, idx, valid)
+    data = jnp.where(out_in_use, child.data[idx],
+                     jnp.zeros((), child.data.dtype))
+    valid = jnp.where(out_in_use, child.validity[idx], False)
+    return Column(data, valid, child.dtype)
+
+
+def _exists_forall(arr: ArrayColumn, body, var: str, forall: bool
+                   ) -> Column:
+    pred = eval_lambda(body, var, arr)
+    erow, in_use = _elem_row_map(arr)
+    t = pred.data & pred.validity & in_use
+    nul = ~pred.validity & in_use
+    any_true = jax.ops.segment_max(t.astype(jnp.int32), erow,
+                                   num_segments=arr.capacity) > 0
+    any_false = jax.ops.segment_max(
+        ((~pred.data) & pred.validity & in_use).astype(jnp.int32), erow,
+        num_segments=arr.capacity) > 0
+    any_null = jax.ops.segment_max(nul.astype(jnp.int32), erow,
+                                   num_segments=arr.capacity) > 0
+    if forall:
+        # false if any false; else null if any null; else true
+        result = ~any_false
+        known = any_false | ~any_null
+    else:
+        # true if any true; else null if any null; else false
+        result = any_true
+        known = any_true | ~any_null
+    valid = arr.validity & known
+    return Column(jnp.where(valid, result, False), valid, BOOLEAN)
+
+
+def array_exists(arr: ArrayColumn, body, var: str) -> Column:
+    return _exists_forall(arr, body, var, forall=False)
+
+
+def array_forall(arr: ArrayColumn, body, var: str) -> Column:
+    return _exists_forall(arr, body, var, forall=True)

@@ -284,13 +284,17 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
         _r(rules, c, d + " (host tier)", in_sig, strbin,
            tag_fn=_tag_host_tier)
 
-    # higher-order functions + collection long tail (host tier)
+    # higher-order functions: literal-leaf lambdas run on device as one
+    # flat pass over the child column; others stay host tier
     ce = collectionexprs
     for c, d in ((ce.ArrayTransform, "transform() HOF"),
                  (ce.ArrayFilter, "filter() HOF"),
                  (ce.ArrayExists, "exists() HOF"),
-                 (ce.ArrayForAll, "forall() HOF"),
-                 (ce.ArrayAggregate, "aggregate() HOF"),
+                 (ce.ArrayForAll, "forall() HOF")):
+        _r(rules, c, d, commonly_supported + arrstr,
+           commonly_supported + arrstr,
+           tag_fn=_tag_device_when_supported)
+    for c, d in ((ce.ArrayAggregate, "aggregate() HOF"),
                  (ce.ArrayPosition, "array_position"),
                  (ce.ArrayRemove, "array_remove"),
                  (ce.ArrayDistinct, "array_distinct"),

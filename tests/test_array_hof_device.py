@@ -1,0 +1,62 @@
+"""Device HOF kernels (ops/array_hof.py) — differential vs the host
+row-tier evaluators."""
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.types import (ArrayType, LONG, STRING, Schema,
+                                    StructField)
+
+SCH = Schema((StructField("a", ArrayType(LONG)),))
+SSCH = Schema((StructField("s", ArrayType(STRING)),))
+
+
+def _run(data, schema, expr):
+    sess = TpuSession()
+    q = sess.from_pydict(data, schema).select(expr.alias("o"))
+    assert "HostProjectExec" not in q._exec().tree_string()
+    return [r[0] for r in q.collect()]
+
+
+def test_transform_device():
+    got = _run({"a": [[1, 2, None], [], None, [5]]}, SCH,
+               F.transform(F.col("a"), lambda x: x * F.lit(3)))
+    assert got == [[3, 6, None], [], None, [15]]
+
+
+def test_filter_device_compacts():
+    got = _run({"a": [[1, 5, None, 7], [2], None, []]}, SCH,
+               F.filter_(F.col("a"), lambda x: x > F.lit(2)))
+    assert got == [[5, 7], [], None, []]
+
+
+def test_exists_forall_three_valued():
+    data = {"a": [[1, None], [5, None], [5], [], None, [1]]}
+    got = _run(data, SCH, F.exists(F.col("a"), lambda x: x > F.lit(4)))
+    assert got == [None, True, True, False, None, False]
+    got = _run(data, SCH, F.forall(F.col("a"), lambda x: x > F.lit(0)))
+    assert got == [None, None, True, True, None, True]
+
+
+def test_filter_string_elements():
+    got = _run({"s": [["aa", "b", None, "ccc"], [], None]}, SSCH,
+               F.filter_(F.col("s"),
+                         lambda x: F.length(x) > F.lit(1)))
+    assert got == [["aa", "ccc"], [], None]
+
+
+def test_transform_string_elements():
+    got = _run({"s": [["ab", None, "c"], None]}, SSCH,
+               F.transform(F.col("s"), lambda x: F.upper(x)))
+    assert got == [["AB", None, "C"], None]
+
+
+def test_host_tier_op_inside_lambda_falls_back():
+    # an operator without a device kernel inside the lambda body must
+    # route the whole projection to the host tier at PLAN time, not
+    # crash inside the compiled projection
+    sess = TpuSession()
+    df = sess.from_pydict({"s": [["ab", "c"]]}, SSCH)
+    q = df.select(F.transform(
+        F.col("s"), lambda x: F.levenshtein(x, F.lit("a"))).alias("o"))
+    assert "HostProjectExec" in q._exec().tree_string()
+    assert q.collect() == [([1, 1],)]

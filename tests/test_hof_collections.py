@@ -90,10 +90,17 @@ def test_flatten_scalar_semantics():
     assert f.host_eval_row(None) is None
 
 
-def test_hof_plans_through_host_tier():
+def test_hof_literal_lambda_plans_on_device():
+    # literal-leaf lambdas run the device kernel since round 3
     sess = TpuSession()
     q = _df(sess).select(F.transform(col("a"), lambda x: x * 2).alias("o"))
     tree = q._exec().tree_string()
-    assert "HostProjectExec" in tree
-    assert "will run on CPU" in _df(sess).select(
-        F.transform(col("a"), lambda x: x * 2).alias("o")).explain()
+    assert "HostProjectExec" not in tree
+
+
+def test_hof_outer_column_lambda_stays_on_host():
+    # a lambda referencing an outer row column still needs the host tier
+    sess = TpuSession()
+    q = _df(sess).select(
+        F.transform(col("a"), lambda x: x * col("k")).alias("o"))
+    assert "HostProjectExec" in q._exec().tree_string()
