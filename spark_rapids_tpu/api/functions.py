@@ -242,7 +242,43 @@ def array_contains(x, value):
 
 def element_at(x, index):
     from ..expr import collectionexprs
+    from ..expr.core import Expression, Literal
+    if isinstance(index, Expression) and not isinstance(index, Literal):
+        # non-literal keys are supported for MAP lookups
+        return get_map_value(x, index)
     return collectionexprs.ElementAt(_e(x), index)
+
+
+# maps -----------------------------------------------------------------------
+def create_map(*cols):
+    from ..expr import mapexprs
+    return mapexprs.CreateMap(*[_e(c) for c in cols])
+
+
+def map_keys(x):
+    from ..expr import mapexprs
+    return mapexprs.MapKeys(_e(x))
+
+
+def map_values(x):
+    from ..expr import mapexprs
+    return mapexprs.MapValues(_e(x))
+
+
+def map_contains_key(x, key):
+    from ..expr import mapexprs
+    return mapexprs.MapContainsKey(_e(x), key)
+
+
+def get_map_value(x, key):
+    from ..expr import mapexprs
+    k = _e(key) if not isinstance(key, (str, int, float)) else key
+    return mapexprs.GetMapValue(_e(x), k)
+
+
+def element_at_key(x, key):
+    """element_at over a MAP with a non-literal (column) key."""
+    return get_map_value(x, key)
 
 
 def get_array_item(x, index):

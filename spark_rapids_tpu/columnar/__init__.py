@@ -1,5 +1,5 @@
 from .column import (
-    ArrayColumn, Column, StringColumn, StructColumn, bucket_capacity,
-    column_from_arrow, column_to_arrow,
+    ArrayColumn, Column, MapColumn, StringColumn, StructColumn,
+    bucket_capacity, build_column, column_from_arrow, column_to_arrow,
 )
 from .batch import ColumnarBatch, empty_batch

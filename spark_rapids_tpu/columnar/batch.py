@@ -72,19 +72,9 @@ class ColumnarBatch:
         assert len(lengths) == 1, "ragged input columns"
         n = lengths.pop()
         cap = capacity or bucket_capacity(n)
-        from ..types import ArrayType
-        from .column import ArrayColumn
-        cols = []
-        for f in schema.fields:
-            vals = data[f.name]
-            if isinstance(f.data_type, ArrayType):
-                cols.append(ArrayColumn.from_pylist(vals, f.data_type,
-                                                    capacity=cap))
-            elif isinstance(f.data_type, StringType) or f.data_type.jnp_dtype is None:
-                cols.append(StringColumn.from_pylist(vals, capacity=cap,
-                                                     dtype=f.data_type))
-            else:
-                cols.append(Column.from_pylist(vals, f.data_type, capacity=cap))
+        from .column import build_column
+        cols = [build_column(data[f.name], f.data_type, cap)
+                for f in schema.fields]
         return ColumnarBatch(cols, n, schema)
 
     @staticmethod

@@ -242,6 +242,21 @@ class StructColumn(Column):
     def capacity(self) -> int:
         return int(self.validity.shape[0])
 
+    @staticmethod
+    def from_pylist(values: Sequence, dtype: StructType,
+                    capacity: Optional[int] = None) -> "StructColumn":
+        n = len(values)
+        cap = capacity or bucket_capacity(n)
+        validity = _pad_np(np.array([v is not None for v in values],
+                                    np.bool_), cap, False)
+        kids = []
+        for f in dtype.fields:
+            fv = [None if v is None else
+                  (v.get(f.name) if isinstance(v, dict)
+                   else getattr(v, f.name)) for v in values]
+            kids.append(build_column(fv, f.data_type, cap))
+        return StructColumn(tuple(kids), jnp.asarray(validity), dtype)
+
     def to_pylist(self, num_rows: int) -> List:
         valid = np.asarray(self.validity[:num_rows])
         kids = [c.to_pylist(num_rows) for c in self.children]
@@ -283,11 +298,7 @@ class ArrayColumn(Column):
         np.cumsum(lengths, out=off[1:n + 1])
         off[n + 1:] = off[n] if n else 0
         flat = [x for v in values if v is not None for x in v]
-        elem_t = dtype.element_type
-        if isinstance(elem_t, StringType) or elem_t.jnp_dtype is None:
-            child: Column = StringColumn.from_pylist(flat, dtype=elem_t)
-        else:
-            child = Column.from_pylist(flat, elem_t)
+        child = build_column(flat, dtype.element_type)
         return ArrayColumn(child, jnp.asarray(off),
                            jnp.asarray(validity), dtype)
 
@@ -300,6 +311,99 @@ class ArrayColumn(Column):
             kid[offsets[i] : offsets[i + 1]] if valid[i] else None
             for i in range(num_rows)
         ]
+
+
+class MapColumn(Column):
+    """Map column: int32 offsets + parallel keys/values child columns
+    (the cuDF lists-of-structs layout with the struct unzipped — keys and
+    values as SEPARATE columns vectorize lookups without interleaving).
+    Reference analog: cuDF LIST<STRUCT<K,V>> under GpuCreateMap /
+    GpuGetMapValue (collectionOperations.scala, GpuMapUtils)."""
+
+    __slots__ = ("offsets", "keys", "values")
+
+    def __init__(self, keys: Column, values: Column, offsets, validity,
+                 dtype):
+        super().__init__(None, validity, dtype)
+        self.keys = keys
+        self.values = values
+        self.offsets = offsets
+
+    @property
+    def capacity(self) -> int:
+        return int(self.validity.shape[0])
+
+    @property
+    def entry_capacity(self) -> int:
+        return self.keys.capacity
+
+    @staticmethod
+    def from_pylist(values: Sequence, dtype,
+                    capacity: Optional[int] = None) -> "MapColumn":
+        n = len(values)
+        cap = capacity or bucket_capacity(n)
+        validity = _pad_np(np.array([v is not None for v in values],
+                                    np.bool_), cap, False)
+        lengths = np.array([0 if v is None else len(v) for v in values],
+                           np.int32)
+        off = np.zeros(cap + 1, np.int32)
+        np.cumsum(lengths, out=off[1:n + 1])
+        off[n + 1:] = off[n] if n else 0
+        items = [(k, x) for v in values if v is not None
+                 for k, x in (v.items() if isinstance(v, dict) else v)]
+        keys = build_column([k for k, _ in items], dtype.key_type)
+        vals = build_column([x for _, x in items], dtype.value_type)
+        # keys and values index in lockstep by construction
+        assert keys.capacity == vals.capacity
+        return MapColumn(keys, vals, jnp.asarray(off),
+                         jnp.asarray(validity), dtype)
+
+    def with_capacity(self, capacity: int) -> "MapColumn":
+        cap = self.capacity
+        if capacity == cap:
+            return self
+        assert capacity > cap, (capacity, cap)
+        extra = capacity - cap
+        offsets = jnp.concatenate(
+            [self.offsets, jnp.broadcast_to(self.offsets[-1], (extra,))])
+        validity = jnp.pad(self.validity, [(0, extra)])
+        return MapColumn(self.keys, self.values, offsets, validity,
+                         self.dtype)
+
+    def to_pylist(self, num_rows: int) -> List:
+        offsets = np.asarray(self.offsets)
+        valid = np.asarray(self.validity[:num_rows])
+        entry_n = int(offsets[num_rows]) if num_rows else 0
+        ks = self.keys.to_pylist(entry_n)
+        vs = self.values.to_pylist(entry_n)
+        out = []
+        for i in range(num_rows):
+            if not valid[i]:
+                out.append(None)
+                continue
+            d = {}
+            for k, v in zip(ks[offsets[i]: offsets[i + 1]],
+                            vs[offsets[i]: offsets[i + 1]]):
+                if k not in d:  # FIRST duplicate key wins, like map_get
+                    d[k] = v
+            out.append(d)
+        return out
+
+
+def build_column(values: Sequence, dtype: DataType,
+                 capacity: Optional[int] = None) -> Column:
+    """Host-list → column of the right class for any supported type,
+    recursing through nested arrays/structs/maps."""
+    from ..types import MapType
+    if isinstance(dtype, ArrayType):
+        return ArrayColumn.from_pylist(values, dtype, capacity)
+    if isinstance(dtype, MapType):
+        return MapColumn.from_pylist(values, dtype, capacity)
+    if isinstance(dtype, StructType):
+        return StructColumn.from_pylist(values, dtype, capacity)
+    if isinstance(dtype, StringType) or dtype.jnp_dtype is None:
+        return StringColumn.from_pylist(values, capacity, dtype=dtype)
+    return Column.from_pylist(values, dtype, capacity)
 
 
 # --- pytree registration: columns flow through jit/shard_map -------------
@@ -340,10 +444,20 @@ def _array_unflatten(dtype, children):
     return ArrayColumn(child, offsets, validity, dtype)
 
 
+def _map_flatten(c: MapColumn):
+    return (c.keys, c.values, c.offsets, c.validity), c.dtype
+
+
+def _map_unflatten(dtype, children):
+    keys, values, offsets, validity = children
+    return MapColumn(keys, values, offsets, validity, dtype)
+
+
 jax.tree_util.register_pytree_node(Column, _column_flatten, _column_unflatten)
 jax.tree_util.register_pytree_node(StringColumn, _string_flatten, _string_unflatten)
 jax.tree_util.register_pytree_node(StructColumn, _struct_flatten, _struct_unflatten)
 jax.tree_util.register_pytree_node(ArrayColumn, _array_flatten, _array_unflatten)
+jax.tree_util.register_pytree_node(MapColumn, _map_flatten, _map_unflatten)
 
 
 def _string_from_arrow_buffers(arr, dt: DataType, n: int) -> StringColumn:
@@ -413,6 +527,19 @@ def column_from_arrow(arr, dtype: Optional[DataType] = None) -> Column:
         child = column_from_arrow(arr.values, dt.element_type)
         return ArrayColumn(child, jnp.asarray(off),
                            jnp.asarray(_pad_np(validity, cap, False)), dt)
+    from ..types import MapType as _MapType
+    if isinstance(dt, _MapType):
+        validity = np.asarray(arr.is_valid())
+        offsets = np.asarray(arr.offsets, dtype=np.int32)
+        cap = bucket_capacity(n)
+        off = np.zeros(cap + 1, dtype=np.int32)
+        off[: n + 1] = offsets
+        off[n + 1:] = offsets[n] if n else 0
+        keys = column_from_arrow(arr.keys, dt.key_type)
+        vals = column_from_arrow(arr.items, dt.value_type)
+        assert keys.capacity == vals.capacity  # same entry count
+        return MapColumn(keys, vals, jnp.asarray(off),
+                         jnp.asarray(_pad_np(validity, cap, False)), dt)
     if isinstance(dt, NullType):
         cap = bucket_capacity(max(n, 1))
         return Column(jnp.zeros(cap, jnp.int8), jnp.zeros(cap, jnp.bool_), dt)

@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .column import ArrayColumn, Column, StringColumn, StructColumn
+from .column import (ArrayColumn, Column, MapColumn, StringColumn,
+                     StructColumn)
 
 
 def _dd_split() -> bool:
@@ -76,6 +77,12 @@ def _pack_column(col: Column, out: List[jnp.ndarray]) -> None:
         out.append(_bytes_of(col.validity))
         _pack_column(col.child, out)
         return
+    if isinstance(col, MapColumn):
+        out.append(_bytes_of(col.offsets))
+        out.append(_bytes_of(col.validity))
+        _pack_column(col.keys, out)
+        _pack_column(col.values, out)
+        return
     out.append(_bytes_of(col.data))
     out.append(_bytes_of(col.validity))
 
@@ -117,6 +124,14 @@ def _unpack_column(col: Column, buf: np.ndarray, pos: int
         v, pos = _take(buf, pos, cap)
         kid, pos = _unpack_column(col.child, buf, pos)
         return ArrayColumn(kid, offsets, v.astype(np.bool_), col.dtype), pos
+    if isinstance(col, MapColumn):
+        raw, pos = _take(buf, pos, (cap + 1) * 4)
+        offsets = raw.view(np.int32)
+        v, pos = _take(buf, pos, cap)
+        keys, pos = _unpack_column(col.keys, buf, pos)
+        vals, pos = _unpack_column(col.values, buf, pos)
+        return MapColumn(keys, vals, offsets, v.astype(np.bool_),
+                         col.dtype), pos
     np_dtype = np.dtype(col.data.dtype)
     if np_dtype == np.bool_:
         raw, pos = _take(buf, pos, cap)

@@ -15,7 +15,8 @@ import jax
 import jax.numpy as jnp
 
 from ..columnar.batch import ColumnarBatch
-from ..columnar.column import ArrayColumn, Column, bucket_capacity
+from ..columnar.column import (ArrayColumn, Column, MapColumn,
+                               bucket_capacity)
 from ..expr.core import Expression, resolve
 from ..ops.basic import active_mask, compaction_order, gather_column
 from ..types import ArrayType, IntegerType, Schema, StructField
@@ -34,9 +35,17 @@ class GenerateExec(TpuExec):
         self.pos_name = pos_name
         self._bound = resolve(generator, child.output_schema)
         arr_t = self._bound.data_type
-        assert isinstance(arr_t, ArrayType), \
-            f"explode needs an ARRAY input, got {arr_t}"
-        self._elem_type = arr_t.element_type
+        from ..types import MapType
+        self._is_map = isinstance(arr_t, MapType)
+        if self._is_map:
+            # explode(map) emits (key, value) pairs (reference
+            # GpuGenerateExec.scala:829 map explode)
+            self._key_type = arr_t.key_type
+            self._elem_type = arr_t.value_type
+        else:
+            assert isinstance(arr_t, ArrayType), \
+                f"explode needs an ARRAY or MAP input, got {arr_t}"
+            self._elem_type = arr_t.element_type
         self._jit = jax.jit(self._kernel, static_argnums=(1,))
         self._jit_measure = jax.jit(self._measure_kernel)
 
@@ -46,7 +55,12 @@ class GenerateExec(TpuExec):
         if self.position:
             fields.append(StructField(self.pos_name, IntegerType(),
                                       self.outer))
-        fields.append(StructField(self.elem_name, self._elem_type, True))
+        if self._is_map:
+            fields.append(StructField("key", self._key_type, self.outer))
+            fields.append(StructField("value", self._elem_type, True))
+        else:
+            fields.append(StructField(self.elem_name, self._elem_type,
+                                      True))
         return Schema(tuple(fields))
 
     def additional_metrics(self):
@@ -82,11 +96,22 @@ class GenerateExec(TpuExec):
                                  ).astype(jnp.int64)
                     needs.append(jnp.sum(
                         copies * jnp.where(act, row_bytes, 0)))
+            elif isinstance(c, MapColumn):
+                el = jnp.where(act, c.offsets[1:] - c.offsets[:-1],
+                               0).astype(jnp.int64)
+                needs.append(jnp.sum(copies * el))
+                for side in (c.keys, c.values):
+                    if isinstance(side, StringColumn):
+                        row_bytes = (side.offsets[c.offsets[1:]]
+                                     - side.offsets[c.offsets[:-1]]
+                                     ).astype(jnp.int64)
+                        needs.append(jnp.sum(
+                            copies * jnp.where(act, row_bytes, 0)))
         return tuple(needs)
 
     def _payload_caps(self, batch: ColumnarBatch) -> tuple:
         from ..columnar.column import StringColumn
-        if not any(isinstance(c, (StringColumn, ArrayColumn))
+        if not any(isinstance(c, (StringColumn, ArrayColumn, MapColumn))
                    for c in batch.columns):
             return (None,) * len(batch.columns)
         needs = iter(int(n) for n in jax.device_get(
@@ -102,6 +127,13 @@ class GenerateExec(TpuExec):
                                  bucket_capacity(max(next(needs), 8))))
                 else:
                     caps.append(elems)
+            elif isinstance(c, MapColumn):
+                elems = bucket_capacity(max(next(needs), 8))
+                kb = bucket_capacity(max(next(needs), 8)) \
+                    if isinstance(c.keys, StringColumn) else None
+                vb = bucket_capacity(max(next(needs), 8)) \
+                    if isinstance(c.values, StringColumn) else None
+                caps.append((elems, kb, vb))
             else:
                 caps.append(None)
         return tuple(caps)
@@ -109,7 +141,13 @@ class GenerateExec(TpuExec):
     def _kernel(self, batch: ColumnarBatch, payload_caps: tuple = ()
                 ) -> ColumnarBatch:
         arr = self._bound.columnar_eval(batch)
-        assert isinstance(arr, ArrayColumn)
+        from ..columnar.column import MapColumn
+        if isinstance(arr, MapColumn):
+            from ..ops.maps import map_keys
+            map_col, arr = arr, map_keys(arr)  # offsets/validity vehicle
+        else:
+            map_col = None
+            assert isinstance(arr, ArrayColumn)
         cap = batch.capacity
         child_cap = arr.child_capacity
         lens = arr.offsets[1:] - arr.offsets[:-1]
@@ -159,7 +197,11 @@ class GenerateExec(TpuExec):
                                else jnp.where(act_out, True, False),
                                IntegerType()))
         elem_idx = jnp.where(is_elem & act_out, e, -1)
-        cols.append(gather_column(arr.child, elem_idx))
+        if map_col is not None:
+            cols.append(gather_column(map_col.keys, elem_idx))
+            cols.append(gather_column(map_col.values, elem_idx))
+        else:
+            cols.append(gather_column(arr.child, elem_idx))
         return ColumnarBatch(cols, n_out, self.output_schema)
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:

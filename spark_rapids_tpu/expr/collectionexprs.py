@@ -47,8 +47,8 @@ class ArrayContains(Expression):
 
 
 class ElementAt(Expression):
-    """element_at(arr, i): 1-based, negative from end, null out of bounds
-    (non-ANSI)."""
+    """element_at(arr, i) — 1-based, negative from end, null out of
+    bounds (non-ANSI) — or element_at(map, key)."""
 
     def __init__(self, child: Expression, index):
         self.children = (child,)
@@ -62,11 +62,29 @@ class ElementAt(Expression):
 
     @property
     def data_type(self):
-        return self.children[0].data_type.element_type
+        from ..types import MapType
+        ct = self.children[0].data_type
+        if isinstance(ct, MapType):
+            return ct.value_type
+        return ct.element_type
 
     def columnar_eval(self, batch):
-        return C.element_at(self.children[0].columnar_eval(batch),
-                            self.index)
+        from ..columnar.column import MapColumn
+        c = self.children[0].columnar_eval(batch)
+        if isinstance(c, MapColumn):
+            from ..ops.maps import map_get
+            return map_get(c, self.index)
+        return C.element_at(c, self.index)
+
+    def host_eval_row(self, v):
+        if v is None or self.index is None:
+            return None
+        if isinstance(v, dict):
+            return v.get(self.index)
+        i = self.index
+        if i == 0 or abs(i) > len(v):
+            return None
+        return v[i - 1] if i > 0 else v[i]
 
 
 class GetArrayItem(ElementAt):

@@ -1,0 +1,163 @@
+"""Map expressions (reference collectionOperations.scala GpuCreateMap /
+GpuGetMapValue / GpuMapKeys / GpuMapValues / GpuElementAt for maps)."""
+
+from __future__ import annotations
+
+from ..columnar.column import MapColumn
+from ..types import BOOLEAN, ArrayType, MapType
+from .core import Expression, Literal
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...)"""
+
+    def __init__(self, *children: Expression):
+        assert children and len(children) % 2 == 0, \
+            "map() takes key/value pairs"
+        self.children = tuple(children)
+
+    def with_children(self, cs):
+        return CreateMap(*cs)
+
+    @property
+    def data_type(self):
+        return MapType(self.children[0].data_type,
+                       self.children[1].data_type)
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        from ..ops.maps import create_map
+        cols = [c.columnar_eval(batch) for c in self.children]
+        return create_map(cols[0::2], cols[1::2], batch.num_rows,
+                          self.data_type)
+
+    def host_eval_with_row(self, row, eval_fn):
+        vals = [eval_fn(c, row) for c in self.children]
+        d = {}
+        for k, v in zip(vals[0::2], vals[1::2]):
+            if k not in d:  # FIRST duplicate wins, matching the device
+                d[k] = v
+        return d
+
+
+class GetMapValue(Expression):
+    """map[key] / element_at(map, key): NULL when absent (non-ANSI)."""
+
+    def __init__(self, child: Expression, key):
+        if isinstance(key, Literal):
+            key = key.value
+        if isinstance(key, Expression):
+            self.children = (child, key)
+            self.key = None
+        else:
+            self.children = (child,)
+            self.key = key
+
+    def with_children(self, cs):
+        if len(cs) == 1:
+            return GetMapValue(cs[0], self.key)
+        return GetMapValue(cs[0], cs[1])
+
+    def _semantic_args(self):
+        return (self.key,)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.value_type
+
+    def columnar_eval(self, batch):
+        from ..ops.maps import map_get
+        m = self.children[0].columnar_eval(batch)
+        key = self.key if len(self.children) == 1 \
+            else self.children[1].columnar_eval(batch)
+        out = map_get(m, key)
+        return out
+
+    def host_eval_row(self, *vals):
+        m = vals[0]
+        k = self.key if len(self.children) == 1 else vals[1]
+        if m is None or k is None:
+            return None
+        return m.get(k)
+
+
+class MapKeys(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, cs):
+        return type(self)(cs[0])
+
+    @property
+    def data_type(self):
+        return ArrayType(self.children[0].data_type.key_type, False)
+
+    def columnar_eval(self, batch):
+        from ..ops.maps import map_keys
+        return map_keys(self.children[0].columnar_eval(batch))
+
+    def host_eval_row(self, m):
+        return None if m is None else list(m.keys())
+
+
+class MapValues(MapKeys):
+    @property
+    def data_type(self):
+        mt = self.children[0].data_type
+        return ArrayType(mt.value_type, mt.value_contains_null)
+
+    def columnar_eval(self, batch):
+        from ..ops.maps import map_values
+        return map_values(self.children[0].columnar_eval(batch))
+
+    def host_eval_row(self, m):
+        return None if m is None else list(m.values())
+
+
+class MapContainsKey(Expression):
+    """map_contains_key(map, key)"""
+
+    def __init__(self, child: Expression, key):
+        if isinstance(key, Literal):
+            key = key.value
+        if isinstance(key, Expression):
+            self.children = (child, key)
+            self.key = None
+        else:
+            self.children = (child,)
+            self.key = key
+
+    def with_children(self, cs):
+        if len(cs) == 1:
+            return MapContainsKey(cs[0], self.key)
+        return MapContainsKey(cs[0], cs[1])
+
+    def _semantic_args(self):
+        return (self.key,)
+
+    @property
+    def data_type(self):
+        return BOOLEAN
+
+    def columnar_eval(self, batch):
+        import jax.numpy as jnp
+
+        from ..columnar.column import Column
+        from ..ops.maps import map_contains_key
+        m = self.children[0].columnar_eval(batch)
+        key = self.key if len(self.children) == 1 \
+            else self.children[1].columnar_eval(batch)
+        if key is None:  # NULL key literal -> NULL result
+            z = jnp.zeros((m.capacity,), jnp.bool_)
+            return Column(z, z, BOOLEAN)
+        return map_contains_key(m, key)
+
+    def host_eval_row(self, *vals):
+        m = vals[0]
+        k = self.key if len(self.children) == 1 else vals[1]
+        if m is None or k is None:
+            return None
+        return k in m

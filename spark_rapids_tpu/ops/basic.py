@@ -39,6 +39,10 @@ def sanitize(col: Column, num_rows) -> Column:
         return StructColumn(kids, validity, col.dtype)
     if isinstance(col, ArrayColumn):
         return ArrayColumn(col.child, col.offsets, validity, col.dtype)
+    from ..columnar.column import MapColumn
+    if isinstance(col, MapColumn):
+        return MapColumn(col.keys, col.values, col.offsets, validity,
+                         col.dtype)
     data = jnp.where(act, col.data, jnp.zeros((), col.data.dtype))
     return Column(data, validity, col.dtype)
 
@@ -66,6 +70,22 @@ def gather_column(col: Column, indices, out_valid=None,
         from .collection import gather_array
         return gather_array(col, safe, valid,
                             out_child_capacity=out_byte_capacity)
+    from ..columnar.column import MapColumn
+    if isinstance(col, MapColumn):
+        from .collection import gather_array
+        from .maps import map_keys, map_values
+        # duplicating gathers pass (entries, key_bytes, value_bytes)
+        if isinstance(out_byte_capacity, tuple):
+            elems, kb, vb = out_byte_capacity
+            kcap = (elems, kb) if kb is not None else elems
+            vcap = (elems, vb) if vb is not None else elems
+        else:
+            kcap = vcap = out_byte_capacity
+        gk = gather_array(map_keys(col), safe, valid,
+                          out_child_capacity=kcap)
+        gv = gather_array(map_values(col), safe, valid,
+                          out_child_capacity=vcap)
+        return MapColumn(gk.child, gv.child, gk.offsets, valid, col.dtype)
     data = jnp.where(valid, col.data[safe], jnp.zeros((), col.data.dtype))
     return Column(data, valid, col.dtype)
 

@@ -1,0 +1,192 @@
+"""Map-column kernels: build, lookup, keys/values views.
+
+Reference analog: GpuCreateMap / GpuGetMapValue / GpuMapKeys /
+GpuMapValues over cuDF LIST<STRUCT> + MapUtils JNI
+(collectionOperations.scala). Here maps are (offsets, keys, values)
+triplets (columnar/column.MapColumn); a lookup is a flat compare over
+the keys child plus one segment-min per row — no per-row loops.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import (ArrayColumn, Column, MapColumn,
+                               StringColumn, bucket_capacity)
+from ..types import ArrayType, MapType
+
+_BIG = jnp.int32(1 << 30)
+
+
+def _entry_rows(m: MapColumn):
+    ecap = m.entry_capacity
+    epos = jnp.arange(ecap, dtype=jnp.int32)
+    erow = jnp.searchsorted(m.offsets, epos,
+                            side="right").astype(jnp.int32) - 1
+    erow = jnp.clip(erow, 0, m.capacity - 1)
+    in_use = epos < m.offsets[m.capacity]
+    return epos, erow, in_use
+
+
+def map_keys(m: MapColumn) -> ArrayColumn:
+    return ArrayColumn(m.keys, m.offsets, m.validity,
+                       ArrayType(m.dtype.key_type, False))
+
+
+def map_values(m: MapColumn) -> ArrayColumn:
+    return ArrayColumn(m.values, m.offsets, m.validity,
+                       ArrayType(m.dtype.value_type,
+                                 m.dtype.value_contains_null))
+
+
+def _key_match(m: MapColumn, key) -> jnp.ndarray:
+    """(entry_capacity,) bool: entry key == lookup key (per entry row).
+
+    `key` is a host literal or a per-row Column of the key type."""
+    keys = m.keys
+    epos, erow, in_use = _entry_rows(m)
+    if isinstance(keys, StringColumn):
+        from .strings import string_lengths
+        klens = string_lengths(keys)
+        if isinstance(key, Column):
+            from .strings import seg_incl_cumsum
+            tgt: StringColumn = key  # per-row key strings
+            tlens = string_lengths(tgt)[erow]
+            # byte-level compare: each byte of the keys child against the
+            # same offset of its row's target key
+            bcap = keys.byte_capacity
+            bpos = jnp.arange(bcap, dtype=jnp.int32)
+            bent = jnp.searchsorted(keys.offsets, bpos,
+                                    side="right").astype(jnp.int32) - 1
+            bent = jnp.clip(bent, 0, keys.capacity - 1)
+            boff = bpos - keys.offsets[bent]
+            brow = erow[bent]
+            tpos = jnp.clip(tgt.offsets[brow] + boff, 0,
+                            tgt.byte_capacity - 1)
+            bad = (bpos < keys.offsets[-1]) & \
+                (keys.data[bpos] != tgt.data[tpos])
+            bad_csum = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32),
+                 jnp.cumsum(bad.astype(jnp.int32))])
+            lo = jnp.clip(keys.offsets[:-1], 0, bcap)
+            hi = jnp.clip(keys.offsets[1:], 0, bcap)
+            match = (klens == tlens) & (bad_csum[hi] - bad_csum[lo] == 0)
+            match = match & tgt.validity[erow]
+        else:
+            kb = key.encode("utf-8") if isinstance(key, str) \
+                else bytes(key)
+            from .strings import _match_at
+            match = (klens == len(kb)) & _match_at(keys, kb,
+                                                   keys.offsets[:-1])
+    else:
+        if isinstance(key, Column):
+            match = (keys.data == key.data[erow]) & key.validity[erow]
+        else:
+            match = keys.data == jnp.asarray(key, keys.data.dtype)
+    return match & keys.validity & in_use
+
+
+def map_get(m: MapColumn, key) -> Column:
+    """element_at(map, key) / map[key]: the value of the FIRST entry whose
+    key equals `key`; NULL when absent or the key is NULL (non-ANSI)."""
+    if key is None:
+        vals = m.values
+        if isinstance(vals, StringColumn):
+            from .strings import gather_string
+            idx = jnp.zeros((m.capacity,), jnp.int32)
+            return gather_string(vals, idx,
+                                 jnp.zeros((m.capacity,), jnp.bool_))
+        return Column(jnp.zeros((m.capacity,), vals.data.dtype),
+                      jnp.zeros((m.capacity,), jnp.bool_), vals.dtype)
+    epos, erow, in_use = _entry_rows(m)
+    match = _key_match(m, key)
+    first = jax.ops.segment_min(jnp.where(match, epos, _BIG), erow,
+                                num_segments=m.capacity)
+    has = (first < _BIG) & m.validity
+    if isinstance(key, Column):
+        has = has & key.validity
+    idx = jnp.clip(first, 0, m.entry_capacity - 1)
+    vals = m.values
+    if isinstance(vals, StringColumn):
+        from .strings import gather_string
+        valid = has & vals.validity[idx]
+        return gather_string(vals, idx, valid)
+    data = jnp.where(has, vals.data[idx], jnp.zeros((), vals.data.dtype))
+    return Column(data, has & vals.validity[idx], vals.dtype)
+
+
+def map_contains_key(m: MapColumn, key) -> Column:
+    from ..types import BOOLEAN
+    epos, erow, _ = _entry_rows(m)
+    match = _key_match(m, key)
+    any_m = jax.ops.segment_max(match.astype(jnp.int32), erow,
+                                num_segments=m.capacity) > 0
+    valid = m.validity
+    if isinstance(key, Column):
+        valid = valid & key.validity
+    return Column(jnp.where(valid, any_m, False), valid, BOOLEAN)
+
+
+def interleave_columns(cols: Sequence[Column]) -> Column:
+    """Row-major interleave of k same-type columns into one column of
+    k*cap rows: output row r*k + j = cols[j][r]. The CreateMap entry
+    builder."""
+    k = len(cols)
+    cap = cols[0].capacity
+    out_cap = bucket_capacity(cap * k)
+    if isinstance(cols[0], StringColumn):
+        from .strings import _rebuild_offsets, string_lengths
+        lens = [string_lengths(c) for c in cols]
+        inter_lens = jnp.stack(lens, axis=1).reshape(-1)  # (cap*k,)
+        inter_lens = jnp.concatenate(
+            [inter_lens, jnp.zeros((out_cap - cap * k,), jnp.int32)])
+        new_off = _rebuild_offsets(inter_lens)
+        byte_cap = bucket_capacity(
+            max(sum(int(c.byte_capacity) for c in cols), 1))
+        bpos = jnp.arange(byte_cap, dtype=jnp.int32)
+        orow = jnp.searchsorted(new_off, bpos,
+                                side="right").astype(jnp.int32) - 1
+        orow = jnp.clip(orow, 0, out_cap - 1)
+        src_row = orow // k
+        src_col = orow % k
+        intra = bpos - new_off[orow]
+        in_use = bpos < new_off[-1]
+        byte = jnp.zeros((byte_cap,), jnp.uint8)
+        for j, c in enumerate(cols):
+            sp = jnp.clip(c.offsets[jnp.clip(src_row, 0, cap - 1)] + intra,
+                          0, c.byte_capacity - 1)
+            byte = jnp.where(src_col == j, c.data[sp], byte)
+        data = jnp.where(in_use, byte, jnp.uint8(0))
+        valid = jnp.stack([c.validity for c in cols], axis=1).reshape(-1)
+        valid = jnp.concatenate(
+            [valid, jnp.zeros((out_cap - cap * k,), jnp.bool_)])
+        return StringColumn(data, new_off, valid, cols[0].dtype)
+    data = jnp.stack([c.data for c in cols], axis=1).reshape(-1)
+    valid = jnp.stack([c.validity for c in cols], axis=1).reshape(-1)
+    pad = out_cap - cap * k
+    data = jnp.concatenate([data, jnp.zeros((pad,), data.dtype)])
+    valid = jnp.concatenate([valid, jnp.zeros((pad,), jnp.bool_)])
+    return Column(data, valid, cols[0].dtype)
+
+
+def create_map(key_cols: Sequence[Column], val_cols: Sequence[Column],
+               num_rows, dtype: MapType) -> MapColumn:
+    """map(k1, v1, k2, v2, ...): k static pairs per row. Duplicate keys
+    are kept in entry order and every consumer (map_get, to_pylist, host
+    rows) resolves them FIRST-wins — a documented divergence from
+    Spark's default EXCEPTION dedup policy (which errors) chosen so the
+    engine never has to raise from inside a compiled kernel."""
+    k = len(key_cols)
+    cap = key_cols[0].capacity
+    keys = interleave_columns(key_cols)
+    vals = interleave_columns(val_cols)
+    from .basic import active_mask
+    act = active_mask(num_rows, cap)
+    # every row slot owns exactly k interleaved entries (offsets must
+    # stay aligned with the row-major entry layout even for padded rows;
+    # padded rows are invalid so their entries are never read)
+    off = jnp.arange(cap + 1, dtype=jnp.int32) * k
+    return MapColumn(keys, vals, off, act, dtype)

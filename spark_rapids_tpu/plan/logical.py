@@ -207,17 +207,25 @@ class LogicalGenerate(LogicalPlan):
 
     @property
     def schema(self) -> Schema:
-        from ..types import ArrayType, IntegerType
+        from ..types import ArrayType, IntegerType, MapType
         bound = resolve(self.generator, self.children[0].schema)
         arr_t = bound.data_type
-        if not isinstance(arr_t, ArrayType):
+        if not isinstance(arr_t, (ArrayType, MapType)):
             raise TypeError(
-                f"explode needs an ARRAY input, got {arr_t.simple_name()}")
+                f"explode needs an ARRAY or MAP input, got "
+                f"{arr_t.simple_name()}")
         fields = list(self.children[0].schema.fields)
         if self.position:
             fields.append(StructField(self.pos_name, IntegerType(),
                                       self.outer))
-        fields.append(StructField(self.elem_name, arr_t.element_type, True))
+        if isinstance(arr_t, MapType):
+            # explode(map) emits (key, value) pairs like Spark
+            fields.append(StructField("key", arr_t.key_type,
+                                      self.outer))
+            fields.append(StructField("value", arr_t.value_type, True))
+        else:
+            fields.append(StructField(self.elem_name, arr_t.element_type,
+                                      True))
         return Schema(tuple(fields))
 
     def describe(self):

@@ -325,10 +325,22 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
     _r(rules, conditional.NullIf, "nullif")
     # collections (fixed-width + string elements; deeper nesting tagged off)
     arr = TypeSig.of("ARRAY")
-    _r(rules, collectionexprs.Size, "array size", arr, integral)
+    mapsig = TypeSig.of("MAP")
+    _r(rules, collectionexprs.Size, "array/map size", arr + mapsig,
+       integral)
     _r(rules, collectionexprs.ArrayContains, "array membership", arr, BOOLEAN)
-    _r(rules, collectionexprs.ElementAt, "1-based element access", arr,
-       commonly_supported)
+    _r(rules, collectionexprs.ElementAt, "element access (array/map)",
+       arr + mapsig, commonly_supported)
+    # maps (reference GpuCreateMap/GpuGetMapValue/GpuMapKeys/GpuMapValues)
+    from ..expr import mapexprs
+    _r(rules, mapexprs.CreateMap, "map constructor", commonly_supported,
+       mapsig)
+    _r(rules, mapexprs.GetMapValue, "map value lookup",
+       mapsig + commonly_supported, commonly_supported)
+    _r(rules, mapexprs.MapKeys, "map_keys", mapsig, arr)
+    _r(rules, mapexprs.MapValues, "map_values", mapsig, arr)
+    _r(rules, mapexprs.MapContainsKey, "map_contains_key", mapsig,
+       BOOLEAN)
     _r(rules, collectionexprs.GetArrayItem, "0-based element access", arr,
        commonly_supported)
     def _fixed_width_elements(meta):
@@ -516,7 +528,7 @@ class PlanMeta(BaseMeta):
             # joins duplicate payload rows; the duplicating array gather
             # has no string-element byte measurement yet — reject at plan
             # time instead of asserting mid-execution
-            from ..types import ArrayType
+            from ..types import ArrayType, MapType
             for child in self.plan.children:
                 for f in child.schema.fields:
                     if isinstance(f.data_type, ArrayType) \
@@ -526,6 +538,11 @@ class PlanMeta(BaseMeta):
                             f"{f.data_type.simple_name()} elements are not "
                             "fixed-width (duplicating gather lacks string "
                             "byte measurement)")
+                    if isinstance(f.data_type, MapType):
+                        self.will_not_work_on_tpu(
+                            f"join payload column {f.name!r}: "
+                            "map payloads lack the join-side duplicating "
+                            "byte measurement")
         for em in self.expr_metas:
             em.tag_for_tpu()
         if any(not em.can_run_on_tpu for em in self.expr_metas):

@@ -32,8 +32,9 @@ from typing import List, Tuple
 import numpy as np
 
 from ..columnar.batch import ColumnarBatch
-from ..columnar.column import (ArrayColumn, Column, StringColumn,
-                               StructColumn, bucket_capacity)
+from ..columnar.column import (ArrayColumn, Column, MapColumn,
+                               StringColumn, StructColumn,
+                               bucket_capacity)
 from ..native import lz4_available, lz4_compress, lz4_decompress, xxh64
 from ..types import Schema
 
@@ -87,6 +88,13 @@ def _encode_column(col: Column, n: int, out: List[np.ndarray],
     elif isinstance(col, StructColumn):
         for ch in col.children:
             _encode_column(ch, n, out, start=start)
+    elif isinstance(col, MapColumn):
+        off = _np(col.offsets)
+        out.append(_rebase_offsets(off, n, start))
+        lo = int(off[start])
+        hi = int(off[start + n]) if n else lo
+        _encode_column(col.keys, hi - lo, out, start=lo)
+        _encode_column(col.values, hi - lo, out, start=lo)
     else:
         out.append(np.ascontiguousarray(_np(col.data)[start: start + n]))
 
@@ -123,6 +131,23 @@ def _decode_column(dtype, n: int, bufs: List[bytes], pos: int,
                                     child_cap)
         return ArrayColumn(child, jnp.asarray(opad), jnp.asarray(vpad),
                            dtype), pos
+
+    from ..types import MapType
+    if isinstance(dtype, MapType):
+        from ..columnar.column import MapColumn
+        off = np.frombuffer(bufs[pos], dtype=np.int32)
+        pos += 1
+        opad = np.zeros(capacity + 1, np.int32)
+        opad[: n + 1] = off
+        opad[n + 1:] = off[n] if n else 0
+        entry_n = int(off[n]) if n else 0
+        ecap = bucket_capacity(max(entry_n, 1))
+        keys, pos = _decode_column(dtype.key_type, entry_n, bufs, pos,
+                                   ecap)
+        vals, pos = _decode_column(dtype.value_type, entry_n, bufs, pos,
+                                   ecap)
+        return MapColumn(keys, vals, jnp.asarray(opad),
+                         jnp.asarray(vpad), dtype), pos
 
     if dtype.jnp_dtype is None or isinstance(dtype, StringType):
         off = np.frombuffer(bufs[pos], dtype=np.int32)

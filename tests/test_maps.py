@@ -1,0 +1,146 @@
+"""Map-type columns: representation, kernels, planner integration."""
+import numpy as np
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar import ColumnarBatch, MapColumn
+from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
+                                                 serialize_batch)
+from spark_rapids_tpu.types import (ArrayType, IntegerType, LONG, MapType,
+                                    STRING, Schema, StructField,
+                                    StructType)
+
+MT = MapType(STRING, LONG)
+ROWS = [{"a": 1, "b": 2}, {}, None, {"x": None, "a": 9}]
+
+
+def _sch(**kw):
+    return Schema(tuple(StructField(k, v) for k, v in kw.items()))
+
+
+def test_map_column_roundtrip():
+    b = ColumnarBatch.from_pydict({"m": ROWS}, _sch(m=MT))
+    assert b.columns[0].to_pylist(4) == ROWS
+
+
+def test_nested_array_ingestion():
+    at = ArrayType(ArrayType(IntegerType()))
+    rows = [[[1], [2, 2]], [], None, [[3, 4]]]
+    b = ColumnarBatch.from_pydict({"a": rows}, _sch(a=at))
+    assert b.columns[0].to_pylist(4) == rows
+
+
+def test_array_of_struct_ingestion():
+    st = ArrayType(StructType((StructField("x", LONG),
+                               StructField("y", STRING))))
+    rows = [[{"x": 1, "y": "a"}, None], None, []]
+    b = ColumnarBatch.from_pydict({"s": rows}, _sch(s=st))
+    assert b.columns[0].to_pylist(3) == rows
+
+
+def test_map_arrow_roundtrip():
+    import pyarrow as pa
+    t = pa.table({"m": pa.array(ROWS, pa.map_(pa.string(), pa.int64()))})
+    b = ColumnarBatch.from_arrow(t)
+    assert isinstance(b.columns[0], MapColumn)
+    assert b.to_pydict()["m"] == ROWS
+    back = b.to_arrow()
+    assert back.column("m").to_pylist() == [
+        list(r.items()) if r is not None else None for r in ROWS]
+
+
+def test_map_shuffle_serialization():
+    b = ColumnarBatch.from_pydict({"m": ROWS}, _sch(m=MT))
+    rt = deserialize_batch(serialize_batch(b), b.schema)
+    assert rt.columns[0].to_pylist(4) == ROWS
+
+
+def test_map_lookup_and_views():
+    sess = TpuSession()
+    df = sess.from_pydict({"m": ROWS, "k": ["a", "a", "a", "x"]},
+                          schema=_sch(m=MT, k=STRING))
+    q = df.select(
+        F.element_at(F.col("m"), "a").alias("va"),
+        F.get_map_value(F.col("m"), F.col("k")).alias("vk"),
+        F.map_keys(F.col("m")).alias("ks"),
+        F.map_values(F.col("m")).alias("vs"),
+        F.map_contains_key(F.col("m"), "b").alias("hb"),
+        F.size(F.col("m")).alias("sz"))
+    assert "host" not in q.explain()
+    out = q.collect()
+    assert out[0] == (1, 1, ["a", "b"], [1, 2], True, 2)
+    assert out[1] == (None, None, [], [], False, 0)
+    assert out[2] == (None, None, None, None, None, None)
+    assert out[3] == (9, None, ["x", "a"], [None, 9], False, 2)
+
+
+def test_create_map_and_filter():
+    sess = TpuSession()
+    df = sess.from_pydict({"k1": ["p", "q"], "v1": [1, 2]},
+                          schema=_sch(k1=STRING, v1=LONG))
+    out = df.select(F.create_map(F.col("k1"), F.col("v1"),
+                                 F.lit("z"), F.lit(0)).alias("m")).collect()
+    assert out == [({"p": 1, "z": 0},), ({"q": 2, "z": 0},)]
+    df2 = sess.from_pydict({"m": [{"a": 1}, {"b": 2}, None],
+                            "x": [1, 2, 3]}, _sch(m=MT, x=LONG))
+    out2 = df2.where(F.col("x") > F.lit(1)).select(F.col("m")).collect()
+    assert out2 == [({"b": 2},), (None,)]
+
+
+def test_map_explode():
+    sess = TpuSession()
+    df = sess.from_pydict({"m": [{"a": 1, "b": 2}, {}, None, {"c": 3}]},
+                          schema=_sch(m=MT))
+    out = df.explode(F.col("m")).collect()
+    assert [(r[-2], r[-1]) for r in out] == [("a", 1), ("b", 2), ("c", 3)]
+
+
+def test_int_key_map():
+    mt = MapType(LONG, STRING)
+    rows = [{1: "x", 2: "y"}, None, {7: None}]
+    sess = TpuSession()
+    df = sess.from_pydict({"m": rows}, _sch(m=mt))
+    out = df.select(F.element_at(F.col("m"), 2).alias("v"),
+                    F.element_at(F.col("m"), 7).alias("w")).collect()
+    assert out == [("y", None), (None, None), (None, None)]
+
+
+def test_map_payload_through_explode():
+    # a map PAYLOAD column duplicated by explode must size its entry
+    # (and string byte) buckets from measurement, not silently truncate
+    sess = TpuSession()
+    big = {chr(97 + i) * 3: i for i in range(6)}
+    df = sess.from_pydict(
+        {"a": [[1, 2, 3, 4], [5, 6, 7, 8]], "m": [big, big]},
+        schema=Schema((StructField("a", ArrayType(LONG)),
+                       StructField("m", MT))))
+    out = df.explode(F.col("a")).collect()
+    assert len(out) == 8
+    assert all(r[1] == big for r in out)
+
+
+def test_duplicate_keys_first_wins_everywhere():
+    sess = TpuSession()
+    df = sess.from_pydict({"v1": [10], "v2": [20]},
+                          schema=_sch(v1=LONG, v2=LONG))
+    q = df.select(F.create_map(F.lit("a"), F.col("v1"),
+                               F.lit("a"), F.col("v2")).alias("m"))
+    m_expr = q.select(F.element_at(F.col("m"), "a").alias("v"))
+    assert m_expr.collect() == [(10,)]        # lookup: first wins
+    assert q.collect() == [({"a": 10},)]      # materialize: first wins
+
+
+def test_map_contains_key_column():
+    sess = TpuSession()
+    df = sess.from_pydict({"m": [{"a": 1}, {"b": 2}], "k": ["a", "a"]},
+                          schema=_sch(m=MT, k=STRING))
+    out = df.select(F.map_contains_key(F.col("m"), F.col("k"))
+                    .alias("c")).collect()
+    assert out == [(True,), (False,)]
+
+
+def test_element_at_null_key():
+    sess = TpuSession()
+    df = sess.from_pydict({"m": [{"a": 1}]}, schema=_sch(m=MT))
+    out = df.select(F.get_map_value(F.col("m"), F.lit(None)).alias("v"))
+    assert out.collect() == [(None,)]
