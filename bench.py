@@ -1,4 +1,4 @@
-"""Headline benchmark: q1-style columnar aggregation throughput on one chip.
+"""Headline benchmarks on one chip: q1-style aggregation + q3-style join.
 
 Runs the flagship pipeline (filter -> derived projection -> group-by
 aggregate, the TPC-H q1 shape) through the full exec layer (spillable
@@ -16,7 +16,12 @@ program. Result correctness is verified against the numpy oracle after the
 clock stops, and the checksum is cross-checked against the fetched result
 so all ITERS iterations are proven to have produced it.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per lane: {"metric", "value", "unit",
+"vs_baseline"}. The q1 lane (headline) prints FIRST. The q3 lane runs
+the scan -> filter -> hash join -> group-by -> top-N shape through the
+exec layer's EXACT aggregation tier (orderkey cardinality is far past
+the speculative bucket table) so join+sort regressions are visible to
+the driver loop (round-2 verdict item 9).
 """
 
 import json
@@ -158,5 +163,130 @@ def main():
     }))
 
 
+N_ORDERS = 1 << 19   # 512K orders
+N_LINES = 1 << 21    # 2M lineitems
+
+
+def build_q3_data():
+    rng = np.random.default_rng(1)
+    return {
+        "o_orderkey": np.arange(N_ORDERS, dtype=np.int64),
+        "o_flag": rng.integers(0, 10, N_ORDERS, dtype=np.int32),
+        "l_orderkey": rng.integers(0, N_ORDERS, N_LINES, dtype=np.int64),
+        "l_price": rng.random(N_LINES) * 1000.0,
+        "l_disc": rng.random(N_LINES) * 0.1,
+        "l_flag": rng.integers(0, 4, N_LINES, dtype=np.int32),
+    }
+
+
+def q3_oracle(d):
+    keep_o = d["o_flag"] < 5
+    keep_l = d["l_flag"] != 0
+    okeys = d["o_orderkey"][keep_o]
+    lkey = d["l_orderkey"][keep_l]
+    rev = (d["l_price"] * (1.0 - d["l_disc"]))[keep_l]
+    sel = np.isin(lkey, okeys)
+    lkey, rev = lkey[sel], rev[sel]
+    order = np.argsort(lkey, kind="stable")
+    lkey, rev = lkey[order], rev[order]
+    uk, starts = np.unique(lkey, return_index=True)
+    sums = np.add.reduceat(rev, starts)
+    top = np.argsort(-sums, kind="stable")[:10]
+    return {int(uk[i]): float(sums[i]) for i in top}
+
+
+def q3_bench():
+    d = build_q3_data()
+    q3_oracle(d)
+    t0 = time.perf_counter()
+    oracle = q3_oracle(d)
+    t_np = time.perf_counter() - t0
+
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+    from spark_rapids_tpu.exec.aggregate import AggregateExec
+    from spark_rapids_tpu.exec.basic import (FilterExec, InMemoryScanExec,
+                                             ProjectExec)
+    from spark_rapids_tpu.exec.joins import HashJoinExec
+    from spark_rapids_tpu.exec.sort import TopNExec
+    from spark_rapids_tpu.expr.aggexprs import Sum
+    from spark_rapids_tpu.expr.core import col, lit
+    from spark_rapids_tpu.types import DOUBLE, INT, LONG, Schema, StructField
+
+    o_schema = Schema((StructField("o_orderkey", LONG),
+                       StructField("o_flag", INT)))
+    l_schema = Schema((StructField("l_orderkey", LONG),
+                       StructField("l_price", DOUBLE),
+                       StructField("l_disc", DOUBLE),
+                       StructField("l_flag", INT)))
+
+    def mk_batch(schema, n):
+        cap = bucket_capacity(n)
+        cols = [Column.from_numpy(d[f.name], f.data_type, capacity=cap)
+                for f in schema.fields]
+        return ColumnarBatch(cols, n, schema)
+
+    orders = mk_batch(o_schema, N_ORDERS)
+    lines = mk_batch(l_schema, N_LINES)
+
+    o_scan = FilterExec(col("o_flag") < lit(5),
+                        InMemoryScanExec([orders], o_schema))
+    l_scan = FilterExec(col("l_flag") != lit(0),
+                        InMemoryScanExec([lines], l_schema))
+    joined = HashJoinExec(l_scan, o_scan, [col("l_orderkey")],
+                          [col("o_orderkey")], "inner",
+                          build_side="right")
+    proj = ProjectExec([
+        col("l_orderkey"),
+        (col("l_price") * (lit(1.0) - col("l_disc"))).alias("rev")],
+        joined)
+    agg = AggregateExec([col("l_orderkey")], [(Sum(col("rev")), "revenue")],
+                        proj)
+    plan = TopNExec(10, [(col("revenue"), False)], agg)
+
+    @jax.jit
+    def checksum(batch, prev):
+        total = prev + batch.num_rows.astype(jnp.float64)
+        for c in batch.columns:
+            v = jnp.where(c.validity, c.data, jnp.zeros((), c.data.dtype))
+            total = total + jnp.sum(v).astype(jnp.float64)
+        return total
+
+    def run_once(prev):
+        outs = list(plan.execute())  # exact tier: no speculation scope
+        for b in outs:
+            prev = checksum(b, prev)
+        return outs, prev
+
+    outs, chk = run_once(jnp.float64(0.0))  # warm + verify
+    rows = [r for b in outs for r in b.to_pylist()]
+    got = {r[0]: r[1] for r in rows}
+    assert set(got) == set(oracle), (sorted(got)[:3], sorted(oracle)[:3])
+    for k, v in oracle.items():
+        assert abs(got[k] - v) / max(abs(v), 1) < 1e-9
+    expect1 = float(np.asarray(chk))
+
+    iters = 10
+    t0 = time.perf_counter()
+    chk = jnp.float64(0.0)
+    for _ in range(iters):
+        _, chk = run_once(chk)
+    final = float(np.asarray(chk))
+    dt = (time.perf_counter() - t0) / iters
+    assert abs(final - iters * expect1) <= 1e-9 * max(abs(final), 1.0)
+
+    bytes_in = sum(v.nbytes for v in d.values())
+    print(json.dumps({
+        "metric": "q3_join_topn_throughput",
+        "value": round(bytes_in / dt / 1e9, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(t_np / dt, 3),
+    }))
+
+
 if __name__ == "__main__":
     main()
+    q3_bench()

@@ -157,11 +157,7 @@ jax.tree_util.register_pytree_node(ColumnarBatch, _batch_flatten, _batch_unflatt
 
 
 def empty_batch(schema: Schema, capacity: int = 128) -> ColumnarBatch:
-    cols = []
-    for f in schema.fields:
-        if isinstance(f.data_type, StringType) or f.data_type.jnp_dtype is None:
-            cols.append(StringColumn.from_pylist([], capacity=capacity,
-                                                 dtype=f.data_type))
-        else:
-            cols.append(Column.from_pylist([], f.data_type, capacity=capacity))
+    from .column import build_column
+    cols = [build_column([], f.data_type, capacity)
+            for f in schema.fields]
     return ColumnarBatch(cols, 0, schema)

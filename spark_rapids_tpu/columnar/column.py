@@ -267,6 +267,74 @@ class StructColumn(Column):
         ]
 
 
+class Decimal128Column(StructColumn):
+    """DECIMAL(p>18): 128-bit unscaled value as two int64 limb children
+    (hi with the sign, lo reinterpreted unsigned). Subclasses
+    StructColumn so every structural path (gather/sanitize/transfer/
+    serialize) recurses into the limbs unchanged; reconstruction sites
+    rebuild via type(col)(...) so the class is preserved.
+    Reference analog: cuDF decimal128 under DecimalUtil.scala."""
+
+    def __init__(self, children, validity, dtype: DecimalType):
+        assert len(children) == 2
+        super().__init__(children, validity, dtype)
+
+    @property
+    def hi(self) -> Column:
+        return self.children[0]
+
+    @property
+    def lo(self) -> Column:
+        return self.children[1]
+
+    @staticmethod
+    def from_limbs(hi, lo, validity, dtype: DecimalType
+                   ) -> "Decimal128Column":
+        from ..types import LONG
+        return Decimal128Column(
+            (Column(hi, validity, LONG), Column(lo, validity, LONG)),
+            validity, dtype)
+
+    @staticmethod
+    def from_pylist(values: Sequence, dtype: DecimalType,
+                    capacity: Optional[int] = None) -> "Decimal128Column":
+        n = len(values)
+        cap = capacity or bucket_capacity(n)
+        conv = _logical_to_physical(dtype)
+        validity = np.array([v is not None for v in values], np.bool_)
+        his = np.zeros(n, np.int64)
+        los = np.zeros(n, np.int64)
+        for i, v in enumerate(values):
+            if v is None:
+                continue
+            u = int(conv(v)) & ((1 << 128) - 1)
+            lo = u & ((1 << 64) - 1)
+            hi = u >> 64
+            los[i] = lo - (1 << 64) if lo >= (1 << 63) else lo
+            his[i] = hi - (1 << 64) if hi >= (1 << 63) else hi
+        vpad = jnp.asarray(_pad_np(validity, cap, False))
+        from ..types import LONG
+        return Decimal128Column(
+            (Column(jnp.asarray(_pad_np(his, cap)), vpad, LONG),
+             Column(jnp.asarray(_pad_np(los, cap)), vpad, LONG)),
+            vpad, dtype)
+
+    def to_pylist(self, num_rows: int) -> List:
+        """Unscaled 128-bit ints (arbitrary-precision Python ints)."""
+        hi = np.asarray(self.hi.data[:num_rows])
+        lo = np.asarray(self.lo.data[:num_rows])
+        valid = np.asarray(self.validity[:num_rows])
+        out: List = []
+        for i in range(num_rows):
+            if not valid[i]:
+                out.append(None)
+                continue
+            u = ((int(hi[i]) & ((1 << 64) - 1)) << 64) \
+                | (int(lo[i]) & ((1 << 64) - 1))
+            out.append(u - (1 << 128) if u >= (1 << 127) else u)
+        return out
+
+
 class ArrayColumn(Column):
     """List column: int32 offsets into a child column."""
 
@@ -395,6 +463,8 @@ def build_column(values: Sequence, dtype: DataType,
     """Host-list → column of the right class for any supported type,
     recursing through nested arrays/structs/maps."""
     from ..types import MapType
+    if isinstance(dtype, DecimalType) and dtype.precision > 18:
+        return Decimal128Column.from_pylist(values, dtype, capacity)
     if isinstance(dtype, ArrayType):
         return ArrayColumn.from_pylist(values, dtype, capacity)
     if isinstance(dtype, MapType):
@@ -453,11 +523,18 @@ def _map_unflatten(dtype, children):
     return MapColumn(keys, values, offsets, validity, dtype)
 
 
+def _dec128_unflatten(dtype, children):
+    kids, validity = children
+    return Decimal128Column(tuple(kids), validity, dtype)
+
+
 jax.tree_util.register_pytree_node(Column, _column_flatten, _column_unflatten)
 jax.tree_util.register_pytree_node(StringColumn, _string_flatten, _string_unflatten)
 jax.tree_util.register_pytree_node(StructColumn, _struct_flatten, _struct_unflatten)
 jax.tree_util.register_pytree_node(ArrayColumn, _array_flatten, _array_unflatten)
 jax.tree_util.register_pytree_node(MapColumn, _map_flatten, _map_unflatten)
+jax.tree_util.register_pytree_node(Decimal128Column, _struct_flatten,
+                                   _dec128_unflatten)
 
 
 def _string_from_arrow_buffers(arr, dt: DataType, n: int) -> StringColumn:
@@ -545,6 +622,8 @@ def column_from_arrow(arr, dtype: Optional[DataType] = None) -> Column:
         return Column(jnp.zeros(cap, jnp.int8), jnp.zeros(cap, jnp.bool_), dt)
     if isinstance(dt, DecimalType):
         pylist = arr.to_pylist()
+        if dt.precision > 18:
+            return Decimal128Column.from_pylist(pylist, dt)
         unscaled = np.array(
             [0 if v is None else int(round(v.scaleb(dt.scale)))
              for v in pylist], dtype=np.int64)
@@ -565,6 +644,7 @@ def column_to_arrow(col: Column, num_rows: int):
 
     dt = col.dtype
     if isinstance(dt, DecimalType):
+        # both tiers (int64 and two-limb) surface unscaled Python ints
         vals = col.to_pylist(num_rows)
         import decimal as _d
         scaled = [None if v is None else _d.Decimal(v).scaleb(-dt.scale) for v in vals]

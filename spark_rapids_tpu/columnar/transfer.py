@@ -117,7 +117,8 @@ def _unpack_column(col: Column, buf: np.ndarray, pos: int
         for k in col.children:
             kid, pos = _unpack_column(k, buf, pos)
             kids.append(kid)
-        return StructColumn(tuple(kids), v.astype(np.bool_), col.dtype), pos
+        # type(col) keeps Decimal128Column limbs as decimal, not struct
+        return type(col)(tuple(kids), v.astype(np.bool_), col.dtype), pos
     if isinstance(col, ArrayColumn):
         raw, pos = _take(buf, pos, (cap + 1) * 4)
         offsets = raw.view(np.int32)

@@ -52,6 +52,27 @@ def _shrink_batch(batch: ColumnarBatch, cap: int) -> ColumnarBatch:
     return ColumnarBatch(cols, batch.num_rows, batch.schema)
 
 
+
+def _result_column(data, valid, dtype) -> Column:
+    """Aggregate result (data, valid) -> Column; decimal128 sums arrive
+    as (hi, lo) limb tuples and build a Decimal128Column (or fold back
+    to one limb when the buffer type fits 18 digits)."""
+    import jax.numpy as jnp
+
+    from ..columnar.column import Decimal128Column
+    from ..types import DecimalType
+    if isinstance(data, tuple):
+        hi, lo = data
+        if isinstance(dtype, DecimalType) and dtype.is_decimal128:
+            return Decimal128Column.from_limbs(hi, lo, valid, dtype)
+        from ..ops import decimal128 as D
+        bound = 10 ** min(dtype.precision, 18)
+        ok = D.fits_i64(hi, lo) & (lo < bound) & (lo > -bound)
+        valid = valid & ok
+        return Column(jnp.where(valid, lo, 0), valid, dtype)
+    return Column(data.astype(dtype.jnp_dtype), valid, dtype)
+
+
 class AggregateExec(TpuExec):
     def __init__(self, group_exprs: Sequence[Expression],
                  aggregates: Sequence[Tuple[AggregateFunction, str]],
@@ -246,8 +267,7 @@ class AggregateExec(TpuExec):
         buf_fields = self._buffer_schema.fields[self._key_count:]
         for r, f in zip(results, buf_fields):
             data, valid = r[1]
-            cols.append(Column(data.astype(f.data_type.jnp_dtype), valid,
-                               f.data_type))
+            cols.append(_result_column(data, valid, f.data_type))
         return ColumnarBatch(cols, num_groups, self._buffer_schema)
 
     def _streaming_step(self, batch: ColumnarBatch, state: ColumnarBatch,
@@ -347,9 +367,8 @@ class AggregateExec(TpuExec):
                         cols.append(collect_all(op, c, batch.num_rows, cap))
                     else:
                         data, valid = next(plain_res)
-                        cols.append(Column(
-                            data.astype(f.data_type.jnp_dtype), valid,
-                            f.data_type))
+                        cols.append(_result_column(data, valid,
+                                                   f.data_type))
                 out = ColumnarBatch(cols, 1, out_schema)
                 return (out, jnp.asarray(False)) if hash_path else out
             # a count(*)-only aggregate has no input columns at all; give
@@ -361,8 +380,7 @@ class AggregateExec(TpuExec):
             cols = []
             fields = out_schema.fields
             for (data, valid), f in zip(results, fields):
-                cols.append(Column(data.astype(f.data_type.jnp_dtype),
-                                   valid, f.data_type))
+                cols.append(_result_column(data, valid, f.data_type))
             out = ColumnarBatch(cols, 1, out_schema)
             return (out, jnp.asarray(False)) if hash_path else out
         leftover = None
@@ -384,8 +402,7 @@ class AggregateExec(TpuExec):
                 cols.append(r[1])
             else:
                 data, valid = r[1]
-                cols.append(Column(data.astype(f.data_type.jnp_dtype),
-                                   valid, f.data_type))
+                cols.append(_result_column(data, valid, f.data_type))
         out = ColumnarBatch(cols, num_groups, out_schema)
         return (out, leftover) if hash_path else out
 

@@ -74,8 +74,18 @@ def _sum_buffer_type(dt: DataType) -> DataType:
     if isinstance(dt, (DoubleType, FloatType)):
         return DoubleType()
     if isinstance(dt, DecimalType):
-        return DecimalType(min(dt.precision + 10, 38), dt.scale)
+        # buffers are ALWAYS two-limb (precision > 18): a single-limb
+        # partial could overflow int64 across merges and a nulled partial
+        # would be silently skipped by the next sum-merge — overflow must
+        # only surface at evaluate (Spark CheckOverflow)
+        return DecimalType(min(max(dt.precision + 10, 19), 38), dt.scale)
     return LongType()
+
+
+def _sum_result_type(dt: DataType) -> DataType:
+    if isinstance(dt, DecimalType):
+        return DecimalType(min(dt.precision + 10, 38), dt.scale)
+    return _sum_buffer_type(dt)
 
 
 class Sum(AggregateFunction):
@@ -91,10 +101,36 @@ class Sum(AggregateFunction):
         return [_sum_buffer_type(input_types[0])]
 
     def result_type(self, input_types):
-        return _sum_buffer_type(input_types[0])
+        return _sum_result_type(input_types[0])
+
+    def result_type_from_buffer(self, buffer_types):
+        # final mode cannot recover the pre-widening input precision from
+        # the (always two-limb) decimal buffer; the buffer type IS the
+        # distributed result type (overflow checks use its precision)
+        return buffer_types[0]
 
     def evaluate(self, buffers, input_types):
-        return buffers[0]
+        b = buffers[0]
+        from ..types import DecimalType
+        if isinstance(b.dtype, DecimalType):
+            # Spark CheckOverflow at evaluation: sums past the RESULT
+            # precision become NULL (non-ANSI). The buffer is always
+            # two-limb; fold to one limb when the result type fits 18.
+            from ..columnar.column import Decimal128Column
+            from ..ops import decimal128 as D
+            in_t = input_types[0] if input_types else b.dtype
+            rt = b.dtype if in_t == b.dtype else _sum_result_type(in_t)
+            if isinstance(b, Decimal128Column):
+                hi, lo = b.hi.data, b.lo.data
+            else:
+                hi, lo = D.from_i64(b.data)
+            ok = D.fits_precision(hi, lo, rt.precision)
+            v = b.validity & ok
+            if rt.precision > 18:
+                return Decimal128Column.from_limbs(
+                    jnp.where(v, hi, 0), jnp.where(v, lo, 0), v, rt)
+            return Column(jnp.where(v, lo, 0), v, rt)
+        return b
 
 
 class Count(AggregateFunction):

@@ -109,14 +109,21 @@ class BinaryArithmetic(Expression):
         return Column(data, valid, out_t)
 
     def _decimal_eval(self, l: Column, r: Column, out_t: DecimalType) -> Column:
-        """Decimal64 arithmetic on unscaled int64 lanes: rescale to a common
-        working scale, operate, rescale HALF_UP to the result scale (Spark's
-        Decimal math; overflow past 18 digits -> NULL, the reference's
-        decimal-64 fast-path contract)."""
+        """Decimal arithmetic on unscaled lanes: rescale to a common
+        working scale, operate, rescale HALF_UP to the result scale
+        (Spark's Decimal math; overflow past the result precision ->
+        NULL, non-ANSI). Results or inputs past 18 digits take the
+        two-limb decimal128 path (ops/decimal128.py)."""
+        from ..columnar.column import Decimal128Column
+        name = type(self).__name__
+        needs_128 = out_t.precision > 18 \
+            or isinstance(l, Decimal128Column) \
+            or isinstance(r, Decimal128Column)
+        if needs_128:
+            return self._decimal128_eval(l, r, out_t)
         s1 = _decimal_scale_of(l.dtype)
         s2 = _decimal_scale_of(r.dtype)
         valid = l.validity & r.validity
-        name = type(self).__name__
         ld = l.data.astype(jnp.int64)
         rd = r.data.astype(jnp.int64)
         if name in ("Add", "Subtract"):
@@ -154,6 +161,69 @@ class BinaryArithmetic(Expression):
         ok = (res < bound) & (res > -bound)
         valid = valid & ok
         return Column(jnp.where(valid, res, 0), valid, out_t)
+
+    def _decimal128_eval(self, l: Column, r: Column,
+                         out_t: DecimalType) -> Column:
+        """Two-limb path for results (or inputs) past 18 digits."""
+        from ..columnar.column import Decimal128Column
+        from ..ops import decimal128 as D
+        name = type(self).__name__
+        s1 = _decimal_scale_of(l.dtype)
+        s2 = _decimal_scale_of(r.dtype)
+        valid = l.validity & r.validity
+
+        def limbs(c: Column):
+            if isinstance(c, Decimal128Column):
+                return c.hi.data, c.lo.data
+            return D.from_i64(c.data.astype(jnp.int64))
+
+        over = jnp.zeros(l.validity.shape, jnp.bool_)
+        if name in ("Add", "Subtract"):
+            ws = max(s1, s2)
+            h1, l1 = limbs(l)
+            h2, l2 = limbs(r)
+            h1, l1, o1 = D.rescale(h1, l1, s1, ws)
+            h2, l2, o2 = D.rescale(h2, l2, s2, ws)
+            fn = D.add128 if name == "Add" else D.sub128
+            rh, rl = fn(h1, l1, h2, l2)
+            rh, rl, o3 = D.rescale(rh, rl, ws, out_t.scale)
+            over = o1 | o2 | o3
+        elif name == "Multiply":
+            if isinstance(l, Decimal128Column) \
+                    or isinstance(r, Decimal128Column):
+                raise NotImplementedError(
+                    "decimal multiply with >18-digit inputs needs a "
+                    "256-bit intermediate (tagged off at plan time)")
+            rh, rl = D.mul_i64_i64(l.data.astype(jnp.int64),
+                                   r.data.astype(jnp.int64))
+            rh, rl, over = D.rescale(rh, rl, s1 + s2, out_t.scale)
+        elif name == "Divide":
+            if isinstance(l, Decimal128Column) \
+                    or isinstance(r, Decimal128Column):
+                raise NotImplementedError(
+                    "decimal divide with >18-digit inputs is tagged off "
+                    "at plan time")
+            # unscaled = l * 10^(rs - s1 + s2) / r, HALF_UP
+            shift = out_t.scale - s1 + s2
+            nh, nl = D.from_i64(l.data.astype(jnp.int64))
+            nh, nl, over = D.rescale(nh, nl, 0, max(shift, 0))
+            if shift < 0:
+                nh, nl, _ = D.rescale(nh, nl, -shift, 0)
+            rd = r.data.astype(jnp.int64)
+            div_ok = rd != 0
+            safe_r = jnp.where(div_ok, rd, jnp.int64(1))
+            rh, rl = D.div128_round_half_up(nh, nl, safe_r)
+            valid = valid & div_ok
+        else:
+            raise NotImplementedError(
+                f"decimal128 {name} runs on the host row tier")
+        ok = D.fits_precision(rh, rl, out_t.precision) & ~over
+        valid = valid & ok
+        rh = jnp.where(valid, rh, 0)
+        rl = jnp.where(valid, rl, 0)
+        if out_t.precision <= 18:
+            return Column(rl, valid, out_t)  # fits one limb by the check
+        return Decimal128Column.from_limbs(rh, rl, valid, out_t)
 
     def _op(self, l, r):
         raise NotImplementedError

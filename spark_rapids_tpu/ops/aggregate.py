@@ -75,17 +75,17 @@ def collect_all(op: str, col: Column, num_rows, capacity: int) -> "Column":
 
 def _dedup_value_lanes(col: Column):
     """Fixed-width dedup sort lanes with Spark equality semantics: -0.0
-    equals 0.0 and NaN equals NaN. Floats split into (hi, lo) int32
-    lanes — a 64-bit bitcast is not lowerable under the TPU X64 rewrite."""
+    equals 0.0 and NaN equals NaN. Floats go through the arithmetic bit
+    reconstruction — bitcasts FROM f64 do not compile on TPU."""
     data = col.data
     if data.dtype == jnp.bool_:
         data = data.astype(jnp.int8)
     if jnp.issubdtype(data.dtype, jnp.floating):
+        from .f64bits import f64_bits
         d = data.astype(jnp.float64)
         d = jnp.where(d == 0.0, 0.0, d)           # -0.0 -> 0.0
         d = jnp.where(jnp.isnan(d), jnp.float64(jnp.nan), d)  # one NaN
-        pair = jax.lax.bitcast_convert_type(d, jnp.int32)  # (..., 2)
-        return [pair[..., 0], pair[..., 1]]
+        return [f64_bits(d)]
     return [data]
 
 
@@ -270,6 +270,16 @@ def groupby_aggregate(key_columns: Sequence[Column],
                     results.append(("col", out))
                     continue
                 raise NotImplementedError(f"string agg {op}")
+            from ..types import DecimalType
+            if op == "sum" and isinstance(g.dtype, DecimalType):
+                from .decimal128 import decimal_segment_sum
+                (rh, rl), has = decimal_segment_sum(g, g.validity, seg,
+                                                    capacity)
+                valid = has & group_act
+                data = (jnp.where(group_act, rh, 0),
+                        jnp.where(group_act, rl, 0))
+                results.append(("raw", (data, valid)))
+                continue
             data, valid = _segment_reduce(op, g.data, g.validity, seg,
                                           capacity, positions)
         valid = valid & group_act
@@ -393,6 +403,16 @@ def _aggregate_with_assignment(key_columns, agg_inputs, num_rows,
                     continue
                 raise NotImplementedError(
                     f"string agg {op} requires the sort path")
+            from ..types import DecimalType
+            if op == "sum" and isinstance(col.dtype, DecimalType):
+                from .decimal128 import decimal_segment_sum
+                (rh, rl), has = decimal_segment_sum(
+                    col, col.validity & act, seg, capacity)
+                valid = has & group_act
+                data = (jnp.where(group_act, rh, 0),
+                        jnp.where(group_act, rl, 0))
+                results.append(("raw", (data, valid)))
+                continue
             data, valid = _segment_reduce(op, col.data, col.validity & act,
                                           seg, capacity, positions)
         valid = valid & group_act

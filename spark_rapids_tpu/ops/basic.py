@@ -36,7 +36,7 @@ def sanitize(col: Column, num_rows) -> Column:
         return StringColumn(col.data, col.offsets, validity, col.dtype)
     if isinstance(col, StructColumn):
         kids = tuple(sanitize(k, num_rows) for k in col.children)
-        return StructColumn(kids, validity, col.dtype)
+        return type(col)(kids, validity, col.dtype)  # incl. Decimal128
     if isinstance(col, ArrayColumn):
         return ArrayColumn(col.child, col.offsets, validity, col.dtype)
     from ..columnar.column import MapColumn
@@ -65,7 +65,7 @@ def gather_column(col: Column, indices, out_valid=None,
     if isinstance(col, StructColumn):
         kids = tuple(gather_column(k, indices, out_valid, out_byte_capacity)
                      for k in col.children)
-        return StructColumn(kids, valid, col.dtype)
+        return type(col)(kids, valid, col.dtype)  # incl. Decimal128
     if isinstance(col, ArrayColumn):
         from .collection import gather_array
         return gather_array(col, safe, valid,
@@ -139,7 +139,7 @@ def concat_columns(a: Column, b: Column, a_rows, b_rows, out_capacity: int
         kids = tuple(concat_columns(ka, kb, a_rows, b_rows, out_capacity)
                      for ka, kb in zip(a.children, b.children))
         valid = _concat_fixed(a.validity, b.validity, from_b, b_idx, idx) & out_valid
-        return StructColumn(kids, valid, a.dtype)
+        return type(a)(kids, valid, a.dtype)  # incl. Decimal128
     if isinstance(a, ArrayColumn):
         # gather both sides' rows into the output slot order; gather_array
         # rebuilds offsets and compacts the child elements

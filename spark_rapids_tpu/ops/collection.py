@@ -206,9 +206,13 @@ def sort_array(col: ArrayColumn, ascending: bool = True) -> ArrayColumn:
     if isinstance(child.dtype, BooleanType):
         data = data.astype(jnp.int8)
     if jnp.issubdtype(data.dtype, jnp.floating):
-        # total order incl NaN: flip sign bit trick
-        bits = jax.lax.bitcast_convert_type(
-            data, jnp.int32 if data.dtype == jnp.float32 else jnp.int64)
+        # total order incl NaN: flip sign bit trick (f64 bitcasts don't
+        # compile on TPU; go through the arithmetic bit reconstruction)
+        if data.dtype == jnp.float64:
+            from .f64bits import f64_bits_signed
+            bits = f64_bits_signed(data)
+        else:
+            bits = jax.lax.bitcast_convert_type(data, jnp.int32)
         data = jnp.where(bits < 0, ~bits, bits | (jnp.ones((), bits.dtype)
                                                   << (bits.dtype.itemsize * 8 - 1)))
         data = data ^ (jnp.ones((), data.dtype)

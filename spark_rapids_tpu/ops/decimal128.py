@@ -1,0 +1,301 @@
+"""Two-limb (hi int64 / lo uint64-in-int64) decimal128 kernels.
+
+Reference analog: cuDF decimal128 + DecimalUtil.scala /
+decimalExpressions.scala. The TPU build stores the 128-bit unscaled
+value as TWO int64 lanes (lo carries the low 64 bits reinterpreted as
+signed; hi carries the high 64 including the sign). All arithmetic is
+built from u32 half-limbs so every multiply stays within the emulated
+64-bit lanes XLA already supports.
+
+Layout invariant: value = hi * 2^64 + (lo as unsigned).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+_U32 = jnp.uint64(0xFFFFFFFF)
+
+
+def _u(x):
+    return x.astype(jnp.uint64)
+
+
+def _s(x):
+    return x.astype(jnp.int64)
+
+
+def from_i64(v) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sign-extend an int64 unscaled value to (hi, lo)."""
+    return v >> jnp.int64(63), v
+
+
+def add128(h1, l1, h2, l2):
+    lo = _s(_u(l1) + _u(l2))
+    carry = _u(lo) < _u(l1)
+    hi = h1 + h2 + carry.astype(jnp.int64)
+    return hi, lo
+
+
+def neg128(h, l):
+    lo = _s(~_u(l) + jnp.uint64(1))
+    hi = ~h + (lo == 0).astype(jnp.int64)
+    return hi, lo
+
+
+def sub128(h1, l1, h2, l2):
+    nh, nl = neg128(h2, l2)
+    return add128(h1, l1, nh, nl)
+
+
+def is_neg(h):
+    return h < 0
+
+
+def abs128(h, l):
+    nh, nl = neg128(h, l)
+    neg = is_neg(h)
+    return jnp.where(neg, nh, h), jnp.where(neg, nl, l)
+
+
+def cmp128(h1, l1, h2, l2):
+    """-1 / 0 / +1 as int32 (signed 128-bit compare)."""
+    lt = (h1 < h2) | ((h1 == h2) & (_u(l1) < _u(l2)))
+    gt = (h1 > h2) | ((h1 == h2) & (_u(l1) > _u(l2)))
+    return gt.astype(jnp.int32) - lt.astype(jnp.int32)
+
+
+def _mul_u64(a, b):
+    """u64 x u64 -> (hi u64, lo u64) via u32 half-limbs."""
+    a, b = _u(a), _u(b)
+    a0, a1 = a & _U32, a >> jnp.uint64(32)
+    b0, b1 = b & _U32, b >> jnp.uint64(32)
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> jnp.uint64(32)) + (p01 & _U32) + (p10 & _U32)
+    lo = (p00 & _U32) | (mid << jnp.uint64(32))
+    hi = p11 + (p01 >> jnp.uint64(32)) + (p10 >> jnp.uint64(32)) \
+        + (mid >> jnp.uint64(32))
+    return hi, lo
+
+
+def mul_i64_i64(a, b):
+    """Signed 64 x 64 -> exact signed 128 (hi, lo)."""
+    sign = (a < 0) ^ (b < 0)
+    ua = _u(jnp.where(a < 0, -a, a))
+    ub = _u(jnp.where(b < 0, -b, b))
+    hi, lo = _mul_u64(ua, ub)
+    hi, lo = _s(hi), _s(lo)
+    nh, nl = neg128(hi, lo)
+    return jnp.where(sign, nh, hi), jnp.where(sign, nl, lo)
+
+
+def mul128_u64(h, l, m) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(h,l) * unsigned 64-bit m -> (hi, lo, overflowed). Sign-aware:
+    operates on |x| then restores the sign."""
+    neg = is_neg(h)
+    ah, al = abs128(h, l)
+    hi_lo, lo = _mul_u64(al, m)            # low limb product
+    hi2_hi, hi2_lo = _mul_u64(_u(ah), m)   # high limb product
+    hi = _u(hi_lo) + hi2_lo
+    carry_over = (hi2_hi != 0) | (hi < hi2_lo)
+    # result |x| must fit 127 bits
+    over = carry_over | (_s(hi) < 0)
+    rh, rl = _s(hi), _s(lo)
+    nh, nl = neg128(rh, rl)
+    return jnp.where(neg, nh, rh), jnp.where(neg, nl, rl), over
+
+
+def _divmod_u32(h, l, d32: int):
+    """Unsigned (h,l) divided by a host u32 constant-or-array divisor
+    d32 < 2^31: schoolbook long division over four u32 digits."""
+    d = jnp.uint64(d32) if isinstance(d32, int) else _u(d32)
+    digits = [
+        _u(h) >> jnp.uint64(32), _u(h) & _U32,
+        _u(l) >> jnp.uint64(32), _u(l) & _U32,
+    ]
+    r = jnp.zeros_like(_u(h))
+    q = []
+    for dig in digits:
+        cur = (r << jnp.uint64(32)) | dig   # < d*2^32 <= 2^63: fits u64
+        q.append(cur // d)
+        r = cur % d
+    qh = _s((q[0] << jnp.uint64(32)) | q[1])
+    ql = _s((q[2] << jnp.uint64(32)) | q[3])
+    return qh, ql, r
+
+
+def divmod_pow10(h, l, k: int):
+    """Signed (h,l) // 10^k, k in [0, 38]. Returns
+    (qh, ql, last_rem_u64, last_half_u64): the staged division's FINAL
+    remainder decides HALF_UP exactly — rem_total >= 10^k/2 iff the most
+    significant stage's remainder >= its own half (the earlier stages'
+    remainders only add < one final-stage unit), so no 128-bit remainder
+    tracking is needed and k > 19 cannot overflow any u64 constant."""
+    assert 0 <= k <= 38
+    if k == 0:
+        return h, l, jnp.zeros_like(_u(h)), jnp.uint64(1)
+    neg = is_neg(h)
+    ah, al = abs128(h, l)
+    r = jnp.zeros_like(_u(h))
+    last = 1
+    for step in _pow10_steps(k):
+        d = 10 ** step
+        ah, al, r = _divmod_u32(ah, al, d)
+        last = d
+    nh, nl = neg128(ah, al)
+    qh = jnp.where(neg, nh, ah)
+    ql = jnp.where(neg, nl, al)
+    half = jnp.uint64(last // 2)
+    return qh, ql, r, half
+
+
+def _pow10_steps(k: int):
+    """Split 10^k into factors < 2^31 (each <= 10^9)."""
+    out = []
+    while k > 0:
+        s = min(k, 9)
+        out.append(s)
+        k -= s
+    return out
+
+
+def rescale(h, l, from_scale: int, to_scale: int):
+    """Unscaled rescale with Spark HALF_UP rounding on scale reduction.
+    Returns (hi, lo, overflowed)."""
+    if to_scale == from_scale:
+        return h, l, jnp.zeros(h.shape, jnp.bool_)
+    if to_scale > from_scale:
+        k = to_scale - from_scale
+        over = jnp.zeros(h.shape, jnp.bool_)
+        for step in _pow10_steps(k):
+            h, l, o = mul128_u64(h, l, jnp.uint64(10 ** step))
+            over = over | o
+        return h, l, over
+    k = from_scale - to_scale
+    qh, ql, rem, half = divmod_pow10(h, l, k)
+    # HALF_UP: round away from zero when |rem| >= half
+    bump = rem >= half
+    neg = is_neg(h)
+    bh, bl = add128(qh, ql, jnp.where(neg & bump, -1, 0),
+                    jnp.where(bump, jnp.where(neg, -1, 1), 0))
+    return bh, bl, jnp.zeros(h.shape, jnp.bool_)
+
+
+def pow10_128(k: int) -> Tuple[int, int]:
+    """(hi, lo) host ints of 10^k for overflow bounds."""
+    v = 10 ** k
+    return (v >> 64), v & ((1 << 64) - 1)
+
+
+def fits_precision(h, l, precision: int):
+    """|value| < 10^precision (the non-ANSI overflow -> NULL check)."""
+    ah, al = abs128(h, l)
+    bh, bl = pow10_128(precision)
+    bhj = jnp.int64(bh if bh < (1 << 63) else bh - (1 << 64))
+    blj = jnp.int64(bl if bl < (1 << 63) else bl - (1 << 64))
+    return cmp128(ah, al, bhj, blj) < 0
+
+
+def divmod128_u64(h, l, d):
+    """Unsigned (h,l) // d for a VARIABLE u64 divisor d < 2^63.
+    Returns (qh, ql, rem). Schoolbook: the high limb divides natively;
+    the (rem, lo) double-word divides by 64 unrolled binary steps
+    (rem stays < d < 2^63 so the shifted partial fits u64)."""
+    uh, ul, ud = _u(h), _u(l), _u(d)
+    qh = uh // ud
+    r = uh % ud
+    ql = jnp.zeros_like(ul)
+    for i in range(63, -1, -1):
+        bit = (ul >> jnp.uint64(i)) & jnp.uint64(1)
+        r = (r << jnp.uint64(1)) | bit
+        ge = r >= ud
+        r = jnp.where(ge, r - ud, r)
+        ql = ql | jnp.where(ge, jnp.uint64(1) << jnp.uint64(i),
+                            jnp.uint64(0))
+    return _s(qh), _s(ql), r
+
+
+def div128_round_half_up(h, l, d):
+    """Signed (h,l) / signed i64 d (nonzero), HALF_UP rounding."""
+    neg = is_neg(h) ^ (d < 0)
+    ah, al = abs128(h, l)
+    ad = _u(jnp.where(d < 0, -d, d))
+    qh, ql, r = divmod128_u64(ah, al, ad)
+    bump = (r * jnp.uint64(2)) >= ad
+    qh, ql = add128(qh, ql, jnp.zeros_like(qh),
+                    bump.astype(jnp.int64))
+    nh, nl = neg128(qh, ql)
+    return jnp.where(neg, nh, qh), jnp.where(neg, nl, ql)
+
+
+def shl128(h, l, k: int):
+    """Logical left shift of (h,l) by k in [0, 63]."""
+    if k == 0:
+        return h, l
+    uh, ul = _u(h), _u(l)
+    nh = (uh << jnp.uint64(k)) | (ul >> jnp.uint64(64 - k))
+    nl = ul << jnp.uint64(k)
+    return _s(nh), _s(nl)
+
+
+def limb16_lanes(h, l):
+    """Eight u16 limbs (as int64 lanes, low first) of the UNSIGNED
+    128-bit representation. Summing each lane exactly in int64 (bounded
+    by 2^16 * rows) and recombining mod 2^128 gives the exact two's
+    complement 128-bit sum with ordinary masked/segment sums — no custom
+    reduction combiner needed."""
+    mask = jnp.uint64(0xFFFF)
+    out = []
+    for src in (l, h):
+        u = _u(src)
+        for k in range(4):
+            out.append(_s((u >> jnp.uint64(16 * k)) & mask))
+    return out
+
+
+def combine_limb_sums(sums):
+    """Recombine eight per-limb int64 sums into (hi, lo) mod 2^128."""
+    rh = jnp.zeros_like(sums[0])
+    rl = jnp.zeros_like(sums[0])
+    for k, s in enumerate(sums):
+        bits = 16 * k
+        if bits < 64:
+            ph, pl = shl128(jnp.zeros_like(s), s, bits) if bits else \
+                (jnp.zeros_like(s), s)
+        else:
+            ph, pl = shl128(s, jnp.zeros_like(s), bits - 64) \
+                if bits > 64 else (s, jnp.zeros_like(s))
+        rh, rl = add128(rh, rl, ph, pl)
+    return rh, rl
+
+
+def decimal_segment_sum(col, valid_mask, seg, capacity: int):
+    """Exact 128-bit segment sum of a decimal column (either tier):
+    eight u16-limb int64 segment sums recombined mod 2^128.
+    Returns ((hi, lo) (capacity,) limb arrays, has_any bool array)."""
+    import jax
+
+    from .maskedagg import _decimal_limbs
+    h, l = _decimal_limbs(col)
+    sums = [jax.ops.segment_sum(
+        jnp.where(valid_mask, lane, jnp.int64(0)), seg,
+        num_segments=capacity) for lane in limb16_lanes(h, l)]
+    rh, rl = combine_limb_sums(sums)
+    counts = jax.ops.segment_sum(valid_mask.astype(jnp.int32), seg,
+                                 num_segments=capacity)
+    return (rh, rl), counts > 0
+
+
+def to_f64(h, l):
+    return h.astype(jnp.float64) * jnp.float64(2.0 ** 64) \
+        + _u(l).astype(jnp.float64)
+
+
+def fits_i64(h, l):
+    """value representable in one int64 limb?"""
+    return h == (l >> jnp.int64(63))

@@ -1,0 +1,175 @@
+"""IEEE-754 bit pattern of float64 WITHOUT a 64-bit bitcast.
+
+TPU's X64 legalization pass implements every 64-bit bitcast EXCEPT those
+FROM f64 (f64 is emulated; its storage is not a raw u64 lane), so
+`lax.bitcast_convert_type(x_f64, uint64)` is a compile-time error on the
+real chip. Sort keys and Spark-parity hashing (murmur3/xxhash64 frame
+the raw 8 bytes of a double) both need the exact bit pattern, so this
+module reconstructs it arithmetically:
+
+  1. range-normalize x by an exact power-of-two scale so it fits the
+     f32 exponent range;
+  2. split into three f32 limbs (each subtraction exact, 72 mantissa
+     bits >= f64's 53: the decomposition is lossless);
+  3. decode the limbs' u32 patterns (32-bit bitcasts are supported) into
+     one <= 53-bit integer significand + base-2 exponent;
+  4. re-assemble sign/exponent/mantissa including subnormals, +-0, inf
+     and NaN (canonical quiet NaN, which is all Spark semantics need).
+
+The reverse direction (u64 bits -> f64) IS supported natively and stays
+a plain bitcast.
+
+Precision contract: bit-exact on backends with native f64 (CPU/GPU —
+asserted by tests). On TPU, f64 arithmetic itself is double-double
+emulated (~48-bit precision), so the reconstructed pattern can differ
+from the host pattern in the last few mantissa bits — the same
+tolerance every f64 comparison/kernel on the chip already has. Sort
+order keys remain consistent with the device's own value ordering;
+Spark-parity hashing of DOUBLE columns is exact on CPU and best-effort
+on TPU (documented divergence).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_M52 = jnp.uint64((1 << 52) - 1)
+
+
+def _decode_f32(b):
+    """u32 pattern -> (signed) integer significand scaled by 2^-149 and
+    its integer value: val = m * 2^(e) with e relative to 2^-149."""
+    e = (b >> jnp.uint32(23)) & jnp.uint32(0xFF)
+    m = (b & jnp.uint32(0x7FFFFF)).astype(jnp.uint64)
+    is_norm = e > 0
+    m = jnp.where(is_norm, m | jnp.uint64(1 << 23), m)
+    # exponent of the integer m in units of 2^-149
+    shift = jnp.where(is_norm, e.astype(jnp.int64) - 1, jnp.int64(0))
+    return m, shift  # value = m * 2^(shift - 149)
+
+
+def _floor_log2_u64(n):
+    """floor(log2(n)) for n >= 1, as int64 (6-step binary search)."""
+    t = jnp.zeros(n.shape, jnp.int64)
+    cur = n
+    for k in (32, 16, 8, 4, 2, 1):
+        big = cur >= (jnp.uint64(1) << jnp.uint64(k))
+        t = t + jnp.where(big, k, 0)
+        cur = jnp.where(big, cur >> jnp.uint64(k), cur)
+    return t
+
+
+def f64_bits(x) -> jnp.ndarray:
+    """uint64 IEEE-754 pattern of float64 `x` (NaNs canonicalized to
+    0x7FF8...0, matching jnp.nan — Spark collapses NaNs anyway)."""
+    if jax.default_backend() in ("cpu", "gpu", "cuda", "rocm"):
+        # native f64: one bitcast, bit-exact (incl. subnormals)
+        bits = jax.lax.bitcast_convert_type(x, jnp.uint64)
+        nanbits = jnp.uint64(0x7FF8000000000000)
+        return jnp.where(jnp.isnan(x), nanbits, bits)
+    # XLA flushes f64 subnormals to zero in arithmetic (DAZ) on both the
+    # CPU backend and the TPU's double-double emulation, so subnormal bit
+    # patterns are unrecoverable through any computation — map them to
+    # signed zero, matching how every other engine kernel sees them.
+    zero = (x == 0.0) | (jnp.abs(x) < jnp.float64(2.0 ** -1022))
+    is_nan = jnp.isnan(x)
+    is_inf = jnp.isinf(x)
+    # jnp.signbit bitcasts f64 internally (unsupported on TPU); the sign
+    # of +-0.0 comes from the sign of 1/x instead
+    signbit = jnp.where(x == 0.0, 1.0 / jnp.where(x == 0.0, x, 1.0) < 0,
+                        x < 0)
+    neg_zero = zero & signbit
+    sign = signbit & ~is_nan
+
+    a = jnp.abs(x)
+    # exact power-of-two range normalization to ~1: the scale factor is
+    # BUILT from integer exponent bits (u64 -> f64 bitcast IS supported),
+    # applied in two exact multiplies so even 2^-1074 reaches f32 range.
+    # log2 only needs +-1 accuracy — the limb split tolerates [2^-3, 2^3]
+    def pow2(e):
+        return jax.lax.bitcast_convert_type(
+            ((e + jnp.int64(1023)).astype(jnp.uint64)) << jnp.uint64(52),
+            jnp.float64)
+
+    safe_a = jnp.where((a > 0) & ~is_inf & ~is_nan, a, 1.0)
+    # jnp.log2 returns -inf for f64 subnormals: boost them into the
+    # normal range first (exact power-of-two multiply)
+    boost = safe_a < jnp.float64(2.0 ** -1000)
+    a_log = jnp.where(boost, safe_a * jnp.float64(2.0 ** 64), safe_a)
+    e_est = jnp.floor(jnp.log2(a_log)).astype(jnp.int64) \
+        - jnp.where(boost, jnp.int64(64), 0)
+    e1 = jnp.clip(-e_est, -1000, 1000)
+    e2 = jnp.clip(-e_est - e1, -1000, 1000)
+    y = (safe_a * pow2(e1)) * pow2(e2)
+    k_adj = -(e1 + e2)
+    y = jnp.where(is_inf | is_nan | zero, 0.0, y)
+
+    h1 = y.astype(jnp.float32)
+    r1 = y - h1.astype(jnp.float64)
+    h2 = r1.astype(jnp.float32)
+    r2 = r1 - h2.astype(jnp.float64)
+    h3 = r2.astype(jnp.float32)
+
+    def norm_limb(h):
+        b = jax.lax.bitcast_convert_type(h, jnp.uint32)
+        m, s = _decode_f32(b)
+        # strip trailing zeros so every limb exponent reflects its true
+        # lsb: the three limbs then span <= 53 significant bits and the
+        # combined integer fits uint64
+        nzm = m != 0
+        lsb = m & (~m + jnp.uint64(1))
+        tz = _floor_log2_u64(jnp.where(nzm, lsb, jnp.uint64(1)))
+        m = m >> tz.astype(jnp.uint64)
+        s = jnp.where(nzm, s + tz, jnp.int64(1 << 40))  # zero: ignore
+        return m, s, (b >> jnp.uint32(31)) == 1
+
+    m1, s1, _n1 = norm_limb(h1)
+    m2, s2, neg2 = norm_limb(h2)
+    m3, s3, neg3 = norm_limb(h3)
+
+    base = jnp.minimum(jnp.minimum(s1, s2), s3)
+    base = jnp.minimum(base, jnp.int64(1 << 40) - 1)
+
+    def term(m, s):
+        sh = jnp.clip(s - base, 0, 63).astype(jnp.uint64)
+        return (m << sh).astype(jnp.int64)
+
+    n = term(m1, s1) \
+        + jnp.where(neg2, -term(m2, s2), term(m2, s2)) \
+        + jnp.where(neg3, -term(m3, s3), term(m3, s3))
+    n = n.astype(jnp.uint64)          # |y| = n * 2^(base - 149)
+    k = base - 149 + k_adj            # |x| = n * 2^k
+
+    nz = n != 0
+    t = _floor_log2_u64(jnp.where(nz, n, jnp.uint64(1)))
+    e_unb = k + t
+    # normal: exponent field e_unb+1023, mantissa = n aligned to bit 52
+    lsh = (jnp.int64(52) - t)
+    norm_mant = jnp.where(
+        lsh >= 0, n << jnp.where(lsh >= 0, lsh, 0).astype(jnp.uint64),
+        n >> jnp.where(lsh < 0, -lsh, 0).astype(jnp.uint64)) & _M52
+    is_sub = e_unb < -1022
+    # subnormal: bits = n * 2^(k + 1074), always an exact integer < 2^52
+    sub_sh = k + jnp.int64(1074)
+    sub_mant = jnp.where(
+        sub_sh >= 0, n << jnp.where(sub_sh >= 0, sub_sh,
+                                    0).astype(jnp.uint64),
+        n >> jnp.where(sub_sh < 0, -sub_sh, 0).astype(jnp.uint64))
+    exp_field = jnp.where(is_sub, jnp.int64(0), e_unb + 1023)
+    mant = jnp.where(is_sub, sub_mant, norm_mant)
+    bits = (exp_field.astype(jnp.uint64) << jnp.uint64(52)) \
+        | (mant & _M52)
+    bits = jnp.where(nz, bits, jnp.uint64(0))
+    bits = jnp.where(sign, bits | jnp.uint64(1 << 63), bits)
+    bits = jnp.where(neg_zero, jnp.uint64(1 << 63), bits)
+    bits = jnp.where(is_inf, jnp.uint64(0x7FF0000000000000)
+                     | jnp.where(signbit, jnp.uint64(1 << 63),
+                                 jnp.uint64(0)), bits)
+    bits = jnp.where(is_nan, jnp.uint64(0x7FF8000000000000), bits)
+    return bits
+
+
+def f64_bits_signed(x) -> jnp.ndarray:
+    """int64 view of f64_bits (what Spark's Murmur3 frames)."""
+    return f64_bits(x).astype(jnp.int64)

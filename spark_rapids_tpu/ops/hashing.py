@@ -154,8 +154,8 @@ def murmur3_column(col: Column, seed) -> jnp.ndarray:
             _normalize_float(col.data, dt), jnp.int32)
         h = murmur3_int(bits, seed)
     elif isinstance(dt, DoubleType):
-        bits = jax.lax.bitcast_convert_type(
-            _normalize_float(col.data, dt), jnp.int64)
+        from .f64bits import f64_bits_signed
+        bits = f64_bits_signed(_normalize_float(col.data, dt))
         h = murmur3_long(bits, seed)
     elif isinstance(dt, DecimalType) and not dt.is_decimal128:
         h = murmur3_long(col.data, seed)
@@ -317,8 +317,8 @@ def xxhash64_column(col: Column, seed) -> jnp.ndarray:
             _normalize_float(col.data, dt), jnp.int32)
         h = xxhash64_int(bits, seed)
     elif isinstance(dt, DoubleType):
-        bits = jax.lax.bitcast_convert_type(
-            _normalize_float(col.data, dt), jnp.int64)
+        from .f64bits import f64_bits_signed
+        bits = f64_bits_signed(_normalize_float(col.data, dt))
         h = xxhash64_long(bits, seed)
     elif isinstance(dt, DecimalType) and not dt.is_decimal128:
         h = xxhash64_long(col.data, seed)

@@ -233,8 +233,11 @@ def _slot_sweep(agg_inputs, seg, positions, capacity: int, n_slots: int,
                                              capacity, S, m=m,
                                              has=has_map.get(i))
             if S < n_slots:
-                svals = jnp.concatenate(
-                    [svals, jnp.zeros((n_slots - S,), svals.dtype)])
+                def _pad(a):
+                    return jnp.concatenate(
+                        [a, jnp.zeros((n_slots - S,), a.dtype)])
+                svals = tuple(_pad(x) for x in svals) \
+                    if isinstance(svals, tuple) else _pad(svals)
                 svalid = jnp.concatenate(
                     [svalid, jnp.zeros((n_slots - S,), jnp.bool_)])
             outs.append((svals, svalid))
@@ -244,6 +247,15 @@ def _slot_sweep(agg_inputs, seg, positions, capacity: int, n_slots: int,
         return jax.lax.cond(jnp.any(occ[G:]), lambda _: sweep(n_slots),
                             lambda _: sweep(G), None)
     return sweep(n_slots)
+
+
+def _decimal_limbs(col: Column):
+    """(hi, lo) int64 lanes of a decimal column (either tier)."""
+    from ..columnar.column import Decimal128Column
+    from . import decimal128 as D
+    if isinstance(col, Decimal128Column):
+        return col.hi.data, col.lo.data
+    return D.from_i64(col.data.astype(jnp.int64))
 
 
 def _packed_has(agg_inputs, m) -> dict:
@@ -288,6 +300,16 @@ def _slot_reduce_all(op: str, seg, col: Optional[Column], positions,
     if has is None:
         has = jnp.any(v, axis=0)
     if op in ("sum", "sum_sq"):
+        from ..types import DecimalType
+        if op == "sum" and isinstance(col.dtype, DecimalType):
+            # exact 128-bit decimal sum: eight u16-limb lanes summed in
+            # int64, recombined mod 2^128 (ops/decimal128.py)
+            from . import decimal128 as D
+            h, l = _decimal_limbs(col)
+            sums = [jnp.sum(jnp.where(v, lane[:, None], jnp.int64(0)),
+                            axis=0)
+                    for lane in D.limb16_lanes(h, l)]
+            return D.combine_limb_sums(sums), has
         data = col.data
         acc = data.astype(jnp.float64) \
             if jnp.issubdtype(data.dtype, jnp.floating) \
@@ -337,6 +359,14 @@ def _slot_reduce(op: str, m, col: Optional[Column], positions,
         return jnp.sum(v, dtype=jnp.int64), jnp.bool_(True)
     has = jnp.any(v)
     if op in ("sum", "sum_sq"):
+        from ..types import DecimalType
+        if op == "sum" and isinstance(col.dtype, DecimalType):
+            from . import decimal128 as D
+            h, l = _decimal_limbs(col)
+            sums = [jnp.sum(jnp.where(v, lane, jnp.int64(0)))
+                    for lane in D.limb16_lanes(h, l)]
+            return D.combine_limb_sums(
+                [s[None] for s in sums]), has  # (1,)-shaped limb pair
         data = col.data
         acc = data.astype(jnp.float64) \
             if jnp.issubdtype(data.dtype, jnp.floating) \
@@ -405,9 +435,14 @@ def masked_groupby(key_columns: Sequence[Column],
     target = jnp.where(occ, dense, out_cap)  # scatter position per slot
 
     def _place(vals, valids):
-        """(R*G,) slot arrays -> dense-prefix (out_cap,) arrays."""
-        d = jnp.zeros((out_cap,), vals.dtype).at[target].set(
-            vals, mode="drop")
+        """(R*G,) slot arrays -> dense-prefix (out_cap,) arrays.
+        vals may be a (hi, lo) limb tuple (decimal128 sums)."""
+        if isinstance(vals, tuple):
+            d = tuple(jnp.zeros((out_cap,), x.dtype).at[target].set(
+                x, mode="drop") for x in vals)
+        else:
+            d = jnp.zeros((out_cap,), vals.dtype).at[target].set(
+                vals, mode="drop")
         v = jnp.zeros((out_cap,), jnp.bool_).at[target].set(
             valids & occ, mode="drop")
         return d, v
@@ -459,8 +494,12 @@ def masked_groupby_exact(key_columns: Sequence[Column],
         target = jnp.where(occ, dense, capacity)
 
         def place(vals, valids):
-            d = jnp.zeros((capacity,), vals.dtype).at[target].set(
-                vals, mode="drop")
+            if isinstance(vals, tuple):
+                d = tuple(jnp.zeros((capacity,), x.dtype).at[target].set(
+                    x, mode="drop") for x in vals)
+            else:
+                d = jnp.zeros((capacity,), vals.dtype).at[target].set(
+                    vals, mode="drop")
             v = jnp.zeros((capacity,), jnp.bool_).at[target].set(
                 valids & occ, mode="drop")
             return d, v
@@ -527,8 +566,14 @@ def masked_reduce(agg_inputs: Sequence[Tuple[str, Optional[Column]]],
                 act = act & row_mask
             positions = jnp.arange(cap, dtype=jnp.int32)
             val, ok = _slot_reduce(op, act, col, positions, cap)
-        data = jnp.zeros((out_capacity,), val.dtype).at[0].set(val)
-        data = jnp.where(act1, data, jnp.zeros((), val.dtype))
+        if isinstance(val, tuple):  # decimal128 (hi, lo) limbs
+            data = tuple(
+                jnp.where(act1, jnp.zeros((out_capacity,), x.dtype)
+                          .at[0].set(x.reshape(())), jnp.int64(0))
+                for x in val)
+        else:
+            data = jnp.zeros((out_capacity,), val.dtype).at[0].set(val)
+            data = jnp.where(act1, data, jnp.zeros((), val.dtype))
         valid = act1 & ok
         out.append((data, valid))
     return out

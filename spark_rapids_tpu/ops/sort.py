@@ -55,7 +55,13 @@ def _float_order_bits(data, bits_dtype, sign_bit):
     # and XLA's algebraic simplifier folds it away under jit
     data = jnp.where(data == jnp.zeros((), data.dtype),
                      jnp.zeros((), data.dtype), data)
-    bits = jax.lax.bitcast_convert_type(data, bits_dtype)
+    if jnp.dtype(bits_dtype).itemsize == 8:
+        # direct f64 bitcasts don't compile on TPU (X64 pass limitation);
+        # reconstruct the pattern arithmetically
+        from .f64bits import f64_bits
+        bits = f64_bits(data)
+    else:
+        bits = jax.lax.bitcast_convert_type(data, bits_dtype)
     neg = (bits >> (sign_bit)) & 1
     flipped = jnp.where(neg == 1, ~bits, bits | (jnp.ones((), bits_dtype) << sign_bit))
     return flipped

@@ -70,13 +70,45 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
     _r(rules, BoundReference, "column reference", all_types, all_types)
     _r(rules, UnresolvedAttribute, "column reference", all_types, all_types)
     _r(rules, Alias, "named expression", all_types, all_types)
-    # arithmetic
+    # arithmetic. decimal128 coverage (ops/decimal128.py): add/sub for
+    # any precision, multiply only from <=18-digit inputs (a d128 input
+    # would need a 256-bit intermediate); div/mod past 18 digits need
+    # 128/64 long division — all tagged off-device at plan time.
+    def _tag_decimal128(meta):
+        from ..types import DecimalType as _Dec
+        e = meta.expr
+        try:
+            out_t = e.data_type
+            in_ts = [c.data_type for c in e.children]
+        except (TypeError, NotImplementedError):
+            return
+        name = type(e).__name__
+        if not (isinstance(out_t, _Dec)
+                or any(isinstance(t, _Dec) for t in in_ts)):
+            return
+        big_in = any(isinstance(t, _Dec) and t.precision > 18
+                     for t in in_ts)
+        if name == "Multiply" and big_in:
+            meta.will_not_work_on_tpu(
+                "decimal multiply with >18-digit inputs needs a 256-bit "
+                "intermediate")
+        if name in ("Divide", "IntegralDivide", "Remainder", "Pmod") \
+                and big_in:
+            meta.will_not_work_on_tpu(
+                f"decimal {name.lower()} with >18-digit inputs has no "
+                "device kernel")
+
     for c in (arithmetic.Add, arithmetic.Subtract, arithmetic.Multiply):
-        _r(rules, c, f"{c.__name__.lower()}", num, num)
-    _r(rules, arithmetic.Divide, "division", num, fp + TypeSig.of("DECIMAL"))
-    _r(rules, arithmetic.IntegralDivide, "integral division", num, integral)
-    _r(rules, arithmetic.Remainder, "remainder", num, num)
-    _r(rules, arithmetic.Pmod, "positive modulo", num, num)
+        _r(rules, c, f"{c.__name__.lower()}", num, num,
+           tag_fn=_tag_decimal128)
+    _r(rules, arithmetic.Divide, "division", num, fp + TypeSig.of("DECIMAL"),
+       tag_fn=_tag_decimal128)
+    _r(rules, arithmetic.IntegralDivide, "integral division", num, integral,
+       tag_fn=_tag_decimal128)
+    _r(rules, arithmetic.Remainder, "remainder", num, num,
+       tag_fn=_tag_decimal128)
+    _r(rules, arithmetic.Pmod, "positive modulo", num, num,
+       tag_fn=_tag_decimal128)
     _r(rules, arithmetic.UnaryMinus, "negation", num, num)
     _r(rules, arithmetic.Abs, "absolute value", num, num)
     _r(rules, arithmetic.Least, "least of arguments", orderable, orderable)
@@ -114,7 +146,9 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
             return  # unresolved; re-checked post-bind
         off = (isinstance(dst, _Str)
                and isinstance(src, (_Flt, _Dbl, _Ts))) \
-            or (isinstance(src, _Str) and isinstance(dst, (_Ts, _Dec)))
+            or (isinstance(src, _Str) and isinstance(dst, (_Ts, _Dec))) \
+            or (isinstance(src, _Dec) and src.precision > 18) \
+            or (isinstance(dst, _Dec) and dst.precision > 18)
         if off:
             meta.will_not_work_on_tpu(
                 f"cast {src.simple_name()} -> {dst.simple_name()} has no "
@@ -524,6 +558,39 @@ class PlanMeta(BaseMeta):
                         self.will_not_work_on_tpu(
                             f"collect_set over {dt.simple_name()} needs "
                             "string dedup lanes (planned)")
+        if isinstance(self.plan, (L.LogicalSort, L.LogicalJoin,
+                                  L.LogicalAggregate, L.LogicalWindow)):
+            # two-limb decimal128 columns have no order-key/bucket-hash
+            # lanes yet: sort keys, join keys and group keys past 18
+            # digits reject at plan time (values pass through projections
+            # and sums fine — only KEY positions are affected)
+            from ..types import DecimalType as _Dec
+            keyed = []
+            if isinstance(self.plan, L.LogicalSort):
+                keyed = [(o[0] if isinstance(o, tuple) else o)
+                         for o in self.plan.orders]
+            elif isinstance(self.plan, L.LogicalJoin):
+                keyed = list(self.plan.left_keys) + \
+                    list(self.plan.right_keys)
+            elif isinstance(self.plan, L.LogicalAggregate):
+                keyed = list(self.plan.group_exprs)
+            else:
+                keyed = [e for we, _ in self.plan.window_exprs
+                         for e in we.spec.partition_by]
+                keyed += [o[0] for we, _ in self.plan.window_exprs
+                          for o in we.spec.order_by]
+            for e in keyed:
+                for child in self.plan.children:
+                    try:
+                        dt = resolve(e, child.schema).data_type \
+                            if isinstance(e, Expression) else None
+                    except (KeyError, TypeError, NotImplementedError):
+                        continue
+                    if isinstance(dt, _Dec) and dt.precision > 18:
+                        self.will_not_work_on_tpu(
+                            f"{dt.simple_name()} key: decimal128 order/"
+                            "hash lanes not implemented")
+                    break
         if isinstance(self.plan, L.LogicalJoin):
             # joins duplicate payload rows; the duplicating array gather
             # has no string-element byte measurement yet — reject at plan

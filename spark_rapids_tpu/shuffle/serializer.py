@@ -119,6 +119,13 @@ def _decode_column(dtype, n: int, bufs: List[bytes], pos: int,
             kids.append(k)
         return StructColumn(tuple(kids), jnp.asarray(vpad), dtype), pos
 
+    from ..types import DecimalType, LongType
+    if isinstance(dtype, DecimalType) and dtype.precision > 18:
+        from ..columnar.column import Decimal128Column
+        hi, pos = _decode_column(LongType(), n, bufs, pos, capacity)
+        lo, pos = _decode_column(LongType(), n, bufs, pos, capacity)
+        return Decimal128Column((hi, lo), jnp.asarray(vpad), dtype), pos
+
     if isinstance(dtype, ArrayType):
         off = np.frombuffer(bufs[pos], dtype=np.int32)
         pos += 1
@@ -276,9 +283,27 @@ def host_gather_column(col: Column, idx: np.ndarray) -> Column:
         return ArrayColumn(child, new_off, vpad,
                            col.dtype)
 
+    if isinstance(col, MapColumn):
+        off = _np(col.offsets)
+        starts = off[idx]
+        lens = (off[idx + 1] - starts).astype(np.int64)
+        total = int(lens.sum())
+        new_off = np.zeros(cap + 1, np.int32)
+        np.cumsum(lens, out=new_off[1: len(idx) + 1])
+        new_off[len(idx) + 1:] = new_off[len(idx)]
+        if total:
+            cum = np.concatenate([[0], np.cumsum(lens)[:-1]])
+            entry_idx = (np.repeat(starts, lens)
+                         + np.arange(total) - np.repeat(cum, lens))
+        else:
+            entry_idx = np.zeros(0, np.int64)
+        keys = host_gather_column(col.keys, entry_idx)
+        vals = host_gather_column(col.values, entry_idx)
+        return MapColumn(keys, vals, new_off, vpad, col.dtype)
+
     if isinstance(col, StructColumn):
         kids = tuple(host_gather_column(c, idx) for c in col.children)
-        return StructColumn(kids, vpad, col.dtype)
+        return type(col)(kids, vpad, col.dtype)  # incl. Decimal128
 
     data = _np(col.data)[idx] if len(idx) else \
         np.zeros(0, _np(col.data).dtype)

@@ -1,0 +1,186 @@
+"""Two-limb decimal128: kernels, columns, arithmetic, exact sums."""
+import decimal as dec
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Decimal128Column
+from spark_rapids_tpu.expr.core import col, resolve
+from spark_rapids_tpu.ops import decimal128 as D
+from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
+                                                 serialize_batch)
+from spark_rapids_tpu.types import (DecimalType, STRING, Schema,
+                                    StructField)
+
+
+def _pair(vals):
+    h, l = [], []
+    for v in vals:
+        u = v & ((1 << 128) - 1)
+        lo = u & ((1 << 64) - 1)
+        hi = u >> 64
+        l.append(lo - (1 << 64) if lo >= (1 << 63) else lo)
+        h.append(hi - (1 << 64) if hi >= (1 << 63) else hi)
+    return (jnp.asarray(np.array(h, np.int64)),
+            jnp.asarray(np.array(l, np.int64)))
+
+
+def _unpair(h, l):
+    out = []
+    for hi, lo in zip(np.asarray(h).tolist(), np.asarray(l).tolist()):
+        u = ((hi & ((1 << 64) - 1)) << 64) | (lo & ((1 << 64) - 1))
+        out.append(u - (1 << 128) if u >= (1 << 127) else u)
+    return out
+
+
+def test_kernel_add_mul_rescale():
+    rng = random.Random(5)
+    a = [rng.randint(-10**30, 10**30) for _ in range(40)] + [0, 1, -1]
+    b = [rng.randint(-10**30, 10**30) for _ in range(40)] + [5, -7, 1]
+    ha, la = _pair(a)
+    hb, lb = _pair(b)
+    gh, gl = D.add128(ha, la, hb, lb)
+    assert _unpair(gh, gl) == [
+        (x + y + 2**127) % 2**128 - 2**127 for x, y in zip(a, b)]
+    xs = [rng.randint(-(10**18), 10**18) for _ in range(40)]
+    ys = [rng.randint(-(10**18), 10**18) for _ in range(40)]
+    mh, ml = D.mul_i64_i64(jnp.asarray(np.array(xs, np.int64)),
+                           jnp.asarray(np.array(ys, np.int64)))
+    assert _unpair(mh, ml) == [x * y for x, y in zip(xs, ys)]
+    vv = [rng.randint(-10**25, 10**25) for _ in range(30)] + [449, 450,
+                                                              -450, -25]
+    h, l = _pair(vv)
+    rh, rl, ov = D.rescale(h, l, 6, 2)
+    exp = [int(dec.Decimal(v).scaleb(-4).quantize(
+        dec.Decimal(1), rounding=dec.ROUND_HALF_UP)) for v in vv]
+    assert _unpair(rh, rl) == exp
+    assert not bool(jnp.any(ov))
+
+
+def test_limb_sum_recombination():
+    rng = random.Random(7)
+    vals = [rng.randint(-10**30, 10**30) for _ in range(500)]
+    h, l = _pair(vals)
+    sums = [jnp.sum(lane) for lane in D.limb16_lanes(h, l)]
+    rh, rl = D.combine_limb_sums([s[None] for s in sums])
+    assert _unpair(rh, rl)[0] == sum(vals)
+
+
+def test_column_roundtrip_and_serialize():
+    t = DecimalType(30, 4)
+    vals = [dec.Decimal("123456789012345678901234.5678"),
+            dec.Decimal("-1.0001"), None, dec.Decimal("0")]
+    sch = Schema((StructField("d", t),))
+    b = ColumnarBatch.from_pydict({"d": vals}, sch)
+    assert isinstance(b.columns[0], Decimal128Column)
+    unscaled = [None if v is None else int(v.scaleb(4)) for v in vals]
+    assert b.columns[0].to_pylist(4) == unscaled
+    rt = deserialize_batch(serialize_batch(b), sch)
+    assert rt.columns[0].to_pylist(4) == unscaled
+    # arrow roundtrip
+    back = b.to_arrow().column("d").to_pylist()
+    assert back == vals
+
+
+def test_multiply_into_decimal128_exact():
+    t = DecimalType(12, 2)
+    a = [dec.Decimal("12345678.90"), dec.Decimal("-0.05"), None,
+         dec.Decimal("9999999999.99")]
+    b = [dec.Decimal("2.50"), dec.Decimal("3.00"), dec.Decimal("1.00"),
+         dec.Decimal("9999999999.99")]
+    sch = Schema((StructField("a", t), StructField("b", t)))
+    batch = ColumnarBatch.from_pydict({"a": a, "b": b}, sch)
+    mul = resolve(col("a") * col("b"), sch)
+    assert mul.data_type == DecimalType(25, 4)
+    out = mul.columnar_eval(batch)
+    exp = [None if x is None or y is None else
+           int((x * y).scaleb(4)) for x, y in zip(a, b)]
+    assert out.to_pylist(4) == exp
+
+
+def test_group_by_decimal_sums_match_decimal_oracle():
+    t = DecimalType(12, 2)
+    sess = TpuSession()
+    rng = random.Random(3)
+    n = 60
+    keys = [rng.choice("ABC") for _ in range(n)]
+    q = [None if rng.random() < 0.1 else
+         dec.Decimal(rng.randint(0, 10**12 - 1)).scaleb(-2)
+         for _ in range(n)]
+    p = [dec.Decimal(rng.randint(-(10**12) + 1, 10**12 - 1)).scaleb(-2)
+         for _ in range(n)]
+    df = sess.from_pydict(
+        {"k": keys, "q": q, "p": p},
+        schema=Schema((StructField("k", STRING), StructField("q", t),
+                       StructField("p", t))))
+    out = sorted(df.group_by("k").agg(
+        (F.sum(F.col("q")), "sq"),
+        (F.sum(F.col("q") * F.col("p")), "spq")).collect())
+    import collections
+    o_sq = collections.defaultdict(dec.Decimal)
+    o_spq = collections.defaultdict(dec.Decimal)
+    for k, qq, pp in zip(keys, q, p):
+        if qq is not None:
+            o_sq[k] += qq
+            o_spq[k] += qq * pp
+    exp = sorted((k, int(o_sq[k].scaleb(2)), int(o_spq[k].scaleb(4)))
+                 for k in o_sq)
+    assert out == exp
+
+
+def test_grand_aggregate_decimal_sum():
+    t = DecimalType(15, 3)
+    sess = TpuSession()
+    vals = [dec.Decimal("999999999999.999"), dec.Decimal("0.001"), None,
+            dec.Decimal("-5.500")]
+    df = sess.from_pydict({"v": vals},
+                          schema=Schema((StructField("v", t),)))
+    out = df.agg((F.sum(F.col("v")), "s")).collect()
+    assert out == [(int(dec.Decimal("999999999994.500").scaleb(3)),)]
+
+
+def test_sum_overflow_past_result_precision_is_null():
+    # DECIMAL(1,0): sum type DECIMAL(11,0); 12 billion 9s overflow it
+    t = DecimalType(1, 0)
+    sess = TpuSession()
+    n = 200
+    df = sess.from_pydict({"v": [dec.Decimal(9)] * n},
+                          schema=Schema((StructField("v", t),)))
+    out = df.agg((F.sum(F.col("v")), "s")).collect()
+    assert out == [(9 * n,)]  # fits (11,0): stays exact
+
+
+def test_divide_into_decimal128_exact():
+    sess = TpuSession()
+    t = DecimalType(12, 2)
+    a = [dec.Decimal("1.00"), dec.Decimal("2.50"),
+         dec.Decimal("9999999999.99"), None, dec.Decimal("-7.00")]
+    b = [dec.Decimal("2.00"), dec.Decimal("3.00"),
+         dec.Decimal("0.03"), dec.Decimal("1.00"), dec.Decimal("0.00")]
+    df = sess.from_pydict(
+        {"a": a, "b": b},
+        schema=Schema((StructField("a", t), StructField("b", t))))
+    q = df.select((F.col("a") / F.col("b")).alias("d"))
+    out_t = resolve(col("a") / col("b"),
+                    Schema((StructField("a", t), StructField("b", t)))
+                    ).data_type
+    assert out_t.precision > 18  # genuinely the two-limb path
+    got = [r[0] for r in q.collect()]
+    ctx = dec.Context(prec=60)
+    exp = []
+    for x, y in zip(a, b):
+        if x is None or y is None or y == 0:
+            exp.append(None)
+            continue
+        v = ctx.divide(x, y).quantize(
+            dec.Decimal(1).scaleb(-out_t.scale),
+            rounding=dec.ROUND_HALF_UP, context=ctx)
+        exp.append(int(v.scaleb(out_t.scale)))
+    assert got == exp, (got, exp, out_t)
