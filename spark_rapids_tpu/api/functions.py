@@ -45,6 +45,16 @@ def udf(fn=None, *, return_type=None):
     return _udf(fn, return_type=return_type)
 
 
+def percentile(x, percentage):
+    from ..expr.aggexprs import Percentile
+    return Percentile(_e(x), percentage)
+
+
+def approx_percentile(x, percentage, accuracy=None):
+    from ..expr.aggexprs import ApproxPercentile
+    return ApproxPercentile(_e(x), percentage, accuracy)
+
+
 def collect_list(x):
     from ..expr.aggexprs import CollectList
     return CollectList(_e(x))

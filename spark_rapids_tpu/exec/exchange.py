@@ -101,10 +101,11 @@ class ShuffleExchangeExec(TpuExec):
                     lens = string_lengths(c)
                     max_len = jnp.maximum(
                         max_len, jnp.max(jnp.where(act, lens, 0)))
-            return max_count, max_len
+            return max_count, max_len, counts[:n]
 
-        max_count, max_len = jax.vmap(per_dev)(stacked)
-        return jnp.max(max_count), jnp.max(max_len)
+        max_count, max_len, totals = jax.vmap(per_dev)(stacked)
+        return jnp.max(max_count), jnp.max(max_len), jnp.sum(totals,
+                                                             axis=0)
 
     def _get_step(self, cap: int, slot_cap: int, width: int):
         key = (cap, slot_cap, width)
@@ -129,51 +130,108 @@ class ShuffleExchangeExec(TpuExec):
         self._steps[key] = step
         return step
 
-    # -- drive -------------------------------------------------------------
-    def internal_execute(self) -> Iterator[ColumnarBatch]:
+    def _exchange_round(self, batches: List[ColumnarBatch]):
+        """One SPMD exchange over a bounded group of input batches;
+        returns the n received shard batches."""
         from ..parallel.distributed import stack_batches, unstack_batches
-
         n = self.n_partitions
         schema = self.output_schema
+        groups = [batches[d::n] for d in range(n)]
+        per_dev = []
+        for g in groups:
+            if not g:
+                per_dev.append(empty_batch(schema))
+            elif len(g) == 1:
+                per_dev.append(g[0])
+            else:
+                per_dev.append(concat_batches(g, schema))
+        cap = max(b.capacity for b in per_dev)
+        per_dev = [b.sized_to(cap) for b in per_dev]
+        stacked = stack_batches(per_dev)
+
+        max_count, max_len, totals = self._jit_measure(stacked)
+        # one host sync per ROUND: size the receive buffer to the
+        # measured max partition load, and string lanes to the measured
+        # max byte length (truncation structurally impossible)
+        slot_cap = min(bucket_capacity(max(int(max_count), 1)), cap)
+        width = max(8, (int(max_len) + 7) // 8 * 8)
+
+        out = self._get_step(cap, slot_cap, width)(stacked)
+        import numpy as _np
+        return list(unstack_batches(out, n)), _np.asarray(totals)
+
+    # -- drive -------------------------------------------------------------
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        """Streamed, bounded drive (round-2 verdict item 6): child
+        batches flow through the ICI exchange in fixed-byte rounds; each
+        round's received shards stage as SPILLABLE batches and the final
+        per-shard outputs concatenate from the staged pieces. Peak device
+        memory = one round of input + one round of output, not the whole
+        stage."""
+        from ..config import EXCHANGE_ROUND_BYTES, active_conf
+        from ..memory.spillable import SpillableBatch
+
+        n = self.n_partitions
         in_batches = self.metrics[NUM_INPUT_BATCHES]
         in_rows = self.metrics[NUM_INPUT_ROWS]
-        batches: List[ColumnarBatch] = []
+        if n == 1:
+            for b in self.child.execute():
+                in_batches.add(1)
+                if b._host_rows is not None:
+                    in_rows.add(b._host_rows)
+                else:
+                    in_rows.add_device(b.num_rows)
+                yield b
+            return
+
+        round_budget = active_conf().get(EXCHANGE_ROUND_BYTES)
+        staged: List[List[SpillableBatch]] = [[] for _ in range(n)]
+        pending: List[ColumnarBatch] = []
+        pending_bytes = 0
+        self.rounds = 0
+        self._part_totals = None
+
+        def flush():
+            nonlocal pending, pending_bytes
+            if not pending:
+                return
+            with self.metrics[OP_TIME].ns_timer():
+                shards, totals = self._exchange_round(pending)
+            # exact per-partition totals accumulate ACROSS rounds; the
+            # metric is the max over partitions of the whole-stage totals
+            self._part_totals = totals if self._part_totals is None \
+                else self._part_totals + totals
+            for d, shard in enumerate(shards):
+                staged[d].append(SpillableBatch.from_batch(shard))
+            pending = []
+            pending_bytes = 0
+            self.rounds += 1
+
         for b in self.child.execute():
             in_batches.add(1)
             if b._host_rows is not None:
                 in_rows.add(b._host_rows)
             else:
                 in_rows.add_device(b.num_rows)
-            batches.append(b)
-        if n == 1:
-            yield from batches
-            return
+            pending.append(b)
+            pending_bytes += b.device_size_bytes()
+            if pending_bytes >= round_budget:
+                flush()
+        flush()
+        if self._part_totals is not None:
+            self.metrics[PARTITION_SIZE].add(int(self._part_totals.max()))
 
-        with self.metrics[OP_TIME].ns_timer():
-            # round-robin batches onto device shards, one batch per device
-            groups = [batches[d::n] for d in range(n)]
-            per_dev = []
-            for g in groups:
-                if not g:
-                    per_dev.append(empty_batch(schema))
-                elif len(g) == 1:
-                    per_dev.append(g[0])
-                else:
-                    per_dev.append(concat_batches(g, schema))
-            cap = max(b.capacity for b in per_dev)
-            per_dev = [b.sized_to(cap) for b in per_dev]
-            stacked = stack_batches(per_dev)
-
-            max_count, max_len = self._jit_measure(stacked)
-            # one host sync per exchange: size the receive buffer to the
-            # measured max partition load, and string lanes to the measured
-            # max byte length (truncation structurally impossible)
-            slot_cap = min(bucket_capacity(max(int(max_count), 1)), cap)
-            width = max(8, (int(max_len) + 7) // 8 * 8)
-            self.metrics[PARTITION_SIZE].add(int(max_count))
-
-            out = self._get_step(cap, slot_cap, width)(stacked)
-            yield from unstack_batches(out, n)
+        schema = self.output_schema
+        for d in range(n):
+            if not staged[d]:
+                yield empty_batch(schema)
+                continue
+            got = []
+            for sp in staged[d]:
+                got.append(sp.get_batch())
+                sp.release()
+                sp.close()
+            yield got[0] if len(got) == 1 else concat_batches(got, schema)
 
     def node_description(self):
         return (f"ShuffleExchangeExec[n={self.n_partitions}, "

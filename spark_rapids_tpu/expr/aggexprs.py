@@ -249,6 +249,63 @@ class CollectList(AggregateFunction):
         return buffers[0]
 
 
+class Percentile(AggregateFunction):
+    """percentile(col, p [, ...]) — exact interpolated percentile
+    (reference GpuPercentile). Values buffer as a list column (collect
+    machinery, exact tier); evaluation segment-sorts once and picks
+    interpolated ranks (ops/percentile.py)."""
+    name = "percentile"
+    _INTERPOLATE = True
+
+    def __init__(self, child, percentage):
+        super().__init__(child)
+        from .core import Literal
+        if isinstance(percentage, Literal):
+            percentage = percentage.value
+        self.percentage = percentage
+
+    def update_ops(self):
+        return [("collect", 0)]
+
+    def merge_ops(self):
+        return ["collect_merge"]
+
+    def buffer_types(self, input_types):
+        from ..types import ArrayType
+        return [ArrayType(input_types[0])]
+
+    def _scalar_result(self, elem_t):
+        from ..types import DOUBLE
+        return DOUBLE if self._INTERPOLATE else elem_t
+
+    def result_type(self, input_types):
+        from ..types import ArrayType
+        rt = self._scalar_result(input_types[0])
+        return rt if not isinstance(self.percentage, (list, tuple)) \
+            else ArrayType(rt)
+
+    def result_type_from_buffer(self, buffer_types):
+        return self.result_type([buffer_types[0].element_type])
+
+    def evaluate(self, buffers, input_types):
+        from ..ops.percentile import percentile_of_arrays
+        return percentile_of_arrays(buffers[0], self.percentage,
+                                    self._INTERPOLATE)
+
+
+class ApproxPercentile(Percentile):
+    """approx_percentile(col, p [, accuracy]) — computed EXACTLY here
+    (satisfies any accuracy; reference GpuApproximatePercentile merges
+    t-digest sketches because cuDF aggregates per batch — this engine's
+    merge pass already concatenates each group's values)."""
+    name = "approx_percentile"
+    _INTERPOLATE = False
+
+    def __init__(self, child, percentage, accuracy=None):
+        super().__init__(child, percentage)
+        self.accuracy = accuracy  # accepted for API parity; unused
+
+
 class CollectSet(CollectList):
     """collect_set(expr): deduped values (reference GpuCollectSet). The
     merge pass flattens partial sets; cross-partial duplicates only arise

@@ -258,25 +258,93 @@ def limb16_lanes(h, l):
     return out
 
 
+#: saturation sentinel for overflowed decimal sums: i128 max, magnitude
+#: ~1.7e38 — beyond every legal decimal(38) value, so it can only arise
+#: from saturation and the any-input-saturated check keeps it sticky
+SAT_HI = (1 << 63) - 1
+SAT_LO = -1
+
+
+def _add192(a2, a1, a0, b2, b1, b0):
+    lo = _s(_u(a0) + _u(b0))
+    c0 = (_u(lo) < _u(a0)).astype(jnp.int64)
+    mid = _s(_u(a1) + _u(b1) + _u(c0))
+    c1 = ((_u(mid) < _u(a1)) | ((c0 == 1) & (_u(mid) == _u(a1)))
+          ).astype(jnp.int64)
+    hi = a2 + b2 + c1
+    return hi, mid, lo
+
+
 def combine_limb_sums(sums):
-    """Recombine eight per-limb int64 sums into (hi, lo) mod 2^128."""
-    rh = jnp.zeros_like(sums[0])
-    rl = jnp.zeros_like(sums[0])
+    """Recombine eight per-limb int64 sums into (hi, lo) mod 2^128.
+    Use combine_limb_sums_checked when overflow past signed 128 bits
+    must surface (decimal sum accumulation)."""
+    rh, rl = combine_limb_sums_checked(sums)[:2]
+    return rh, rl
+
+
+def combine_limb_sums_checked(sums, neg_count=None):
+    """(hi, lo, overflowed): exact 192-bit accumulation of the shifted
+    limb sums, so a true sum past +-2^127 is DETECTED instead of
+    aliasing back into range mod 2^128.
+
+    The limbs decompose each value's UNSIGNED two's-complement pattern,
+    so every NEGATIVE input inflates the 192-bit total by exactly 2^128;
+    `neg_count` (per-slot count of negative summed values) corrects the
+    top limb before the fits-signed-128 test. None disables the check
+    (overflowed is returned as all-False)."""
+    t2 = jnp.zeros_like(sums[0])
+    t1 = jnp.zeros_like(sums[0])
+    t0 = jnp.zeros_like(sums[0])
     for k, s in enumerate(sums):
         bits = 16 * k
-        if bits < 64:
-            ph, pl = shl128(jnp.zeros_like(s), s, bits) if bits else \
-                (jnp.zeros_like(s), s)
-        else:
-            ph, pl = shl128(s, jnp.zeros_like(s), bits - 64) \
-                if bits > 64 else (s, jnp.zeros_like(s))
-        rh, rl = add128(rh, rl, ph, pl)
+        # sign-extend s to 3 limbs, then shift left by `bits` (< 128)
+        s2, s1, s0 = s >> jnp.int64(63), s >> jnp.int64(63), s
+        if bits:
+            if bits < 64:
+                nb = jnp.uint64(bits)
+                inv = jnp.uint64(64 - bits)
+                n0 = _s(_u(s0) << nb)
+                n1 = _s((_u(s1) << nb) | (_u(s0) >> inv))
+                n2 = _s((_u(s2) << nb) | (_u(s1) >> inv))
+                s2, s1, s0 = n2, n1, n0
+            else:
+                nb = jnp.uint64(bits - 64)
+                inv = jnp.uint64(64 - (bits - 64)) if bits > 64 else None
+                if bits == 64:
+                    s2, s1, s0 = s1, s0, jnp.zeros_like(s0)
+                else:
+                    n1 = _s(_u(s0) << nb)
+                    n2 = _s((_u(s1) << nb) | (_u(s0) >> inv))
+                    s2, s1, s0 = n2, n1, jnp.zeros_like(s0)
+        t2, t1, t0 = _add192(t2, t1, t0, s2, s1, s0)
+    if neg_count is None:
+        return t1, t0, jnp.zeros(t1.shape, jnp.bool_)
+    # fits signed 128 iff (after removing the unsigned-representation
+    # inflation) the top limb is the sign extension of the mid limb
+    over = (t2 - neg_count) != (t1 >> jnp.int64(63))
+    return t1, t0, over
+
+
+def saturate_sum(rh, rl, over, any_sat):
+    """Apply decimal-sum overflow semantics: past signed-128 (or fed by
+    an already-saturated partial) the slot pins to the SAT sentinel,
+    which fails fits_precision at evaluate -> NULL (Spark saturates
+    decimal sums at the buffer precision the same way)."""
+    bad = over | any_sat
+    rh = jnp.where(bad, jnp.int64(SAT_HI), rh)
+    rl = jnp.where(bad, jnp.int64(SAT_LO), rl)
     return rh, rl
+
+
+def is_saturated(h, l):
+    return (h == jnp.int64(SAT_HI)) & (l == jnp.int64(SAT_LO))
 
 
 def decimal_segment_sum(col, valid_mask, seg, capacity: int):
     """Exact 128-bit segment sum of a decimal column (either tier):
-    eight u16-limb int64 segment sums recombined mod 2^128.
+    eight u16-limb int64 segment sums recombined with 192-bit overflow
+    detection and sticky saturation.
     Returns ((hi, lo) (capacity,) limb arrays, has_any bool array)."""
     import jax
 
@@ -285,7 +353,14 @@ def decimal_segment_sum(col, valid_mask, seg, capacity: int):
     sums = [jax.ops.segment_sum(
         jnp.where(valid_mask, lane, jnp.int64(0)), seg,
         num_segments=capacity) for lane in limb16_lanes(h, l)]
-    rh, rl = combine_limb_sums(sums)
+    negs = jax.ops.segment_sum(
+        ((h < 0) & valid_mask).astype(jnp.int64), seg,
+        num_segments=capacity)
+    rh, rl, over = combine_limb_sums_checked(sums, negs)
+    any_sat = jax.ops.segment_max(
+        (is_saturated(h, l) & valid_mask).astype(jnp.int32), seg,
+        num_segments=capacity) > 0
+    rh, rl = saturate_sum(rh, rl, over, any_sat)
     counts = jax.ops.segment_sum(valid_mask.astype(jnp.int32), seg,
                                  num_segments=capacity)
     return (rh, rl), counts > 0

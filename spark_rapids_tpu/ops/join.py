@@ -44,16 +44,28 @@ def _keys_valid(key_cols: Sequence[Column], num_rows, capacity: int):
     return v
 
 
-class BuildTable:
-    """Hash-sorted build side: the TPU analog of the cuDF hash table the
-    reference builds once and probes per stream batch. A registered pytree
-    so the whole build phase jits and the probe phase takes it as a traced
-    argument."""
+def _bucket_bits(capacity: int) -> int:
+    """Static bucket-count exponent: ~2 slots per build row, capped so
+    the offsets table stays small."""
+    return min(21, max(10, (capacity - 1).bit_length() + 1))
 
-    def __init__(self, sorted_hash, perm, valid_count, num_rows,
+
+class BuildTable:
+    """Hash-bucketed build side: the TPU analog of the cuDF hash table
+    the reference builds once and probes per stream batch. Rows sort by
+    the u32 hash pair (u32 sort keys are ~5x cheaper than emulated u64 on
+    v5e) and a top-B-bits bucket offsets table replaces binary search:
+    probing is two tiny table gathers instead of 2 x 19 emulated-u64
+    searchsorted rounds (measured: ~1.05 s per 2M probes). Bucket-mates
+    with unequal keys are filtered by the existing exact key-verify pass,
+    so correctness never depends on hash-range tightness. A registered
+    pytree so the whole build phase jits and the probe phase takes it as
+    a traced argument."""
+
+    def __init__(self, bucket_table, perm, valid_count, num_rows,
                  key_cols: Sequence[Column], payload: Sequence[Column],
                  capacity: int, payload_prefix: Sequence = ()):
-        self.sorted_hash = sorted_hash
+        self.bucket_table = bucket_table  # (2^B + 1,) int32 offsets
         self.perm = perm  # sorted position -> original build row
         self.valid_count = valid_count
         self.num_rows = num_rows
@@ -73,13 +85,28 @@ class BuildTable:
         h = xxhash64_batch(list(key_cols), seed=JOIN_HASH_SEED)
         # invalid/inactive rows: push to the end with the max hash AND keep
         # them out of every candidate range via the valid-count boundary.
-        big = jnp.uint64(0xFFFF_FFFF_FFFF_FFFF)
         h_u = jax.lax.bitcast_convert_type(h, jnp.uint64)
-        sort_h = jnp.where(valid, h_u, big)
+        h_hi = (h_u >> jnp.uint64(32)).astype(jnp.uint32)
+        h_lo = h_u.astype(jnp.uint32)
+        big32 = jnp.uint32(0xFFFF_FFFF)
+        k_hi = jnp.where(valid, h_hi, big32)
+        k_lo = jnp.where(valid, h_lo, big32)
         iota = jnp.arange(capacity, dtype=jnp.int32)
-        sorted_h, _, perm = jax.lax.sort(
-            (sort_h, (~valid).astype(jnp.int8), iota), num_keys=2)
+        sorted_hi, _, _, perm = jax.lax.sort(
+            (k_hi, k_lo, (~valid).astype(jnp.int8), iota), num_keys=3)
         valid_count = jnp.sum(valid, dtype=jnp.int32)
+        # top-B-bits bucket offsets over the sorted order
+        B = _bucket_bits(capacity)
+        n_buckets = 1 << B
+        sorted_bucket = (sorted_hi >> jnp.uint32(32 - B)).astype(jnp.int32)
+        in_valid = iota < valid_count
+        seg = jnp.where(in_valid, sorted_bucket, n_buckets)
+        counts = jax.ops.segment_sum(
+            jnp.ones((capacity,), jnp.int32), seg,
+            num_segments=n_buckets + 1)[:n_buckets]
+        bucket_table = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(counts, dtype=jnp.int32)])
         prefixes = []
         for c in payload:
             if isinstance(c, (StringColumn, ArrayColumn)):
@@ -90,20 +117,20 @@ class BuildTable:
                 sorted_lens = jnp.where(iota < valid_count, lens[perm], 0)
                 prefixes.append(jnp.concatenate(
                     [jnp.zeros((1,), jnp.int64), jnp.cumsum(sorted_lens)]))
-        return BuildTable(sorted_h, perm, valid_count,
+        return BuildTable(bucket_table, perm, valid_count,
                           num_rows, key_cols, payload, capacity, prefixes)
 
 
 def _bt_flatten(bt: BuildTable):
-    return ((bt.sorted_hash, bt.perm, bt.valid_count, bt.num_rows,
+    return ((bt.bucket_table, bt.perm, bt.valid_count, bt.num_rows,
              tuple(bt.key_cols), tuple(bt.payload), bt.payload_prefix),
             bt.capacity)
 
 
 def _bt_unflatten(capacity, children):
-    (sorted_hash, perm, valid_count, num_rows, key_cols, payload,
+    (bucket_table, perm, valid_count, num_rows, key_cols, payload,
      payload_prefix) = children
-    return BuildTable(sorted_hash, perm, valid_count, num_rows,
+    return BuildTable(bucket_table, perm, valid_count, num_rows,
                       list(key_cols), list(payload), capacity,
                       payload_prefix)
 
@@ -113,13 +140,16 @@ jax.tree_util.register_pytree_node(BuildTable, _bt_flatten, _bt_unflatten)
 
 def probe_counts(build: BuildTable, stream_keys: Sequence[Column],
                  stream_rows, stream_cap: int):
-    """Per-stream-row candidate range (lo, hi) in the sorted build table."""
+    """Per-stream-row candidate range (lo, hi) in the bucketed build
+    table: two offset-table gathers; bucket-mates with different keys
+    are dropped by the key-verify pass downstream."""
     valid = _keys_valid(stream_keys, stream_rows, stream_cap)
     h = xxhash64_batch(list(stream_keys), seed=JOIN_HASH_SEED)
     h_u = jax.lax.bitcast_convert_type(h, jnp.uint64)
-    lo = jnp.searchsorted(build.sorted_hash, h_u, side="left").astype(jnp.int32)
-    hi = jnp.searchsorted(build.sorted_hash, h_u, side="right").astype(jnp.int32)
-    hi = jnp.minimum(hi, build.valid_count)
+    B = _bucket_bits(build.capacity)
+    b = (h_u >> jnp.uint64(64 - B)).astype(jnp.int32)
+    lo = build.bucket_table[b]
+    hi = jnp.minimum(build.bucket_table[b + 1], build.valid_count)
     lo = jnp.minimum(lo, hi)
     counts = jnp.where(valid, hi - lo, 0)
     return lo, counts, valid
@@ -136,8 +166,18 @@ def expand_candidates(lo, counts, out_capacity: int):
     # (review finding r1)
     cum = jnp.cumsum(counts.astype(jnp.int64))  # inclusive
     total = cum[-1] if counts.shape[0] else jnp.int64(0)
-    i = jnp.arange(out_capacity, dtype=jnp.int64)
-    stream_idx = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+    if out_capacity < (1 << 31):
+        # the host already sized out_capacity from the true total, so
+        # every in-range value fits int32 — emulated-i64 binary search is
+        # ~10x the cost of i32 on v5e (clip keeps out-of-range safe)
+        cum32 = jnp.clip(cum, 0, (1 << 31) - 1).astype(jnp.int32)
+        i32 = jnp.arange(out_capacity, dtype=jnp.int32)
+        stream_idx = jnp.searchsorted(cum32, i32,
+                                      side="right").astype(jnp.int32)
+        i = i32.astype(jnp.int64)
+    else:
+        i = jnp.arange(out_capacity, dtype=jnp.int64)
+        stream_idx = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
     in_range = i < total
     safe_stream = jnp.clip(stream_idx, 0, counts.shape[0] - 1)
     before = cum[safe_stream] - counts[safe_stream]

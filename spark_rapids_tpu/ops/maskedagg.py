@@ -309,7 +309,12 @@ def _slot_reduce_all(op: str, seg, col: Optional[Column], positions,
             sums = [jnp.sum(jnp.where(v, lane[:, None], jnp.int64(0)),
                             axis=0)
                     for lane in D.limb16_lanes(h, l)]
-            return D.combine_limb_sums(sums), has
+            negs = jnp.sum(v & (h < 0)[:, None], axis=0,
+                           dtype=jnp.int64)
+            rh, rl, over = D.combine_limb_sums_checked(sums, negs)
+            any_sat = jnp.any(v & D.is_saturated(h, l)[:, None], axis=0)
+            rh, rl = D.saturate_sum(rh, rl, over, any_sat)
+            return (rh, rl), has
         data = col.data
         acc = data.astype(jnp.float64) \
             if jnp.issubdtype(data.dtype, jnp.floating) \
@@ -365,8 +370,12 @@ def _slot_reduce(op: str, m, col: Optional[Column], positions,
             h, l = _decimal_limbs(col)
             sums = [jnp.sum(jnp.where(v, lane, jnp.int64(0)))
                     for lane in D.limb16_lanes(h, l)]
-            return D.combine_limb_sums(
-                [s[None] for s in sums]), has  # (1,)-shaped limb pair
+            negs = jnp.sum(v & (h < 0), dtype=jnp.int64)[None]
+            rh, rl, over = D.combine_limb_sums_checked(
+                [s[None] for s in sums], negs)  # (1,)-shaped limb pair
+            any_sat = jnp.any(v & D.is_saturated(h, l))[None]
+            rh, rl = D.saturate_sum(rh, rl, over, any_sat)
+            return (rh, rl), has
         data = col.data
         acc = data.astype(jnp.float64) \
             if jnp.issubdtype(data.dtype, jnp.floating) \

@@ -1,0 +1,110 @@
+"""Percentile kernels over array-of-values aggregation buffers.
+
+Reference analog: GpuPercentile / GpuApproximatePercentile
+(aggregate/GpuApproximatePercentile.scala over the JNI Histogram /
+cuDF t-digest). The TPU build computes both EXACTLY: percentile
+aggregates buffer their group's values as a list column (the collect
+machinery), and evaluation segment-sorts the flat child once and picks
+rank positions — approx_percentile therefore returns exact quantiles,
+which satisfies (and beats) its accuracy contract. The reference needs
+the sketch because cuDF merges per-batch; here the merge pass already
+concatenates each group's values."""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import ArrayColumn, Column
+from ..types import DOUBLE
+from .sort import _numeric_order_key
+
+
+def _sorted_child(arr: ArrayColumn):
+    """Stable sort of the child within each row's segment; returns the
+    sorted child data (same offsets)."""
+    ccap = arr.child.capacity
+    epos = jnp.arange(ccap, dtype=jnp.int32)
+    erow = jnp.searchsorted(arr.offsets, epos,
+                            side="right").astype(jnp.int32) - 1
+    erow = jnp.clip(erow, 0, arr.capacity - 1)
+    in_use = (epos < arr.offsets[arr.capacity]) & arr.child.validity
+    row_key = jnp.where(in_use, erow, jnp.int32(1 << 30))
+    lane = _numeric_order_key(arr.child)
+    _, _, perm = jax.lax.sort((row_key, lane, epos), num_keys=2)
+    return arr.child.data[perm]
+
+
+def percentile_of_arrays(arr: ArrayColumn,
+                         percentages: Union[float, Sequence[float]],
+                         interpolate: bool) -> Column:
+    """Per row (group): the percentile(s) of its array values.
+
+    interpolate=True  -> Spark `percentile` (DOUBLE, linear interpolation
+                         at rank p*(n-1));
+    interpolate=False -> Spark `approx_percentile` (input type, element
+                         at rank ceil(p*n)-1).
+    Scalar `percentages` yields a scalar column; a list yields an array
+    column (one element per percentage)."""
+    scalar = not isinstance(percentages, (list, tuple))
+    ps = [float(percentages)] if scalar else [float(p) for p in percentages]
+    cap = arr.capacity
+    sorted_vals = _sorted_child(arr)
+    starts = arr.offsets[:-1]
+    lens = (arr.offsets[1:] - starts)
+    # valid element count per row (nulls sorted to the tail by the
+    # validity-aware in_use mask above... nulls are excluded from
+    # percentile entirely, so count only valid elements)
+    ccap = arr.child.capacity
+    epos = jnp.arange(ccap, dtype=jnp.int32)
+    erow = jnp.clip(jnp.searchsorted(arr.offsets, epos, side="right")
+                    .astype(jnp.int32) - 1, 0, cap - 1)
+    in_use = (epos < arr.offsets[cap]) & arr.child.validity
+    nvalid = jax.ops.segment_sum(in_use.astype(jnp.int32), erow,
+                                 num_segments=cap)
+    # valid elements of row i occupy sorted positions
+    # [valid_start[i], valid_start[i] + nvalid[i]) where valid_start is
+    # the exclusive cumsum of nvalid (the segment sort moves invalid
+    # elements to the global tail, compacting valid ones to a prefix)
+    valid_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(nvalid, dtype=jnp.int32)])[:-1]
+
+    outs = []
+    valids = []
+    for p in ps:
+        has = nvalid > 0
+        n = jnp.maximum(nvalid, 1)
+        if interpolate:
+            rank = p * (n - 1).astype(jnp.float64)
+            lo_k = jnp.floor(rank).astype(jnp.int32)
+            hi_k = jnp.ceil(rank).astype(jnp.int32)
+            frac = rank - lo_k.astype(jnp.float64)
+            lo_i = jnp.clip(valid_start + lo_k, 0, ccap - 1)
+            hi_i = jnp.clip(valid_start + hi_k, 0, ccap - 1)
+            lo_v = sorted_vals[lo_i].astype(jnp.float64)
+            hi_v = sorted_vals[hi_i].astype(jnp.float64)
+            outs.append(lo_v + frac * (hi_v - lo_v))
+        else:
+            k = jnp.ceil(p * n.astype(jnp.float64)).astype(jnp.int32) - 1
+            k = jnp.clip(k, 0, n - 1)
+            idx = jnp.clip(valid_start + k, 0, ccap - 1)
+            outs.append(sorted_vals[idx])
+        valids.append(arr.validity & has)
+
+    out_t = DOUBLE if interpolate else arr.dtype.element_type
+    if scalar:
+        data = jnp.where(valids[0], outs[0],
+                         jnp.zeros((), outs[0].dtype))
+        return Column(data, valids[0], out_t)
+    from ..types import ArrayType
+    from .maps import interleave_columns
+    cols = [Column(jnp.where(v, o, jnp.zeros((), o.dtype)), v, out_t)
+            for o, v in zip(outs, valids)]
+    child = interleave_columns(cols)
+    off = jnp.arange(cap + 1, dtype=jnp.int32) * len(ps)
+    # a group with no valid values yields a NULL array, not [NULL, ...]
+    row_valid = arr.validity & (nvalid > 0)
+    return ArrayColumn(child, off, row_valid, ArrayType(out_t))

@@ -480,6 +480,13 @@ def estimate_plan_size(plan: L.LogicalPlan) -> Optional[int]:
         if any(s is None for s in sizes):
             return None
         return sum(sizes)
+    if isinstance(plan, L.LogicalAggregate):
+        if not plan.group_exprs:
+            return 256  # grand aggregate: exactly one tiny row
+        # keyed aggregates shrink to the key cardinality — unknown here;
+        # returning None routes joins over this subtree to the runtime-
+        # measured AdaptiveJoinExec instead of "never broadcast"
+        return None
     return None
 
 
@@ -879,6 +886,18 @@ class PlanMeta(BaseMeta):
                                                    n_parts)
             if out is not None:
                 return out
+        if thr >= 0 and p.left_keys and (size_r is None or size_l is None):
+            # UNKNOWN sizes go through the symmetric adaptive join: both
+            # sides spillable, runtime build-side choice by MEASURED
+            # bytes, sub-partitioning when both sides are huge (reference
+            # GpuShuffledSymmetricHashJoinExec:354; sizes come from the exec
+            # itself instead of AQE statistics). Known sizes keep the
+            # streaming HashJoinExec below — re-measuring them would
+            # break the probe-side pipeline for no information.
+            from ..exec.joins import AdaptiveJoinExec
+            return AdaptiveJoinExec(kids[0], kids[1], p.left_keys,
+                                    p.right_keys, p.join_type,
+                                    p.condition, self.conf)
         return HashJoinExec(kids[0], kids[1], p.left_keys, p.right_keys,
                             p.join_type, condition=p.condition)
 

@@ -1,0 +1,104 @@
+"""AdaptiveJoinExec: runtime-measured build side (AQE-lite, r2 item 10)."""
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.exec.joins import AdaptiveJoinExec
+from spark_rapids_tpu.types import LONG, STRING, Schema, StructField
+
+
+def _find_adaptive(e):
+    from spark_rapids_tpu.exec.joins import AdaptiveJoinExec
+    if isinstance(e, AdaptiveJoinExec):
+        return e
+    for c in e.children:
+        got = _find_adaptive(c)
+        if got is not None:
+            return got
+    return None
+
+
+def _sess_dfs(sess):
+    left = sess.from_pydict(
+        {"k": [1, 2, 3, 4, 2], "x": [10, 20, 30, 40, 21]},
+        schema=Schema((StructField("k", LONG), StructField("x", LONG))))
+    right = sess.from_pydict(
+        {"k": [2, 3, 2, 9], "g": ["p", "q", "r", "z"]},
+        schema=Schema((StructField("k", LONG), StructField("g", STRING))))
+    return left, right
+
+
+def test_post_aggregation_build_goes_adaptive():
+    # a keyed aggregate has unknown plan-time size: the join over it must
+    # pick its strategy at runtime instead of never broadcasting.
+    # broadcast threshold 0 keeps the (known-size) left side from being
+    # broadcast, isolating the adaptive path... threshold must stay >= 0
+    # for adaptive planning, so use 1 byte
+    sess = TpuSession(conf={
+        "spark.rapids.sql.broadcastSizeThreshold": "1"})
+    left, right = _sess_dfs(sess)
+    small = right.group_by("k").agg((F.count(), "n"))
+    q = left.join(small, on="k", how="inner")
+    tree = q._exec().tree_string()
+    assert "AdaptiveJoinExec" in tree, tree
+    out = sorted(q.collect())
+    assert out == [(2, 20, 2), (2, 21, 2), (3, 30, 1)]
+
+
+def test_adaptive_join_measures_and_runs():
+    sess = TpuSession()
+    left, right = _sess_dfs(sess)
+    agg = right.group_by("k").agg((F.count(), "n"))
+    q = left.join(agg, on="k", how="left_outer")
+    ex = q._exec()
+    out = sorted(q.collect(), key=lambda r: (r[0], r[1]))
+    assert out == [(1, 10, None), (2, 20, 2), (2, 21, 2), (3, 30, 1),
+                   (4, 40, None)]
+
+
+def test_symmetric_build_side_choice():
+    # inner join, left much smaller: the runtime measurement must build
+    # LEFT (semantics-preserving swap). Post-aggregation sides make the
+    # plan-time sizes unknown, which is what routes to the adaptive exec
+    # (known sizes keep the streaming join).
+    sess = TpuSession(conf={
+        "spark.rapids.sql.broadcastSizeThreshold": "1"})
+    left = sess.from_pydict(
+        {"k": [2, 3], "x": [20, 30]},
+        schema=Schema((StructField("k", LONG), StructField("x", LONG)))
+    ).group_by("k").agg((F.sum(F.col("x")), "sx"))
+    right = sess.from_pydict(
+        {"k": list(range(600)), "y": list(range(600))},
+        schema=Schema((StructField("k", LONG), StructField("y", LONG)))
+    ).group_by("k").agg((F.sum(F.col("y")), "sy"))
+    q = left.join(right, on="k", how="inner")
+    ex = q._exec()
+    assert "AdaptiveJoinExec" in ex.tree_string()
+    out = sorted(ex.collect())
+    assert out == [(2, 20, 2), (3, 30, 3)]
+    aj = _find_adaptive(ex)
+    assert aj is not None and aj._choice == "build_left", aj._choice
+
+
+def test_symmetric_both_huge_subpartitions_with_spill():
+    # both sides over the (tiny, forced) sub-partition threshold: the
+    # adaptive join must route through sub-partitioned exchanges
+    sess = TpuSession(conf={
+        "spark.rapids.sql.broadcastSizeThreshold": "1",
+        "spark.rapids.sql.join.subPartitionThreshold": "4096",
+        "spark.rapids.shuffle.mode": "MULTITHREADED"})
+    n = 3000
+    # aggregates make both sides' sizes UNKNOWN at plan time, so the
+    # runtime-measuring adaptive exec owns the decision
+    left = sess.from_pydict(
+        {"k": [i % 500 for i in range(n)], "x": list(range(n))},
+        schema=Schema((StructField("k", LONG), StructField("x", LONG)))
+    ).group_by("k").agg((F.sum(F.col("x")), "sx"))
+    right = sess.from_pydict(
+        {"k": [i % 500 for i in range(n)], "y": list(range(n))},
+        schema=Schema((StructField("k", LONG), StructField("y", LONG)))
+    ).group_by("k").agg((F.sum(F.col("y")), "sy"))
+    q = left.join(right, on="k", how="inner")
+    ex = q._exec()
+    out = ex.collect()
+    assert len(out) == 500
+    aj = _find_adaptive(ex)
+    assert aj is not None and aj._choice == "subpartition",         (aj and aj._choice, aj and aj._measured)

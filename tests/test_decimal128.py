@@ -147,14 +147,28 @@ def test_grand_aggregate_decimal_sum():
 
 
 def test_sum_overflow_past_result_precision_is_null():
-    # DECIMAL(1,0): sum type DECIMAL(11,0); 12 billion 9s overflow it
-    t = DecimalType(1, 0)
+    # DECIMAL(35,0): sum type DECIMAL(38,0) = 10^38 bound; twelve values
+    # of 9e34 total 1.08e36 (fits), but 9e34 * 1200 = 1.08e38 overflows
+    t = DecimalType(35, 0)
     sess = TpuSession()
-    n = 200
-    df = sess.from_pydict({"v": [dec.Decimal(9)] * n},
+    small = sess.from_pydict({"v": [dec.Decimal(9 * 10 ** 34)] * 12},
+                             schema=Schema((StructField("v", t),)))
+    assert small.agg((F.sum(F.col("v")), "s")).collect() == \
+        [(12 * 9 * 10 ** 34,)]
+    big = sess.from_pydict({"v": [dec.Decimal(9 * 10 ** 34)] * 1200},
+                           schema=Schema((StructField("v", t),)))
+    assert big.agg((F.sum(F.col("v")), "s")).collect() == [(None,)]
+
+
+def test_sum_overflow_past_128_bits_is_null_not_aliased():
+    # the 192-bit checked combine: a true sum past 2^127 must NOT wrap
+    # mod 2^128 back into range — it saturates and evaluates to NULL
+    t = DecimalType(38, 0)
+    v = dec.Decimal(85070591730234615865843651857942052864)  # 2^126
+    sess = TpuSession()
+    df = sess.from_pydict({"v": [v] * 4},
                           schema=Schema((StructField("v", t),)))
-    out = df.agg((F.sum(F.col("v")), "s")).collect()
-    assert out == [(9 * n,)]  # fits (11,0): stays exact
+    assert df.agg((F.sum(F.col("v")), "s")).collect() == [(None,)]
 
 
 def test_divide_into_decimal128_exact():

@@ -232,3 +232,50 @@ def test_shuffle_plan_exchange_disabled():
     assert "ShuffleExchangeExec" not in tree
     assert _sorted(df.group_by("k").agg((F.sum("v"), "sv")).collect()) \
         == [(1, 3), (2, 3)]
+
+
+def _kv_schema():
+    from spark_rapids_tpu.types import LONG, STRING, Schema, StructField
+    return Schema((StructField("k", LONG), StructField("tag", STRING)))
+
+
+@needs_8
+def test_exchange_streams_in_bounded_rounds():
+    # input many times the per-round budget: the exchange must run
+    # MULTIPLE rounds with spillable staging, and results stay exact
+    single, _ = _both_sessions()
+    dist = TpuSession({"spark.rapids.sql.broadcastSizeThreshold": "-1",
+                       "spark.rapids.sql.exchange.roundBytes": "16384",
+                       # keep the upstream coalescer from folding the
+                       # whole input into one batch before the exchange
+                       "spark.rapids.sql.batchSizeBytes": "8192"},
+                      mesh_devices=8)
+    rng = np.random.default_rng(5)
+    data, sch = _data(rng, n=1600), _schema()
+    # joins exchange RAW rows (a partial aggregate would collapse to one
+    # tiny state batch before the exchange)
+    left = dist.from_pydict(data, sch, batch_rows=64)
+    right = dist.from_pydict(
+        {"k": list(range(7)), "tag": [f"t{i}" for i in range(7)]},
+        _kv_schema(), batch_rows=64)
+    q = left.join(right, on="k", how="inner")
+    ex = q._exec()
+    got = _sorted(ex.collect())
+    sl = single.from_pydict(data, sch, batch_rows=64)
+    sr = single.from_pydict(
+        {"k": list(range(7)), "tag": [f"t{i}" for i in range(7)]},
+        _kv_schema(), batch_rows=64)
+    ref = _sorted(sl.join(sr, on="k", how="inner").collect())
+    assert got == ref
+
+    def find_exchanges(e, out):
+        from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+        if isinstance(e, ShuffleExchangeExec):
+            out.append(e)
+        for c in e.children:
+            find_exchanges(c, out)
+        return out
+    exchanges = find_exchanges(ex, [])
+    assert exchanges, "no exchange planned"
+    rounds = [getattr(x, "rounds", 0) for x in exchanges]
+    assert max(rounds) > 1, rounds  # the big side ran multiple rounds

@@ -1,0 +1,74 @@
+"""percentile / approx_percentile aggregates (exact computation)."""
+import random
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.types import LONG, STRING, Schema, StructField
+
+
+def _df(sess, keys, vals):
+    return sess.from_pydict(
+        {"k": keys, "v": vals},
+        schema=Schema((StructField("k", STRING), StructField("v", LONG))))
+
+
+def test_percentile_group_by():
+    sess = TpuSession()
+    keys = ["a"] * 10 + ["b"] * 5 + ["c"]
+    vals = list(range(1, 11)) + [10, 20, 30, 40, 50] + [7]
+    out = sorted(_df(sess, keys, vals).group_by("k").agg(
+        (F.percentile(F.col("v"), 0.5), "p50"),
+        (F.approx_percentile(F.col("v"), 0.5), "ap50"),
+        (F.percentile(F.col("v"), [0.0, 0.5, 1.0]), "pm")).collect())
+    assert out[0] == ("a", 5.5, 5, [1.0, 5.5, 10.0])
+    assert out[1] == ("b", 30.0, 30, [10.0, 30.0, 50.0])
+    assert out[2] == ("c", 7.0, 7, [7.0, 7.0, 7.0])
+
+
+def test_percentile_nulls():
+    sess = TpuSession()
+    out = sorted(_df(sess, ["x", "x", "y"], [None, 4, None])
+                 .group_by("k")
+                 .agg((F.percentile(F.col("v"), 0.5), "p")).collect())
+    assert out == [("x", 4.0), ("y", None)]
+
+
+def test_grand_approx_percentile():
+    sess = TpuSession()
+    vals = [9, 1, 7, 3, 5, 8, 2, 6, 4, 10]
+    out = _df(sess, ["g"] * 10, vals).agg(
+        (F.approx_percentile(F.col("v"), 0.25), "q1")).collect()
+    assert out == [(sorted(vals)[2],)]  # ceil(0.25*10)-1 = index 2
+
+
+def test_percentile_fuzz_vs_oracle():
+    rng = random.Random(11)
+    sess = TpuSession()
+    keys = [rng.choice("pqr") for _ in range(120)]
+    vals = [None if rng.random() < 0.15 else rng.randint(-50, 50)
+            for _ in range(120)]
+    out = dict((r[0], (r[1], r[2])) for r in
+               _df(sess, keys, vals).group_by("k").agg(
+                   (F.percentile(F.col("v"), 0.3), "p"),
+                   (F.approx_percentile(F.col("v"), 0.3), "ap"))
+               .collect())
+    import math
+    for k in "pqr":
+        xs = sorted(v for kk, v in zip(keys, vals)
+                    if kk == k and v is not None)
+        if not xs:
+            assert out[k] == (None, None)
+            continue
+        rank = 0.3 * (len(xs) - 1)
+        lo, hi = math.floor(rank), math.ceil(rank)
+        interp = xs[lo] + (rank - lo) * (xs[hi] - xs[lo])
+        nearest = xs[max(0, math.ceil(0.3 * len(xs)) - 1)]
+        assert abs(out[k][0] - interp) < 1e-9, k
+        assert out[k][1] == nearest, k
+
+
+def test_multi_percentage_all_null_group_is_null():
+    sess = TpuSession()
+    out = sorted(_df(sess, ["x", "y"], [None, 3]).group_by("k").agg(
+        (F.percentile(F.col("v"), [0.25, 0.75]), "p")).collect())
+    assert out == [("x", None), ("y", [3.0, 3.0])]

@@ -225,3 +225,33 @@ def test_bounded_min_max_empty_frame():
     plan = WindowExec([(WindowAgg("min", col("v")).over(spec), "mn")],
                       scan(data))
     assert [r[3] for r in plan.collect()] == [None, 7, 3, 3]
+
+
+def test_partition_aligned_chunked_window():
+    # >MERGE_FAN_IN child batches engage the out-of-core sorted stream:
+    # the window must emit MULTIPLE batches (concat-all is gone) with
+    # partitions never split across outputs, and results must equal the
+    # single-batch reference run
+    import random
+    rng = random.Random(13)
+    n_rows = 600
+    ks = [rng.randint(0, 40) for _ in range(n_rows)]
+    vs = [rng.randint(-100, 100) for _ in range(n_rows)]
+    sch = Schema((StructField("k", LONG), StructField("v", LONG)))
+
+    def mk_plan(num_batches):
+        per = n_rows // num_batches
+        batches = [ColumnarBatch.from_pydict(
+            {"k": ks[i * per:(i + 1) * per], "v": vs[i * per:(i + 1) * per]},
+            sch) for i in range(num_batches)]
+        spec = window(partition_by=["k"], order_by=["v"],
+                      frame=WindowFrame.rows(None, 0))
+        return WindowExec([(WindowAgg("sum", col("v")).over(spec), "s")],
+                          InMemoryScanExec(batches, sch))
+
+    chunked = mk_plan(12)
+    outs = list(chunked.execute())
+    assert len(outs) > 1, "expected multiple output batches"
+    got = sorted(r for b in outs for r in b.to_pylist())
+    ref = sorted(r for b in mk_plan(1).execute() for r in b.to_pylist())
+    assert got == ref
