@@ -839,6 +839,8 @@ class Levenshtein(_HostString):
 class Base64Encode(_HostString):
     """base64(bin) (reference GpuBase64): input str is encoded utf-8."""
 
+    HOST_ONLY = False  # device codec kernels
+
     def __init__(self, child: Expression):
         self.children = (child,)
 
@@ -856,9 +858,15 @@ class Base64Encode(_HostString):
         raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
         return _b.b64encode(raw).decode("ascii")
 
+    def columnar_eval(self, batch):
+        from ..ops.codecs import base64_encode
+        return base64_encode(self.children[0].columnar_eval(batch))
+
 
 class UnBase64(_HostString):
     """unbase64(str) -> binary (reference GpuUnBase64)."""
+
+    HOST_ONLY = False  # device codec kernels
 
     def __init__(self, child: Expression):
         self.children = (child,)
@@ -870,6 +878,10 @@ class UnBase64(_HostString):
     def data_type(self):
         from ..types import BINARY
         return BINARY
+
+    def columnar_eval(self, batch):
+        from ..ops.codecs import base64_decode
+        return base64_decode(self.children[0].columnar_eval(batch))
 
     def host_eval_row(self, v):
         import base64 as _b
@@ -890,6 +902,8 @@ class UnBase64(_HostString):
 class Hex(_HostString):
     """hex(long | str): uppercase hex, Spark's minimal-width long form."""
 
+    HOST_ONLY = False  # device codec kernels
+
     def __init__(self, child: Expression):
         self.children = (child,)
 
@@ -899,6 +913,14 @@ class Hex(_HostString):
     @property
     def data_type(self):
         return STRING
+
+    def columnar_eval(self, batch):
+        from ..columnar.column import StringColumn
+        from ..ops.codecs import hex_encode, hex_encode_long
+        c = self.children[0].columnar_eval(batch)
+        if isinstance(c, StringColumn):
+            return hex_encode(c)
+        return hex_encode_long(c)
 
     def host_eval_row(self, v):
         if v is None:
@@ -914,8 +936,14 @@ class Unhex(_HostString):
     """unhex(str) -> binary; NULL on malformed input (odd-length input
     gets a leading 0, like Spark)."""
 
+    HOST_ONLY = False  # device codec kernels
+
     def __init__(self, child: Expression):
         self.children = (child,)
+
+    def columnar_eval(self, batch):
+        from ..ops.codecs import hex_decode
+        return hex_decode(self.children[0].columnar_eval(batch))
 
     def with_children(self, cs):
         return Unhex(cs[0])

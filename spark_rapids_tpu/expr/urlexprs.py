@@ -1,7 +1,6 @@
-"""URL expressions (reference GpuParseUrl.scala + JNI ParseURI). Host
-row-engine tier, like the JSON family — the reference uses a dedicated
-CUDA URI parser; this engine routes parse_url through the CPU fallback
-transitions until a device kernel exists."""
+"""URL expressions (reference GpuParseUrl.scala + JNI ParseURI).
+Literal part/key run the byte-parallel device kernel (ops/url.py);
+non-literal parts keep the host row tier."""
 
 from __future__ import annotations
 
@@ -18,7 +17,7 @@ _PARTS = ("HOST", "PATH", "QUERY", "REF", "PROTOCOL", "FILE",
 class ParseUrl(Expression):
     """parse_url(url, part[, key]) with Spark's part names."""
 
-    HOST_ONLY = True
+    HOST_ONLY = False  # device kernel for literal part/key
 
     def __init__(self, child: Expression, part, key=None):
         self.children = (child,)
@@ -69,6 +68,17 @@ class ParseUrl(Expression):
                                  if p.password is not None else "")
         return None
 
+    @property
+    def device_supported(self) -> bool:
+        """Literal part/key run the byte-parallel device kernel
+        (ops/url.py)."""
+        return isinstance(self.part, str) and (
+            self.key is None or isinstance(self.key, str))
+
     def columnar_eval(self, batch):
-        raise NotImplementedError(
-            "parse_url runs on the host tier (CPU fallback)")
+        from ..ops.url import parse_url
+        if not self.device_supported:
+            raise NotImplementedError(
+                "parse_url with non-literal part runs on the host tier")
+        c = self.children[0].columnar_eval(batch)
+        return parse_url(c, self.part, self.key)

@@ -94,7 +94,8 @@ def _first_occurrence(col: Column, group_key, keep, capacity: int):
     the dedup primitive behind collect_set. The dropped-row sentinel is
     far above any group id (group ids may exceed `capacity` when the
     group domain is the parent batch of a child buffer)."""
-    lanes = _dedup_value_lanes(col)
+    from .sort import _split_u64_lanes
+    lanes = _split_u64_lanes(_dedup_value_lanes(col))
     iota = jnp.arange(capacity, dtype=jnp.int32)
     big = jnp.int32(1 << 30)
     gk = jnp.where(keep, group_key, big).astype(jnp.int32)

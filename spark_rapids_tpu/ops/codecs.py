@@ -1,0 +1,216 @@
+"""Device base64 / hex codecs over the (offsets, bytes) layout.
+
+Reference analog: GpuBase64/GpuUnBase64/GpuHex/GpuUnhex over cuDF string
+kernels. Byte-parallel emit: every OUTPUT byte computes its source group
+arithmetically — no per-row loops, one gather per output byte lane."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn, bucket_capacity
+from ..types import BINARY, STRING
+from .strings import _rebuild_offsets, _row_of_byte, string_lengths
+
+_B64 = b"ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/"
+_HEXU = b"0123456789ABCDEF"
+
+
+def base64_encode(col: StringColumn) -> StringColumn:
+    """base64(bin): 3 source bytes -> 4 output chars, '=' padded."""
+    lens = string_lengths(col)
+    out_lens = ((lens + 2) // 3) * 4
+    new_off = _rebuild_offsets(jnp.where(col.validity, out_lens, 0))
+    # worst case: ceil(len/3)*4 <= 4*len/3 + 4 per row
+    out_cap = bucket_capacity(
+        max((int(col.byte_capacity) * 4) // 3 + 4 * col.capacity, 1))
+    opos = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_off, opos, side="right")
+                   .astype(jnp.int32) - 1, 0, col.capacity - 1)
+    j = opos - new_off[row]              # output position within row
+    g, k = j // 4, j % 4                 # 4-char group, char index
+    src0 = col.offsets[row] + 3 * g
+    bcap = col.byte_capacity
+
+    def byte_at(off):
+        p = src0 + off
+        ok = (3 * g + off) < lens[row]
+        return jnp.where(ok, col.data[jnp.clip(p, 0, bcap - 1)],
+                         jnp.uint8(0)), ok
+
+    b0, ok0 = byte_at(0)
+    b1, ok1 = byte_at(1)
+    b2, ok2 = byte_at(2)
+    b0i = b0.astype(jnp.int32)
+    b1i = b1.astype(jnp.int32)
+    b2i = b2.astype(jnp.int32)
+    sextet = jnp.select(
+        [k == 0, k == 1, k == 2],
+        [b0i >> 2,
+         ((b0i & 3) << 4) | (b1i >> 4),
+         ((b1i & 15) << 2) | (b2i >> 6)],
+        b2i & 63)
+    table = jnp.asarray(bytearray(_B64), jnp.uint8)
+    ch = table[jnp.clip(sextet, 0, 63)]
+    # '=' padding: char 2 pads when byte1 absent; char 3 when byte2 absent
+    pad = ((k == 2) & ~ok1) | ((k == 3) & ~ok2)
+    ch = jnp.where(pad, jnp.uint8(ord("=")), ch)
+    in_use = opos < new_off[-1]
+    return StringColumn(jnp.where(in_use, ch, jnp.uint8(0)), new_off,
+                        col.validity, STRING)
+
+
+def _b64_val(b):
+    v = jnp.full(b.shape, jnp.int32(-1))
+    v = jnp.where((b >= ord("A")) & (b <= ord("Z")),
+                  b.astype(jnp.int32) - ord("A"), v)
+    v = jnp.where((b >= ord("a")) & (b <= ord("z")),
+                  b.astype(jnp.int32) - ord("a") + 26, v)
+    v = jnp.where((b >= ord("0")) & (b <= ord("9")),
+                  b.astype(jnp.int32) - ord("0") + 52, v)
+    v = jnp.where(b == ord("+"), jnp.int32(62), v)
+    v = jnp.where(b == ord("/"), jnp.int32(63), v)
+    return v
+
+
+def base64_decode(col: StringColumn) -> StringColumn:
+    """unbase64(str) -> BINARY; NULL on malformed input (non-alphabet
+    chars, bad length, '=' anywhere but the tail — java.util.Base64
+    semantics, matching the host tier)."""
+    cap = col.capacity
+    bcap = col.byte_capacity
+    lens = string_lengths(col)
+    data = col.data
+    pos = jnp.arange(bcap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    intra = pos - col.offsets[row]
+    in_use = pos < col.offsets[-1]
+
+    is_pad = (data == jnp.uint8(ord("="))) & in_use
+    val = _b64_val(data)
+    # count trailing '=' (only 1 or 2 allowed, only at the very end)
+    pad_cnt = jax.ops.segment_sum(is_pad.astype(jnp.int32), row,
+                                  num_segments=cap)
+    last_non_pad = jnp.maximum(jax.ops.segment_max(
+        jnp.where(in_use & ~is_pad, intra, -1), row, num_segments=cap),
+        -1)  # empty rows: segment_max identity is INT32_MIN
+    pads_at_tail = last_non_pad + 1 + pad_cnt == lens
+    bad_char = jax.ops.segment_max(
+        (in_use & ~is_pad & (val < 0)).astype(jnp.int32), row,
+        num_segments=cap) > 0
+    ok = col.validity & (lens % 4 == 0) & (pad_cnt <= 2) & pads_at_tail \
+        & ~bad_char
+    n_data = lens - pad_cnt
+    out_lens = jnp.where(ok, (n_data * 3) // 4, 0)
+    # 4 chars -> 3 bytes exactly when unpadded; padding drops 1-2 bytes
+    new_off = _rebuild_offsets(out_lens)
+    out_cap = bucket_capacity(max(int(bcap), 1))
+    opos = jnp.arange(out_cap, dtype=jnp.int32)
+    orow = jnp.clip(jnp.searchsorted(new_off, opos, side="right")
+                    .astype(jnp.int32) - 1, 0, cap - 1)
+    j = opos - new_off[orow]
+    g, k = j // 3, j % 3
+    src0 = col.offsets[orow] + 4 * g
+
+    def v_at(off):
+        p = jnp.clip(src0 + off, 0, bcap - 1)
+        return jnp.clip(_b64_val(data[p]), 0, 63)
+
+    v0, v1, v2, v3 = v_at(0), v_at(1), v_at(2), v_at(3)
+    byte = jnp.select(
+        [k == 0, k == 1],
+        [(v0 << 2) | (v1 >> 4),
+         ((v1 & 15) << 4) | (v2 >> 2)],
+        ((v2 & 3) << 6) | v3)
+    in_use_o = opos < new_off[-1]
+    return StringColumn(
+        jnp.where(in_use_o, byte.astype(jnp.uint8), jnp.uint8(0)),
+        new_off, ok, BINARY)
+
+
+def hex_encode(col: StringColumn) -> StringColumn:
+    """hex(str/bin): two uppercase hex chars per byte."""
+    lens = string_lengths(col)
+    new_off = _rebuild_offsets(jnp.where(col.validity, lens * 2, 0))
+    out_cap = bucket_capacity(max(int(col.byte_capacity) * 2, 1))
+    opos = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_off, opos, side="right")
+                   .astype(jnp.int32) - 1, 0, col.capacity - 1)
+    j = opos - new_off[row]
+    src = jnp.clip(col.offsets[row] + j // 2, 0, col.byte_capacity - 1)
+    b = col.data[src].astype(jnp.int32)
+    nib = jnp.where(j % 2 == 0, b >> 4, b & 15)
+    table = jnp.asarray(bytearray(_HEXU), jnp.uint8)
+    ch = table[jnp.clip(nib, 0, 15)]
+    in_use = opos < new_off[-1]
+    return StringColumn(jnp.where(in_use, ch, jnp.uint8(0)), new_off,
+                        col.validity, STRING)
+
+
+def hex_encode_long(col: Column) -> StringColumn:
+    """hex(long): minimal-width uppercase hex of the UNSIGNED 64-bit
+    pattern (Spark: hex(-1) = 'FFFFFFFFFFFFFFFF')."""
+    cap = col.capacity
+    u = col.data.astype(jnp.uint64)
+    # number of hex digits: 16 - leading_zero_nibbles, min 1
+    ndig = jnp.ones((cap,), jnp.int32)
+    for d in range(2, 17):
+        ndig = jnp.where(u >= (jnp.uint64(1) << jnp.uint64(4 * (d - 1))),
+                         d, ndig)
+    new_off = _rebuild_offsets(jnp.where(col.validity, ndig, 0))
+    out_cap = bucket_capacity(max(cap * 16, 1))
+    opos = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_off, opos, side="right")
+                   .astype(jnp.int32) - 1, 0, cap - 1)
+    j = opos - new_off[row]
+    shift = (ndig[row] - 1 - j) * 4
+    nib = (u[row] >> jnp.clip(shift, 0, 63).astype(jnp.uint64)) \
+        & jnp.uint64(15)
+    table = jnp.asarray(bytearray(_HEXU), jnp.uint8)
+    ch = table[nib.astype(jnp.int32)]
+    in_use = opos < new_off[-1]
+    return StringColumn(jnp.where(in_use, ch, jnp.uint8(0)), new_off,
+                        col.validity, STRING)
+
+
+from .strings import hex_digit_val as _hex_val  # noqa: E402
+
+
+def hex_decode(col: StringColumn) -> StringColumn:
+    """unhex(str) -> BINARY; odd length gets an implicit leading 0;
+    NULL on any non-hex character (Spark semantics)."""
+    cap = col.capacity
+    bcap = col.byte_capacity
+    lens = string_lengths(col)
+    data = col.data
+    pos = jnp.arange(bcap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    in_use = pos < col.offsets[-1]
+    bad = jax.ops.segment_max(
+        (in_use & (_hex_val(data) < 0)).astype(jnp.int32), row,
+        num_segments=cap) > 0
+    ok = col.validity & ~bad
+    out_lens = jnp.where(ok, (lens + 1) // 2, 0)
+    new_off = _rebuild_offsets(out_lens)
+    out_cap = bucket_capacity(max(int(bcap), 1))
+    opos = jnp.arange(out_cap, dtype=jnp.int32)
+    orow = jnp.clip(jnp.searchsorted(new_off, opos, side="right")
+                    .astype(jnp.int32) - 1, 0, cap - 1)
+    j = opos - new_off[orow]
+    odd = (lens[orow] % 2) == 1
+    # source char indices for output byte j: (2j-1, 2j) when odd (char -1
+    # is the implicit leading 0), else (2j, 2j+1)
+    i_hi = jnp.where(odd, 2 * j - 1, 2 * j)
+    i_lo = jnp.where(odd, 2 * j, 2 * j + 1)
+    base = col.offsets[orow]
+    hi = jnp.where(i_hi >= 0,
+                   jnp.clip(_hex_val(
+                       data[jnp.clip(base + i_hi, 0, bcap - 1)]), 0, 15),
+                   0)
+    lo = jnp.clip(_hex_val(
+        data[jnp.clip(base + i_lo, 0, bcap - 1)]), 0, 15)
+    byte = ((hi << 4) | lo).astype(jnp.uint8)
+    in_use_o = opos < new_off[-1]
+    return StringColumn(jnp.where(in_use_o, byte, jnp.uint8(0)),
+                        new_off, ok, BINARY)

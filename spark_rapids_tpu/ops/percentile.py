@@ -32,9 +32,11 @@ def _sorted_child(arr: ArrayColumn):
     erow = jnp.clip(erow, 0, arr.capacity - 1)
     in_use = (epos < arr.offsets[arr.capacity]) & arr.child.validity
     row_key = jnp.where(in_use, erow, jnp.int32(1 << 30))
-    lane = _numeric_order_key(arr.child)
-    _, _, perm = jax.lax.sort((row_key, lane, epos), num_keys=2)
-    return arr.child.data[perm]
+    from .sort import _split_u64_lanes
+    lanes = _split_u64_lanes([_numeric_order_key(arr.child)])
+    out = jax.lax.sort(tuple([row_key] + lanes + [epos]),
+                       num_keys=1 + len(lanes))
+    return arr.child.data[out[-1]]
 
 
 def percentile_of_arrays(arr: ArrayColumn,

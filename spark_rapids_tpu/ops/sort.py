@@ -152,12 +152,27 @@ def order_key_lanes(columns: Sequence[Column], orders: Sequence[SortOrder],
     return lanes
 
 
+def _split_u64_lanes(lanes):
+    """Split uint64 sort lanes into (hi, lo) uint32 pairs: emulated-u64
+    compares make XLA's TPU sort ~5x slower than the u32 equivalent
+    (measured v5e; order is identical lexicographically)."""
+    out = []
+    for lane in lanes:
+        if lane.dtype == jnp.uint64:
+            out.append((lane >> jnp.uint64(32)).astype(jnp.uint32))
+            out.append(lane.astype(jnp.uint32))
+        else:
+            out.append(lane)
+    return out
+
+
 def sort_permutation(columns: Sequence[Column], orders: Sequence[SortOrder],
                      num_rows, capacity: int,
                      string_words: int = DEFAULT_STRING_WORDS):
     """Stable sort permutation: int32 (capacity,) such that gathering by it
     yields rows in the requested order, inactive rows last."""
-    lanes = order_key_lanes(columns, orders, num_rows, capacity, string_words)
+    lanes = _split_u64_lanes(
+        order_key_lanes(columns, orders, num_rows, capacity, string_words))
     iota = jnp.arange(capacity, dtype=jnp.int32)
     out = jax.lax.sort(tuple(lanes) + (iota,), num_keys=len(lanes))
     return out[-1]

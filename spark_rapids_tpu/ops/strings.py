@@ -21,6 +21,19 @@ def string_lengths(col: StringColumn):
     return col.offsets[1:] - col.offsets[:-1]
 
 
+def hex_digit_val(b):
+    """Value of an ASCII hex digit byte; -1 for non-hex (shared by the
+    json/codec/url kernels)."""
+    v = jnp.full(b.shape, jnp.int32(-1))
+    v = jnp.where((b >= ord("0")) & (b <= ord("9")),
+                  b.astype(jnp.int32) - ord("0"), v)
+    v = jnp.where((b >= ord("a")) & (b <= ord("f")),
+                  b.astype(jnp.int32) - ord("a") + 10, v)
+    v = jnp.where((b >= ord("A")) & (b <= ord("F")),
+                  b.astype(jnp.int32) - ord("A") + 10, v)
+    return v
+
+
 def seg_incl_cumsum(x, row_start_pos):
     """Per-row inclusive cumsum of int32 x over a flat byte buffer:
     global cumsum minus the exclusive cumsum at each byte's row start."""

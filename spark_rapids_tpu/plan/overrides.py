@@ -267,6 +267,12 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
             f"{type(meta.expr).__name__} is a host-tier expression "
             "(runs via CPU fallback; no device kernel)")
 
+    def _tag_device_when_supported(meta):
+        # expressions with a partial device kernel expose
+        # `device_supported`; unsupported shapes drop to the host tier
+        if not getattr(meta.expr, "device_supported", True):
+            _tag_host_tier(meta)
+
     from ..expr.jsonexprs import GetJsonObject, JsonToStructsField
     from ..expr.urlexprs import ParseUrl
 
@@ -280,15 +286,9 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
        stringlike, stringlike, tag_fn=_tag_get_json)
     _r(rules, JsonToStructsField, "from_json single field (host tier)",
        stringlike, commonly_supported, tag_fn=_tag_host_tier)
-    _r(rules, ParseUrl, "URL part extraction (host tier)", stringlike,
-       stringlike, tag_fn=_tag_host_tier)
+    _r(rules, ParseUrl, "URL part extraction", stringlike,
+       stringlike, tag_fn=_tag_device_when_supported)
     arrstr = TypeSig.of("ARRAY")
-
-    def _tag_device_when_supported(meta):
-        # expressions with a partial device kernel expose
-        # `device_supported`; unsupported shapes drop to the host tier
-        if not getattr(meta.expr, "device_supported", True):
-            _tag_host_tier(meta)
 
     _r(rules, stringexprs.StringSplit, "string split",
        stringlike, arrstr, tag_fn=_tag_device_when_supported)
@@ -312,7 +312,9 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
             (stringexprs.Base64Encode, "base64 encode", strbin),
             (stringexprs.UnBase64, "base64 decode", strbin),
             (stringexprs.Hex, "hex encode", strbin + integral),
-            (stringexprs.Unhex, "hex decode", strbin),
+            (stringexprs.Unhex, "hex decode", strbin)):
+        _r(rules, c, d, in_sig, strbin)  # device codecs (ops/codecs.py)
+    for c, d, in_sig in (
             (stringexprs.Encode, "charset encode", stronly),
             (stringexprs.Decode, "charset decode", strbin)):
         _r(rules, c, d + " (host tier)", in_sig, strbin,

@@ -1,0 +1,90 @@
+"""Device parse_url (ops/url.py) vs the host urlparse tier."""
+import random
+
+import pytest
+
+from spark_rapids_tpu.columnar.column import StringColumn
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.expr.urlexprs import ParseUrl
+from spark_rapids_tpu.ops.url import parse_url
+
+URLS = [
+    "https://user:pw@example.com:8443/p/a?x=1&y=2#frag",
+    "http://spark.apache.org/path",
+    "http://example.com",
+    "ftp://host/file.txt",
+    "https://Example.COM/UP?a=b",
+    "http://example.com/?",
+    "http://example.com/#",
+    "no-scheme-just-text",
+    "/relative/path?q=v",
+    "http://[::1]:8080/x",
+    "http://user@h.io/",
+    None,
+    "",
+    "HTTPS://U:P@H.COM/Q?k=v+w%21#z",
+    "http://h/p?a=1&a=2&b=",
+    "http://h/p?key",
+    "mailto:someone@example.com",
+]
+
+
+@pytest.mark.parametrize("part", ["HOST", "PATH", "QUERY", "REF",
+                                  "PROTOCOL", "FILE", "AUTHORITY",
+                                  "USERINFO", "host"])
+def test_parts_match_host_tier(part):
+    sc = StringColumn.from_pylist(URLS)
+    expr = ParseUrl(col("u"), part)
+    host = [expr.host_eval_row(u) for u in URLS]
+    assert parse_url(sc, part).to_pylist(len(URLS)) == host
+
+
+@pytest.mark.parametrize("key", ["x", "a", "b", "key", "k", "missing"])
+def test_query_key_match_host_tier(key):
+    sc = StringColumn.from_pylist(URLS)
+    expr = ParseUrl(col("u"), "QUERY", key)
+    host = [expr.host_eval_row(u) for u in URLS]
+    assert parse_url(sc, "QUERY", key).to_pylist(len(URLS)) == host
+
+
+def test_fuzz_realistic_urls():
+    rng = random.Random(8)
+    urls = []
+    for _ in range(80):
+        u = rng.choice(["http", "https", "ftp", "s3a"]) + "://"
+        if rng.random() < 0.3:
+            u += f"user{rng.randint(0, 9)}@"
+        u += rng.choice(["host.example.com", "h", "a.b.c.d"])
+        if rng.random() < 0.4:
+            u += f":{rng.randint(1, 65000)}"
+        u += "/" + "/".join(f"p{i}" for i in range(rng.randint(0, 3)))
+        if rng.random() < 0.5:
+            u += "?" + "&".join(f"k{i}={rng.randint(0, 99)}"
+                                for i in range(rng.randint(1, 3)))
+        if rng.random() < 0.3:
+            u += "#sec" + str(rng.randint(0, 9))
+        urls.append(u)
+    sc = StringColumn.from_pylist(urls)
+    for part in ("HOST", "PATH", "QUERY", "PROTOCOL", "AUTHORITY",
+                 "USERINFO", "FILE", "REF"):
+        expr = ParseUrl(col("u"), part)
+        host = [expr.host_eval_row(u) for u in urls]
+        assert parse_url(sc, part).to_pylist(len(urls)) == host, part
+    for key in ("k0", "k2", "zz"):
+        expr = ParseUrl(col("u"), "QUERY", key)
+        host = [expr.host_eval_row(u) for u in urls]
+        assert parse_url(sc, "QUERY", key).to_pylist(len(urls)) == host
+
+
+def test_planner_routes_parse_url_to_device():
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import STRING, Schema, StructField
+    sess = TpuSession()
+    df = sess.from_pydict(
+        {"u": ["https://h.io/p?a=1", None]},
+        schema=Schema((StructField("u", STRING),)))
+    q = df.select(F.parse_url(F.col("u"), "HOST").alias("h"),
+                  F.parse_url(F.col("u"), "QUERY", "a").alias("a"))
+    assert "host row engine" not in q.explain()
+    assert q.collect() == [("h.io", "1"), (None, None)]
