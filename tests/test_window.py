@@ -227,6 +227,33 @@ def test_bounded_min_max_empty_frame():
     assert [r[3] for r in plan.collect()] == [None, 7, 3, 3]
 
 
+def _chunked_vs_reference(ks, vs, sch, num_batches=12):
+    """Shared harness: run the same windowed sum chunked vs single-batch
+    and return (chunked outputs, sorted rows, sorted reference rows)."""
+    n_rows = len(ks)
+
+    def mk_plan(nb):
+        per = n_rows // nb
+        batches = [ColumnarBatch.from_pydict(
+            {"k": ks[i * per:(i + 1) * per],
+             "v": vs[i * per:(i + 1) * per]}, sch)
+            for i in range(nb)]
+        spec = window(partition_by=["k"], order_by=["v"],
+                      frame=WindowFrame.rows(None, 0))
+        return WindowExec([(WindowAgg("sum", col("v")).over(spec), "s")],
+                          InMemoryScanExec(batches, sch))
+
+    def skey(r):
+        return (r[0] is None, str(r[0]) if r[0] is not None else "",
+                r[1], r[2])
+
+    outs = list(mk_plan(num_batches).execute())
+    got = sorted((r for b in outs for r in b.to_pylist()), key=skey)
+    ref = sorted((r for b in mk_plan(1).execute() for r in b.to_pylist()),
+                 key=skey)
+    return outs, got, ref
+
+
 def test_partition_aligned_chunked_window():
     # >MERGE_FAN_IN child batches engage the out-of-core sorted stream:
     # the window must emit MULTIPLE batches (concat-all is gone) with
@@ -234,24 +261,23 @@ def test_partition_aligned_chunked_window():
     # single-batch reference run
     import random
     rng = random.Random(13)
-    n_rows = 600
-    ks = [rng.randint(0, 40) for _ in range(n_rows)]
-    vs = [rng.randint(-100, 100) for _ in range(n_rows)]
+    ks = [rng.randint(0, 40) for _ in range(600)]
+    vs = [rng.randint(-100, 100) for _ in range(600)]
     sch = Schema((StructField("k", LONG), StructField("v", LONG)))
-
-    def mk_plan(num_batches):
-        per = n_rows // num_batches
-        batches = [ColumnarBatch.from_pydict(
-            {"k": ks[i * per:(i + 1) * per], "v": vs[i * per:(i + 1) * per]},
-            sch) for i in range(num_batches)]
-        spec = window(partition_by=["k"], order_by=["v"],
-                      frame=WindowFrame.rows(None, 0))
-        return WindowExec([(WindowAgg("sum", col("v")).over(spec), "s")],
-                          InMemoryScanExec(batches, sch))
-
-    chunked = mk_plan(12)
-    outs = list(chunked.execute())
+    outs, got, ref = _chunked_vs_reference(ks, vs, sch)
     assert len(outs) > 1, "expected multiple output batches"
-    got = sorted(r for b in outs for r in b.to_pylist())
-    ref = sorted(r for b in mk_plan(1).execute() for r in b.to_pylist())
+    assert got == ref
+
+
+def test_partition_aligned_chunks_string_keys_with_nulls():
+    # string partition keys incl. NULLs across chunk boundaries: the
+    # boundary detector compares null rows by validity, not stale bytes
+    import random
+    rng = random.Random(29)
+    ks = [None if rng.random() < 0.2 else f"key{rng.randint(0, 20):03d}"
+          for _ in range(480)]
+    vs = [rng.randint(-50, 50) for _ in range(480)]
+    sch = Schema((StructField("k", STRING), StructField("v", LONG)))
+    outs, got, ref = _chunked_vs_reference(ks, vs, sch)
+    assert len(outs) > 1
     assert got == ref
