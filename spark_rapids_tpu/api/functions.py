@@ -251,11 +251,9 @@ def array_contains(x, value):
 
 
 def element_at(x, index):
+    # ElementAt dispatches on the child's RESOLVED type (map lookup vs
+    # array index), so expression indices work for both (ADVICE r3 #1)
     from ..expr import collectionexprs
-    from ..expr.core import Expression, Literal
-    if isinstance(index, Expression) and not isinstance(index, Literal):
-        # non-literal keys are supported for MAP lookups
-        return get_map_value(x, index)
     return collectionexprs.ElementAt(_e(x), index)
 
 

@@ -76,9 +76,15 @@ def _result_column(data, valid, dtype) -> Column:
 class AggregateExec(TpuExec):
     def __init__(self, group_exprs: Sequence[Expression],
                  aggregates: Sequence[Tuple[AggregateFunction, str]],
-                 child: TpuExec, mode: str = "complete"):
+                 child: TpuExec, mode: str = "complete",
+                 input_types: Optional[List[List["DataType"]]] = None):
+        """input_types: per-aggregate original INPUT types, passed by the
+        planner to final-mode instances so result types (e.g. decimal sum
+        precision) match the single-stage plan instead of being derived
+        from the widened buffer types (ADVICE r3 #3)."""
         super().__init__(child)
         assert mode in ("complete", "partial", "final")
+        self._final_input_types = input_types
         self.mode = mode
         self.group_exprs = list(group_exprs)
         self.aggregates = list(aggregates)
@@ -116,9 +122,10 @@ class AggregateExec(TpuExec):
                                          static_argnums=(2,))
 
         if mode == "final":
-            # input is keys+buffers produced by a partial instance
+            # input is keys+buffers produced by a partial instance; the
+            # planner's input_types hint restores original result types
             self._key_count = len(group_exprs)
-            self._input_types = None
+            self._input_types = input_types
             self._buffer_schema = in_schema
         else:
             # pre-projection: keys then the union of agg inputs
@@ -513,7 +520,13 @@ class AggregateExec(TpuExec):
                     for out in with_retry(spillable,
                                           self._spill_wrap(first_pass),
                                           split_policy=split_in_half_by_rows):
-                        if out.capacity >= self.SHRINK_THRESHOLD_CAP:
+                        if (out.capacity >= self.SHRINK_THRESHOLD_CAP
+                                and aggregated):
+                            # the FIRST partial is held unshrunken: for the
+                            # (common) single-batch pipeline the shrink's
+                            # d2h sync (~100 ms on the tunnel) buys nothing
+                            # — one full-size partial costs what the input
+                            # batch already cost, and it is spillable
                             # big-batch partials keep the input capacity
                             # (groups are usually few): pay ONE host sync
                             # to shrink rather than hold MERGE_FAN_IN

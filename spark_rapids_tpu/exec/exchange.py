@@ -166,8 +166,11 @@ class ShuffleExchangeExec(TpuExec):
         batches flow through the ICI exchange in fixed-byte rounds; each
         round's received shards stage as SPILLABLE batches and the final
         per-shard outputs concatenate from the staged pieces. Peak device
-        memory = one round of input + one round of output, not the whole
-        stage."""
+        memory = one round of input + the LARGEST OUTPUT SHARD (ADVICE r3
+        #2): consumers rely on exactly one batch per partition in
+        partition order (ShuffledHashJoinExec's lazy zip), so a skewed
+        shard is materialized whole at yield; the bound during the
+        exchange rounds themselves is one round in + one round out."""
         from ..config import EXCHANGE_ROUND_BYTES, active_conf
         from ..memory.spillable import SpillableBatch
 

@@ -350,8 +350,9 @@ def _dedup_array(col):
                                  num_segments=col.capacity)
     counts = jnp.where(col.validity, counts, 0)
     offsets = _rebuild_offsets(counts)
-    perm, _ = compaction_order(keep, jnp.int32(cap))
-    new_child = gather_column(child, perm)
+    perm, n_kept = compaction_order(keep, jnp.int32(cap))
+    from ..ops.basic import active_mask
+    new_child = gather_column(child, perm, active_mask(n_kept, cap))
     return ArrayColumn(new_child, offsets, col.validity, col.dtype)
 
 

@@ -51,11 +51,18 @@ class ElementAt(Expression):
     bounds (non-ANSI) — or element_at(map, key)."""
 
     def __init__(self, child: Expression, index):
-        self.children = (child,)
-        self.index = index.value if isinstance(index, Literal) else index
+        if isinstance(index, Expression) and not isinstance(index, Literal):
+            # expression index: dispatches on the CHILD's resolved type at
+            # eval (map lookup vs per-row array index — ADVICE r3 #1)
+            self.children = (child, index)
+            self.index = None
+        else:
+            self.children = (child,)
+            self.index = index.value if isinstance(index, Literal) else index
 
     def with_children(self, children):
-        return type(self)(children[0], self.index)
+        return type(self)(children[0],
+                          children[1] if len(children) == 2 else self.index)
 
     def _semantic_args(self):
         return (self.index,)
@@ -71,17 +78,24 @@ class ElementAt(Expression):
     def columnar_eval(self, batch):
         from ..columnar.column import MapColumn
         c = self.children[0].columnar_eval(batch)
+        if len(self.children) == 2:
+            k = self.children[1].columnar_eval(batch)
+            if isinstance(c, MapColumn):
+                from ..ops.maps import map_get
+                return map_get(c, k)
+            return C.element_at_col(c, k)
         if isinstance(c, MapColumn):
             from ..ops.maps import map_get
             return map_get(c, self.index)
         return C.element_at(c, self.index)
 
-    def host_eval_row(self, v):
-        if v is None or self.index is None:
+    def host_eval_row(self, *vals):
+        v = vals[0]
+        i = vals[1] if len(self.children) == 2 else self.index
+        if v is None or i is None:
             return None
         if isinstance(v, dict):
-            return v.get(self.index)
-        i = self.index
+            return v.get(i)
         if i == 0 or abs(i) > len(v):
             return None
         return v[i - 1] if i > 0 else v[i]

@@ -93,18 +93,20 @@ def gather_column(col: Column, indices, out_valid=None,
 def compaction_order(keep, num_rows):
     """Stable permutation moving kept active rows to the front.
 
-    Returns (perm, new_num_rows). This is the engine's copy_if: an O(n)
-    cumsum + scatter (prefix-sum stream compaction, the classic parallel
-    formulation) — kept row i lands at position (#kept before i); dropped
-    slots point out of range, which gather_column turns into invalid rows.
+    Returns (perm, new_num_rows). This is the engine's copy_if. Slots at
+    positions >= new_num_rows hold the DROPPED rows' indices (it is a full
+    permutation); callers must mask the tail (gather with an
+    active_mask(new_num_rows) out_valid, or wrap indices to -1).
     """
     cap = keep.shape[0]
     act = active_mask(num_rows, cap)
     k = keep & act
-    pos = jnp.cumsum(k.astype(jnp.int32)) - 1
     iota = jnp.arange(cap, dtype=jnp.int32)
-    perm = jnp.full((cap,), cap, jnp.int32)
-    perm = perm.at[jnp.where(k, pos, cap)].set(iota, mode="drop")
+    # stable sort on the drop flag: kept rows first in original order.
+    # Measured ~2x the scatter formulation on v5e (round 4): lax.sort is
+    # the chip's cheapest reordering primitive.
+    _, perm = jax.lax.sort(((~k).astype(jnp.uint32), iota), num_keys=1,
+                           is_stable=True)
     new_rows = jnp.sum(k, dtype=jnp.int32)
     return perm, new_rows
 
@@ -112,12 +114,28 @@ def compaction_order(keep, num_rows):
 def compact_columns(columns: Sequence[Column], keep, num_rows
                     ) -> Tuple[Tuple[Column, ...], jnp.ndarray]:
     """Filter: keep rows where `keep` is True (null predicate rows dropped
-    by the caller having already AND-ed validity into keep)."""
+    by the caller having already AND-ed validity into keep).
+
+    Fixed-width columns compact through ONE packed row gather (XLA's
+    gather cost on v5e is per-row loop overhead, not bytes — see
+    ops/rowpack); varlen/nested columns keep the per-column path."""
+    from .rowpack import gather_rows, pack_rows, split_packable, unpack_rows
     perm, new_rows = compaction_order(keep, num_rows)
     cap = keep.shape[0]
     out_valid = active_mask(new_rows, cap)
-    out = tuple(gather_column(c, perm, out_valid) for c in columns)
-    return out, new_rows
+    out: list = [None] * len(columns)
+    p_idx, o_idx = split_packable(columns)
+    if len(p_idx) > 1:
+        plan, imat, fmat = pack_rows([columns[i] for i in p_idx])
+        gi, gf = gather_rows(plan, imat, fmat,
+                             jnp.where(out_valid, perm, -1))
+        for j, c in zip(p_idx, unpack_rows(plan, gi, gf)):
+            out[j] = c
+    else:
+        o_idx = sorted(p_idx + o_idx)
+    for j in o_idx:
+        out[j] = gather_column(columns[j], perm, out_valid)
+    return tuple(out), new_rows
 
 
 def concat_columns(a: Column, b: Column, a_rows, b_rows, out_capacity: int

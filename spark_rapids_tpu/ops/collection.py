@@ -183,6 +183,19 @@ def element_at(col: ArrayColumn, index: int) -> Column:
     return gather_column(col.child, src)
 
 
+def element_at_col(col: ArrayColumn, idx: Column) -> Column:
+    """element_at(arr, expr): per-row 1-based index, negative from the
+    end, null when out of bounds or index null (non-ANSI Spark;
+    reference collectionOperations.scala GpuElementAt)."""
+    lens = array_lengths(col)
+    i = idx.data.astype(jnp.int32)
+    pos = jnp.where(i >= 0, i - 1, lens + i)
+    ok = (pos >= 0) & (pos < lens) & col.validity & idx.validity
+    src = jnp.where(ok, col.offsets[:-1].astype(jnp.int32) + pos, -1)
+    from .basic import gather_column
+    return gather_column(col.child, src)
+
+
 def get_array_item(col: ArrayColumn, index: int) -> Column:
     """arr[i]: 0-based, null out of bounds (GetArrayItem non-ANSI)."""
     lens = array_lengths(col)

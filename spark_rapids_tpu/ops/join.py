@@ -31,10 +31,27 @@ from ..columnar.column import (
     ArrayColumn, Column, StringColumn, bucket_capacity,
 )
 from .basic import active_mask, compaction_order, gather_column
-from .hashing import xxhash64_batch
+from .hashing import murmur3_batch
+from .rowpack import gather_rows, pack_rows, split_packable, unpack_rows
 from .strings import string_equal
 
 JOIN_HASH_SEED = 0x5370_6172  # arbitrary fixed seed, 'Spar'
+JOIN_HASH_SEED2 = 0x85EB_CA6B
+
+
+def join_hash_pair(key_cols: Sequence[Column], lo_too: bool = True):
+    """Internal join bucket hash: two independent murmur3 passes (u32 VPU
+    ops only). xxhash64's emulated 64-bit arithmetic measured ~120 ms per
+    2M i64 keys on v5e vs ~10 ms for murmur3 lanes (round 4); the join
+    never needs Spark-exact hashing here — collisions only cost a false
+    candidate that the exact key-verify pass drops."""
+    h_hi = jax.lax.bitcast_convert_type(
+        murmur3_batch(list(key_cols), seed=JOIN_HASH_SEED), jnp.uint32)
+    if not lo_too:
+        return h_hi, None
+    h_lo = jax.lax.bitcast_convert_type(
+        murmur3_batch(list(key_cols), seed=JOIN_HASH_SEED2), jnp.uint32)
+    return h_hi, h_lo
 
 
 def _keys_valid(key_cols: Sequence[Column], num_rows, capacity: int):
@@ -64,7 +81,8 @@ class BuildTable:
 
     def __init__(self, bucket_table, perm, valid_count, num_rows,
                  key_cols: Sequence[Column], payload: Sequence[Column],
-                 capacity: int, payload_prefix: Sequence = ()):
+                 capacity: int, payload_prefix: Sequence = (),
+                 pair_table=None, pack=None):
         self.bucket_table = bucket_table  # (2^B + 1,) int32 offsets
         self.perm = perm  # sorted position -> original build row
         self.valid_count = valid_count
@@ -76,18 +94,23 @@ class BuildTable:
         # prefix sum of row byte lengths in sorted order — sizes the join's
         # string output buckets without per-stream-batch recomputation
         self.payload_prefix = tuple(payload_prefix)
+        # (2^B, 2) int32 [lo, hi) per bucket: ONE row gather per probe
+        # instead of two offset-table gathers (round 4)
+        self.pair_table = pair_table
+        # (plan, imat_sorted, fmat_sorted, key_pack_idx, payload_pack_idx,
+        #  payload_other_idx): every fixed-width key/payload column packed
+        #  into one u32 (+ one f64) matrix in SORTED hash order, so the
+        #  probe's verify+emit is a couple of row gathers (ops/rowpack)
+        self.pack = pack
 
     @staticmethod
     def build(key_cols: Sequence[Column], payload: Sequence[Column],
               num_rows, capacity: int) -> "BuildTable":
         from .strings import string_lengths
         valid = _keys_valid(key_cols, num_rows, capacity)
-        h = xxhash64_batch(list(key_cols), seed=JOIN_HASH_SEED)
         # invalid/inactive rows: push to the end with the max hash AND keep
         # them out of every candidate range via the valid-count boundary.
-        h_u = jax.lax.bitcast_convert_type(h, jnp.uint64)
-        h_hi = (h_u >> jnp.uint64(32)).astype(jnp.uint32)
-        h_lo = h_u.astype(jnp.uint32)
+        h_hi, h_lo = join_hash_pair(key_cols)
         big32 = jnp.uint32(0xFFFF_FFFF)
         k_hi = jnp.where(valid, h_hi, big32)
         k_lo = jnp.where(valid, h_lo, big32)
@@ -107,6 +130,7 @@ class BuildTable:
         bucket_table = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32),
              jnp.cumsum(counts, dtype=jnp.int32)])
+        pair_table = jnp.stack([bucket_table[:-1], bucket_table[1:]], axis=1)
         prefixes = []
         for c in payload:
             if isinstance(c, (StringColumn, ArrayColumn)):
@@ -117,22 +141,36 @@ class BuildTable:
                 sorted_lens = jnp.where(iota < valid_count, lens[perm], 0)
                 prefixes.append(jnp.concatenate(
                     [jnp.zeros((1,), jnp.int64), jnp.cumsum(sorted_lens)]))
+        # pack fixed-width keys + payload into sorted-order matrices
+        key_pack_idx, _ = split_packable(key_cols)
+        payload_pack_idx, payload_other_idx = split_packable(payload)
+        pcols = [key_cols[i] for i in key_pack_idx] + \
+            [payload[i] for i in payload_pack_idx]
+        plan, imat, fmat = pack_rows(pcols)
+        imat_s, fmat_s = gather_rows(plan, imat, fmat, perm)
+        pack = (plan, imat_s, fmat_s, tuple(key_pack_idx),
+                tuple(payload_pack_idx), tuple(payload_other_idx))
         return BuildTable(bucket_table, perm, valid_count,
-                          num_rows, key_cols, payload, capacity, prefixes)
+                          num_rows, key_cols, payload, capacity, prefixes,
+                          pair_table, pack)
 
 
 def _bt_flatten(bt: BuildTable):
+    plan, imat_s, fmat_s, kpi, ppi, poi = bt.pack
     return ((bt.bucket_table, bt.perm, bt.valid_count, bt.num_rows,
-             tuple(bt.key_cols), tuple(bt.payload), bt.payload_prefix),
-            bt.capacity)
+             tuple(bt.key_cols), tuple(bt.payload), bt.payload_prefix,
+             bt.pair_table, imat_s, fmat_s),
+            (bt.capacity, plan, kpi, ppi, poi))
 
 
-def _bt_unflatten(capacity, children):
+def _bt_unflatten(aux, children):
+    capacity, plan, kpi, ppi, poi = aux
     (bucket_table, perm, valid_count, num_rows, key_cols, payload,
-     payload_prefix) = children
+     payload_prefix, pair_table, imat_s, fmat_s) = children
     return BuildTable(bucket_table, perm, valid_count, num_rows,
                       list(key_cols), list(payload), capacity,
-                      payload_prefix)
+                      payload_prefix, pair_table,
+                      (plan, imat_s, fmat_s, kpi, ppi, poi))
 
 
 jax.tree_util.register_pytree_node(BuildTable, _bt_flatten, _bt_unflatten)
@@ -144,12 +182,17 @@ def probe_counts(build: BuildTable, stream_keys: Sequence[Column],
     table: two offset-table gathers; bucket-mates with different keys
     are dropped by the key-verify pass downstream."""
     valid = _keys_valid(stream_keys, stream_rows, stream_cap)
-    h = xxhash64_batch(list(stream_keys), seed=JOIN_HASH_SEED)
-    h_u = jax.lax.bitcast_convert_type(h, jnp.uint64)
+    h_hi, _ = join_hash_pair(stream_keys, lo_too=False)
     B = _bucket_bits(build.capacity)
-    b = (h_u >> jnp.uint64(64 - B)).astype(jnp.int32)
-    lo = build.bucket_table[b]
-    hi = jnp.minimum(build.bucket_table[b + 1], build.valid_count)
+    b = (h_hi >> jnp.uint32(32 - B)).astype(jnp.int32)
+    if build.pair_table is not None:
+        # ONE row gather for [lo, hi) (round 4; two offset gathers before)
+        pair = build.pair_table[b]
+        lo = pair[:, 0]
+        hi = jnp.minimum(pair[:, 1], build.valid_count)
+    else:
+        lo = build.bucket_table[b]
+        hi = jnp.minimum(build.bucket_table[b + 1], build.valid_count)
     lo = jnp.minimum(lo, hi)
     counts = jnp.where(valid, hi - lo, 0)
     return lo, counts, valid
@@ -160,26 +203,45 @@ def expand_candidates(lo, counts, out_capacity: int):
 
     out_capacity >= total candidates (host-chosen bucket). Pair i belongs to
     the stream row whose cumulative count interval contains i.
+
+    Formulation (round 4): interval starts scatter their OWNER ROW INDEX
+    (disjoint targets by construction), a cummax forward-fills (row index
+    is monotone along the flat order), and one 2-lane row gather fetches
+    (lo, start) to turn flat positions into build positions. The old i32
+    searchsorted was ~21 binary-search rounds, each a full-width gather —
+    ~10x this formulation's cost on v5e (tools/exp_join_parts.py).
+
+    Overflow discipline: the i32 prefix sums are exact whenever the true
+    candidate total < 2^31; the total itself is accumulated in int64 (a
+    cheap reduce), so skew past 2^31 is still detected by the caller's
+    sizing/overflow checks (review finding r1) and served by the int64
+    searchsorted fallback.
     """
-    # int64 accumulation: with extreme key skew the candidate count can
-    # exceed 2^31; an int32 cumsum would wrap silently and drop join rows
-    # (review finding r1)
+    total = jnp.sum(counts.astype(jnp.int64)) if counts.shape[0] \
+        else jnp.int64(0)
+    if counts.shape[0] and out_capacity < (1 << 31):
+        n_rows = counts.shape[0]
+        cum32 = jnp.cumsum(counts)          # inclusive, i32
+        start = cum32 - counts              # exclusive prefix
+        nonempty = counts > 0
+        pos = jnp.where(nonempty, jnp.minimum(start, out_capacity),
+                        out_capacity)
+        j = jnp.arange(n_rows, dtype=jnp.int32)
+        seg = jnp.zeros((out_capacity,), jnp.int32).at[pos].max(
+            j, mode="drop")
+        row_f = jax.lax.cummax(seg)
+        ls = jnp.stack([lo, start], axis=1)
+        g = ls[row_f]                       # one 2-lane row gather
+        i = jnp.arange(out_capacity, dtype=jnp.int32)
+        in_range = i.astype(jnp.int64) < total
+        stream_idx = jnp.where(in_range, row_f, -1)
+        build_pos = g[:, 0] + (i - g[:, 1])
+        return stream_idx, build_pos, total
     cum = jnp.cumsum(counts.astype(jnp.int64))  # inclusive
-    total = cum[-1] if counts.shape[0] else jnp.int64(0)
-    if out_capacity < (1 << 31):
-        # the host already sized out_capacity from the true total, so
-        # every in-range value fits int32 — emulated-i64 binary search is
-        # ~10x the cost of i32 on v5e (clip keeps out-of-range safe)
-        cum32 = jnp.clip(cum, 0, (1 << 31) - 1).astype(jnp.int32)
-        i32 = jnp.arange(out_capacity, dtype=jnp.int32)
-        stream_idx = jnp.searchsorted(cum32, i32,
-                                      side="right").astype(jnp.int32)
-        i = i32.astype(jnp.int64)
-    else:
-        i = jnp.arange(out_capacity, dtype=jnp.int64)
-        stream_idx = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+    i = jnp.arange(out_capacity, dtype=jnp.int64)
+    stream_idx = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
     in_range = i < total
-    safe_stream = jnp.clip(stream_idx, 0, counts.shape[0] - 1)
+    safe_stream = jnp.clip(stream_idx, 0, max(counts.shape[0] - 1, 0))
     before = cum[safe_stream] - counts[safe_stream]
     # (i - before) < per-row count <= capacity, so the int64->int32 narrowing
     # is safe after the subtraction

@@ -1,0 +1,173 @@
+"""Packed-row gather: the TPU JoinGatherer fast path (round 4).
+
+XLA's random gather on v5e is loop-bound, not bandwidth-bound: a 2M-row
+gather of ONE i32 column costs ~26 ms while a 2M-row gather of an
+(N, 8) i32 matrix costs ~7.4 ms (tools/exp_gather.py). The engine's old
+join probe did 2 gathers per column (data + validity); packing every
+fixed-width column of a batch into one u32 matrix (plus one f64 matrix —
+TPU forbids bitcasts from f64) turns a whole-batch row gather into 1-2
+XLA gathers regardless of column count.
+
+Reference analog: cuDF's JoinGatherer gathers a table in one pass per
+column because GPU gathers are bandwidth-bound; on TPU the same
+architectural slot is filled by this row-packing (SURVEY §2.9,
+reference JoinGatherer.scala).
+
+Layout of the u32 matrix (capacity, n_lanes):
+  lane 0..nv-1   validity bits, column c -> bit (c % 32) of lane (c // 32)
+  data lanes     per column: 1 lane (<=32-bit, bitcast), 2 lanes
+                 (64-bit ints, little-endian bitcast), or none (f64 data
+                 goes to the f64 matrix; validity still in the u32 bits)
+
+Only plain fixed-width Columns pack; strings/arrays/structs/maps keep the
+per-column gather path (ops/basic.gather_column).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.column import Column
+
+__all__ = [
+    "is_packable", "split_packable", "pack_rows", "gather_rows",
+    "unpack_rows", "PackPlan",
+]
+
+
+class PackPlan(NamedTuple):
+    """Static description of a pack: per-column (kind, lane) and engine
+    dtypes, derived at trace time from the concrete Columns. A NamedTuple
+    so jit static/aux comparisons use value equality (a BuildTable carries
+    its plan as pytree aux data — identity equality would retrace every
+    probe)."""
+
+    kinds: Tuple                  # ('w1'|'w2'|'f64', lane_index)
+    np_dtypes: Tuple              # numpy dtype per column
+    dtypes: Tuple                 # engine DataType per column
+    n_valid_lanes: int
+    n_data_lanes: int
+    n_f_lanes: int
+
+    @property
+    def n_ilanes(self) -> int:
+        return self.n_valid_lanes + self.n_data_lanes
+
+
+def is_packable(col: Column) -> bool:
+    if type(col) is not Column:
+        return False
+    k = col.data.dtype.kind
+    if k == "f":
+        return col.data.dtype.itemsize in (4, 8)
+    return k in ("i", "u", "b") and col.data.dtype.itemsize <= 8
+
+
+def split_packable(cols: Sequence[Column]):
+    """Partition columns into (packable_idx, other_idx), order-preserving."""
+    p, o = [], []
+    for i, c in enumerate(cols):
+        (p if is_packable(c) else o).append(i)
+    return p, o
+
+
+def _plan(cols: Sequence[Column]) -> PackPlan:
+    kinds: List = []
+    n_data = 0
+    n_f = 0
+    for c in cols:
+        dt = c.data.dtype
+        if dt.kind == "f" and dt.itemsize == 8:
+            kinds.append(("f64", n_f))
+            n_f += 1
+        elif dt.itemsize == 8:
+            kinds.append(("w2", n_data))
+            n_data += 2
+        else:
+            kinds.append(("w1", n_data))
+            n_data += 1
+    nv = max(1, -(-len(cols) // 32)) if cols else 0
+    return PackPlan(tuple(kinds), tuple(c.data.dtype for c in cols),
+                    tuple(c.dtype for c in cols), nv, n_data, n_f)
+
+
+def pack_rows(cols: Sequence[Column]) -> Tuple[PackPlan, jnp.ndarray,
+                                               Optional[jnp.ndarray]]:
+    """Pack columns into (plan, u32 matrix, f64 matrix|None)."""
+    plan = _plan(cols)
+    cap = cols[0].capacity if cols else 0
+    vlanes = [jnp.zeros((cap,), jnp.uint32)
+              for _ in range(plan.n_valid_lanes)]
+    dlanes: List[Optional[jnp.ndarray]] = [None] * plan.n_data_lanes
+    flanes: List[Optional[jnp.ndarray]] = [None] * plan.n_f_lanes
+    for ci, (c, (kind, lane)) in enumerate(zip(cols, plan.kinds)):
+        vlanes[ci // 32] = vlanes[ci // 32] | (
+            c.validity.astype(jnp.uint32) << np.uint32(ci % 32))
+        d = c.data
+        if kind == "f64":
+            flanes[lane] = d
+        elif kind == "w2":
+            pair = jax.lax.bitcast_convert_type(d, jnp.uint32)  # (cap, 2)
+            dlanes[lane] = pair[:, 0]
+            dlanes[lane + 1] = pair[:, 1]
+        else:
+            if d.dtype.kind == "b":
+                dlanes[lane] = d.astype(jnp.uint32)
+            else:
+                if d.dtype.itemsize < 4:
+                    d = d.astype(jnp.int32)
+                dlanes[lane] = jax.lax.bitcast_convert_type(d, jnp.uint32)
+    imat = jnp.stack(vlanes + [x for x in dlanes], axis=1) \
+        if (vlanes or dlanes) else jnp.zeros((cap, 0), jnp.uint32)
+    fmat = jnp.stack([x for x in flanes], axis=1) if flanes else None
+    return plan, imat, fmat
+
+
+def gather_rows(plan: PackPlan, imat, fmat, idx):
+    """Row gather with out-of-range masking: idx < 0 or >= capacity yields
+    an all-invalid row (validity lanes zeroed; data lanes left as row 0)."""
+    cap = imat.shape[0]
+    in_range = (idx >= 0) & (idx < cap)
+    safe = jnp.where(in_range, idx, 0)
+    g = imat[safe]
+    nv = plan.n_valid_lanes
+    if nv:
+        vmask = jnp.where(in_range, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+        g = jnp.concatenate([g[:, :nv] & vmask[:, None], g[:, nv:]], axis=1)
+    gf = fmat[safe] if fmat is not None else None
+    return g, gf
+
+
+def unpack_rows(plan: PackPlan, imat, fmat,
+                only: Optional[Sequence[int]] = None) -> List[Column]:
+    """Rebuild Columns from packed matrices (inverse of pack_rows).
+    `only` restricts to a subset of column indices (plan order)."""
+    out: List[Column] = []
+    nv = plan.n_valid_lanes
+    cols = range(len(plan.kinds)) if only is None else only
+    for ci in cols:
+        (kind, lane), npdt, edt = (plan.kinds[ci], plan.np_dtypes[ci],
+                                   plan.dtypes[ci])
+        valid = ((imat[:, ci // 32] >> np.uint32(ci % 32))
+                 & np.uint32(1)) != 0
+        if kind == "f64":
+            d = fmat[:, lane]
+        elif kind == "w2":
+            pair = jnp.stack([imat[:, nv + lane], imat[:, nv + lane + 1]],
+                             axis=1)
+            d = jax.lax.bitcast_convert_type(pair, npdt)
+        else:
+            u = imat[:, nv + lane]
+            if npdt == np.bool_:
+                d = u != 0
+            elif np.dtype(npdt).itemsize < 4:
+                d = jax.lax.bitcast_convert_type(u, jnp.int32).astype(npdt)
+            else:
+                d = jax.lax.bitcast_convert_type(u, npdt)
+        d = jnp.where(valid, d, jnp.zeros((), d.dtype))
+        out.append(Column(d, valid, edt))
+    return out

@@ -726,7 +726,8 @@ class PlanMeta(BaseMeta):
         part_keys = [UnresolvedAttribute(n) for n in key_names]
         exchange = ShuffleExchangeExec(part_keys, partial, mesh)
         return AggregateExec(p.group_exprs, p.aggregates, exchange,
-                             mode="final")
+                             mode="final",
+                             input_types=partial._input_types)
 
     def _host_shuffle_partitions(self) -> int:
         """Partition count for the MULTITHREADED host shuffle, or 1 when
@@ -757,7 +758,8 @@ class PlanMeta(BaseMeta):
         exchange = HostShuffleExchangeExec(part_keys, partial, n_parts,
                                            self.conf)
         return AggregateExec(p.group_exprs, p.aggregates, exchange,
-                             mode="final")
+                             mode="final",
+                             input_types=partial._input_types)
 
     def _convert_range_partitioned_sort(self, p, child: TpuExec,
                                         n_parts: int) -> Optional[TpuExec]:

@@ -1,0 +1,82 @@
+"""Time the join's three compiled phases separately on the chip."""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import bench
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.exec.basic import FilterExec, InMemoryScanExec
+from spark_rapids_tpu.exec.joins import HashJoinExec
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, Schema, StructField
+
+d = bench.build_q3_data()
+o_schema = Schema((StructField("o_orderkey", LONG), StructField("o_flag", INT)))
+l_schema = Schema((StructField("l_orderkey", LONG),
+                   StructField("l_price", DOUBLE),
+                   StructField("l_disc", DOUBLE),
+                   StructField("l_flag", INT)))
+
+
+def mk_batch(schema, n):
+    cap = bucket_capacity(n)
+    cols = [Column.from_numpy(d[f.name], f.data_type, capacity=cap)
+            for f in schema.fields]
+    return ColumnarBatch(cols, n, schema)
+
+
+orders = mk_batch(o_schema, bench.N_ORDERS)
+lines = mk_batch(l_schema, bench.N_LINES)
+
+o_scan = FilterExec(col("o_flag") < lit(5),
+                    InMemoryScanExec([orders], o_schema))
+l_scan = FilterExec(col("l_flag") != lit(0),
+                    InMemoryScanExec([lines], l_schema))
+join = HashJoinExec(l_scan, o_scan, [col("l_orderkey")],
+                    [col("o_orderkey")], "inner", build_side="right")
+
+o_filtered = list(o_scan.execute())[0]
+l_filtered = list(l_scan.execute())[0]
+jax.block_until_ready(o_filtered.columns[0].data)
+
+
+def timeit(name, fn, iters=10):
+    r = fn()
+    jax.block_until_ready(jax.tree_util.tree_leaves(r))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn()
+        jax.block_until_ready(jax.tree_util.tree_leaves(r))
+    dt = (time.perf_counter() - t0) / iters * 1e3
+    print(f"{name:28s} {dt:9.2f} ms")
+    return r
+
+
+bt = timeit("build_kernel (512K)", lambda: join._jit_build(o_filtered))
+cres = timeit("counts_kernel (2M)", lambda: join._jit_counts(bt, l_filtered))
+lo, counts, skeys, total_dev, needs = cres
+total, needs_h = jax.device_get((total_dev, needs))
+cand_cap = bucket_capacity(max(int(total), 1))
+print("total candidates:", int(total), "cand_cap:", cand_cap)
+bm = jnp.zeros((bt.capacity,), jnp.bool_)
+timeit("probe_kernel", lambda: join._jit_probe(
+    bt, o_filtered, l_filtered, (lo, counts, skeys), bm, cand_cap, (), ()))
+
+# sub-parts: expansion and xxhash
+from spark_rapids_tpu.ops.join import expand_candidates
+from spark_rapids_tpu.ops.hashing import xxhash64_batch
+
+ec = jax.jit(lambda l, c: expand_candidates(l, c, cand_cap))
+timeit("expand_candidates alone", lambda: ec(lo, counts))
+kc = [l_filtered.columns[0]]
+xh = jax.jit(lambda c: xxhash64_batch([c], seed=1))
+timeit("xxhash64 2M i64", lambda: xh(kc[0]))
+from spark_rapids_tpu.exec.basic import FilterExec as _F
+timeit("filter 2M (scan+filter)", lambda: list(l_scan.execute())[0])

@@ -1,0 +1,120 @@
+"""Pipelined steady-state cost of each q3 sub-stage (one block at end).
+
+Also prints the traceback of any num_rows_host call in steady state.
+"""
+
+import sys
+import time
+import traceback
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import bench
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.exec.aggregate import AggregateExec
+from spark_rapids_tpu.exec.basic import (FilterExec, InMemoryScanExec,
+                                         ProjectExec)
+from spark_rapids_tpu.exec.joins import HashJoinExec
+from spark_rapids_tpu.exec.sort import TopNExec
+from spark_rapids_tpu.expr.aggexprs import Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, Schema, StructField
+from spark_rapids_tpu.exec.speculation import speculation_scope
+
+d = bench.build_q3_data()
+o_schema = Schema((StructField("o_orderkey", LONG), StructField("o_flag", INT)))
+l_schema = Schema((StructField("l_orderkey", LONG),
+                   StructField("l_price", DOUBLE),
+                   StructField("l_disc", DOUBLE),
+                   StructField("l_flag", INT)))
+
+
+def mk_batch(schema, n):
+    cap = bucket_capacity(n)
+    cols = [Column.from_numpy(d[f.name], f.data_type, capacity=cap)
+            for f in schema.fields]
+    return ColumnarBatch(cols, n, schema)
+
+
+orders = mk_batch(o_schema, bench.N_ORDERS)
+lines = mk_batch(l_schema, bench.N_LINES)
+
+trace_nrh = "--trace-nrh" in sys.argv
+if trace_nrh:
+    orig = ColumnarBatch.num_rows_host
+
+    def spy(self):
+        traceback.print_stack(limit=8)
+        return orig.fget(self)
+    ColumnarBatch.num_rows_host = property(spy)
+
+
+def mk_plan():
+    o_scan = FilterExec(col("o_flag") < lit(5),
+                        InMemoryScanExec([orders], o_schema))
+    l_scan = FilterExec(col("l_flag") != lit(0),
+                        InMemoryScanExec([lines], l_schema))
+    joined = HashJoinExec(l_scan, o_scan, [col("l_orderkey")],
+                          [col("o_orderkey")], "inner", build_side="right")
+    proj = ProjectExec([
+        col("l_orderkey"),
+        (col("l_price") * (lit(1.0) - col("l_disc"))).alias("rev")], joined)
+    agg = AggregateExec([col("l_orderkey")], [(Sum(col("rev")), "revenue")],
+                        proj)
+    agg._spec_enabled = False
+    top = TopNExec(10, [(col("revenue"), False)], agg)
+    return o_scan, l_scan, joined, proj, agg, top
+
+
+o_scan, l_scan, joined, proj, agg, top = mk_plan()
+cm = speculation_scope()
+scope = cm.__enter__()
+
+
+def steady(name, fn, iters=10):
+    outs = fn()
+    jax.block_until_ready([c.data for b in outs for c in b.columns])
+    scope.drain()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        outs = fn()
+        scope.drain()
+    jax.block_until_ready([c.data for b in outs for c in b.columns])
+    dt = (time.perf_counter() - t0) / iters * 1e3
+    print(f"{name:26s} {dt:9.1f} ms", flush=True)
+
+
+which = [a for a in sys.argv[1:] if not a.startswith("--")] or \
+    ["filters", "build", "counts", "probe", "join", "agg", "topn"]
+
+if "filters" in which:
+    steady("filters(l+o)", lambda: list(l_scan.execute())
+           + list(o_scan.execute()))
+if "build" in which:
+    def run_build():
+        b = list(o_scan.execute())[0]
+        bt = joined._jit_build(b)
+        return [b]
+    steady("filters+build", run_build)
+if "counts" in which:
+    b0 = list(o_scan.execute())[0]
+    bt0 = joined._jit_build(b0)
+
+    def run_counts():
+        lb = list(l_scan.execute())[0]
+        joined._jit_counts(bt0, lb)
+        return [lb]
+    steady("filter(l)+counts", run_counts)
+if "probe" in which:
+    steady("join (full exec)", lambda: list(joined.execute()))
+if "join" in which:
+    steady("join+proj", lambda: list(proj.execute()))
+if "agg" in which:
+    steady("join+proj+agg", lambda: list(agg.execute()))
+if "topn" in which:
+    steady("full pipeline", lambda: list(top.execute()))

@@ -1,0 +1,82 @@
+"""Per-stage profile of the q3 bench lane on the real chip (VERDICT r3
+Weak #6: per-stage timers before optimizing blind)."""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import bench
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.exec.aggregate import AggregateExec
+from spark_rapids_tpu.exec.basic import FilterExec, InMemoryScanExec, ProjectExec
+from spark_rapids_tpu.exec.joins import HashJoinExec
+from spark_rapids_tpu.exec.sort import TopNExec
+from spark_rapids_tpu.expr.aggexprs import Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, Schema, StructField
+
+d = bench.build_q3_data()
+o_schema = Schema((StructField("o_orderkey", LONG), StructField("o_flag", INT)))
+l_schema = Schema((StructField("l_orderkey", LONG),
+                   StructField("l_price", DOUBLE),
+                   StructField("l_disc", DOUBLE),
+                   StructField("l_flag", INT)))
+
+
+def mk_batch(schema, n):
+    cap = bucket_capacity(n)
+    cols = [Column.from_numpy(d[f.name], f.data_type, capacity=cap)
+            for f in schema.fields]
+    return ColumnarBatch(cols, n, schema)
+
+
+orders = mk_batch(o_schema, bench.N_ORDERS)
+lines = mk_batch(l_schema, bench.N_LINES)
+
+
+def block_batches(bs):
+    for b in bs:
+        for c in b.columns:
+            jax.block_until_ready(jax.tree_util.tree_leaves(c))
+    return bs
+
+
+def mk(upto):
+    o_scan = FilterExec(col("o_flag") < lit(5),
+                        InMemoryScanExec([orders], o_schema))
+    l_scan = FilterExec(col("l_flag") != lit(0),
+                        InMemoryScanExec([lines], l_schema))
+    if upto == "scan":
+        return l_scan
+    joined = HashJoinExec(l_scan, o_scan, [col("l_orderkey")],
+                          [col("o_orderkey")], "inner", build_side="right")
+    if upto == "join":
+        return joined
+    proj = ProjectExec([
+        col("l_orderkey"),
+        (col("l_price") * (lit(1.0) - col("l_disc"))).alias("rev")], joined)
+    if upto == "proj":
+        return proj
+    agg = AggregateExec([col("l_orderkey")], [(Sum(col("rev")), "revenue")],
+                        proj)
+    if upto == "agg":
+        return agg
+    return TopNExec(10, [(col("revenue"), False)], agg)
+
+
+stages = sys.argv[1:] or ("scan", "join", "proj", "agg", "topn")
+for upto in stages:
+    plan = mk(upto)
+    block_batches(list(plan.execute()))  # warm
+    t0 = time.perf_counter()
+    N = 3
+    for _ in range(N):
+        block_batches(list(plan.execute()))
+    dt = (time.perf_counter() - t0) / N * 1e3
+    print(f"{upto:6s} cumulative {dt:9.1f} ms")
