@@ -129,25 +129,25 @@ def main():
             flags = ()
         return outs, chk
 
-    # warmup (compile + one full round trip)
-    scope_cm = speculation_scope()
-    scope = scope_cm.__enter__()
-    outs, chk = run_once(jnp.float64(0.0), scope)
-    rows = [r for b in outs for r in b.to_pylist()]
-    got = {r[0]: (r[1], r[2], r[3]) for r in rows}
-    for k, (sq, sd, c) in oracle.items():
-        assert got[k][0] == sq and got[k][2] == c, (k, got[k], oracle[k])
-        assert abs(got[k][1] - sd) / max(abs(sd), 1) < 1e-9
-    expect_chk_1 = float(np.asarray(chk))
+    # warmup (compile + one full round trip); the with-block keeps an
+    # assertion failure from leaking the thread-local scope into later
+    # benchmarks in the same process
+    with speculation_scope() as scope:
+        outs, chk = run_once(jnp.float64(0.0), scope)
+        rows = [r for b in outs for r in b.to_pylist()]
+        got = {r[0]: (r[1], r[2], r[3]) for r in rows}
+        for k, (sq, sd, c) in oracle.items():
+            assert got[k][0] == sq and got[k][2] == c, (k, got[k], oracle[k])
+            assert abs(got[k][1] - sd) / max(abs(sd), 1) < 1e-9
+        expect_chk_1 = float(np.asarray(chk))
 
-    # timed steady state: ITERS chained pipelines, ONE sync at the end
-    t0 = time.perf_counter()
-    chk = jnp.float64(0.0)
-    for _ in range(ITERS):
-        _, chk = run_once(chk, scope)
-    final_chk = float(np.asarray(chk))  # forces completion of all ITERS
-    dt = (time.perf_counter() - t0) / ITERS
-    scope_cm.__exit__(None, None, None)
+        # timed steady state: ITERS chained pipelines, ONE sync at the end
+        t0 = time.perf_counter()
+        chk = jnp.float64(0.0)
+        for _ in range(ITERS):
+            _, chk = run_once(chk, scope)
+        final_chk = float(np.asarray(chk))  # forces completion of all ITERS
+        dt = (time.perf_counter() - t0) / ITERS
 
     # every iteration produced the verified result (checksum telescopes)
     assert abs(final_chk - ITERS * expect_chk_1) <= \
@@ -265,37 +265,36 @@ def q3_bench():
             total = total + jnp.where(f, jnp.nan, 0.0)
         return total
 
-    scope_cm = speculation_scope()
-    scope = scope_cm.__enter__()
+    with speculation_scope() as scope:
 
-    def run_once(prev):
-        outs = list(plan.execute())
-        flags = tuple(scope.drain())
-        for b in outs:
-            prev = checksum(b, prev, flags)
-            flags = ()
-        return outs, prev
+        def run_once(prev):
+            outs = list(plan.execute())
+            flags = tuple(scope.drain())
+            for b in outs:
+                prev = checksum(b, prev, flags)
+                flags = ()
+            return outs, prev
 
-    outs, chk = run_once(jnp.float64(0.0))  # warm + verify (sync sizing)
-    rows = [r for b in outs for r in b.to_pylist()]
-    got = {r[0]: r[1] for r in rows}
-    assert set(got) == set(oracle), (sorted(got)[:3], sorted(oracle)[:3])
-    for k, v in oracle.items():
-        assert abs(got[k] - v) / max(abs(v), 1) < 1e-9
-    # second warm pass compiles the speculative (cached-bucket) probe path
-    _, chk2 = run_once(jnp.float64(0.0))
-    assert abs(float(np.asarray(chk2)) - float(np.asarray(chk))) \
-        <= 1e-9 * max(abs(float(np.asarray(chk))), 1.0)
-    expect1 = float(np.asarray(chk))
+        outs, chk = run_once(jnp.float64(0.0))  # warm + verify (sync sizing)
+        rows = [r for b in outs for r in b.to_pylist()]
+        got = {r[0]: r[1] for r in rows}
+        assert set(got) == set(oracle), (sorted(got)[:3], sorted(oracle)[:3])
+        for k, v in oracle.items():
+            assert abs(got[k] - v) / max(abs(v), 1) < 1e-9
+        # second warm pass compiles the speculative (cached-bucket) probe
+        # path
+        _, chk2 = run_once(jnp.float64(0.0))
+        assert abs(float(np.asarray(chk2)) - float(np.asarray(chk))) \
+            <= 1e-9 * max(abs(float(np.asarray(chk))), 1.0)
+        expect1 = float(np.asarray(chk))
 
-    iters = 10
-    t0 = time.perf_counter()
-    chk = jnp.float64(0.0)
-    for _ in range(iters):
-        _, chk = run_once(chk)
-    final = float(np.asarray(chk))
-    dt = (time.perf_counter() - t0) / iters
-    scope_cm.__exit__(None, None, None)
+        iters = 10
+        t0 = time.perf_counter()
+        chk = jnp.float64(0.0)
+        for _ in range(iters):
+            _, chk = run_once(chk)
+        final = float(np.asarray(chk))
+        dt = (time.perf_counter() - t0) / iters
     assert abs(final - iters * expect1) <= 1e-9 * max(abs(final), 1.0)
 
     bytes_in = sum(v.nbytes for v in d.values())

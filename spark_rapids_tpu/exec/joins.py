@@ -417,6 +417,14 @@ class HashJoinExec(TpuExec):
             cand_cap, s_caps, b_caps = cached
             flag = total_dev > cand_cap
             s_needs, b_needs = needs_dev
+            # the zip below pairs byte-needs with caps positionally; if
+            # _string_byte_needs and _byte_cap_tuple ever drift in column
+            # order/count a silent mis-pairing could fail to trip the flag
+            # and ship truncated payloads — guard the lengths
+            assert len(list(s_needs)) == sum(c is not None for c in s_caps), \
+                (len(list(s_needs)), s_caps)
+            assert len(list(b_needs)) == sum(c is not None for c in b_caps), \
+                (len(list(b_needs)), b_caps)
             for need, cap in zip(list(s_needs) + list(b_needs),
                                  [c for c in s_caps if c is not None]
                                  + [c for c in b_caps if c is not None]):

@@ -87,16 +87,33 @@ class ElementAt(Expression):
         if isinstance(c, MapColumn):
             from ..ops.maps import map_get
             return map_get(c, self.index)
+        if self.index == 0:
+            # Spark raises even in non-ANSI mode (GpuElementAt); the
+            # per-row expression-index path (element_at_col) deviates and
+            # returns NULL — the index is data, and a device-side raise
+            # would force a host sync per batch (documented in
+            # ops/collection.element_at_col).
+            raise ValueError("SQL array indices start at 1")
         return C.element_at(c, self.index)
 
     def host_eval_row(self, *vals):
         v = vals[0]
         i = vals[1] if len(self.children) == 2 else self.index
+        if len(self.children) == 1 and i == 0:
+            from ..types import MapType
+            if not isinstance(self.children[0].data_type, MapType):
+                # static literal 0: raise before the null check so host and
+                # device tiers agree (Spark raises regardless of the row)
+                raise ValueError("SQL array indices start at 1")
         if v is None or i is None:
             return None
         if isinstance(v, dict):
             return v.get(i)
-        if i == 0 or abs(i) > len(v):
+        if i == 0:
+            # per-row index 0 -> NULL, matching the device kernel's
+            # documented deviation (ops/collection.element_at_col)
+            return None
+        if abs(i) > len(v):
             return None
         return v[i - 1] if i > 0 else v[i]
 
@@ -107,6 +124,14 @@ class GetArrayItem(ElementAt):
     def columnar_eval(self, batch):
         return C.get_array_item(self.children[0].columnar_eval(batch),
                                 self.index)
+
+    def host_eval_row(self, *vals):
+        v = vals[0]
+        if v is None or self.index is None:
+            return None
+        if 0 <= self.index < len(v):
+            return v[self.index]
+        return None
 
 
 class SortArray(Expression):

@@ -2,6 +2,6 @@
 
 from .basic import (
     active_mask, compact_columns, compaction_order, concat_columns,
-    gather_column, sanitize, slice_rows,
+    gather_column, masked_compaction_order, sanitize, slice_rows,
 )
 from .hashing import murmur3_batch, pmod, xxhash64_batch

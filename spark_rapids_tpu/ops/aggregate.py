@@ -259,11 +259,18 @@ def groupby_aggregate(key_columns: Sequence[Column],
     from ..types import DecimalType
 
     def prefixable(op, g):
+        # Integer-only for sums: i64 cumsum differences are exact mod 2^64,
+        # but a float sum computed as the difference of a GLOBAL cumsum
+        # inherits absolute error from every preceding sorted row
+        # (catastrophic cancellation: a group of 1e-6 values after 1e12-scale
+        # groups collapses to 0.0).  Floating sums stay on the segment-local
+        # exact tier below.
         if op in ("count", "count_star"):
             return True
         if op in ("sum", "sum_sq"):
             return g is not None and not isinstance(g, StringColumn) \
-                and not isinstance(g.dtype, DecimalType)
+                and not isinstance(g.dtype, DecimalType) \
+                and not jnp.issubdtype(g.data.dtype, jnp.floating)
         return False
 
     in_it = iter(sorted_in)

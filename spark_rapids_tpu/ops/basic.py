@@ -93,10 +93,14 @@ def gather_column(col: Column, indices, out_valid=None,
 def compaction_order(keep, num_rows):
     """Stable permutation moving kept active rows to the front.
 
-    Returns (perm, new_num_rows). This is the engine's copy_if. Slots at
-    positions >= new_num_rows hold the DROPPED rows' indices (it is a full
-    permutation); callers must mask the tail (gather with an
-    active_mask(new_num_rows) out_valid, or wrap indices to -1).
+    Returns (perm, new_num_rows). This is the engine's copy_if.
+
+    HAZARD: slots at positions >= new_num_rows hold the DROPPED rows'
+    indices (it is a full permutation) — an unmasked gather silently
+    resurrects dropped rows as plausible-looking data. Every caller MUST
+    mask the tail (gather with an active_mask(new_num_rows) out_valid, or
+    wrap tail indices to -1). Use masked_compaction_order for the
+    fail-safe variant that pre-wraps tail slots to -1.
     """
     cap = keep.shape[0]
     act = active_mask(num_rows, cap)
@@ -109,6 +113,15 @@ def compaction_order(keep, num_rows):
                            is_stable=True)
     new_rows = jnp.sum(k, dtype=jnp.int32)
     return perm, new_rows
+
+
+def masked_compaction_order(keep, num_rows):
+    """Fail-safe compaction_order: tail slots (>= new_num_rows) are -1, so
+    an unmasked gather yields invalid rows instead of resurrecting dropped
+    ones."""
+    perm, new_rows = compaction_order(keep, num_rows)
+    out_valid = active_mask(new_rows, keep.shape[0])
+    return jnp.where(out_valid, perm, -1), new_rows
 
 
 def compact_columns(columns: Sequence[Column], keep, num_rows

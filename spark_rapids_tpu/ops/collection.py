@@ -186,7 +186,13 @@ def element_at(col: ArrayColumn, index: int) -> Column:
 def element_at_col(col: ArrayColumn, idx: Column) -> Column:
     """element_at(arr, expr): per-row 1-based index, negative from the
     end, null when out of bounds or index null (non-ANSI Spark;
-    reference collectionOperations.scala GpuElementAt)."""
+    reference collectionOperations.scala GpuElementAt).
+
+    DEVIATION: Spark raises 'SQL array indices start at 1' for a row whose
+    index evaluates to 0 even in non-ANSI mode; this kernel returns NULL
+    for such rows. Raising would require a per-batch host sync on a
+    data-dependent predicate. The scalar/literal path (ElementAt with a
+    static index) does raise, matching Spark."""
     lens = array_lengths(col)
     i = idx.data.astype(jnp.int32)
     pos = jnp.where(i >= 0, i - 1, lens + i)

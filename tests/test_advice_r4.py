@@ -1,0 +1,83 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.ops.aggregate import groupby_aggregate
+from spark_rapids_tpu.ops.basic import masked_compaction_order
+from spark_rapids_tpu.types import (
+    DOUBLE, LONG, ArrayType, Schema, StructField,
+)
+
+
+def _group_sums(keys, vals, dtype):
+    k = Column.from_pylist(keys, LONG)
+    v = Column.from_pylist(vals, dtype, capacity=k.capacity)
+    out_keys, results, num_groups = groupby_aggregate(
+        [k], [("sum", v)], jnp.int32(len(keys)), k.capacity, 0)
+    ng = int(num_groups)
+    ks = out_keys[0].to_pylist(ng)
+    tag, (data, valid) = results[0]
+    assert tag == "raw"
+    return dict(zip(ks, np.asarray(data)[:ng].tolist()))
+
+
+def test_float_sum_not_prefix_differenced():
+    # ADVICE r4 high: a tiny group sorted after huge groups must not lose
+    # its sum to global-cumsum cancellation. Group 0: 1e12-scale; group 1:
+    # ten 1e-6 values -> exact sum 1e-5.
+    keys = [0] * 200 + [1] * 10
+    vals = [1e12] * 200 + [1e-6] * 10
+    got = _group_sums(keys, vals, DOUBLE)
+    assert got[1] == pytest.approx(1e-5, rel=1e-9)
+    assert got[0] == pytest.approx(200e12, rel=1e-12)
+
+
+def test_int_sum_prefix_tier_exact():
+    # integer sums stay on the cumsum-difference tier and are exact
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, 37, 4000).tolist()
+    vals = rng.integers(-(2 ** 40), 2 ** 40, 4000).tolist()
+    got = _group_sums(keys, vals, LONG)
+    exp = {}
+    for k, v in zip(keys, vals):
+        exp[k] = exp.get(k, 0) + v
+    assert {k: int(s) for k, s in got.items()} == exp
+
+
+def test_masked_compaction_order_tail_fail_safe():
+    keep = jnp.asarray([True, False, True, False, True, False, False, False])
+    perm, n = masked_compaction_order(keep, jnp.int32(6))
+    assert int(n) == 3
+    p = np.asarray(perm)
+    assert p[:3].tolist() == [0, 2, 4]
+    # tail slots are -1, not dropped-row indices
+    assert (p[3:] == -1).all()
+
+
+@pytest.fixture(scope="module")
+def adf():
+    s = TpuSession()
+    sch = Schema((StructField("a", ArrayType(LONG)),
+                  StructField("i", LONG)))
+    return s.from_pydict(
+        {"a": [[1, 2, 3], [4], None, [5, 6]],
+         "i": [0, 1, 0, 2]}, sch)
+
+
+def test_element_at_literal_zero_raises(adf):
+    with pytest.raises(ValueError, match="indices start at 1"):
+        adf.select(F.element_at(col("a"), 0).alias("r")).collect()
+
+
+def test_element_at_col_zero_is_null_documented_deviation(adf):
+    # per-row expression index: rows with index 0 yield NULL (documented
+    # deviation from Spark's runtime raise, ops/collection.element_at_col)
+    out = [r[0] for r in
+           adf.select(F.element_at(col("a"), col("i")).alias("r")).collect()]
+    assert out == [None, 4, None, 6]
