@@ -159,6 +159,25 @@ class DataFrame:
 
     groupBy = group_by
 
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """Spark df.mapInPandas(fn, schema): fn(iterator of pandas
+        DataFrames) -> iterator of DataFrames (reference
+        GpuMapInBatchExec.scala)."""
+        return self._with(L.LogicalMapInBatch(fn, _to_schema(schema),
+                                              self._plan))
+
+    mapInPandas = map_in_pandas
+
+    def window_in_pandas(self, partition_by, *wins) -> "DataFrame":
+        """Whole-partition pandas window UDFs: each win is (fn, name,
+        result_type, input columns...); fn(series...) -> scalar broadcast
+        over its partition (reference GpuWindowInPandasExecBase)."""
+        parts = [_to_expr(p) for p in (
+            partition_by if isinstance(partition_by, (list, tuple))
+            else [partition_by])]
+        return self._with(L.LogicalWindowInPandas(
+            parts, _named_pandas_fns(wins), self._plan))
+
     def agg(self, *aggs: Tuple[AggregateFunction, str]) -> "DataFrame":
         return GroupedData([], self).agg(*aggs)
 
@@ -390,10 +409,65 @@ class DataFrame:
         return DataFrame(plan, self.session)
 
 
+def _to_schema(schema) -> Schema:
+    assert isinstance(schema, Schema), \
+        "pandas UDF output schema must be a Schema"
+    return schema
+
+
+def _named_pandas_fns(specs):
+    """Normalize (fn, name, result_type, inputs...) pandas-UDF specs: the
+    inputs may be varargs or one list/tuple."""
+    named = []
+    for fn, name, rt, *ins in specs:
+        exprs = [_to_expr(e) for e in
+                 (ins[0] if len(ins) == 1
+                  and isinstance(ins[0], (list, tuple)) else ins)]
+        named.append((fn, name, rt, exprs))
+    return named
+
+
+class CoGroupedData:
+    def __init__(self, left: "GroupedData", right: "GroupedData"):
+        self.left = left
+        self.right = right
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """fn(left_group_df, right_group_df) -> DataFrame per key in
+        either input (reference GpuFlatMapCoGroupsInPandasExec)."""
+        return self.left.df._with(L.LogicalCoGroupedMapInPandas(
+            self.left.keys, self.right.keys, fn, _to_schema(schema),
+            self.left.df._plan, self.right.df._plan))
+
+    applyInPandas = apply_in_pandas
+
+
 class GroupedData:
     def __init__(self, keys: List[Expression], df: DataFrame):
         self.keys = keys
         self.df = df
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """Spark df.groupBy(...).applyInPandas(fn, schema): fn receives
+        each group as a pandas DataFrame and returns a DataFrame matching
+        `schema` (reference GpuFlatMapGroupsInPandasExec.scala:79)."""
+        return self.df._with(L.LogicalGroupedMapInPandas(
+            self.keys, fn, _to_schema(schema), self.df._plan))
+
+    applyInPandas = apply_in_pandas
+
+    def agg_in_pandas(self, *aggs) -> DataFrame:
+        """Grouped pandas aggregates: each agg is (fn, name, result_type,
+        input columns/exprs...); fn receives one pandas Series per input
+        and returns a scalar (reference GpuAggregateInPandasExec)."""
+        key_names = [getattr(k, "name", f"key_{i}")
+                     for i, k in enumerate(self.keys)]
+        return self.df._with(L.LogicalAggregateInPandas(
+            self.keys, key_names, _named_pandas_fns(aggs), self.df._plan))
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """Spark df.groupBy(k).cogroup(other.groupBy(k))."""
+        return CoGroupedData(self, other)
 
     def agg(self, *aggs) -> DataFrame:
         named: List[Tuple[AggregateFunction, str]] = []

@@ -1,0 +1,347 @@
+"""Pandas UDF exec family — grouped map (applyInPandas), grouped
+aggregate, mapInPandas/mapInBatch, cogrouped map and window-in-pandas.
+
+Reference: the 14-file exec family under
+sql-plugin/src/main/scala/org/apache/spark/sql/rapids/execution/python/
+(GpuFlatMapGroupsInPandasExec.scala:79, GpuAggregateInPandasExec.scala,
+GpuMapInBatchExec.scala, GpuFlatMapCoGroupsInPandasExec.scala,
+GpuWindowInPandasExecBase.scala). There the plugin keeps data columnar on
+the GPU and ships Arrow batches over a socket to a Python worker; here
+the engine IS the Python process, so the transport collapses to one
+device→Arrow fetch per batch and the group slicing that the reference
+does with cuDF contiguous_split becomes host-side pandas groupby over
+engine-computed key columns (expressions evaluate on device first).
+
+Shape notes:
+- group completeness: like the reference (which requires an upstream
+  hash partitioning), each exec sees its full input; all child batches
+  fold into one pandas frame before grouping;
+- NULL keys form a real group (Spark groupBy semantics; dropna=False);
+- output re-enters the engine through Arrow with the declared schema, so
+  dtype mismatches fail loudly at the boundary, not downstream.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from ..columnar.batch import ColumnarBatch
+from ..expr.core import Expression, col
+from ..types import DataType, Schema, StructField, to_arrow as _t2a
+from .base import OP_TIME, TpuExec
+from .basic import bind_projection, eval_projection, projection_schema
+
+_KEY_PREFIX = "__pandas_gkey_"
+
+
+def _batch_to_pandas(batch: ColumnarBatch):
+    return batch.to_arrow().to_pandas()
+
+
+def _pandas_to_batches(pdf, schema: Schema,
+                       max_rows: int = 1 << 20) -> List[ColumnarBatch]:
+    import pyarrow as pa
+    arrow_schema = pa.schema([pa.field(f.name, _t2a(f.data_type))
+                              for f in schema.fields])
+    if len(pdf) == 0:
+        return []
+    pdf = pdf[[f.name for f in schema.fields]]
+    out = []
+    for s in range(0, len(pdf), max_rows):
+        table = pa.Table.from_pandas(pdf.iloc[s:s + max_rows],
+                                     schema=arrow_schema,
+                                     preserve_index=False)
+        out.append(ColumnarBatch.from_arrow(table))
+    return out
+
+
+class _PandasExecBase(TpuExec):
+    """Shared drive: evaluate (child cols + key exprs) on device per
+    batch, fetch each to pandas, concat, and expose host group frames."""
+
+    def __init__(self, key_exprs: Sequence[Expression], child: TpuExec):
+        super().__init__(child)
+        from ..expr.predicates import IsNotNull
+        in_schema = child.output_schema
+        self._key_names = [f"{_KEY_PREFIX}{i}"
+                           for i in range(len(key_exprs))]
+        # one validity lane per key: pandas folds NULL into NaN at the
+        # to_pandas boundary, but Spark groups NaN as a DISTINCT non-null
+        # value — the (value, is_not_null) pair keeps them apart
+        self._key_valid_names = [f"{n}_valid" for n in self._key_names]
+        pre = [col(n) for n in in_schema.names] + [
+            k.alias(n) for k, n in zip(key_exprs, self._key_names)] + [
+            IsNotNull(k).alias(n)
+            for k, n in zip(key_exprs, self._key_valid_names)]
+        self._pre_bound = bind_projection(pre, in_schema)
+        self._pre_schema = projection_schema(pre, in_schema)
+        import jax
+        self._jit_pre = jax.jit(lambda b: eval_projection(
+            self._pre_bound, b, self._pre_schema))
+
+    def _host_frame(self):
+        import pandas as pd
+        frames = [_batch_to_pandas(self._jit_pre(b))
+                  for b in self.child.execute()]
+        frames = [f for f in frames if len(f)]
+        if not frames:
+            return None
+        return pd.concat(frames, ignore_index=True) if len(frames) > 1 \
+            else frames[0]
+
+    def _groups(self, pdf):
+        """Yield (key_tuple, group_pdf_without_key_cols)."""
+        if not self._key_names:
+            yield (), pdf
+            return
+        nk = len(self._key_names)
+        by = self._key_names + self._key_valid_names
+        for key, g in pdf.groupby(by, sort=True, dropna=False):
+            if not isinstance(key, tuple):
+                key = (key,)
+            vals, valids = key[:nk], key[nk:]
+            key = tuple(None if not ok else k
+                        for k, ok in zip(vals, valids))
+            yield key, g.drop(columns=by)
+
+
+class GroupedMapInPandasExec(_PandasExecBase):
+    """df.groupBy(keys).applyInPandas(fn, schema) — reference
+    GpuFlatMapGroupsInPandasExec.scala:79."""
+
+    def __init__(self, key_exprs: Sequence[Expression], fn: Callable,
+                 out_schema: Schema, child: TpuExec):
+        super().__init__(key_exprs, child)
+        self.fn = fn
+        self._out_schema = out_schema
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._out_schema
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        import pandas as pd
+        with self.metrics[OP_TIME].ns_timer():
+            pdf = self._host_frame()
+            if pdf is None:
+                return
+            outs = []
+            for _, g in self._groups(pdf):
+                r = self.fn(g.reset_index(drop=True))
+                assert isinstance(r, pd.DataFrame), \
+                    "applyInPandas function must return a pandas DataFrame"
+                if len(r):
+                    outs.append(r)
+            if not outs:
+                return
+            merged = pd.concat(outs, ignore_index=True) \
+                if len(outs) > 1 else outs[0]
+            yield from _pandas_to_batches(merged, self._out_schema)
+
+
+class AggregateInPandasExec(_PandasExecBase):
+    """df.groupBy(keys).agg(pandas_udf) — one scalar per (group, agg);
+    output = key columns + agg columns. Reference
+    GpuAggregateInPandasExec.scala."""
+
+    def __init__(self, key_exprs: Sequence[Expression],
+                 aggs: Sequence[Tuple[Callable, str, DataType,
+                                      Sequence[Expression]]],
+                 key_names: Sequence[str], child: TpuExec):
+        # aggs: (fn, output name, result type, input expressions); fn
+        # receives one pandas Series per input expression
+        self._aggs = list(aggs)
+        self._out_key_names = list(key_names)
+        all_inputs: List[Expression] = [e for _, _, _, ins in self._aggs
+                                        for e in ins]
+        # ride the key machinery: keys first, then agg inputs
+        self._n_keys = len(key_exprs)
+        super().__init__(list(key_exprs) + list(all_inputs), child)
+        self._input_names = self._key_names[self._n_keys:]
+        self._agg_slots = []
+        pos = 0
+        for _, _, _, ins in self._aggs:
+            self._agg_slots.append(
+                [self._input_names[pos + j] for j in range(len(ins))])
+            pos += len(ins)
+        # grouping must NOT include the agg inputs (nor their validity
+        # lanes)
+        self._key_names = self._key_names[: self._n_keys]
+        self._key_valid_names = self._key_valid_names[: self._n_keys]
+
+    @property
+    def output_schema(self) -> Schema:
+        from ..expr.core import resolve
+        child_sch = self.child.output_schema
+        fields = []
+        for name, kexpr in zip(self._out_key_names,
+                               self._pre_schema.fields[
+                                   len(child_sch.fields):
+                                   len(child_sch.fields) + self._n_keys]):
+            fields.append(StructField(name, kexpr.data_type))
+        for _, name, rt, _ in self._aggs:
+            fields.append(StructField(name, rt))
+        return Schema(tuple(fields))
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        import pandas as pd
+        with self.metrics[OP_TIME].ns_timer():
+            pdf = self._host_frame()
+            if pdf is None:
+                return
+            rows: List[tuple] = []
+            for key, g in self._groups(pdf):
+                vals = []
+                for (fn, _, _, _), slots in zip(self._aggs,
+                                                self._agg_slots):
+                    vals.append(fn(*[g[s].reset_index(drop=True)
+                                     for s in slots]))
+                rows.append(tuple(key) + tuple(vals))
+            out = pd.DataFrame(
+                rows, columns=[f.name for f in self.output_schema.fields])
+            yield from _pandas_to_batches(out, self.output_schema)
+
+
+class MapInBatchExec(TpuExec):
+    """df.mapInPandas(fn, schema): fn(iterator of pandas DataFrames) ->
+    iterator of DataFrames, streamed batch-by-batch. Reference
+    GpuMapInBatchExec.scala (base of mapInPandas / mapInArrow)."""
+
+    def __init__(self, fn: Callable, out_schema: Schema, child: TpuExec):
+        super().__init__(child)
+        self.fn = fn
+        self._out_schema = out_schema
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._out_schema
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        with self.metrics[OP_TIME].ns_timer():
+            def frames():
+                for b in self.child.execute():
+                    pdf = _batch_to_pandas(b)
+                    if len(pdf):
+                        yield pdf
+            for out in self.fn(frames()):
+                yield from _pandas_to_batches(out, self._out_schema)
+
+
+class CoGroupedMapInPandasExec(TpuExec):
+    """cogroup(left.groupBy(k), right.groupBy(k)).applyInPandas —
+    fn(left_group_df, right_group_df) per key in either side (missing
+    side passes an empty frame). Reference
+    GpuFlatMapCoGroupsInPandasExec.scala."""
+
+    def __init__(self, left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression], fn: Callable,
+                 out_schema: Schema, left: TpuExec, right: TpuExec):
+        super().__init__(left, right)
+        self.fn = fn
+        self._out_schema = out_schema
+        self._lside = _PandasSide(left_keys, left)
+        self._rside = _PandasSide(right_keys, right)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._out_schema
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        import pandas as pd
+        with self.metrics[OP_TIME].ns_timer():
+            lg = self._lside.host_groups()
+            rg = self._rside.host_groups()
+            keys = list(lg.keys()) + [k for k in rg.keys() if k not in lg]
+            outs = []
+            lempty = self._lside.empty_frame()
+            rempty = self._rside.empty_frame()
+            for k in keys:
+                r = self.fn(lg.get(k, lempty), rg.get(k, rempty))
+                assert isinstance(r, pd.DataFrame)
+                if len(r):
+                    outs.append(r)
+            if not outs:
+                return
+            merged = pd.concat(outs, ignore_index=True) \
+                if len(outs) > 1 else outs[0]
+            yield from _pandas_to_batches(merged, self._out_schema)
+
+
+class _PandasSide(_PandasExecBase):
+    """One cogroup input: owns its key projection and host grouping."""
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def host_groups(self):
+        pdf = self._host_frame()
+        if pdf is None:
+            return {}
+        return {k: g.reset_index(drop=True) for k, g in self._groups(pdf)}
+
+    def empty_frame(self):
+        import pandas as pd
+        return pd.DataFrame(
+            {f.name: pd.Series([], dtype=object)
+             for f in self.child.output_schema.fields})
+
+    def internal_execute(self):  # never driven directly
+        raise NotImplementedError
+
+
+class WindowInPandasExec(_PandasExecBase):
+    """Whole-partition window over a pandas UDF: fn(input series...) ->
+    scalar, broadcast to every row of the partition (the reference's
+    GpuWindowInPandasExec main case — unbounded-to-unbounded frames,
+    GpuWindowInPandasExecBase.scala)."""
+
+    def __init__(self, part_exprs: Sequence[Expression],
+                 wins: Sequence[Tuple[Callable, str, DataType,
+                                      Sequence[Expression]]],
+                 child: TpuExec):
+        self._wins = list(wins)
+        all_inputs: List[Expression] = []
+        for _, _, _, ins in self._wins:
+            all_inputs.extend(ins)
+        self._n_parts = len(part_exprs)
+        super().__init__(list(part_exprs) + all_inputs, child)
+        self._win_names = self._key_names[self._n_parts:]
+        self._win_slots = []
+        pos = 0
+        for _, _, _, ins in self._wins:
+            self._win_slots.append(
+                [self._win_names[pos + j] for j in range(len(ins))])
+            pos += len(ins)
+        self._key_names = self._key_names[: self._n_parts]
+        self._key_valid_names = self._key_valid_names[: self._n_parts]
+
+    @property
+    def output_schema(self) -> Schema:
+        fields = list(self.child.output_schema.fields)
+        for _, name, rt, _ in self._wins:
+            fields.append(StructField(name, rt))
+        return Schema(tuple(fields))
+
+    def internal_execute(self) -> Iterator[ColumnarBatch]:
+        import pandas as pd
+        with self.metrics[OP_TIME].ns_timer():
+            pdf = self._host_frame()
+            if pdf is None:
+                return
+            n_child = len(self.child.output_schema.fields)
+            child_names = [f.name for f in self.child.output_schema.fields]
+            outs = []
+            for _, g in self._groups(pdf):
+                piece = g[child_names].reset_index(drop=True)
+                for (fn, name, _, _), slots in zip(self._wins,
+                                                   self._win_slots):
+                    val = fn(*[g[s].reset_index(drop=True)
+                               for s in slots])
+                    piece[name] = val
+                outs.append(piece)
+            merged = pd.concat(outs, ignore_index=True) \
+                if len(outs) > 1 else outs[0]
+            yield from _pandas_to_batches(merged, self.output_schema)

@@ -101,6 +101,8 @@ class WindowExec(TpuExec):
         self._pre_schema = projection_schema(self._pre_exprs, in_schema)
         self._jit_window = jax.jit(self._window_kernel, static_argnums=(1,))
         self._jit_lps = None
+        self._jit_fpl = None
+        self._jit_carry_update = None
         self._jit_pre = jax.jit(lambda b: eval_projection(
             self._pre_bound, b, self._pre_schema))
 
@@ -162,12 +164,12 @@ class WindowExec(TpuExec):
             res_type = out_schema.fields[self._n_child + i].data_type
             ins = [sorted_cols[s] for s in self._input_slots[i]]
             col = self._eval_fn(fn, we.spec.frame, ins, seg, ob, group_last,
-                                n, cap, res_type)
+                                n, cap, res_type, sorted_orders)
             out_cols.append(sanitize(col, n))
         return ColumnarBatch(out_cols, n, out_schema)
 
     def _eval_fn(self, fn, frame, ins, seg, order_boundary, group_last,
-                 n, cap, res_type) -> Column:
+                 n, cap, res_type, sorted_orders=()) -> Column:
         ones = jnp.ones((cap,), jnp.bool_)
         if isinstance(fn, RowNumber):
             return Column(row_number(seg, n, cap), ones, res_type)
@@ -204,6 +206,37 @@ class WindowExec(TpuExec):
             preceding, following = frame.preceding, frame.following
 
         values = ins[0] if ins else None
+        if frame.kind == "range" and not (preceding is None
+                                          and following is None):
+            # bounded RANGE frame: value-offset bounds over the single
+            # numeric order key (Spark's analyzer enforces exactly one)
+            assert len(self._order_slots) == 1, \
+                "bounded RANGE frame requires exactly one order expression"
+            from ..ops.window import (range_frame_bounds, range_min_max,
+                                      range_sum_count)
+            asc, nf = self._order_dirs[0]
+            if nf is None:
+                nf = asc  # Spark default: asc => nulls first
+            lo, hi = range_frame_bounds(sorted_orders[0], seg, n, cap,
+                                        preceding, following, asc, nf)
+            if fn.op in ("sum", "count", "avg"):
+                if values is None:
+                    data = jnp.ones((cap,), jnp.int64)
+                    valid = active_mask(n, cap)
+                else:
+                    data, valid = values.data, values.validity
+                s, c = range_sum_count(data, valid, seg, n, cap, lo, hi)
+                if fn.op == "count":
+                    return Column(c.astype(jnp.int64), ones, res_type)
+                if fn.op == "avg":
+                    ok = c > 0
+                    d = s.astype(jnp.float64) / jnp.where(ok, c, 1)
+                    return Column(jnp.where(ok, d, 0.0), ok, res_type)
+                return Column(s.astype(res_type.jnp_dtype), c > 0, res_type)
+            assert fn.op in ("min", "max"), fn.op
+            data, valid = range_min_max(values.data, values.validity, n,
+                                        cap, lo, hi, fn.op == "max")
+            return Column(data.astype(values.data.dtype), valid, res_type)
         if fn.op in ("sum", "count", "avg"):
             if values is None:
                 data = jnp.ones((cap,), jnp.int64)
@@ -259,6 +292,197 @@ class WindowExec(TpuExec):
                                       fn.op == "max")
         return Column(data.astype(values.data.dtype), valid, res_type)
 
+    # -- giant-partition two-pass (reference
+    # GpuUnboundedToUnboundedAggWindowExec.scala:1155) ---------------------
+    # When one partition outgrows the chunk budget AND every window
+    # expression is a whole-partition aggregate, hold only tiny carry
+    # STATE (sum/count/min/max scalars) plus spillable row pieces; pass 2
+    # replays the pieces appending the broadcast final values. Peak device
+    # memory = one chunk, not the partition.
+    TWO_PASS_THRESHOLD_ROWS = 1 << 21
+
+    def _whole_partition_aggs(self):
+        """(op, input slot or None) per expr if EVERY window expression is
+        a whole-partition numeric aggregate, else None."""
+        out = []
+        for i, (we, _) in enumerate(self.window_exprs):
+            fn = we.fn
+            if not isinstance(fn, WindowAgg) or fn.op not in (
+                    "sum", "count", "avg", "min", "max"):
+                return None
+            fr = we.spec.frame
+            whole = (fr.kind == "default" and not self._order_slots) or \
+                (fr.kind in ("rows", "range") and fr.preceding is None
+                 and fr.following is None)
+            if not whole:
+                return None
+            slots = self._input_slots[i]
+            if slots:
+                from ..columnar.column import Column as _C
+                ft = self._pre_schema.fields[slots[0]].data_type
+                from ..types import (ByteType, DoubleType, FloatType,
+                                     IntegerType, LongType, ShortType)
+                if not isinstance(ft, (ByteType, ShortType, IntegerType,
+                                       LongType, FloatType, DoubleType)):
+                    return None
+            out.append((fn.op, slots[0] if slots else None))
+        return out
+
+    class _PartitionCarry:
+        """Running whole-partition aggregate state + spilled row pieces
+        for ONE partition streaming through multiple chunks."""
+
+        def __init__(self, exec_, aggs):
+            self._exec = exec_
+            self._aggs = aggs
+            self._pieces: List = []
+            self._state = None  # per-agg (sum, cnt, mn, mx) device scalars
+            # the compiled update kernel lives on the exec (aggs are fixed
+            # per exec), so successive giant partitions share it
+            if getattr(exec_, "_jit_carry_update", None) is None:
+                exec_._jit_carry_update = jax.jit(self._update_kernel)
+            self._jit_update = exec_._jit_carry_update
+
+        def _update_kernel(self, batch: ColumnarBatch, state):
+            out = []
+            act = active_mask(batch.num_rows, batch.capacity)
+            for (op, slot), st in zip(self._aggs, state):
+                s, c, mn, mx = st
+                if slot is None:
+                    c = c + jnp.sum(act, dtype=jnp.int64)
+                    out.append((s, c, mn, mx))
+                    continue
+                col = batch.columns[slot]
+                valid = col.validity & act
+                # widen BEFORE the where: an i64 sentinel stuffed into an
+                # i32 lane truncates to -1/0 and poisons the extrema
+                if jnp.issubdtype(col.data.dtype, jnp.floating):
+                    v = col.data.astype(jnp.float64)
+                    lo_sent, hi_sent = jnp.inf, -jnp.inf
+                else:
+                    v = col.data.astype(jnp.int64)
+                    info = jnp.iinfo(jnp.int64)
+                    lo_sent, hi_sent = info.max, info.min
+                s = s + jnp.sum(jnp.where(valid, v, jnp.zeros((), v.dtype)))
+                c = c + jnp.sum(valid, dtype=jnp.int64)
+                mn = jnp.minimum(mn, jnp.min(jnp.where(valid, v, lo_sent)))
+                mx = jnp.maximum(mx, jnp.max(jnp.where(valid, v, hi_sent)))
+                out.append((s, c, mn, mx))
+            return tuple(out)
+
+        def _zero_state(self, batch: ColumnarBatch):
+            st = []
+            for op, slot in self._aggs:
+                flt = slot is not None and jnp.issubdtype(
+                    batch.columns[slot].data.dtype, jnp.floating)
+                s = jnp.float64(0.0) if flt else jnp.int64(0)
+                mn = jnp.float64(jnp.inf) if flt \
+                    else jnp.int64(jnp.iinfo(jnp.int64).max)
+                mx = jnp.float64(-jnp.inf) if flt \
+                    else jnp.int64(jnp.iinfo(jnp.int64).min)
+                st.append((s, jnp.int64(0), mn, mx))
+            return tuple(st)
+
+        def add(self, piece: ColumnarBatch):
+            from ..memory.spillable import SpillableBatch
+            if self._state is None:
+                self._state = self._zero_state(piece)
+            self._state = self._jit_update(piece, self._state)
+            self._pieces.append(SpillableBatch.from_batch(piece))
+
+        def finalize(self) -> Iterator[ColumnarBatch]:
+            ex = self._exec
+            out_schema = ex.output_schema
+            n_child = ex._n_child
+            state = self._state
+            for sp in self._pieces:
+                piece = sp.get_batch()
+                cap = piece.capacity
+                n = piece.num_rows
+                act = active_mask(n, cap)
+                cols = list(piece.columns[:n_child])
+                for i, ((op, slot), st) in enumerate(
+                        zip(self._aggs, state)):
+                    s, c, mn, mx = st
+                    rt = out_schema.fields[n_child + i].data_type
+                    if op == "count":
+                        data, ok = jnp.broadcast_to(c, (cap,)), \
+                            jnp.broadcast_to(jnp.bool_(True), (cap,))
+                    elif op == "avg":
+                        d = s.astype(jnp.float64) / jnp.maximum(c, 1)
+                        data = jnp.broadcast_to(d, (cap,))
+                        ok = jnp.broadcast_to(c > 0, (cap,))
+                    elif op == "sum":
+                        data = jnp.broadcast_to(
+                            s.astype(rt.jnp_dtype), (cap,))
+                        ok = jnp.broadcast_to(c > 0, (cap,))
+                    else:
+                        v = mn if op == "min" else mx
+                        data = jnp.broadcast_to(
+                            v.astype(rt.jnp_dtype), (cap,))
+                        ok = jnp.broadcast_to(c > 0, (cap,))
+                    cols.append(sanitize(
+                        Column(data, ok & act, rt), n))
+                yield ColumnarBatch(cols, n, out_schema)
+                sp.release()
+                sp.close()
+            self._pieces = []
+
+    def _part_key_match(self, columns, words: int, ref_cols, ref_idx):
+        """(cap,) bool: row's partition key equals ref_cols' key at
+        ref_idx. ref_cols holds ONE column per partition slot (possibly
+        the same batch's columns). Shared by the last-partition split and
+        the carry-continuation check — the string-lane gotchas (exact
+        prefix lanes at `words`; null rows compare by validity alone, the
+        underlying bytes may be arbitrary) live in exactly one place."""
+        from ..columnar.column import StringColumn
+        from ..ops.sort import _numeric_order_key, string_prefix_lanes
+        from ..ops.strings import string_lengths
+
+        cap = columns[self._part_slots[0]].capacity if self._part_slots \
+            else 0
+        same = jnp.ones((cap,), jnp.bool_)
+        for c, r in zip((columns[s] for s in self._part_slots), ref_cols):
+            if isinstance(c, StringColumn):
+                for lane, rlane in zip(string_prefix_lanes(c, words),
+                                       string_prefix_lanes(r, words)):
+                    lane = jnp.where(c.validity, lane, 0)
+                    rlane = jnp.where(r.validity, rlane, 0)
+                    same = same & (lane == rlane[ref_idx])
+                lens = jnp.where(c.validity, string_lengths(c), 0)
+                rlens = jnp.where(r.validity, string_lengths(r), 0)
+                same = same & (lens == rlens[ref_idx])
+                same = same & (c.validity == r.validity[ref_idx])
+            else:
+                lane = _numeric_order_key(c)
+                lane = jnp.where(c.validity, lane, jnp.zeros((), lane.dtype))
+                rlane = _numeric_order_key(r)
+                rlane = jnp.where(r.validity, rlane,
+                                  jnp.zeros((), rlane.dtype))
+                same = same & (lane == rlane[ref_idx]) \
+                    & (c.validity == r.validity[ref_idx])
+        return same
+
+    def _first_partition_len(self, batch: ColumnarBatch, words: int,
+                             ref_cols) -> int:
+        """Host int: number of leading rows whose partition key equals the
+        CARRY partition's key (ref_cols, one 1-row column per partition
+        slot) — NOT the batch's own first key, which would fold a fresh
+        partition into the carry when a chunk boundary lands exactly on
+        the giant partition's end."""
+        if self._jit_fpl is None:
+            def fpl(b: ColumnarBatch, w: int, refs):
+                n = b.num_rows
+                cap = b.capacity
+                same = self._part_key_match(b.columns, w, refs, 0)
+                act = active_mask(n, cap)
+                idx = jnp.arange(cap, dtype=jnp.int32)
+                nm = jnp.min(jnp.where(act & ~same, idx, cap))
+                return jnp.minimum(nm, n)
+
+            self._jit_fpl = jax.jit(fpl, static_argnums=(1,))
+        return int(self._jit_fpl(batch, words, ref_cols))
+
     # -- drive -------------------------------------------------------------
     def _last_partition_start(self, batch: ColumnarBatch,
                               words: int) -> int:
@@ -266,34 +490,13 @@ class WindowExec(TpuExec):
         a (partition, order)-sorted batch. One tiny device sync per
         chunk — the price of partition-aligned batching."""
         if self._jit_lps is None:
-            from ..ops.sort import _numeric_order_key
-
             def lps(b: ColumnarBatch, w: int):
                 n = b.num_rows
                 cap = b.capacity
                 last = jnp.clip(n - 1, 0, cap - 1)
-                same = jnp.ones((cap,), jnp.bool_)
-                for s in self._part_slots:
-                    c = b.columns[s]
-                    from ..columnar.column import StringColumn
-                    if isinstance(c, StringColumn):
-                        from ..ops.sort import string_prefix_lanes
-                        from ..ops.strings import string_lengths
-                        # prefix lanes are exact at `w` (string_words_for);
-                        # null rows compare by validity alone (their
-                        # underlying bytes may be arbitrary)
-                        for lane in string_prefix_lanes(c, w):
-                            lane = jnp.where(c.validity, lane, 0)
-                            same = same & (lane == lane[last])
-                        lens = jnp.where(c.validity, string_lengths(c), 0)
-                        same = same & (lens == lens[last])
-                        same = same & (c.validity == c.validity[last])
-                    else:
-                        lane = _numeric_order_key(c)
-                        lane = jnp.where(c.validity, lane,
-                                         jnp.zeros((), lane.dtype))
-                        same = same & (lane == lane[last]) \
-                            & (c.validity == c.validity[last])
+                same = self._part_key_match(
+                    b.columns, w, [b.columns[s] for s in self._part_slots],
+                    last)
                 act = active_mask(n, cap)
                 # first index i such that rows i..n-1 all match the last
                 # key: max over non-matching active rows + 1
@@ -337,9 +540,36 @@ class WindowExec(TpuExec):
                 in zip(self._order_slots, self._order_dirs)]
             sorter = SortExec(orders, source)
             held: ColumnarBatch = None
+            carry = None
+            two_pass_aggs = self._whole_partition_aggs()
             saw = False
             for chunk in sorter.execute():
                 saw = True
+                if carry is not None:
+                    # an active giant partition: rows continuing it fold
+                    # into the carry state; the first foreign key closes it
+                    cw = string_words_for(
+                        chunk.columns, self._part_slots + self._order_slots)
+                    cw = max(cw, carry_words)
+                    flen = self._first_partition_len(chunk, cw, carry_ref)
+                    nch = chunk.num_rows_host
+                    if flen >= nch:
+                        carry.add(chunk)
+                        continue
+                    if flen > 0:
+                        hcap = bucket_capacity(max(flen, 1))
+                        carry.add(ColumnarBatch(
+                            [slice_rows(c, jnp.int32(0), jnp.int32(flen),
+                                        hcap) for c in chunk.columns],
+                            flen, self._pre_schema))
+                    yield from carry.finalize()
+                    carry = None
+                    rest_n = nch - flen
+                    rcap = bucket_capacity(max(rest_n, 1))
+                    chunk = ColumnarBatch(
+                        [slice_rows(c, jnp.int32(flen), jnp.int32(rest_n),
+                                    rcap) for c in chunk.columns],
+                        rest_n, self._pre_schema)
                 if held is not None and held.num_rows_host > 0:
                     cur = concat_batches([held, chunk], self._pre_schema)
                 else:
@@ -349,7 +579,24 @@ class WindowExec(TpuExec):
                     cur.columns, self._part_slots + self._order_slots)
                 split = self._last_partition_start(cur, cur_words)
                 if split <= 0:
-                    held = cur  # one giant partition so far: keep growing
+                    # one giant partition so far: switch to carry state if
+                    # every expression is a whole-partition aggregate,
+                    # else keep growing (concat fallback)
+                    if two_pass_aggs is not None and \
+                            n > self.TWO_PASS_THRESHOLD_ROWS:
+                        carry = self._PartitionCarry(self, two_pass_aggs)
+                        carry.add(cur)
+                        # 1-row reference key identifying the carried
+                        # partition (continuation checks compare against
+                        # THIS, not an incoming chunk's own first row)
+                        carry_ref = [
+                            slice_rows(cur.columns[s], jnp.int32(0),
+                                       jnp.int32(1), bucket_capacity(1))
+                            for s in self._part_slots]
+                        carry_words = cur_words
+                        held = None
+                    else:
+                        held = cur
                     continue
                 ready_cap = bucket_capacity(max(split, 1))
                 ready = ColumnarBatch(
@@ -367,7 +614,9 @@ class WindowExec(TpuExec):
                 yield self._jit_window(ready, cur_words)
             if not saw:
                 return
-            if held is not None and held.num_rows_host > 0:
+            if carry is not None:
+                yield from carry.finalize()
+            elif held is not None and held.num_rows_host > 0:
                 words = string_words_for(
                     held.columns, self._part_slots + self._order_slots)
                 yield self._jit_window(held, words)

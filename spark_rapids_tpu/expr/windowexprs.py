@@ -15,7 +15,7 @@ from .core import Expression
 #: frame bound: None = UNBOUNDED, 0 = CURRENT ROW, n>0 = n rows
 @dataclass(frozen=True)
 class WindowFrame:
-    kind: str = "default"  # 'default' | 'rows'
+    kind: str = "default"  # 'default' | 'rows' | 'range'
     preceding: Optional[int] = None
     following: Optional[int] = 0
 
@@ -23,6 +23,13 @@ class WindowFrame:
     def rows(preceding: Optional[int], following: Optional[int]
              ) -> "WindowFrame":
         return WindowFrame("rows", preceding, following)
+
+    @staticmethod
+    def range(preceding, following) -> "WindowFrame":
+        """RANGE frame with VALUE offsets over the single numeric order
+        key (None = unbounded; 0 = CURRENT ROW incl. ties). Reference
+        window/GpuWindowExpression.scala:111-179."""
+        return WindowFrame("range", preceding, following)
 
     @staticmethod
     def unbounded() -> "WindowFrame":

@@ -1,33 +1,56 @@
-"""ORC scan + write (reference GpuOrcScan.scala / GpuOrcFileFormat:
-footer-driven stripe slicing + device decode; here pyarrow's C++ ORC
-reader decodes stripes on the prefetch pool, uploaded as device columns).
+"""ORC scan + write (reference GpuOrcScan.scala:1455-1546 /
+GpuOrcFileFormat).
 
-Stripe-per-task granularity mirrors the parquet row-group reader; column
-pruning via `columns`."""
+Round-5 parity rework: the scan prunes stripes with prove-absence
+semantics from the file's own StripeStatistics (parsed by io/orc_meta —
+pyarrow exposes stripe counts but not the statistics values), pushes
+column and predicate selection, supports the COALESCING reader shape,
+and reports pruning counters, mirroring io/parquet.py's surface so the
+planner's pushdown hook (`with_filters`) treats both formats alike.
+Decode itself rides pyarrow's C++ ORC reader on the prefetch pool,
+uploaded as device columns; stripe-per-task granularity mirrors the
+parquet row-group reader."""
 
 from __future__ import annotations
 
 import os
-from typing import Iterator, Optional, Sequence
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 from ..columnar.batch import ColumnarBatch
 from ..config import RapidsConf
 from ..types import Schema, StructField, from_arrow
 from .multifile import arrow_to_batches, expand_paths, threaded_chunks
-from .parquet import DEFAULT_BATCH_ROWS, DEFAULT_NUM_THREADS
+from .orc_meta import OrcFileMeta
+from .parquet import (
+    DEFAULT_BATCH_ROWS, DEFAULT_NUM_THREADS, _stats_can_skip,
+)
+
+
+def _to_stat_literal(value) -> object:
+    """Convert a pushed literal to the domain ORC statistics use
+    (dates are day numbers; everything else compares as-is)."""
+    import datetime as dt
+    if isinstance(value, dt.date) and not isinstance(value, dt.datetime):
+        return (value - dt.date(1970, 1, 1)).days
+    return value
 
 
 class OrcSource:
     def __init__(self, path, conf: Optional[RapidsConf] = None,
                  columns: Optional[Sequence[str]] = None,
                  num_threads: int = DEFAULT_NUM_THREADS,
-                 batch_rows: int = DEFAULT_BATCH_ROWS):
+                 batch_rows: int = DEFAULT_BATCH_ROWS,
+                 filters: Optional[Sequence[Tuple[str, str, object]]] = None,
+                 reader_type: Optional[str] = None):
         import pyarrow.orc as paorc
         self.paths = expand_paths(path)
         assert self.paths, f"no orc files at {path!r}"
         self.columns = list(columns) if columns is not None else None
         self.num_threads = num_threads
         self.batch_rows = batch_rows
+        self.filters = list(filters or [])
+        self._conf = conf
+        self.reader_type = (reader_type or "MULTITHREADED").upper()
         f = paorc.ORCFile(self.paths[0])
         arrow_schema = f.schema
         fields = []
@@ -36,37 +59,109 @@ class OrcSource:
             fields.append(StructField(fld.name, from_arrow(fld.type),
                                       fld.nullable))
         self.schema = Schema(tuple(fields))
+        #: observability (mirrors ParquetSource.scan_stats; the reference's
+        #: ORC scan metrics are the stripe read/skip counters)
+        self.scan_stats = {"stripes_read": 0, "stripes_pruned": 0}
+
+    @property
+    def stripes_read(self) -> int:
+        return self.scan_stats["stripes_read"]
+
+    @property
+    def stripes_pruned(self) -> int:
+        return self.scan_stats["stripes_pruned"]
+
+    def with_filters(self, filters: Sequence[Tuple[str, str, object]]
+                     ) -> "OrcSource":
+        """Planner pushdown hook (same contract as ParquetSource): stats
+        only prove absence, never presence — the Filter stays above."""
+        out = OrcSource.__new__(OrcSource)
+        out.__dict__.update(self.__dict__)
+        out.filters = list(self.filters) + list(filters)
+        return out
 
     def estimated_size_bytes(self) -> int:
         return sum(os.path.getsize(p) for p in self.paths)
+
+    def _stripe_pruned(self, per_name) -> bool:
+        for (name, op, value) in self.filters:
+            stats = per_name.get(name)
+            if stats is None:
+                continue
+            if _stats_can_skip(stats, op, _to_stat_literal(value)):
+                return True
+        return False
 
     def batches(self) -> Iterator[ColumnarBatch]:
         import pyarrow.orc as paorc
 
         tasks = []
+        self.scan_stats["stripes_read"] = 0
+        self.scan_stats["stripes_pruned"] = 0
+        may_prune = bool(self.filters)
         for p in self.paths:
             f = paorc.ORCFile(p)
             n = f.nstripes
+            meta = OrcFileMeta(p) if may_prune and n > 0 else None
+            stats = meta.stripe_stats if meta is not None and meta.ok \
+                else []
             for s in range(n):
+                if s < len(stats) and self._stripe_pruned(stats[s]):
+                    self.scan_stats["stripes_pruned"] += 1
+                    continue
+                self.scan_stats["stripes_read"] += 1
+
                 def decode(p=p, s=s):
+                    # fresh handle per task: ORCFile is not thread-safe
                     return paorc.ORCFile(p).read_stripe(
                         s, columns=self.columns)
                 tasks.append(decode)
             if n == 0:
                 tasks.append(lambda p=p: paorc.ORCFile(p).read(
                     columns=self.columns))
-        for item in threaded_chunks(tasks, self.num_threads):
-            import pyarrow as pa
-            table = pa.Table.from_batches([item]) \
-                if isinstance(item, pa.RecordBatch) else item
-            yield from arrow_to_batches(table, self.batch_rows)
+        import pyarrow as pa
+
+        def tables():
+            for item in threaded_chunks(tasks, self.num_threads):
+                yield pa.Table.from_batches([item]) \
+                    if isinstance(item, pa.RecordBatch) else item
+
+        if self.reader_type == "COALESCING":
+            yield from self._coalescing_drive(tables())
+        else:
+            for table in tables():
+                yield from arrow_to_batches(table, self.batch_rows)
+
+    def _coalescing_drive(self, tables) -> Iterator[ColumnarBatch]:
+        """Stitch decoded stripes host-side into ~batch_rows tables before
+        the device upload (reference COALESCING reader shape,
+        GpuMultiFileReader.scala:830)."""
+        import pyarrow as pa
+        pending: List = []
+        pending_rows = 0
+        for table in tables:
+            pending.append(table)
+            pending_rows += table.num_rows
+            if pending_rows >= self.batch_rows:
+                yield from arrow_to_batches(pa.concat_tables(pending),
+                                            self.batch_rows)
+                pending, pending_rows = [], 0
+        if pending:
+            yield from arrow_to_batches(pa.concat_tables(pending),
+                                        self.batch_rows)
 
 
-def write_orc(df, path):
+def write_orc(df, path, compression: Optional[str] = None,
+              stripe_size: Optional[int] = None):
     """DataFrame -> ORC file (reference GpuOrcFileFormat writer)."""
     import pyarrow.orc as paorc
 
     table = df.to_arrow()
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
                 exist_ok=True)
-    paorc.write_table(table, path)
+    kw = {}
+    if compression is not None:
+        kw["compression"] = compression
+    if stripe_size is not None:
+        kw["stripe_size"] = stripe_size
+    paorc.write_table(table, path, **kw)

@@ -93,7 +93,8 @@ def exchange_columns(columns: Sequence[Column], key_ordinals: Sequence[int],
 
     out_cols: List[Column] = []
     recv_cap = n_parts * slot_cap
-    for col in columns:
+
+    def xch_one(col: Column) -> Column:
         if isinstance(col, StringColumn):
             g = gather_column(col, send_idx)
             lengths, padded = string_to_padded(g, string_width)
@@ -102,19 +103,33 @@ def exchange_columns(columns: Sequence[Column], key_ordinals: Sequence[int],
                 tiled=False).reshape((recv_cap,))
             r_pad = jax.lax.all_to_all(
                 padded.reshape((n_parts, slot_cap, string_width)),
-                axis_name, 0, 0, tiled=False).reshape((recv_cap, string_width))
+                axis_name, 0, 0,
+                tiled=False).reshape((recv_cap, string_width))
             r_val = jax.lax.all_to_all(
                 g.validity.reshape((n_parts, slot_cap)), axis_name, 0, 0,
                 tiled=False).reshape((recv_cap,))
-            out_cols.append(string_from_padded(r_len, r_pad, r_val,
-                                               col.dtype))
-        else:
-            data, valid = _fixed_to_blocks(col, send_idx, n_parts, slot_cap)
-            r_data = jax.lax.all_to_all(data, axis_name, 0, 0,
-                                        tiled=False).reshape((recv_cap,))
-            r_val = jax.lax.all_to_all(valid, axis_name, 0, 0,
-                                       tiled=False).reshape((recv_cap,))
-            out_cols.append(Column(r_data, r_val, col.dtype))
+            return string_from_padded(r_len, r_pad, r_val, col.dtype)
+        from ..columnar.column import StructColumn
+        if isinstance(col, StructColumn):
+            # struct/decimal128: exchange the limbs/fields recursively and
+            # carry the struct's own validity as one more lane
+            kids = tuple(xch_one(k) for k in col.children)
+            g_val = gather_column(
+                Column(jnp.zeros((col.capacity,), jnp.int32), col.validity,
+                       col.dtype), send_idx).validity
+            r_val = jax.lax.all_to_all(
+                g_val.reshape((n_parts, slot_cap)), axis_name, 0, 0,
+                tiled=False).reshape((recv_cap,))
+            return type(col)(kids, r_val, col.dtype)
+        data, valid = _fixed_to_blocks(col, send_idx, n_parts, slot_cap)
+        r_data = jax.lax.all_to_all(data, axis_name, 0, 0,
+                                    tiled=False).reshape((recv_cap,))
+        r_val = jax.lax.all_to_all(valid, axis_name, 0, 0,
+                                   tiled=False).reshape((recv_cap,))
+        return Column(r_data, r_val, col.dtype)
+
+    for col in columns:
+        out_cols.append(xch_one(col))
 
     # occupancy: a slot is occupied iff its send side had a row; validity of
     # a real-but-null row is False, so track occupancy separately

@@ -286,3 +286,103 @@ class LogicalSample(LogicalPlan):
 
     def describe(self):
         return f"Sample[fraction={self.fraction}, seed={self.seed}]"
+
+
+class LogicalGroupedMapInPandas(LogicalPlan):
+    """df.groupBy(keys).applyInPandas(fn, schema) — reference
+    GpuFlatMapGroupsInPandasExec.scala:79."""
+
+    def __init__(self, keys, fn, out_schema: Schema, child: LogicalPlan):
+        self.keys = list(keys)
+        self.fn = fn
+        self.out_schema = out_schema
+        self.children = (child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.out_schema
+
+    def describe(self):
+        return f"GroupedMapInPandas[{getattr(self.fn, '__name__', 'fn')}]"
+
+
+class LogicalAggregateInPandas(LogicalPlan):
+    """df.groupBy(keys).agg(pandas_udf...) — reference
+    GpuAggregateInPandasExec.scala."""
+
+    def __init__(self, keys, key_names, aggs, child: LogicalPlan):
+        self.keys = list(keys)
+        self.key_names = list(key_names)
+        self.aggs = list(aggs)  # (fn, name, result type, [input exprs])
+        self.children = (child,)
+
+    @property
+    def schema(self) -> Schema:
+        from ..expr.core import resolve
+        from ..types import StructField
+        child = self.children[0].schema
+        fields = [StructField(n, resolve(k, child).data_type)
+                  for n, k in zip(self.key_names, self.keys)]
+        fields += [StructField(name, rt)
+                   for _, name, rt, _ in self.aggs]
+        return Schema(tuple(fields))
+
+    def describe(self):
+        return f"AggregateInPandas[{len(self.aggs)} aggs]"
+
+
+class LogicalMapInBatch(LogicalPlan):
+    """df.mapInPandas(fn, schema) — reference GpuMapInBatchExec.scala."""
+
+    def __init__(self, fn, out_schema: Schema, child: LogicalPlan):
+        self.fn = fn
+        self.out_schema = out_schema
+        self.children = (child,)
+
+    @property
+    def schema(self) -> Schema:
+        return self.out_schema
+
+    def describe(self):
+        return f"MapInPandas[{getattr(self.fn, '__name__', 'fn')}]"
+
+
+class LogicalCoGroupedMapInPandas(LogicalPlan):
+    """cogroup(...).applyInPandas(fn, schema) — reference
+    GpuFlatMapCoGroupsInPandasExec.scala."""
+
+    def __init__(self, left_keys, right_keys, fn, out_schema: Schema,
+                 left: LogicalPlan, right: LogicalPlan):
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self.out_schema = out_schema
+        self.children = (left, right)
+
+    @property
+    def schema(self) -> Schema:
+        return self.out_schema
+
+    def describe(self):
+        return "CoGroupedMapInPandas"
+
+
+class LogicalWindowInPandas(LogicalPlan):
+    """Whole-partition pandas window UDF — reference
+    GpuWindowInPandasExecBase.scala."""
+
+    def __init__(self, part_exprs, wins, child: LogicalPlan):
+        self.part_exprs = list(part_exprs)
+        self.wins = list(wins)  # (fn, name, result type, [input exprs])
+        self.children = (child,)
+
+    @property
+    def schema(self) -> Schema:
+        from ..types import StructField
+        fields = list(self.children[0].schema.fields)
+        for _, name, rt, _ in self.wins:
+            fields.append(StructField(name, rt))
+        return Schema(tuple(fields))
+
+    def describe(self):
+        return f"WindowInPandas[{len(self.wins)} fns]"

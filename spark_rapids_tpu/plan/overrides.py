@@ -525,6 +525,19 @@ class PlanMeta(BaseMeta):
             if p.condition is not None:
                 out.append((p.condition, None))  # pair-scope, binds later
             return out
+        if isinstance(p, L.LogicalGroupedMapInPandas):
+            return [(k, child_sch) for k in p.keys]
+        if isinstance(p, L.LogicalAggregateInPandas):
+            return [(k, child_sch) for k in p.keys] + [
+                (e, child_sch) for _, _, _, ins in p.aggs for e in ins]
+        if isinstance(p, L.LogicalMapInBatch):
+            return []
+        if isinstance(p, L.LogicalCoGroupedMapInPandas):
+            return [(k, p.children[0].schema) for k in p.left_keys] + \
+                [(k, p.children[1].schema) for k in p.right_keys]
+        if isinstance(p, L.LogicalWindowInPandas):
+            return [(e, child_sch) for e in p.part_exprs] + [
+                (e, child_sch) for _, _, _, ins in p.wins for e in ins]
         if isinstance(p, L.LogicalExpand):
             return [(e, child_sch) for proj in p.projections for e in proj]
         if isinstance(p, L.LogicalGenerate):
@@ -985,6 +998,25 @@ class PlanMeta(BaseMeta):
             return ExpandExec(p.projections, kids[0])
         if isinstance(p, L.LogicalWindow):
             return WindowExec(p.window_exprs, kids[0])
+        if isinstance(p, L.LogicalGroupedMapInPandas):
+            from ..exec.python_udf import GroupedMapInPandasExec
+            return GroupedMapInPandasExec(p.keys, p.fn, p.out_schema,
+                                          kids[0])
+        if isinstance(p, L.LogicalAggregateInPandas):
+            from ..exec.python_udf import AggregateInPandasExec
+            return AggregateInPandasExec(p.keys, p.aggs, p.key_names,
+                                         kids[0])
+        if isinstance(p, L.LogicalMapInBatch):
+            from ..exec.python_udf import MapInBatchExec
+            return MapInBatchExec(p.fn, p.out_schema, kids[0])
+        if isinstance(p, L.LogicalCoGroupedMapInPandas):
+            from ..exec.python_udf import CoGroupedMapInPandasExec
+            return CoGroupedMapInPandasExec(p.left_keys, p.right_keys,
+                                            p.fn, p.out_schema, kids[0],
+                                            kids[1])
+        if isinstance(p, L.LogicalWindowInPandas):
+            from ..exec.python_udf import WindowInPandasExec
+            return WindowInPandasExec(p.part_exprs, p.wins, kids[0])
         if isinstance(p, L.LogicalGenerate):
             from ..exec.generate import GenerateExec
             return GenerateExec(p.generator, kids[0], p.outer, p.position,
