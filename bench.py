@@ -56,12 +56,24 @@ def numpy_oracle(d):
     return out
 
 
+def _median_time(fn, reps=3):
+    """Median-of-N oracle timing: one-shot numpy timings swung the
+    recorded vs_baseline 389x->65x between rounds at near-identical
+    engine GB/s (VERDICT r4 Weak #5) — the median makes the driver's
+    trend line signal."""
+    times = []
+    out = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        times.append(time.perf_counter() - t0)
+    return out, sorted(times)[len(times) // 2]
+
+
 def main():
     d = build_data()
     numpy_oracle(d)  # warm the page cache
-    t_np0 = time.perf_counter()
-    oracle = numpy_oracle(d)
-    t_np = time.perf_counter() - t_np0
+    oracle, t_np = _median_time(lambda: numpy_oracle(d))
 
     import jax
     import jax.numpy as jnp
@@ -197,10 +209,8 @@ def q3_oracle(d):
 
 def q3_bench():
     d = build_q3_data()
-    q3_oracle(d)
-    t0 = time.perf_counter()
-    oracle = q3_oracle(d)
-    t_np = time.perf_counter() - t0
+    q3_oracle(d)  # warm
+    oracle, t_np = _median_time(lambda: q3_oracle(d))
 
     import jax
     import jax.numpy as jnp

@@ -641,3 +641,9 @@ def encode(x, charset):
 def decode(x, charset):
     from ..expr.stringexprs import Decode
     return Decode(_e(x), charset)
+
+
+def array_repeat(x, n):
+    """array_repeat(e, n) (reference GpuArrayRepeat)."""
+    from ..expr.collectionexprs import ArrayRepeat
+    return ArrayRepeat(_e(x), _e(n))

@@ -163,8 +163,28 @@ class AggregateExec(TpuExec):
         # + fold into the O(1) device state — ONE program per source batch
         self._jit_step_spec = jax.jit(self._streaming_step)
         self._jit_step_exact = jax.jit(self._fused_update_exact)
+
+        # round 5: when the child contract (output_grouped_by) already
+        # groups rows by this aggregate's keys — e.g. the inner join's
+        # key-grouped emission — the exact tier skips its batch sort
+        self._pre_grouped = mode != "final" and self._input_pre_grouped()
         self._jit_evaluate = jax.jit(self._evaluate)
         self._initial_state_cache = None
+
+    def _input_pre_grouped(self) -> bool:
+        from ..expr.core import UnresolvedAttribute
+        hint = self.children[0].output_grouped_by
+        if not hint or not self.group_exprs:
+            return False
+        names = set()
+        for e in self.group_exprs:
+            if not isinstance(e, UnresolvedAttribute):
+                return False
+            names.add(e.name)
+        all_names = set().union(*hint)
+        # every key must belong to a grouping class, and every class must
+        # be represented (otherwise joint-tuple contiguity doesn't hold)
+        return names <= all_names and all(cls & names for cls in hint)
 
     # -- schemas -----------------------------------------------------------
     def _make_buffer_schema(self) -> Schema:
@@ -228,7 +248,8 @@ class AggregateExec(TpuExec):
         keys, agg_inputs = self._update_inputs(batch)
         return self._run_groupby(keys, agg_inputs, batch,
                                  self._buffer_schema, words, hash_path,
-                                 hash_rounds, auto_path, row_mask)
+                                 hash_rounds, auto_path, row_mask,
+                                 is_update=True)
 
     def _merge_batch(self, batch: ColumnarBatch, words: int = 4,
                      hash_path: bool = False, hash_rounds: int = 2,
@@ -355,22 +376,24 @@ class AggregateExec(TpuExec):
 
     def _run_groupby(self, keys, agg_inputs, batch, out_schema, words: int,
                      hash_path: bool = False, hash_rounds: int = 2,
-                     auto_path: bool = False, row_mask=None):
+                     auto_path: bool = False, row_mask=None,
+                     is_update: bool = False):
         from ..ops.maskedagg import masked_groupby_exact, masked_reduce
         cap = batch.capacity
         if not keys:
-            if any(op.startswith("collect") for op, _ in agg_inputs):
+            if any(op.startswith(("collect", "psketch"))
+                   for op, _ in agg_inputs):
                 # grand collect_list/set: one-row array outputs
                 from ..ops.aggregate import collect_all
                 cols = []
                 fields = out_schema.fields
                 plain = [(op, c) for op, c in agg_inputs
-                         if not op.startswith("collect")]
+                         if not op.startswith(("collect", "psketch"))]
                 plain_res = iter(masked_reduce(
                     plain, batch.num_rows, row_mask, cap)) if plain else \
                     iter(())
                 for (op, c), f in zip(agg_inputs, fields):
-                    if op.startswith("collect"):
+                    if op.startswith(("collect", "psketch")):
                         cols.append(collect_all(op, c, batch.num_rows, cap))
                     else:
                         data, valid = next(plain_res)
@@ -400,8 +423,11 @@ class AggregateExec(TpuExec):
             out_keys, results, num_groups, leftover = groupby_aggregate_hash(
                 keys, agg_inputs, batch.num_rows, cap, rounds=hash_rounds)
         else:
+            # pre_grouped only holds for SOURCE batches (the child's
+            # grouping contract); merge inputs are concatenated partials
             out_keys, results, num_groups = groupby_aggregate(
-                keys, agg_inputs, batch.num_rows, cap, words)
+                keys, agg_inputs, batch.num_rows, cap, words,
+                pre_grouped=self._pre_grouped and is_update)
         cols = list(out_keys)
         buf_fields = out_schema.fields[self._key_count:]
         for r, f in zip(results, buf_fields):
@@ -609,10 +635,13 @@ class AggregateExec(TpuExec):
         """True when the masked-bucket kernels apply: every key and buffer
         column is fixed-width (strings have no static order lanes for the
         in-program exact fallback and no masked min/max encoding)."""
-        from ..types import ArrayType, BinaryType, StringType, StructType
+        from ..types import (ArrayType, BinaryType, DecimalType, StringType,
+                             StructType)
         return not any(
             isinstance(f.data_type,
                        (StringType, BinaryType, StructType, ArrayType))
+            or (isinstance(f.data_type, DecimalType)
+                and f.data_type.is_decimal128)
             for f in self._buffer_schema.fields)
 
     @property

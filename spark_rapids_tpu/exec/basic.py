@@ -91,6 +91,34 @@ class ProjectExec(TpuExec):
     def output_schema(self) -> Schema:
         return self._schema
 
+    @property
+    def output_grouped_by(self):
+        """Projection preserves row order: the child's grouping contract
+        carries through for columns projected as bare references."""
+        child_hint = self.child.output_grouped_by
+        if not child_hint:
+            return None
+        from ..expr.core import Alias, UnresolvedAttribute
+        renames = {}  # child name -> set of output names
+        for e in self.exprs:
+            out_name = None
+            src = e
+            if isinstance(e, Alias):
+                out_name = e.name
+                src = e.children[0]
+            src_name = getattr(src, "name", None) \
+                if isinstance(src, UnresolvedAttribute) else None
+            if src_name is not None:
+                renames.setdefault(src_name, set()).add(
+                    out_name or src_name)
+        classes = []
+        for cls in child_hint:
+            mapped = frozenset(n for c in cls for n in renames.get(c, ()))
+            if not mapped:
+                return None  # a grouping class vanished from the output
+            classes.append(mapped)
+        return tuple(classes)
+
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         op_time = self.metrics[OP_TIME]
         for batch in self.child.execute():

@@ -156,6 +156,34 @@ class HashJoinExec(TpuExec):
         self._size_cache = {}
         # per-shape speculative-use counters driving cap decay (round 5)
         self._spec_uses = {}
+        # round 5: absorb child Filters into the probe/build kernels as
+        # key-validity masks — an invalid key never matches, so for join
+        # shapes that emit ONLY matched rows from that side the filter's
+        # compaction (sort + gather, ~40 ms per 2M-row batch on v5e) is
+        # pure overhead. Build side: safe whenever unmatched build rows
+        # are never emitted; stream side: inner/semi only (outer/anti
+        # emit unmatched stream rows, which must already be filtered).
+        from .basic import FilterExec
+        self._stream_filter = None
+        self._build_filter = None
+        stream_idx = 0 if build_side == "right" else 1
+        build_idx = 1 - stream_idx
+        kids = list(self.children)
+        if join_type in (INNER, LEFT_SEMI):
+            preds = []
+            while isinstance(kids[stream_idx], FilterExec):
+                preds.append(kids[stream_idx]._bound)
+                kids[stream_idx] = kids[stream_idx].child
+            if preds:
+                self._stream_filter = preds
+        if not self._need_build_flags:
+            preds = []
+            while isinstance(kids[build_idx], FilterExec):
+                preds.append(kids[build_idx]._bound)
+                kids[build_idx] = kids[build_idx].child
+            if preds:
+                self._build_filter = preds
+        self.children = tuple(kids)
 
     # -- schema ------------------------------------------------------------
     @property
@@ -184,6 +212,67 @@ class HashJoinExec(TpuExec):
     def additional_metrics(self):
         return (BUILD_TIME, JOIN_TIME, NUM_INPUT_BATCHES)
 
+    @property
+    def output_grouped_by(self):
+        """INNER-join output batches are emitted key-grouped (the pair
+        compaction carries the packed key lanes — see _probe_kernel); one
+        equivalence class per key pair, since left key == right key on
+        every emitted row."""
+        if self.join_type != INNER:
+            return None
+        out_names = [f.name for f in self.output_schema.fields]
+        classes = []
+        for lk, rk in zip(self.left_keys, self.right_keys):
+            for e, sch in ((lk, self.left_schema), (rk, self.right_schema)):
+                try:
+                    dt = resolve(e, sch).data_type
+                except (KeyError, TypeError):
+                    return None
+                from ..types import DecimalType
+                if not dt.is_fixed_width or isinstance(dt, DecimalType):
+                    # string/decimal keys are not in the packed lanes
+                    return None
+            names = set()
+            for e in (lk, rk):
+                n = getattr(e, "name", None)
+                if n and out_names.count(n) == 1:
+                    names.add(n)
+            if not names:
+                return None  # an unnamed key: grouping not expressible
+            classes.append(frozenset(names))
+        return tuple(classes)
+
+    @staticmethod
+    def _filter_mask(preds, batch: ColumnarBatch):
+        keep = None
+        for p in preds:
+            c = p.columnar_eval(batch)
+            k = c.data & c.validity  # Spark: null predicate rows drop
+            keep = k if keep is None else (keep & k)
+        return keep
+
+    @staticmethod
+    def _mask_keys(key_cols, keep):
+        """AND an absorbed-filter mask into key validity (invalid keys
+        never match; dropped rows vanish from matched-only outputs)."""
+        from ..columnar.column import (ArrayColumn, MapColumn,
+                                       StringColumn, StructColumn)
+        out = []
+        for c in key_cols:
+            v = c.validity & keep
+            if isinstance(c, StringColumn):
+                out.append(StringColumn(c.data, c.offsets, v, c.dtype))
+            elif isinstance(c, StructColumn):
+                out.append(type(c)(c.children, v, c.dtype))
+            elif isinstance(c, MapColumn):
+                out.append(MapColumn(c.keys, c.values, c.offsets, v,
+                                     c.dtype))
+            elif isinstance(c, ArrayColumn):
+                out.append(ArrayColumn(c.child, c.offsets, v, c.dtype))
+            else:
+                out.append(Column(c.data, v, c.dtype))
+        return out
+
     # -- build -------------------------------------------------------------
     def _build_kernel(self, batch: ColumnarBatch) -> BuildTable:
         build_child = self.children[1] if self.build_side == "right" \
@@ -191,6 +280,9 @@ class HashJoinExec(TpuExec):
         keys = self.right_keys if self.build_side == "right" else self.left_keys
         bound = bind_projection(keys, build_child.output_schema)
         key_cols = [e.columnar_eval(batch) for e in bound]
+        if self._build_filter is not None:
+            key_cols = self._mask_keys(
+                key_cols, self._filter_mask(self._build_filter, batch))
         return BuildTable.build(key_cols, list(batch.columns),
                                 batch.num_rows, batch.capacity)
 
@@ -239,6 +331,10 @@ class HashJoinExec(TpuExec):
             else self.right_keys
         bound = bind_projection(stream_keys, stream_child.output_schema)
         skey_cols = [e.columnar_eval(stream_batch) for e in bound]
+        if self._stream_filter is not None:
+            skey_cols = self._mask_keys(
+                skey_cols,
+                self._filter_mask(self._stream_filter, stream_batch))
         lo, counts, _ = probe_counts(build, skey_cols,
                                      stream_batch.num_rows,
                                      stream_batch.capacity)
@@ -306,7 +402,14 @@ class HashJoinExec(TpuExec):
                 eq = string_equal(b, s)
                 ok = ok & eq.data & eq.validity
             else:
-                ok = ok & (b.data == s.data) & b.validity & s.validity
+                from ..columnar.column import Decimal128Column
+                if isinstance(bk, Decimal128Column):
+                    # two-limb equality (round 5: decimal128 join keys)
+                    ok = ok & (b.hi.data == s.hi.data) \
+                        & (b.lo.data == s.lo.data) \
+                        & b.validity & s.validity
+                else:
+                    ok = ok & (b.data == s.data) & b.validity & s.validity
         verified = ok
         if self.condition is not None:
             verified = verified & self._eval_condition(
@@ -338,7 +441,36 @@ class HashJoinExec(TpuExec):
 
         # --- compact verified pairs (and append the stream/build row maps
         # as extra lanes so they ride the same row gather) ---
-        perm_c, n_pairs = compaction_order(verified, total_dev)
+        grouped_emit = jt == INNER and len(kpi) == len(skey_cols)
+        if grouped_emit:
+            # key-grouped emission (round 5): carry the packed build-key
+            # lanes as extra sort keys so equal join keys land contiguous
+            # in the output — a downstream group-by on the join keys then
+            # skips its own sort (output_grouped_by). Extra sort lanes
+            # are ~free on v5e (docs/perf.md r5). Key LANES, not b_pos:
+            # the build table is hash-sorted, so two distinct keys
+            # sharing a 64-bit hash could interleave by position.
+            act_c = active_mask(total_dev, cand_cap)
+            kflag = verified & act_c
+            nvl = plan_b.n_valid_lanes
+            klanes = []
+            for ci in kpi:
+                kind, lane = plan_b.kinds[ci]
+                if kind == "f64":
+                    klanes.append(bf_c[:, lane])
+                elif kind == "w2":
+                    klanes.append(bi_c[:, nvl + lane])
+                    klanes.append(bi_c[:, nvl + lane + 1])
+                else:
+                    klanes.append(bi_c[:, nvl + lane])
+            iota_c = jnp.arange(cand_cap, dtype=jnp.int32)
+            res = jax.lax.sort(
+                ((~kflag).astype(jnp.uint32), *klanes, iota_c),
+                num_keys=2 + len(klanes))
+            perm_c = res[-1]
+            n_pairs = jnp.sum(kflag, dtype=jnp.int32)
+        else:
+            perm_c, n_pairs = compaction_order(verified, total_dev)
         extra = [jax.lax.bitcast_convert_type(s_idx, jnp.uint32)[:, None]]
         if need_b_row:
             extra.append(

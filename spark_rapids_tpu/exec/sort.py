@@ -345,13 +345,12 @@ class TopNExec(SortExec):
 
 
 class PartitionWiseSortExec(TpuExec):
-    """Per-partition sort over a range exchange: the child (a range-
-    partitioned HostShuffleExchangeExec) yields one batch per partition in
-    ascending bound order, so sorting each partition independently yields
-    a GLOBALLY sorted stream (the reference's distributed sort:
-    GpuRangePartitioner bounds + per-partition GpuSortExec). One inner
-    SortExec is reused so compiled sort programs cache across
-    partitions."""
+    """Per-partition sort over a range exchange: the child yields one
+    batch STREAM per partition (execute_partitions) in ascending bound
+    order, so sorting each partition independently yields a GLOBALLY
+    sorted stream (the reference's distributed sort: GpuRangePartitioner
+    bounds + per-partition GpuSortExec). One inner SortExec is reused so
+    compiled sort programs cache across partitions."""
 
     def __init__(self, orders: Sequence, child: TpuExec):
         super().__init__(child)
@@ -364,8 +363,11 @@ class PartitionWiseSortExec(TpuExec):
         return self.child.output_schema
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
-        for part in self.child.execute():
-            self._scan._batches = [part]
+        # partition boundaries come from execute_partitions (round 5:
+        # exchanges stream a partition as MULTIPLE pieces — flat batches
+        # no longer delimit partitions)
+        for gen in self.child.execute_partitions():
+            self._scan._batches = list(gen)
             yield from self._sort.execute()
 
     def node_description(self):

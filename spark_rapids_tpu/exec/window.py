@@ -454,13 +454,15 @@ class WindowExec(TpuExec):
                 same = same & (lens == rlens[ref_idx])
                 same = same & (c.validity == r.validity[ref_idx])
             else:
-                lane = _numeric_order_key(c)
-                lane = jnp.where(c.validity, lane, jnp.zeros((), lane.dtype))
-                rlane = _numeric_order_key(r)
-                rlane = jnp.where(r.validity, rlane,
-                                  jnp.zeros((), rlane.dtype))
-                same = same & (lane == rlane[ref_idx]) \
-                    & (c.validity == r.validity[ref_idx])
+                from ..ops.sort import numeric_order_lanes
+                for lane, rlane in zip(numeric_order_lanes(c),
+                                       numeric_order_lanes(r)):
+                    lane = jnp.where(c.validity, lane,
+                                     jnp.zeros((), lane.dtype))
+                    rlane = jnp.where(r.validity, rlane,
+                                      jnp.zeros((), rlane.dtype))
+                    same = same & (lane == rlane[ref_idx])
+                same = same & (c.validity == r.validity[ref_idx])
         return same
 
     def _first_partition_len(self, batch: ColumnarBatch, words: int,

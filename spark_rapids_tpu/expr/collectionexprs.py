@@ -506,8 +506,33 @@ class _HostCollection(Expression):
             f"{type(self).__name__} runs on the host tier (CPU fallback)")
 
 
+def _host_spark_eq(a, b) -> bool:
+    """Spark ordering equality on the host tier: NaN == NaN,
+    -0.0 != 0.0 (java.lang.Double.compare semantics)."""
+    import math
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) and math.isnan(b):
+            return True
+        if a == 0.0 and b == 0.0:
+            return math.copysign(1.0, a) == math.copysign(1.0, b)
+    return a == b
+
+
+def _fixed_width_elems(expr) -> bool:
+    """Device gate: array child with fixed-width (non-nested, non-string)
+    elements."""
+    from ..types import ArrayType
+    try:
+        dt = expr.data_type
+    except TypeError:
+        return False
+    return isinstance(dt, ArrayType) and dt.element_type.is_fixed_width
+
+
 class ArrayPosition(_HostCollection):
-    """array_position(arr, v): 1-based first index, 0 if absent."""
+    """array_position(arr, v): 1-based first index, 0 if absent.
+    Device kernel for fixed-width elements (ops/collection.array_position,
+    reference GpuArrayPosition); string elements take the host tier."""
 
     def __init__(self, child: Expression, value: Expression):
         self.children = (child, value)
@@ -516,20 +541,32 @@ class ArrayPosition(_HostCollection):
         return ArrayPosition(cs[0], cs[1])
 
     @property
+    def device_supported(self):
+        return _fixed_width_elems(self.children[0])
+
+    @property
     def data_type(self):
         from ..types import LONG
         return LONG
+
+    def columnar_eval(self, batch):
+        from ..ops.collection import array_position
+        return array_position(self.children[0].columnar_eval(batch),
+                              self.children[1].columnar_eval(batch))
 
     def host_eval_row(self, arr, v):
         if arr is None or v is None:
             return None
         for i, item in enumerate(arr):
-            if item is not None and item == v:
+            if item is not None and _host_spark_eq(item, v):
                 return i + 1
         return 0
 
 
 class ArrayRemove(_HostCollection):
+    """Device kernel for fixed-width elements (reference
+    GpuArrayRemove)."""
+
     def __init__(self, child: Expression, value: Expression):
         self.children = (child, value)
 
@@ -537,16 +574,29 @@ class ArrayRemove(_HostCollection):
         return ArrayRemove(cs[0], cs[1])
 
     @property
+    def device_supported(self):
+        return _fixed_width_elems(self.children[0])
+
+    @property
     def data_type(self):
         return self.children[0].data_type
+
+    def columnar_eval(self, batch):
+        from ..ops.collection import array_remove
+        return array_remove(self.children[0].columnar_eval(batch),
+                            self.children[1].columnar_eval(batch))
 
     def host_eval_row(self, arr, v):
         if arr is None or v is None:
             return None
-        return [x for x in arr if x is None or x != v]
+        return [x for x in arr
+                if x is None or not _host_spark_eq(x, v)]
 
 
 class ArrayDistinct(_HostCollection):
+    """Device kernel for fixed-width elements (reference
+    GpuArrayDistinct)."""
+
     def __init__(self, child: Expression):
         self.children = (child,)
 
@@ -554,8 +604,16 @@ class ArrayDistinct(_HostCollection):
         return ArrayDistinct(cs[0])
 
     @property
+    def device_supported(self):
+        return _fixed_width_elems(self.children[0])
+
+    @property
     def data_type(self):
         return self.children[0].data_type
+
+    def columnar_eval(self, batch):
+        from ..ops.collection import array_distinct
+        return array_distinct(self.children[0].columnar_eval(batch))
 
     def host_eval_row(self, arr):
         if arr is None:
@@ -573,7 +631,10 @@ class ArrayDistinct(_HostCollection):
 
 
 class Slice(_HostCollection):
-    """slice(arr, start, length): 1-based; negative start from end."""
+    """slice(arr, start, length): 1-based; negative start from end.
+    Device kernel (reference GpuSlice); a data-dependent start of 0 or
+    negative length yields NULL on device (Spark raises — the host tier
+    keeps the raise for literal args)."""
 
     def __init__(self, child: Expression, start: Expression,
                  length: Expression):
@@ -583,8 +644,18 @@ class Slice(_HostCollection):
         return Slice(cs[0], cs[1], cs[2])
 
     @property
+    def device_supported(self):
+        return _fixed_width_elems(self.children[0])
+
+    @property
     def data_type(self):
         return self.children[0].data_type
+
+    def columnar_eval(self, batch):
+        from ..ops.collection import array_slice
+        return array_slice(self.children[0].columnar_eval(batch),
+                           self.children[1].columnar_eval(batch),
+                           self.children[2].columnar_eval(batch))
 
     def host_eval_row(self, arr, start, length):
         if arr is None or start is None or length is None:
@@ -600,6 +671,9 @@ class Slice(_HostCollection):
 
 
 class Flatten(_HostCollection):
+    """flatten(arr<arr<T>>): pure offset composition on device for ANY
+    inner element type (reference GpuFlatten)."""
+
     def __init__(self, child: Expression):
         self.children = (child,)
 
@@ -607,10 +681,24 @@ class Flatten(_HostCollection):
         return Flatten(cs[0])
 
     @property
+    def device_supported(self):
+        from ..types import ArrayType
+        try:
+            dt = self.children[0].data_type
+        except TypeError:
+            return False
+        return isinstance(dt, ArrayType) \
+            and isinstance(dt.element_type, ArrayType)
+
+    @property
     def data_type(self):
         from ..types import ArrayType
         dt = self.children[0].data_type
         return dt.element_type if isinstance(dt, ArrayType) else dt
+
+    def columnar_eval(self, batch):
+        from ..ops.collection import flatten_array
+        return flatten_array(self.children[0].columnar_eval(batch))
 
     def host_eval_row(self, arr):
         if arr is None:
@@ -624,6 +712,9 @@ class Flatten(_HostCollection):
 
 
 class ArraysOverlap(_HostCollection):
+    """Device sort-merge kernel for fixed-width elements (reference
+    GpuArraysOverlap)."""
+
     def __init__(self, left: Expression, right: Expression):
         self.children = (left, right)
 
@@ -631,9 +722,19 @@ class ArraysOverlap(_HostCollection):
         return ArraysOverlap(cs[0], cs[1])
 
     @property
+    def device_supported(self):
+        return _fixed_width_elems(self.children[0]) \
+            and _fixed_width_elems(self.children[1])
+
+    @property
     def data_type(self):
         from ..types import BOOLEAN
         return BOOLEAN
+
+    def columnar_eval(self, batch):
+        from ..ops.collection import arrays_overlap
+        return arrays_overlap(self.children[0].columnar_eval(batch),
+                              self.children[1].columnar_eval(batch))
 
     def host_eval_row(self, a, b):
         if a is None or b is None:
@@ -681,7 +782,12 @@ class ArrayJoin(_HostCollection):
 
 
 class Sequence(_HostCollection):
-    """sequence(start, stop[, step]) -> array<long>"""
+    """sequence(start, stop[, step]) -> array<long>.
+
+    Device kernel (ops/collection.sequence_array, reference GpuSequence)
+    when every bound is a LITERAL — the output child capacity is then
+    static under XLA; data-dependent bounds keep the host tier (dynamic
+    output shapes cannot trace)."""
 
     def __init__(self, start: Expression, stop: Expression,
                  step: Expression = None):
@@ -690,6 +796,41 @@ class Sequence(_HostCollection):
 
     def with_children(self, cs):
         return Sequence(*cs)
+
+    @property
+    def device_supported(self):
+        from .core import Literal
+        from ..types import IntegerType, LongType, ShortType, ByteType
+        if not all(isinstance(c, Literal) and c.value is not None
+                   for c in self.children):
+            return False
+        try:
+            return all(isinstance(c.data_type, (ByteType, ShortType,
+                                                IntegerType, LongType))
+                       for c in self.children)
+        except TypeError:
+            return False
+
+    def columnar_eval(self, batch):
+        from ..columnar.column import Column, bucket_capacity
+        from ..ops.collection import sequence_array
+        import jax.numpy as jnp
+        start = self.children[0].value
+        stop = self.children[1].value
+        step = self.children[2].value if len(self.children) > 2 \
+            else (1 if stop >= start else -1)
+        if step == 0:
+            raise ValueError("sequence(): step must not be 0")
+        n = max((stop - start) // step + 1, 0) \
+            if (stop - start) * step >= 0 else 0
+        cols = [c.columnar_eval(batch) for c in self.children]
+        cap = cols[0].capacity
+        if len(cols) < 3:
+            cols.append(Column(jnp.full((cap,), step, cols[0].data.dtype),
+                               jnp.ones((cap,), jnp.bool_),
+                               cols[0].dtype))
+        ccap = bucket_capacity(max(int(n) * cap, 1))
+        return sequence_array(cols[0], cols[1], cols[2], ccap)
 
     @property
     def data_type(self):
@@ -714,3 +855,45 @@ class Sequence(_HostCollection):
                 out.append(v)
                 v += step
         return out
+
+
+class ArrayRepeat(_HostCollection):
+    """array_repeat(e, n) (reference GpuArrayRepeat). Device kernel when
+    the count is a LITERAL (static child capacity under XLA); per-row
+    counts keep the host tier."""
+
+    def __init__(self, elem: Expression, count: Expression):
+        self.children = (elem, count)
+
+    def with_children(self, cs):
+        return ArrayRepeat(cs[0], cs[1])
+
+    @property
+    def device_supported(self):
+        from .core import Literal
+        c = self.children[1]
+        if not (isinstance(c, Literal) and c.value is not None):
+            return False
+        try:
+            return self.children[0].data_type.is_fixed_width
+        except TypeError:
+            return False
+
+    @property
+    def data_type(self):
+        from ..types import ArrayType
+        return ArrayType(self.children[0].data_type)
+
+    def columnar_eval(self, batch):
+        from ..columnar.column import bucket_capacity
+        from ..ops.collection import array_repeat
+        elem = self.children[0].columnar_eval(batch)
+        count = self.children[1].columnar_eval(batch)
+        n = max(int(self.children[1].value), 0)
+        ccap = bucket_capacity(max(n * elem.capacity, 1))
+        return array_repeat(elem, count, ccap)
+
+    def host_eval_row(self, v, n):
+        if n is None:
+            return None
+        return [v] * max(n, 0)

@@ -784,7 +784,9 @@ class RegExpReplace(_HostString):
 
 
 class FormatNumber(_HostString):
-    """format_number(x, d): thousands separators + d decimals."""
+    """format_number(x, d): thousands separators + d decimals. Device
+    digit-emission kernel (ops/cast_strings.format_number_string,
+    reference GpuFormatNumber); decimal inputs keep the host tier."""
 
     def __init__(self, child: Expression, decimals):
         self.children = (child,)
@@ -798,8 +800,26 @@ class FormatNumber(_HostString):
         return (self.decimals,)
 
     @property
+    def device_supported(self):
+        from ..types import DecimalType
+        # d <= 18 keeps 10^d in int64 (the device kernel's scaled lane);
+        # larger d takes the host tier like decimal inputs
+        if not isinstance(self.decimals, int) \
+                or not 0 <= self.decimals <= 18:
+            return False
+        try:
+            return not isinstance(self.children[0].data_type, DecimalType)
+        except TypeError:
+            return False
+
+    @property
     def data_type(self):
         return STRING
+
+    def columnar_eval(self, batch):
+        from ..ops.cast_strings import format_number_string
+        return format_number_string(self.children[0].columnar_eval(batch),
+                                    int(self.decimals))
 
     def host_eval_row(self, v):
         if v is None or self.decimals is None or self.decimals < 0:
@@ -994,9 +1014,25 @@ class Encode(_HostString):
         return (self.charset,)
 
     @property
+    def device_supported(self):
+        # byte-map kernels (ops/charsets.py); UTF-16 needs the host's
+        # surrogate/BOM state machine
+        return isinstance(self.charset, str) and self.charset.upper() in (
+            "UTF-8", "US-ASCII", "ISO-8859-1")
+
+    @property
     def data_type(self):
         from ..types import BINARY
         return BINARY
+
+    def columnar_eval(self, batch):
+        from ..ops.charsets import encode_single_byte, recast_bytes
+        from ..types import BINARY
+        c = self.children[0].columnar_eval(batch)
+        cs = self.charset.upper()
+        if cs == "UTF-8":
+            return recast_bytes(c, BINARY)
+        return encode_single_byte(c, cs)
 
     def host_eval_row(self, v):
         if v is None:
@@ -1023,8 +1059,23 @@ class Decode(_HostString):
         return (self.charset,)
 
     @property
+    def device_supported(self):
+        # UTF-8 decode is a passthrough that does NOT substitute U+FFFD
+        # for malformed bytes (documented deviation, ops/charsets.py)
+        return isinstance(self.charset, str) and self.charset.upper() in (
+            "UTF-8", "US-ASCII", "ISO-8859-1")
+
+    @property
     def data_type(self):
         return STRING
+
+    def columnar_eval(self, batch):
+        from ..ops.charsets import decode_single_byte, recast_bytes
+        c = self.children[0].columnar_eval(batch)
+        cs = self.charset.upper()
+        if cs == "UTF-8":
+            return recast_bytes(c, STRING)
+        return decode_single_byte(c, cs)
 
     def host_eval_row(self, v):
         if v is None:

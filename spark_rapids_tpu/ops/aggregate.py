@@ -49,6 +49,13 @@ def collect_all(op: str, col: Column, num_rows, capacity: int) -> "Column":
     from .strings import _rebuild_offsets
 
     act = active_mask(num_rows, capacity)
+    if op.startswith("psketch"):
+        # grand approx_percentile: one segment covering every active row
+        seg = jnp.where(act, 0, jnp.int32(capacity))
+        positions = jnp.arange(capacity, dtype=jnp.int32)
+        group_act = jnp.zeros(capacity, jnp.bool_).at[0].set(True)
+        return _collect_group(op, col, seg, act, capacity, positions,
+                              group_act)
     if op == "collect_merge":
         assert isinstance(col, ArrayColumn)
         from .collection import array_lengths
@@ -210,6 +217,44 @@ def _collect_group(op: str, g: Column, seg, act, capacity: int, positions,
         offsets = _rebuild_offsets(jnp.where(group_act, counts, 0))
         return ArrayColumn(g.child, offsets, group_act, g.dtype)
 
+    if op.startswith("psketch_merge"):
+        # bounded approx_percentile: merge partial sketches
+        # ([values..., n] rows) of each group — decode per-element
+        # weights from the PRE-flatten row structure, flatten like
+        # collect_merge, then resample to K (ops/percentile.sketch_merge)
+        k = int(op.split(":")[1])
+        assert isinstance(g, ArrayColumn), g
+        from .collection import array_lengths
+        from .percentile import sketch_merge
+        cap = capacity
+        rowlen = array_lengths(g)
+        ccap = g.child.capacity
+        epos = jnp.arange(ccap, dtype=jnp.int32)
+        prow = jnp.clip(jnp.searchsorted(g.offsets, epos, side="right")
+                        .astype(jnp.int32) - 1, 0, cap - 1)
+        last_idx = jnp.clip(g.offsets[1:] - 1, 0, ccap - 1)
+        counts_row = jnp.where(rowlen > 0, g.child.data[last_idx], 0.0)
+        lens_row = jnp.maximum(rowlen - 1, 0)
+        pos_in_row = epos - g.offsets[prow]
+        is_count_elem = pos_in_row == (rowlen[prow] - 1)
+        row_lens_e = jnp.where(is_count_elem, 0.0,
+                               lens_row[prow].astype(jnp.float64))
+        row_counts_e = counts_row[prow].astype(jnp.float64)
+        lens = jnp.where(act & g.validity, rowlen, 0)
+        counts = jax.ops.segment_sum(lens, seg, num_segments=capacity)
+        offsets = _rebuild_offsets(jnp.where(group_act, counts, 0))
+        flat = ArrayColumn(g.child, offsets, group_act, g.dtype)
+        return sketch_merge(flat, row_lens_e, row_counts_e, k)
+
+    if op.startswith("psketch"):
+        # bounded approx_percentile update: collect the group's raw
+        # values then compress to the K-point sketch encoding
+        k = int(op.split(":")[1])
+        collected = _collect_group("collect", g, seg, act, capacity,
+                                   positions, group_act)
+        from .percentile import sketch_compress
+        return sketch_compress(collected, k)
+
     keep = act & g.validity  # Spark: collect_* drop nulls
     if op == "collect_set":
         # dedup: first kept occurrence of each (segment, value)
@@ -227,21 +272,30 @@ def groupby_aggregate(key_columns: Sequence[Column],
                       agg_inputs: Sequence[Tuple[str, Optional[Column]]],
                       num_rows, capacity: int,
                       string_words: int,
+                      pre_grouped: bool = False,
                       ) -> Tuple[List[Column], List[Tuple[jnp.ndarray, jnp.ndarray]], jnp.ndarray]:
     """Sort-based group-by over one batch.
 
     agg_inputs: list of (op, input Column or None for count_star).
     Returns (grouped key columns, [(agg data, agg validity)], num_groups).
     All outputs have the input capacity; rows >= num_groups are inactive.
+
+    pre_grouped: the caller guarantees equal keys are already CONTIGUOUS
+    (e.g. the inner join's key-grouped emission, exec/joins.py) — the
+    batch sort is skipped entirely; segment detection works on adjacency
+    and never needed a total order.
     """
-    orders = [SortOrder(i) for i in range(len(key_columns))]
-    # ONE sort carries keys AND agg inputs as packed lanes (round 4): the
-    # old per-column gather-by-permutation cost ~26 ms per column on v5e
     all_cols = list(key_columns) + [c for _, c in agg_inputs
                                     if c is not None]
-    from .sort import sort_batch_columns
-    sorted_all, perm = sort_batch_columns(all_cols, orders, num_rows,
-                                          capacity, string_words)
+    if pre_grouped:
+        sorted_all = list(all_cols)
+    else:
+        orders = [SortOrder(i) for i in range(len(key_columns))]
+        # ONE sort carries keys AND agg inputs as packed lanes (round 4):
+        # the old per-column gather-by-permutation cost ~26 ms per column
+        from .sort import sort_batch_columns
+        sorted_all, _ = sort_batch_columns(all_cols, orders, num_rows,
+                                           capacity, string_words)
     sorted_keys = sorted_all[: len(key_columns)]
     sorted_in = sorted_all[len(key_columns):]
     seg, num_groups = group_segment_ids(sorted_keys, num_rows, capacity,
@@ -250,27 +304,24 @@ def groupby_aggregate(key_columns: Sequence[Column],
     positions = jnp.arange(capacity, dtype=jnp.int32)
     group_act = active_mask(num_groups, capacity)
 
-    # -- prefix-difference tier (round 4) ---------------------------------
-    # Over SORTED segments, sum/count collapse to exclusive-prefix
-    # differences at segment starts: one cumsum per lane plus ONE stable
-    # boundary-compaction sort that also yields per-group first positions
-    # and the representative keys. jax.ops.segment_sum is a scatter-add
-    # (~163 ms for 2M f64 on v5e); this path has no scatters at all.
+    # -- prefix-difference tier (round 4, reworked round 5) ---------------
+    # Over SORTED segments, sum/count collapse to SEGMENT-LOCAL inclusive
+    # cumsums read at each group's LAST row. jax.ops.segment_sum is a
+    # scatter-add (~163 ms for 2M f64 on v5e); this path has no scatters.
+    # Segment-local scans (associative_scan with a segment-reset combine)
+    # keep float sums numerically sound — a global cumsum difference
+    # loses tiny groups sorted after large-magnitude ones to catastrophic
+    # cancellation (ADVICE r4) — and the group totals come back via ONE
+    # packed row gather at group-last positions instead of carrying every
+    # prefix lane through the boundary-compaction sort.
     from ..types import DecimalType
 
     def prefixable(op, g):
-        # Integer-only for sums: i64 cumsum differences are exact mod 2^64,
-        # but a float sum computed as the difference of a GLOBAL cumsum
-        # inherits absolute error from every preceding sorted row
-        # (catastrophic cancellation: a group of 1e-6 values after 1e12-scale
-        # groups collapses to 0.0).  Floating sums stay on the segment-local
-        # exact tier below.
         if op in ("count", "count_star"):
             return True
         if op in ("sum", "sum_sq"):
             return g is not None and not isinstance(g, StringColumn) \
-                and not isinstance(g.dtype, DecimalType) \
-                and not jnp.issubdtype(g.data.dtype, jnp.floating)
+                and not isinstance(g.dtype, DecimalType)
         return False
 
     in_it = iter(sorted_in)
@@ -279,22 +330,20 @@ def groupby_aggregate(key_columns: Sequence[Column],
         per_agg_inputs.append(next(in_it) if col is not None else None)
 
     first_flag = ((seg != jnp.roll(seg, 1)) | (positions == 0)) & act
-    prefix_lanes: List[jnp.ndarray] = []
-    lane_totals: List[jnp.ndarray] = []
+    scan_lanes: List[jnp.ndarray] = []
     agg_lane: dict = {}
     for i, (op, _) in enumerate(agg_inputs):
         g = per_agg_inputs[i]
         if not prefixable(op, g):
             continue
         if op == "count_star":
-            # active rows sort first, so the exclusive prefix of ones over
-            # the active mask IS the row position
+            # active rows sort first, so group size falls out of the
+            # first-row positions alone
             agg_lane[i] = ("pos", None, None)
             continue
         valid_c = (g.validity & act).astype(jnp.int32)
-        vlane = len(prefix_lanes)
-        prefix_lanes.append(jnp.cumsum(valid_c) - valid_c)
-        lane_totals.append(jnp.sum(valid_c))
+        vlane = len(scan_lanes)
+        scan_lanes.append(valid_c)
         if op == "count":
             agg_lane[i] = ("count", vlane, None)
             continue
@@ -304,13 +353,26 @@ def groupby_aggregate(key_columns: Sequence[Column],
         if op == "sum_sq":
             v = v * v
         v = jnp.where(g.validity & act, v, jnp.zeros((), v.dtype))
-        slane = len(prefix_lanes)
-        prefix_lanes.append(jnp.cumsum(v) - v)
-        lane_totals.append(jnp.sum(v))
+        slane = len(scan_lanes)
+        scan_lanes.append(v)
         agg_lane[i] = ("sum", vlane, slane)
 
-    # boundary compaction: one stable sort carrying the prefix lanes, the
-    # first-row positions, and the packed key lanes
+    # ONE fused segment-reset scan over every lane: incl[j] = sum of the
+    # lane within j's segment up to and including j
+    if scan_lanes:
+        def _comb(a, b):
+            af, bf = a[-1], b[-1]
+            out = tuple(jnp.where(bf, bv, av + bv)
+                        for av, bv in zip(a[:-1], b[:-1]))
+            return out + (af | bf,)
+
+        scanned = jax.lax.associative_scan(
+            _comb, tuple(scan_lanes) + (first_flag,))[:-1]
+    else:
+        scanned = ()
+
+    # boundary compaction: one stable sort carrying the first-row
+    # positions and the packed key lanes (prefix lanes no longer ride it)
     from .rowpack import pack_rows, split_packable, unpack_rows
     kp_idx, ko_idx = split_packable(sorted_keys)
     if kp_idx:
@@ -321,22 +383,53 @@ def groupby_aggregate(key_columns: Sequence[Column],
     else:
         key_lanes, key_flanes = [], []
     operands = ((~first_flag).astype(jnp.uint32), positions,
-                *prefix_lanes, *key_lanes, *key_flanes)
+                *key_lanes, *key_flanes)
     comp = jax.lax.sort(operands, num_keys=1, is_stable=True)
     first_pos = jnp.where(group_act, comp[1], capacity)
-    comp_prefix = comp[2: 2 + len(prefix_lanes)]
-    comp_keys_i = comp[2 + len(prefix_lanes):
-                       2 + len(prefix_lanes) + len(key_lanes)]
-    comp_keys_f = comp[2 + len(prefix_lanes) + len(key_lanes):]
+    comp_keys_i = comp[2: 2 + len(key_lanes)]
+    comp_keys_f = comp[2 + len(key_lanes):]
 
     last_group = positions == (num_groups - 1)
 
-    def lane_diff(lane_idx):
-        start = comp_prefix[lane_idx]
-        nxt = jnp.where(last_group, lane_totals[lane_idx],
-                        jnp.roll(start, -1))
-        d = nxt - start
-        return jnp.where(group_act, d, jnp.zeros((), d.dtype))
+    # per-group LAST row: ONE stacked-matrix gather per dtype class reads
+    # every group total (per-lane gathers cost ~26 ms each on v5e; an
+    # (N, L) matrix gather is ~13 ms total)
+    if scan_lanes:
+        last_pos = jnp.where(last_group, num_rows - 1,
+                             jnp.roll(first_pos, -1) - 1)
+        last_safe = jnp.clip(jnp.where(group_act, last_pos, 0), 0,
+                             capacity - 1)
+        ilanes: List[jnp.ndarray] = []
+        flanes: List[jnp.ndarray] = []
+        lane_slot = []
+        for lane in scanned:
+            if lane.dtype == jnp.float64:
+                lane_slot.append(("f", len(flanes)))
+                flanes.append(lane)
+            elif lane.dtype == jnp.int64:
+                pair = jax.lax.bitcast_convert_type(lane, jnp.uint32)
+                lane_slot.append(("w2", len(ilanes)))
+                ilanes.append(pair[:, 0])
+                ilanes.append(pair[:, 1])
+            else:
+                lane_slot.append(("w1", len(ilanes)))
+                ilanes.append(jax.lax.bitcast_convert_type(
+                    lane.astype(jnp.int32), jnp.uint32))
+        gi = jnp.stack(ilanes, axis=1)[last_safe] if ilanes else None
+        gf = jnp.stack(flanes, axis=1)[last_safe] if flanes else None
+        lane_vals = []
+        for kind, j in lane_slot:
+            if kind == "f":
+                lane_vals.append(gf[:, j])
+            elif kind == "w2":
+                pair = jnp.stack([gi[:, j], gi[:, j + 1]], axis=1)
+                lane_vals.append(
+                    jax.lax.bitcast_convert_type(pair, jnp.int64))
+            else:
+                lane_vals.append(jax.lax.bitcast_convert_type(
+                    gi[:, j], jnp.int32))
+    else:
+        lane_vals = []
 
     results = []
     for i, (op, col) in enumerate(agg_inputs):
@@ -348,11 +441,13 @@ def groupby_aggregate(key_columns: Sequence[Column],
                     .astype(jnp.int64)
                 valid = group_act
             elif kind == "count":
-                data = lane_diff(vlane).astype(jnp.int64)
+                data = jnp.where(group_act, lane_vals[vlane], 0) \
+                    .astype(jnp.int64)
                 valid = group_act
             else:
-                data = lane_diff(slane)
-                valid = (lane_diff(vlane) > 0) & group_act
+                data = jnp.where(group_act, lane_vals[slane],
+                                 jnp.zeros((), lane_vals[slane].dtype))
+                valid = (lane_vals[vlane] > 0) & group_act
             results.append(("raw", (data, valid)))
             continue
         if col is None:
@@ -360,7 +455,8 @@ def groupby_aggregate(key_columns: Sequence[Column],
                                           act, seg, capacity, positions)
         else:
             g = per_agg_inputs[i]
-            if op in ("collect", "collect_set", "collect_merge"):
+            if op in ("collect", "collect_set", "collect_merge") \
+                    or op.startswith("psketch"):
                 results.append(("col", _collect_group(
                     op, g, seg, act, capacity, positions, group_act)))
                 continue

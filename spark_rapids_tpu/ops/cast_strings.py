@@ -409,3 +409,71 @@ def cast_string_to(col: StringColumn, dst: DataType) -> Column:
         from .datetime_ops import string_to_date
         return string_to_date(col)
     raise TypeError(f"cast string -> {dst} not yet on device")
+
+
+def format_number_string(col: Column, decimals: int) -> StringColumn:
+    """format_number(x, d): HALF_EVEN rounding to d places, thousands
+    separators (reference GpuFormatNumber / Java DecimalFormat
+    '#,##0.00'). Device path: the scaled value rides an int64, so inputs
+    with |x|*10^d >= 2^63 saturate (documented deviation — Spark prints
+    full digits via arbitrary-precision DecimalFormat)."""
+    assert 0 <= decimals <= 18  # 10^d must fit an int64 (gated upstream)
+    cap = col.capacity
+    x = col.data.astype(jnp.float64)
+    neg = x < 0
+    scale = float(10 ** decimals)
+    scaled = jnp.rint(jnp.abs(x) * scale)  # rint = HALF_EVEN
+    scaled = jnp.clip(scaled, 0.0, 9.2e18).astype(jnp.int64)
+    if jnp.issubdtype(col.data.dtype, jnp.integer):
+        # exact for integral inputs: no float round trip on the int part;
+        # |x|*10^d past int64 saturates like the float path (documented)
+        mag = jnp.where(neg, -(col.data.astype(jnp.int64)),
+                        col.data.astype(jnp.int64))
+        limit = jnp.int64((2 ** 63 - 1) // 10 ** decimals)
+        scaled = jnp.where(mag > limit, jnp.int64(2 ** 63 - 1),
+                           mag * jnp.int64(10 ** decimals))
+    int_part = scaled // jnp.int64(10 ** decimals)
+    frac = (scaled % jnp.int64(10 ** decimals)).astype(jnp.int64)
+
+    digit_mat, ndig, _ = _digits_fixed(int_part)
+    n_commas = (ndig - 1) // 3
+    int_chars = ndig + n_commas
+    frac_chars = (1 + decimals) if decimals > 0 else 0
+    lengths = (neg.astype(jnp.int32) + int_chars + frac_chars)
+    lengths = jnp.where(col.validity, lengths, 0).astype(jnp.int32)
+    offsets = _rebuild_offsets(lengths)
+
+    byte_cap = int(27 + 1 + decimals + 1) * cap
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, cap - 1)
+    intra = pos - offsets[row]
+    r_neg = neg[row]
+    j = intra - r_neg.astype(jnp.int32)       # 0-based in int section
+    m = int_chars[row]
+    is_sign = r_neg & (intra == 0)
+    in_int = (j >= 0) & (j < m)
+    r0 = m - 1 - j                            # 0-based from the right
+    is_comma = in_int & ((r0 + 1) % 4 == 0)
+    dig_from_right = r0 - (r0 + 1) // 4
+    mat_col = jnp.clip(18 - dig_from_right, 0, 18)
+    int_ch = digit_mat[row, mat_col] + jnp.uint8(ord("0"))
+    fpos = j - m                              # 0 is the '.', 1.. digits
+    is_dot = fpos == 0
+    fd = jnp.clip(fpos - 1, 0, max(decimals - 1, 0))
+    if decimals > 0:
+        pow10 = jnp.asarray([10 ** (decimals - 1 - k)
+                             for k in range(decimals)], jnp.int64)
+        frac_ch = ((frac[row] // pow10[fd]) % 10).astype(jnp.uint8) \
+            + jnp.uint8(ord("0"))
+    else:
+        frac_ch = jnp.zeros((byte_cap,), jnp.uint8)
+    ch = jnp.where(is_sign, jnp.uint8(ord("-")),
+                   jnp.where(is_comma, jnp.uint8(ord(",")),
+                             jnp.where(in_int, int_ch,
+                                       jnp.where(is_dot,
+                                                 jnp.uint8(ord(".")),
+                                                 frac_ch))))
+    in_use = pos < offsets[-1]
+    return StringColumn(jnp.where(in_use, ch, jnp.uint8(0)), offsets,
+                        col.validity, STRING)

@@ -1,0 +1,113 @@
+"""Charset encode/decode kernels (reference GpuEncode/GpuDecode under
+stringFunctions.scala — Java String.getBytes / new String(bytes, charset)
+semantics, '?' for unmappable on encode, U+FFFD on decode).
+
+Engine strings are UTF-8 bytes, so:
+  encode(s, 'UTF-8')        -> byte-identical BINARY
+  encode(s, 'US-ASCII')     -> one byte per code point; >0x7F -> '?'
+  encode(s, 'ISO-8859-1')   -> code points <=0xFF collapse to one byte
+  decode(b, 'UTF-8')        -> byte-identical STRING (malformed input is
+                               passed through, a documented deviation —
+                               Java substitutes U+FFFD per bad byte)
+  decode(b, 'ISO-8859-1')   -> bytes >=0x80 expand to two UTF-8 bytes
+  decode(b, 'US-ASCII')     -> bytes >=0x80 expand to U+FFFD (3 bytes)
+UTF-16 variants keep the host tier (surrogates + BOM state machine).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column, StringColumn
+from ..types import BINARY, STRING
+from .basic import active_mask, compaction_order
+
+
+def _rebuild_offsets(lengths):
+    return jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(lengths)]).astype(jnp.int32)
+
+
+def _row_of_byte(col: StringColumn, pos):
+    row = jnp.searchsorted(col.offsets[: col.capacity + 1], pos,
+                           side="right").astype(jnp.int32) - 1
+    return jnp.clip(row, 0, col.capacity - 1)
+
+
+def encode_single_byte(col: StringColumn, charset: str) -> StringColumn:
+    """UTF-8 -> US-ASCII / ISO-8859-1 (one output byte per code point)."""
+    cap = col.capacity
+    byte_cap = col.byte_capacity
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    in_use = pos < col.offsets[-1]
+    b = col.data
+    is_start = (b & jnp.uint8(0xC0)) != jnp.uint8(0x80)
+    keep = in_use & is_start
+    # output char per code-point start
+    nxt = jnp.concatenate([b[1:], jnp.zeros((1,), jnp.uint8)])
+    if charset == "US-ASCII":
+        ch = jnp.where(b < 0x80, b, jnp.uint8(ord("?")))
+    else:  # ISO-8859-1
+        ch = jnp.where(
+            b < 0x80, b,
+            jnp.where(b == 0xC2, nxt,
+                      jnp.where(b == 0xC3, nxt + jnp.uint8(0x40),
+                                jnp.uint8(ord("?")))))
+    counts = jax.ops.segment_sum(keep.astype(jnp.int32), row,
+                                 num_segments=cap)
+    lengths = jnp.where(col.validity, counts, 0)
+    offsets = _rebuild_offsets(lengths)
+    perm, total = compaction_order(keep, col.offsets[-1])
+    out_use = active_mask(total, byte_cap)
+    data = jnp.where(out_use, ch[jnp.clip(perm, 0, byte_cap - 1)],
+                     jnp.uint8(0))
+    return StringColumn(data, offsets, col.validity, BINARY)
+
+
+def decode_single_byte(col: StringColumn, charset: str) -> StringColumn:
+    """US-ASCII / ISO-8859-1 bytes -> UTF-8 string."""
+    cap = col.capacity
+    byte_cap = col.byte_capacity
+    b = col.data
+    hi = b >= 0x80
+    per_len = jnp.where(hi, 3 if charset == "US-ASCII" else 2, 1) \
+        .astype(jnp.int32)
+    pos = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = _row_of_byte(col, pos)
+    in_use = pos < col.offsets[-1]
+    per_len = jnp.where(in_use, per_len, 0)
+    out_counts = jax.ops.segment_sum(per_len, row, num_segments=cap)
+    lengths = jnp.where(col.validity, out_counts, 0)
+    offsets = _rebuild_offsets(lengths)
+
+    # source start position of each input byte within the OUTPUT stream
+    out_start = jnp.cumsum(per_len) - per_len
+    out_total = offsets[-1]
+    mult = 3 if charset == "US-ASCII" else 2
+    out_cap = byte_cap * mult
+    opos = jnp.arange(out_cap, dtype=jnp.int32)
+    # map output byte -> source input byte: searchsorted over out_start
+    src = jnp.clip(jnp.searchsorted(out_start, opos, side="right")
+                   .astype(jnp.int32) - 1, 0, byte_cap - 1)
+    k = opos - out_start[src]  # 0..2 within the expansion
+    sb = b[src]
+    if charset == "US-ASCII":
+        # U+FFFD = EF BF BD
+        rep = jnp.asarray([0xEF, 0xBF, 0xBD], jnp.uint8)
+        ch = jnp.where(sb < 0x80, sb, rep[jnp.clip(k, 0, 2)])
+    else:
+        ch = jnp.where(
+            sb < 0x80, sb,
+            jnp.where(k == 0,
+                      jnp.uint8(0xC0) | (sb >> jnp.uint8(6)),
+                      jnp.uint8(0x80) | (sb & jnp.uint8(0x3F))))
+    out_use = opos < out_total
+    data = jnp.where(out_use, ch, jnp.uint8(0))
+    return StringColumn(data, offsets, col.validity, STRING)
+
+
+def recast_bytes(col: StringColumn, dtype) -> StringColumn:
+    """UTF-8 passthrough: same bytes, new logical type."""
+    return StringColumn(col.data, col.offsets, col.validity, dtype)

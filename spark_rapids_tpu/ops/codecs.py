@@ -99,7 +99,11 @@ def base64_decode(col: StringColumn) -> StringColumn:
     bad_char = jax.ops.segment_max(
         (in_use & ~is_pad & (val < 0)).astype(jnp.int32), row,
         num_segments=cap) > 0
-    ok = col.validity & (lens % 4 == 0) & (pad_cnt <= 2) & pads_at_tail \
+    # lenient tail (Spark UnBase64 / the host tier, which pads up before
+    # decoding): a final group of 2 or 3 data chars decodes with ANY
+    # number of trailing '=' (0..2); 1 leftover data char is malformed
+    rem = (lens - pad_cnt) % 4
+    ok = col.validity & (rem != 1) & (pad_cnt <= 2) & pads_at_tail \
         & ~bad_char
     n_data = lens - pad_cnt
     out_lens = jnp.where(ok, (n_data * 3) // 4, 0)

@@ -292,3 +292,265 @@ def create_array(cols, dtype) -> ArrayColumn:
     child = Column(data, valid, dtype.element_type)
     offsets = jnp.arange(cap + 1, dtype=jnp.int32) * k
     return ArrayColumn(child, offsets, jnp.ones(cap, jnp.bool_), dtype)
+
+
+# -- round-5 device kernels for the former host-tier family ---------------
+# (reference collectionOperations.scala: GpuArrayPosition, GpuArrayRemove,
+# GpuArrayDistinct, GpuSlice, GpuFlatten, GpuArraysOverlap, GpuArrayRepeat,
+# GpuSequence)
+
+def _elem_grid(col: ArrayColumn):
+    """(idx, row, in_use, pos0): child element index, owning row, active
+    flag and 0-based position within its row."""
+    idx = jnp.arange(col.child_capacity, dtype=jnp.int32)
+    row = _row_of_child(col, idx)
+    in_use = idx < col.offsets[-1]
+    pos0 = idx - col.offsets[row]
+    return idx, row, in_use, pos0
+
+
+def _value_order_key(child: Column):
+    """Total-order integer key over fixed-width element values (floats via
+    the sign-flip bit trick; TPU forbids f64 bitcasts so f64 goes through
+    the arithmetic reconstruction)."""
+    data = child.data
+    if isinstance(child.dtype, BooleanType):
+        return data.astype(jnp.int32)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        if data.dtype == jnp.float64:
+            from .f64bits import f64_bits_signed
+            bits = f64_bits_signed(data)
+        else:
+            bits = jax.lax.bitcast_convert_type(
+                data.astype(jnp.float32), jnp.int32)
+        return jnp.where(bits < 0, ~bits,
+                         bits | (jnp.ones((), bits.dtype)
+                                 << (bits.dtype.itemsize * 8 - 1)))
+    return data
+
+
+def _rebuild_with_keep(col: ArrayColumn, keep) -> ArrayColumn:
+    """New ArrayColumn keeping only flagged ACTIVE elements, preserving
+    per-row element order (stable global compaction keeps rows
+    contiguous)."""
+    from .basic import active_mask, compaction_order, gather_column
+    idx, row, in_use, _ = _elem_grid(col)
+    k = keep & in_use
+    counts = jax.ops.segment_sum(k.astype(jnp.int32), row,
+                                 num_segments=col.capacity)
+    new_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)])
+    perm, total = compaction_order(k, col.offsets[-1])
+    new_child = gather_column(
+        col.child, jnp.where(active_mask(total, col.child_capacity),
+                             perm, -1))
+    return ArrayColumn(new_child, new_offsets, col.validity, col.dtype)
+
+
+def _spark_value_eq(a, b):
+    """Spark ordering equality (interpreted ordering / Double.compare):
+    NaN == NaN, but -0.0 != 0.0 — IEEE == gets both wrong. Floats
+    compare by exact bit pattern with NaN canonicalized (f64_bits is the
+    TPU-safe arithmetic reconstruction)."""
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        from .f64bits import f64_bits
+
+        def bits(x):
+            d = x.astype(jnp.float64)
+            d = jnp.where(jnp.isnan(d), jnp.float64(jnp.nan), d)
+            return f64_bits(d)
+        return bits(a) == bits(b)
+    return a == b
+
+
+def array_position(col: ArrayColumn, value: Column) -> Column:
+    """array_position(arr, v): 1-based first index of v (per-row value),
+    0 when absent, NULL when the array or value is NULL. Null elements
+    never match; equality is Spark's ordering equality (NaN matches NaN,
+    -0.0 does not match 0.0 — Spark GpuArrayPosition)."""
+    from ..types import LONG
+    idx, row, in_use, pos0 = _elem_grid(col)
+    child = col.child
+    v_data = value.data[row]
+    v_ok = value.validity[row]
+    match = in_use & child.validity & v_ok \
+        & _spark_value_eq(child.data, v_data)
+    big = jnp.int32(2 ** 31 - 1)
+    first = jax.ops.segment_min(jnp.where(match, pos0 + 1, big), row,
+                                num_segments=col.capacity)
+    data = jnp.where(first == big, 0, first).astype(jnp.int64)
+    valid = col.validity & value.validity
+    return Column(jnp.where(valid, data, 0), valid, LONG)
+
+
+def array_remove(col: ArrayColumn, value: Column) -> ArrayColumn:
+    """array_remove(arr, v): drop elements equal to v (nulls kept,
+    Spark ordering equality — see _spark_value_eq); NULL array or NULL v
+    gives NULL (Spark GpuArrayRemove)."""
+    idx, row, in_use, _ = _elem_grid(col)
+    child = col.child
+    v_data = value.data[row]
+    v_ok = value.validity[row]
+    drop = child.validity & v_ok & _spark_value_eq(child.data, v_data)
+    out = _rebuild_with_keep(col, ~drop)
+    return ArrayColumn(out.child, out.offsets,
+                       out.validity & value.validity, out.dtype)
+
+
+def array_distinct(col: ArrayColumn) -> ArrayColumn:
+    """array_distinct: first occurrence of each value kept in original
+    order; one NULL element survives (Spark GpuArrayDistinct)."""
+    idx, row, in_use, _ = _elem_grid(col)
+    child = col.child
+    nullflag = (~child.validity).astype(jnp.int32)
+    key = _value_order_key(child)
+    key = jnp.where(child.validity, key, jnp.zeros((), key.dtype))
+    inactive = (~in_use).astype(jnp.int32)
+    key64 = key.astype(jnp.int64) if key.dtype != jnp.int64 else key
+    srow, sinact, snull, skey, sidx = jax.lax.sort(
+        (row, inactive, nullflag, key64, idx), num_keys=4, is_stable=True)
+    dup = (srow == jnp.roll(srow, 1)) & (snull == jnp.roll(snull, 1)) \
+        & (skey == jnp.roll(skey, 1))
+    dup = dup.at[0].set(False)
+    first_sorted = ~dup
+    keep = jnp.zeros((col.child_capacity,), jnp.bool_) \
+        .at[sidx].set(first_sorted)
+    return _rebuild_with_keep(col, keep)
+
+
+def array_slice(col: ArrayColumn, start: Column, length: Column
+                ) -> ArrayColumn:
+    """slice(arr, start, length): 1-based start, negative from the end
+    (Spark GpuSlice). A negative start reaching past the front yields [].
+
+    DEVIATION: rows where start == 0 or length < 0 yield NULL; Spark
+    raises at runtime, and a device-side raise on a data-dependent
+    predicate would cost a per-batch host sync (the host tier and
+    literal-argument paths keep the raise)."""
+    idx, row, in_use, pos0 = _elem_grid(col)
+    lens = array_lengths(col)
+    s = start.data.astype(jnp.int32)
+    ln = length.data.astype(jnp.int32)
+    s0 = jnp.where(s > 0, s - 1, lens + s)
+    s0e = s0[row]
+    # negative start past the array front: empty result (host: i<0 -> [])
+    keep = (pos0 >= s0e) & (pos0 < s0e + ln[row]) & (s0e >= 0)
+    out = _rebuild_with_keep(col, keep)
+    bad = ((s == 0) & start.validity) | ((ln < 0) & length.validity)
+    valid = out.validity & start.validity & length.validity & ~bad
+    return ArrayColumn(out.child, out.offsets, valid, out.dtype)
+
+
+def flatten_array(col: ArrayColumn) -> ArrayColumn:
+    """flatten(arr<arr<T>>) -> arr<T>: pure offset composition (the
+    nested layout is row-major contiguous); NULL when the outer row or
+    ANY inner array in it is NULL (Spark GpuFlatten)."""
+    inner = col.child
+    assert isinstance(inner, ArrayColumn), "flatten needs nested arrays"
+    o = jnp.clip(col.offsets, 0, inner.capacity)
+    new_offsets = inner.offsets[o]
+    idx, row, in_use, _ = _elem_grid(col)  # over INNER ROWS as elements
+    inner_ok = jnp.where(in_use, inner.validity[
+        jnp.clip(idx, 0, inner.capacity - 1)], True)
+    all_ok = jax.ops.segment_min(inner_ok.astype(jnp.int32), row,
+                                 num_segments=col.capacity) > 0
+    valid = col.validity & all_ok
+    from ..types import ArrayType
+    return ArrayColumn(inner.child, new_offsets, valid,
+                       ArrayType(inner.dtype.element_type))
+
+
+def arrays_overlap(a: ArrayColumn, b: ArrayColumn) -> Column:
+    """arrays_overlap(a, b): TRUE when a non-null element is shared;
+    NULL when no match but either side holds a NULL element (and both
+    are non-empty); FALSE otherwise; NULL when either array is NULL
+    (Spark GpuArraysOverlap). Sort-merge: one stable sort of both
+    element sets keyed (row, value, side) — any shared value puts an
+    a-entry adjacent to a b-entry."""
+    ca, cb = a.child, b.child
+    na, nb = ca.capacity, cb.capacity
+    idx_a, row_a, use_a, _ = _elem_grid(a)
+    idx_b, row_b, use_b, _ = _elem_grid(b)
+    rows = jnp.concatenate([row_a, row_b])
+    use = jnp.concatenate([use_a, use_b])
+    validc = jnp.concatenate([ca.validity, cb.validity])
+    key = jnp.concatenate([
+        _value_order_key(ca).astype(jnp.int64),
+        _value_order_key(cb).astype(jnp.int64)])
+    key = jnp.where(validc, key, 0)
+    side = jnp.concatenate([jnp.zeros((na,), jnp.int32),
+                            jnp.ones((nb,), jnp.int32)])
+    ok = use & validc
+    srow, sbad, skey, sside = jax.lax.sort(
+        (rows, (~ok).astype(jnp.int32), key, side), num_keys=4)
+    adj = (srow == jnp.roll(srow, 1)) & (sbad == 0) \
+        & (jnp.roll(sbad, 1) == 0) & (skey == jnp.roll(skey, 1)) \
+        & (sside != jnp.roll(sside, 1))
+    adj = adj.at[0].set(False)
+    hit = jax.ops.segment_max(adj.astype(jnp.int32), srow,
+                              num_segments=a.capacity) > 0
+    has_null_a = jax.ops.segment_max(
+        (use_a & ~ca.validity).astype(jnp.int32), row_a,
+        num_segments=a.capacity) > 0
+    has_null_b = jax.ops.segment_max(
+        (use_b & ~cb.validity).astype(jnp.int32), row_b,
+        num_segments=b.capacity) > 0
+    len_a = array_lengths(a)
+    len_b = array_lengths(b)
+    null_out = ~hit & (has_null_a | has_null_b) & (len_a > 0) & (len_b > 0)
+    valid = a.validity & b.validity & ~null_out
+    return Column(hit & valid, valid, BOOLEAN)
+
+
+def array_repeat(elem: Column, count: Column, child_capacity: int
+                 ) -> ArrayColumn:
+    """array_repeat(e, n): n copies of e per row; negative n gives an
+    empty array; NULL n gives NULL (Spark GpuArrayRepeat). The caller
+    sizes child_capacity (one measured sync at the expression layer)."""
+    from ..types import ArrayType
+    cap = elem.capacity
+    cnt = jnp.where(count.validity, count.data.astype(jnp.int32), 0)
+    cnt = jnp.maximum(cnt, 0)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(cnt)]).astype(jnp.int32)
+    idx = jnp.arange(child_capacity, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, idx, side="right")
+                   .astype(jnp.int32) - 1, 0, cap - 1)
+    in_use = idx < offsets[-1]
+    data = jnp.where(in_use, elem.data[row], jnp.zeros((), elem.data.dtype))
+    cvalid = in_use & elem.validity[row]
+    child = Column(data, cvalid, elem.dtype)
+    return ArrayColumn(child, offsets, count.validity,
+                       ArrayType(elem.dtype))
+
+
+def sequence_array(start: Column, stop: Column, step: Column,
+                   child_capacity: int) -> ArrayColumn:
+    """sequence(start, stop, step) over integers (Spark GpuSequence);
+    rows where the step is zero or points away from stop yield NULL (the
+    reference raises — documented deviation, a device-side raise would
+    need a host sync). The caller sizes child_capacity."""
+    from ..types import ArrayType
+    cap = start.capacity
+    s = start.data.astype(jnp.int64)
+    e = stop.data.astype(jnp.int64)
+    st = step.data.astype(jnp.int64)
+    in_valid = start.validity & stop.validity & step.validity
+    ok_dir = (st != 0) & jnp.where(st > 0, e >= s, e <= s)
+    # Spark also allows start==stop with any nonzero step -> [start]
+    ok = in_valid & (ok_dir | (s == e))
+    n = jnp.where(ok, jnp.abs(
+        jnp.where(st != 0, (e - s) // jnp.where(st == 0, 1, st), 0)) + 1, 0)
+    n = n.astype(jnp.int32)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(n)]).astype(jnp.int32)
+    idx = jnp.arange(child_capacity, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, idx, side="right")
+                   .astype(jnp.int32) - 1, 0, cap - 1)
+    in_use = idx < offsets[-1]
+    pos = idx - offsets[row]
+    data = s[row] + pos.astype(jnp.int64) * st[row]
+    child = Column(jnp.where(in_use, data, 0), in_use,
+                   start.dtype)
+    return ArrayColumn(child, offsets, ok,
+                       ArrayType(start.dtype))

@@ -322,6 +322,14 @@ def xxhash64_column(col: Column, seed) -> jnp.ndarray:
         h = xxhash64_long(bits, seed)
     elif isinstance(dt, DecimalType) and not dt.is_decimal128:
         h = xxhash64_long(col.data, seed)
+    elif isinstance(col, StructColumn):
+        # decimal128/struct: fold the children (limbs) — engine-internal
+        # consistency (bucketing/grouping); cross-system partition parity
+        # for >18-digit decimals is not claimed
+        h = seed
+        for kid in col.children:
+            h = xxhash64_column(kid, h)
+        return jnp.where(col.validity, h, seed)
     else:
         raise TypeError(f"xxhash64 unsupported for {dt}")
     return jnp.where(col.validity, h, seed)

@@ -110,3 +110,225 @@ def percentile_of_arrays(arr: ArrayColumn,
     # a group with no valid values yields a NULL array, not [NULL, ...]
     row_valid = arr.validity & (nvalid > 0)
     return ArrayColumn(child, off, row_valid, ArrayType(out_t))
+
+
+# -- round-5 bounded sketch (approx_percentile) ---------------------------
+# Reference GpuApproximatePercentile.scala:41-76 merges cuDF t-digests so
+# per-group state stays O(accuracy). The TPU analog is a uniform-weight
+# quantile sketch: a group keeps at most K value points (K = 2*accuracy),
+# each merge/compress resamples to K evenly-spaced weighted quantiles, and
+# groups with <= K values stay EXACT. Rank error per compress level is
+# <= n/(2K) = n/(4*accuracy); the merge tree is MERGE_FAN_IN-ary, so a
+# few levels stay comfortably inside Spark's n/accuracy contract.
+#
+# Buffer encoding (one ArrayColumn of DOUBLE per group, the same layout
+# Spark's sketch serializes to a binary buffer): [v_0..v_{L-1}, n] — the
+# TRUE value count rides as the trailing element, so element weights
+# (n/L) survive merges without a second buffer column.
+
+
+def _group_sorted_elements(arr: ArrayColumn, weights=None):
+    """Per-group ascending value sort of the child. Returns (sorted
+    values f64, sorted weights f64, erow, in_use-sorted mask,
+    valid_start, nvalid_weights?) pieces used by the resamplers."""
+    cap = arr.capacity
+    ccap = arr.child.capacity
+    epos = jnp.arange(ccap, dtype=jnp.int32)
+    erow = jnp.clip(jnp.searchsorted(arr.offsets, epos, side="right")
+                    .astype(jnp.int32) - 1, 0, cap - 1)
+    in_use = (epos < arr.offsets[cap]) & arr.child.validity
+    row_key = jnp.where(in_use, erow, jnp.int32(1 << 30))
+    from .sort import _split_u64_lanes
+    lanes = _split_u64_lanes([_numeric_order_key(arr.child)])
+    w = weights if weights is not None else jnp.ones((ccap,), jnp.float64)
+    out = jax.lax.sort(tuple([row_key] + lanes
+                             + [epos, w.astype(jnp.float64)]),
+                       num_keys=1 + len(lanes))
+    sorted_vals = arr.child.data[out[-2]].astype(jnp.float64)
+    sorted_w = out[-1]
+    sorted_row = out[0]
+    return sorted_vals, sorted_w, sorted_row
+
+
+def _merge_rank_1d(key_a, val_a, key_b, val_b, nb: int):
+    """rank of each (key_b, val_b) probe among the (key_a, val_a)
+    entries (count sorting strictly before), via one stable sort — both
+    sequences must already be sorted by (key, val)."""
+    na = key_a.shape[0]
+    keys = jnp.concatenate([key_a, key_b])
+    vals = jnp.concatenate([val_a, val_b])
+    flag = jnp.concatenate([jnp.ones((na,), jnp.int32),
+                            jnp.zeros((nb,), jnp.int32)])
+    payload = jnp.arange(na + nb, dtype=jnp.int32)
+    out = jax.lax.sort((keys, vals, flag, payload), num_keys=3,
+                       is_stable=True)
+    pos_of = jnp.zeros((na + nb,), jnp.int32).at[out[-1]].set(payload)
+    return pos_of[na:] - jnp.arange(nb, dtype=jnp.int32)
+
+
+def sketch_compress(arr: ArrayColumn, k: int) -> ArrayColumn:
+    """Compress per-group RAW value lists (weights 1) into the sketch
+    encoding; merging already-encoded partial sketches is sketch_merge's
+    job (it decodes per-row weights before resampling)."""
+    from ..types import ArrayType
+    cap = arr.capacity
+    ccap = arr.child.capacity
+    epos = jnp.arange(ccap, dtype=jnp.int32)
+
+    # raw values, weights 1
+    sorted_vals, sorted_w, sorted_row = _group_sorted_elements(arr)
+    in_use = (epos < arr.offsets[cap]) & arr.child.validity
+    nvalid = jax.ops.segment_sum(
+        in_use.astype(jnp.int32),
+        jnp.clip(jnp.searchsorted(arr.offsets, epos, side="right")
+                 .astype(jnp.int32) - 1, 0, cap - 1),
+        num_segments=cap)
+    valid_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(nvalid, dtype=jnp.int32)])[:-1]
+
+    out_len = jnp.minimum(nvalid, k) + 1  # +1 for the trailing count
+    out_len = jnp.where(arr.validity, out_len, 0)
+    from .strings import _rebuild_offsets
+    offsets = _rebuild_offsets(out_len)
+    out_cap = ccap + cap  # worst case: every group exact + count slot
+    opos = jnp.arange(out_cap, dtype=jnp.int32)
+    orow = jnp.clip(jnp.searchsorted(offsets, opos, side="right")
+                    .astype(jnp.int32) - 1, 0, cap - 1)
+    o_use = opos < offsets[cap]
+    j = opos - offsets[orow]
+    m = nvalid[orow]
+    L = jnp.minimum(m, k)
+    is_count = j == L
+    exact = m <= k
+    # exact: element j; compressed: element floor((j+0.5)*m/L)
+    idx_exact = j
+    idx_comp = jnp.floor((j.astype(jnp.float64) + 0.5)
+                         * m.astype(jnp.float64)
+                         / jnp.maximum(L, 1).astype(jnp.float64)
+                         ).astype(jnp.int32)
+    idx = jnp.clip(jnp.where(exact, idx_exact, idx_comp), 0,
+                   jnp.maximum(m - 1, 0))
+    src = jnp.clip(valid_start[orow] + idx, 0, ccap - 1)
+    val = jnp.where(is_count, m.astype(jnp.float64), sorted_vals[src])
+    data = jnp.where(o_use, val, 0.0)
+    child = Column(data, o_use, DOUBLE)
+    return ArrayColumn(child, offsets, arr.validity, ArrayType(DOUBLE))
+
+
+def sketch_merge(flat: ArrayColumn, row_lens, row_counts,
+                 k: int) -> ArrayColumn:
+    """Merge partial sketches already flattened per group.
+
+    flat: per GROUP, the concatenation of its partial sketch rows'
+    elements (counts still embedded); row_lens/row_counts: per ELEMENT of
+    flat.child, the source sketch row's value-length and true count
+    (decoded by the caller, which knows the pre-flatten row structure).
+    Resamples every group to min(total_values, k) uniform-weight points
+    and re-appends the merged count."""
+    from ..types import ArrayType
+    cap = flat.capacity
+    ccap = flat.child.capacity
+    epos = jnp.arange(ccap, dtype=jnp.int32)
+    erow = jnp.clip(jnp.searchsorted(flat.offsets, epos, side="right")
+                    .astype(jnp.int32) - 1, 0, cap - 1)
+    in_use = (epos < flat.offsets[cap]) & flat.child.validity
+    is_val = in_use & (row_lens > 0)
+    w = jnp.where(is_val,
+                  row_counts / jnp.maximum(row_lens, 1.0), 0.0)
+
+    # group totals
+    m = jax.ops.segment_sum(is_val.astype(jnp.int32), erow,
+                            num_segments=cap)          # value points
+    n_total = jax.ops.segment_sum(w, erow, num_segments=cap)
+
+    # per-group value sort carrying weights; dead elements (count slots)
+    # sort to the tail of their group via a +inf value key
+    masked = ArrayColumn(
+        Column(flat.child.data,
+               flat.child.validity & (row_lens > 0), flat.dtype.element_type
+               if hasattr(flat.dtype, "element_type") else DOUBLE),
+        flat.offsets, flat.validity, flat.dtype)
+    sorted_vals, sorted_w, sorted_row = _group_sorted_elements(masked, w)
+    # cumulative weight WITHIN each group (segment-reset scan)
+    def comb(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av + bv), af | bf
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_row[1:] != sorted_row[:-1]])
+    cumw, _ = jax.lax.associative_scan(comb, (sorted_w, is_start))
+
+    valid_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(m, dtype=jnp.int32)])[:-1]
+
+    out_len = jnp.minimum(m, k) + 1
+    out_len = jnp.where(flat.validity, out_len, 0)
+    from .strings import _rebuild_offsets
+    offsets = _rebuild_offsets(out_len)
+    out_cap = ccap + cap
+    opos = jnp.arange(out_cap, dtype=jnp.int32)
+    orow = jnp.clip(jnp.searchsorted(offsets, opos, side="right")
+                    .astype(jnp.int32) - 1, 0, cap - 1)
+    o_use = opos < offsets[cap]
+    j = opos - offsets[orow]
+    L = jnp.minimum(m, k)[orow]
+    is_count = j == L
+    # weighted resample target for slot j of its group
+    t = (j.astype(jnp.float64) + 0.5) * n_total[orow] \
+        / jnp.maximum(L, 1).astype(jnp.float64)
+    # rank among the group's cumweights: first element with cumw > t
+    # (probe ranks via ONE merge sort; both sides sorted by (group, w))
+    probe_key = jnp.where(o_use & ~is_count, orow, jnp.int32(1 << 30))
+    # entries: (group, cumw) — dead/count elements already carry the
+    # BIG row key from the group sort; probes: (group, t). probe-first
+    # (strictly-before count = #cumw < t) picks the first cumw >= t
+    rank = _merge_rank_1d(sorted_row, cumw, probe_key, t, out_cap)
+    idx = jnp.clip(rank - valid_start[orow], 0,
+                   jnp.maximum(m[orow] - 1, 0))
+    src = jnp.clip(valid_start[orow] + idx, 0, ccap - 1)
+    val = jnp.where(is_count, n_total[orow], sorted_vals[src])
+    data = jnp.where(o_use, val, 0.0)
+    child = Column(data, o_use, DOUBLE)
+    return ArrayColumn(child, offsets, flat.validity, ArrayType(DOUBLE))
+
+
+def approx_percentile_of_sketches(arr: ArrayColumn, percentages,
+                                  result_type) -> Column:
+    """Final evaluation over sketch buffers ([values..., n] per group):
+    element at weighted rank ceil(p*n) (Spark approx_percentile pick)."""
+    scalar = not isinstance(percentages, (list, tuple))
+    ps = [float(percentages)] if scalar \
+        else [float(p) for p in percentages]
+    cap = arr.capacity
+    ccap = arr.child.capacity
+    lens = arr.offsets[1:] - arr.offsets[:-1]
+    L = jnp.maximum(lens - 1, 0)         # value points per group
+    last = jnp.clip(arr.offsets[1:] - 1, 0, ccap - 1)
+    n = jnp.where(L > 0, arr.child.data[last], 0.0)  # true counts
+    has = (L > 0) & (n > 0)
+    outs, valids = [], []
+    for p in ps:
+        # rank r = ceil(p*n) of n uniform-weight points spread over L
+        # centroids: centroid ceil(r*L/n) - 1
+        r = jnp.ceil(p * n)
+        ci = jnp.ceil(r * L.astype(jnp.float64)
+                      / jnp.maximum(n, 1.0)) - 1
+        ci = jnp.clip(ci.astype(jnp.int32), 0, jnp.maximum(L - 1, 0))
+        idx = jnp.clip(arr.offsets[:-1] + ci, 0, ccap - 1)
+        v = arr.child.data[idx]
+        outs.append(v.astype(result_type.jnp_dtype))
+        valids.append(arr.validity & has)
+    out_t = result_type
+    if scalar:
+        data = jnp.where(valids[0], outs[0], jnp.zeros((), outs[0].dtype))
+        return Column(data, valids[0], out_t)
+    from ..types import ArrayType
+    from .maps import interleave_columns
+    cols = [Column(jnp.where(v, o, jnp.zeros((), o.dtype)), v, out_t)
+            for o, v in zip(outs, valids)]
+    child = interleave_columns(cols)
+    off = jnp.arange(cap + 1, dtype=jnp.int32) * len(ps)
+    row_valid = arr.validity & has
+    return ArrayColumn(child, off, row_valid, ArrayType(out_t))

@@ -86,6 +86,19 @@ def _numeric_order_key(col: Column):
     return data  # already unsigned
 
 
+def numeric_order_lanes(col: Column):
+    """Order-consistent unsigned lane LIST for one fixed-width column:
+    one lane for plain columns, two u64 limb lanes for decimal128
+    (round 5: decimal keys)."""
+    from ..columnar.column import Decimal128Column
+    if isinstance(col, Decimal128Column):
+        sign = jnp.uint64(1) << jnp.uint64(63)
+        return [jax.lax.bitcast_convert_type(col.hi.data, jnp.uint64)
+                ^ sign,
+                jax.lax.bitcast_convert_type(col.lo.data, jnp.uint64)]
+    return [_numeric_order_key(col)]
+
+
 def string_prefix_lanes(col: StringColumn, num_words: int) -> List[jnp.ndarray]:
     """First `num_words`*8 bytes of each string as big-endian uint64 lanes;
     plain ascending uint64 order == UTF-8 binary order (zero-padded, so
@@ -143,7 +156,10 @@ def order_key_lanes(columns: Sequence[Column], orders: Sequence[SortOrder],
         if isinstance(col, StringColumn):
             vlanes = string_prefix_lanes(col, string_words)
         else:
-            vlanes = [_numeric_order_key(col)]
+            # one lane for plain columns, two limb lanes for
+            # decimal128 (round 5: decimal keys; i64 bitcasts are fine
+            # on TPU — only f64 sources are broken)
+            vlanes = numeric_order_lanes(col)
         for v in vlanes:
             v = jnp.where(valid, v, jnp.zeros((), v.dtype))
             if not o.ascending:

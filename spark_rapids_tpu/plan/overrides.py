@@ -194,10 +194,18 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
     _r(rules, datetimeexprs.ToUTCTimestamp,
        "zone wall clock → UTC (device tz transition tables)", tssig, tssig,
        tag_fn=_tag_timezone)
-    # math
-    for c in (emath.UnaryMath, emath.Pow, emath.Atan, emath.Floor,
-              emath.Ceil, emath.Round, emath.BRound):
-        _r(rules, c, "math function", num, num)
+    # math: each Spark expression registers its own rule (the reference
+    # table is per-expression, GpuOverrides.scala:919); all share the
+    # UnaryMath device kernel family (expr/math.py)
+    for c in (emath.Sqrt, emath.Exp, emath.Expm1, emath.Log, emath.Log2,
+              emath.Log10, emath.Log1p, emath.Sin, emath.Cos, emath.Tan,
+              emath.Asin, emath.Acos, emath.Atan, emath.Sinh, emath.Cosh,
+              emath.Tanh, emath.Asinh, emath.Acosh, emath.Atanh,
+              emath.Cbrt, emath.ToDegrees, emath.ToRadians, emath.Signum,
+              emath.Rint, emath.Pow, emath.Floor, emath.Ceil, emath.Round,
+              emath.BRound):
+        _r(rules, c, f"math function {c.__name__.lower()}", num, num)
+    _r(rules, emath.UnaryMath, "math function (family base)", num, num)
     # hash
     _r(rules, hashexprs.Murmur3Hash, "murmur3 hash", commonly_supported, integral)
     _r(rules, hashexprs.XxHash64, "xxhash64", commonly_supported, integral)
@@ -300,8 +308,9 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
        stringlike, stringlike, tag_fn=_tag_device_when_supported)
     _r(rules, stringexprs.RegExpReplace, "regex replace",
        stringlike, stringlike, tag_fn=_tag_device_when_supported)
-    _r(rules, stringexprs.FormatNumber, "format_number (host tier)",
-       numeric, stringlike, tag_fn=_tag_host_tier)
+    _r(rules, stringexprs.FormatNumber,
+       "format_number (device digit emission; decimal inputs host tier)",
+       numeric, stringlike, tag_fn=_tag_device_when_supported)
     _r(rules, stringexprs.Levenshtein, "edit distance (host tier)",
        stringlike, integral, tag_fn=_tag_host_tier)
     # per-expression input signatures: only types the host evaluators
@@ -317,8 +326,9 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
     for c, d, in_sig in (
             (stringexprs.Encode, "charset encode", stronly),
             (stringexprs.Decode, "charset decode", strbin)):
-        _r(rules, c, d + " (host tier)", in_sig, strbin,
-           tag_fn=_tag_host_tier)
+        _r(rules, c,
+           d + " (device UTF-8/ASCII/Latin-1 byte maps; UTF-16 host tier)",
+           in_sig, strbin, tag_fn=_tag_device_when_supported)
 
     # higher-order functions: literal-leaf lambdas run on device as one
     # flat pass over the child column; others stay host tier
@@ -330,15 +340,26 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
         _r(rules, c, d, commonly_supported + arrstr,
            commonly_supported + arrstr,
            tag_fn=_tag_device_when_supported)
-    for c, d in ((ce.ArrayAggregate, "aggregate() HOF"),
-                 (ce.ArrayPosition, "array_position"),
+    # r5: segment-kernel device implementations (ops/collection.py);
+    # string-element shapes drop to the host tier via device_supported
+    for c, d in ((ce.ArrayPosition, "array_position"),
                  (ce.ArrayRemove, "array_remove"),
                  (ce.ArrayDistinct, "array_distinct"),
                  (ce.Slice, "slice"),
                  (ce.Flatten, "flatten"),
                  (ce.ArraysOverlap, "arrays_overlap"),
-                 (ce.ArrayJoin, "array_join"),
-                 (ce.Sequence, "sequence")):
+                 (ce.ArrayRepeat, "array_repeat (literal count)"),
+                 (ce.Sequence, "sequence (literal bounds)")):
+        _r(rules, c, d, commonly_supported + arrstr,
+           commonly_supported + arrstr,
+           tag_fn=_tag_device_when_supported)
+    # residual host tier with one-line justifications:
+    # - aggregate() HOF: arbitrary non-associative lambda fold — no
+    #   static-shape device formulation
+    # - array_join: per-row varlen string ASSEMBLY (dynamic byte output
+    #   composition) — planned with the string-builder substrate
+    for c, d in ((ce.ArrayAggregate, "aggregate() HOF"),
+                 (ce.ArrayJoin, "array_join")):
         _r(rules, c, d + " (host tier)", commonly_supported,
            commonly_supported, tag_fn=_tag_host_tier)
 
@@ -404,7 +425,58 @@ def expression_rules() -> Dict[Type[Expression], ExprRule]:
     _r(rules, collectionexprs.CreateArray, "array constructor",
        numeric_and_decimal + TypeSig.of("BOOLEAN", "DATE", "TIMESTAMP",
                                         "TIMESTAMP_NTZ"), arr)
+
     _EXPR_RULES = rules
+    return rules
+
+
+_AGG_WINDOW_RULES = None
+
+
+def aggregate_window_rules() -> Dict[type, ExprRule]:
+    """Aggregate functions and window functions as rules (the reference
+    registers each as an expression rule, GpuOverrides.scala aggregate
+    exprs). They live in their OWN table: AggregateFunction and
+    WindowFunction are not Expression subclasses here (their tagging
+    runs at the LogicalAggregate/LogicalWindow plan nodes), so the
+    expression table's ExprMeta invariants do not apply — but the
+    per-expression docs/typesig surface and the total rule count do."""
+    global _AGG_WINDOW_RULES
+    if _AGG_WINDOW_RULES is not None:
+        return _AGG_WINDOW_RULES
+    rules: Dict[type, ExprRule] = {}
+    from ..expr import aggexprs as agg
+    for c, d in ((agg.Sum, "sum aggregate"),
+                 (agg.Count, "count aggregate"),
+                 (agg.Min, "min aggregate"),
+                 (agg.Max, "max aggregate"),
+                 (agg.First, "first aggregate"),
+                 (agg.Last, "last aggregate"),
+                 (agg.Average, "average aggregate"),
+                 (agg.CollectList, "collect_list aggregate"),
+                 (agg.CollectSet, "collect_set aggregate"),
+                 (agg.Percentile, "percentile aggregate"),
+                 (agg.ApproxPercentile,
+                  "approx_percentile aggregate (bounded sketch)"),
+                 (agg.StddevPop, "stddev_pop aggregate"),
+                 (agg.StddevSamp, "stddev_samp aggregate"),
+                 (agg.VariancePop, "var_pop aggregate"),
+                 (agg.VarianceSamp, "var_samp aggregate")):
+        _r(rules, c, d, commonly_supported, commonly_supported)
+    from ..expr import windowexprs as win
+    for c, d in ((win.RowNumber, "row_number window function"),
+                 (win.Rank, "rank window function"),
+                 (win.DenseRank, "dense_rank window function"),
+                 (win.Lag, "lag window function"),
+                 (win.Lead, "lead window function"),
+                 (win.FirstValue, "first_value window function"),
+                 (win.LastValue, "last_value window function"),
+                 (win.WindowAgg, "aggregate over window frame"),
+                 (win.WindowExpression, "window expression"),
+                 (win.WindowSpec, "window specification"),
+                 (win.WindowFrame, "window frame (rows/range bounds)")):
+        _r(rules, c, d, commonly_supported, commonly_supported)
+    _AGG_WINDOW_RULES = rules
     return rules
 
 
@@ -580,39 +652,10 @@ class PlanMeta(BaseMeta):
                         self.will_not_work_on_tpu(
                             f"collect_set over {dt.simple_name()} needs "
                             "string dedup lanes (planned)")
-        if isinstance(self.plan, (L.LogicalSort, L.LogicalJoin,
-                                  L.LogicalAggregate, L.LogicalWindow)):
-            # two-limb decimal128 columns have no order-key/bucket-hash
-            # lanes yet: sort keys, join keys and group keys past 18
-            # digits reject at plan time (values pass through projections
-            # and sums fine — only KEY positions are affected)
-            from ..types import DecimalType as _Dec
-            keyed = []
-            if isinstance(self.plan, L.LogicalSort):
-                keyed = [(o[0] if isinstance(o, tuple) else o)
-                         for o in self.plan.orders]
-            elif isinstance(self.plan, L.LogicalJoin):
-                keyed = list(self.plan.left_keys) + \
-                    list(self.plan.right_keys)
-            elif isinstance(self.plan, L.LogicalAggregate):
-                keyed = list(self.plan.group_exprs)
-            else:
-                keyed = [e for we, _ in self.plan.window_exprs
-                         for e in we.spec.partition_by]
-                keyed += [o[0] for we, _ in self.plan.window_exprs
-                          for o in we.spec.order_by]
-            for e in keyed:
-                for child in self.plan.children:
-                    try:
-                        dt = resolve(e, child.schema).data_type \
-                            if isinstance(e, Expression) else None
-                    except (KeyError, TypeError, NotImplementedError):
-                        continue
-                    if isinstance(dt, _Dec) and dt.precision > 18:
-                        self.will_not_work_on_tpu(
-                            f"{dt.simple_name()} key: decimal128 order/"
-                            "hash lanes not implemented")
-                    break
+        # (round 5: decimal128 KEY positions are supported — two-limb
+        # order lanes in ops/sort.order_key_lanes, limb equality in the
+        # join verify, recursive murmur3 over the limb children — so the
+        # former >18-digit key tag-off is gone.)
         if isinstance(self.plan, L.LogicalJoin):
             # joins duplicate payload rows; the duplicating array gather
             # has no string-element byte measurement yet — reject at plan

@@ -1,0 +1,244 @@
+"""Round-5 de-hosted collection/string kernels vs the host-tier oracle
+(VERDICT r4 item 4; reference collectionOperations.scala,
+stringFunctions.scala GpuFormatNumber/GpuEncode/GpuDecode)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col, lit
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.types import (
+    DOUBLE, LONG, STRING, ArrayType, Schema, StructField,
+)
+
+ARRS = [[1, 2, 3, 2], [], None, [5], [7, None, 3, 7, None], [10, 10],
+        [None], [-4, 0, -4]]
+BRRS = [[2, 9], [1], [3, None], None, [7], [None], [], [0]]
+
+
+@pytest.fixture(scope="module")
+def df():
+    s = TpuSession()
+    sch = Schema((StructField("a", ArrayType(LONG)),
+                  StructField("b", ArrayType(LONG)),
+                  StructField("v", LONG)))
+    return s.from_pydict(
+        {"a": ARRS, "b": BRRS, "v": [2, 1, 3, 5, 7, 10, None, -4]}, sch)
+
+
+def run1(df, expr):
+    return [r[0] for r in df.select(expr.alias("r")).collect()]
+
+
+def _plan_is_device(df, expr):
+    tree = df.select(expr.alias("r"))._exec().tree_string()
+    return "Fallback" not in tree and "HostRow" not in tree
+
+
+def test_array_position_device(df):
+    e = F.array_position(col("a"), col("v"))
+    got = run1(df, e)
+    exp = []
+    for a, v in zip(ARRS, [2, 1, 3, 5, 7, 10, None, -4]):
+        if a is None or v is None:
+            exp.append(None)
+        else:
+            pos = 0
+            for i, x in enumerate(a):
+                if x is not None and x == v:
+                    pos = i + 1
+                    break
+            exp.append(pos)
+    assert got == exp
+    assert _plan_is_device(df, e)
+
+
+def test_array_remove_device(df):
+    e = F.array_remove(col("a"), col("v"))
+    assert _plan_is_device(df, e)
+    got = run1(df, e)
+    exp = []
+    for a, v in zip(ARRS, [2, 1, 3, 5, 7, 10, None, -4]):
+        exp.append(None if a is None or v is None
+                   else [x for x in a if x is None or x != v])
+    assert got == exp
+
+
+def test_array_distinct_device(df):
+    got = run1(df, F.array_distinct(col("a")))
+    exp = []
+    for a in ARRS:
+        if a is None:
+            exp.append(None)
+            continue
+        out, saw = [], False
+        for x in a:
+            if x is None:
+                if not saw:
+                    out.append(None)
+                    saw = True
+            elif x not in out:
+                out.append(x)
+        exp.append(out)
+    assert got == exp
+
+
+def test_slice_device(df):
+    assert _plan_is_device(df, F.slice(col("a"), 2, 2))
+    got = run1(df, F.slice(col("a"), 2, 2))
+    exp = [None if a is None else a[1:3] for a in ARRS]
+    assert got == exp
+    got_neg = run1(df, F.slice(col("a"), -2, 2))
+    exp_neg = []
+    for a in ARRS:
+        if a is None:
+            exp_neg.append(None)
+        else:
+            i = len(a) - 2
+            exp_neg.append([] if i < 0 else a[i:i + 2])
+    assert got_neg == exp_neg
+
+
+def test_arrays_overlap_device(df):
+    got = run1(df, F.arrays_overlap(col("a"), col("b")))
+    exp = []
+    for a, b in zip(ARRS, BRRS):
+        if a is None or b is None:
+            exp.append(None)
+            continue
+        bs = {x for x in b if x is not None}
+        if any(x in bs for x in a if x is not None):
+            exp.append(True)
+        elif a and b and (None in a or None in b):
+            exp.append(None)
+        else:
+            exp.append(False)
+    assert got == exp
+
+
+def test_flatten_device():
+    s = TpuSession()
+    NEST = [[[1, 2], [3]], [[], [4, None]], None, [None, [5]], [[]]]
+    sch = Schema((StructField("n", ArrayType(ArrayType(LONG))),))
+    ndf = s.from_pydict({"n": NEST}, sch)
+    got = run1(ndf, F.flatten(col("n")))
+    exp = []
+    for arr in NEST:
+        if arr is None or any(x is None for x in arr):
+            exp.append(None)
+        else:
+            exp.append([y for sub in arr for y in sub])
+    assert got == exp
+
+
+def test_sequence_literal_device(df):
+    got = run1(df, F.sequence(lit(1), lit(7), lit(2)))
+    assert got == [[1, 3, 5, 7]] * len(ARRS)
+    got_desc = run1(df, F.sequence(lit(5), lit(1), lit(-2)))
+    assert got_desc == [[5, 3, 1]] * len(ARRS)
+
+
+def test_array_repeat_literal_device(df):
+    got = run1(df, F.array_repeat(col("v"), 3))
+    exp = [[v] * 3 for v in [2, 1, 3, 5, 7, 10, None, -4]]
+    assert got == exp
+
+
+def test_format_number_device():
+    s = TpuSession()
+    vals = [1234567.891, -0.004, 0.0, None, -98765.5, 1e12]
+    sch = Schema((StructField("x", DOUBLE),))
+    fdf = s.from_pydict({"x": vals}, sch)
+    got = run1(fdf, F.format_number(col("x"), 2))
+    assert got == [None if v is None else f"{v:,.2f}" for v in vals]
+    ldf = s.from_pydict({"x": [0, -5, 1234567, None]},
+                        Schema((StructField("x", LONG),)))
+    assert run1(ldf, F.format_number(col("x"), 0)) == \
+        ["0", "-5", "1,234,567", None]
+
+
+def test_encode_decode_device_roundtrip():
+    s = TpuSession()
+    vals = ["héllo", "abc", "ü¢", None, "", "mixed é ascii"]
+    sch = Schema((StructField("s", STRING),))
+    sdf = s.from_pydict({"s": vals}, sch)
+    dec = run1(sdf, F.decode(F.encode(col("s"), "ISO-8859-1"),
+                             "ISO-8859-1"))
+    assert dec == vals
+    utf = run1(sdf, F.decode(F.encode(col("s"), "UTF-8"), "UTF-8"))
+    assert utf == vals
+    asc = run1(sdf, F.decode(F.encode(col("s"), "US-ASCII"), "US-ASCII"))
+    assert asc == [None if v is None else
+                   v.encode("ascii", "replace").decode("ascii")
+                   for v in vals]
+
+
+def test_string_elements_fall_back_to_host():
+    # string-element arrays keep the host tier but stay CORRECT
+    s = TpuSession()
+    sch = Schema((StructField("a", ArrayType(STRING)),))
+    sdf = s.from_pydict({"a": [["x", "y", "x"], None, ["z"]]}, sch)
+    got = run1(sdf, F.array_distinct(col("a")))
+    assert got == [["x", "y"], None, ["z"]]
+
+
+def test_slice_negative_start_past_front_is_empty(df):
+    # slice([1,2], -5, 4) -> [] (Spark; host tier agrees)
+    got = run1(df, F.slice(col("a"), -5, 4))
+    exp = []
+    for a in ARRS:
+        if a is None:
+            exp.append(None)
+        else:
+            i = len(a) - 5
+            exp.append([] if i < 0 else a[i:i + 4])
+    assert got == exp
+
+
+def test_slice_zero_start_and_negative_length_null_deviation(df):
+    # data-dependent start 0 / length < 0 -> NULL on device (documented
+    # deviation; Spark raises)
+    s = TpuSession()
+    sch = Schema((StructField("a", ArrayType(LONG)),
+                  StructField("st", LONG), StructField("ln", LONG)))
+    sdf = s.from_pydict({"a": [[1, 2, 3]] * 3, "st": [0, 1, 2],
+                         "ln": [2, -1, 2]}, sch)
+    got = [r[0] for r in sdf.select(
+        F.slice(col("a"), col("st"), col("ln")).alias("r")).collect()]
+    assert got == [None, None, [2, 3]]
+
+
+def test_format_number_large_decimals_host_tier():
+    s = TpuSession()
+    sch = Schema((StructField("x", DOUBLE),))
+    fdf = s.from_pydict({"x": [1.5, None]}, sch)
+    got = run1(fdf, F.format_number(col("x"), 19))  # host tier (d > 18)
+    assert got == [f"{1.5:,.19f}", None]
+
+
+def test_format_number_int_overflow_saturates():
+    s = TpuSession()
+    sch = Schema((StructField("x", LONG),))
+    fdf = s.from_pydict({"x": [10 ** 18]}, sch)
+    # |x|*10^2 exceeds int64: device saturates (documented deviation):
+    # scaled pins to 2^63-1 -> int part 92,233,720,368,547,758
+    got = run1(fdf, F.format_number(col("x"), 2))
+    assert got[0] == "92,233,720,368,547,758.07"
+
+
+def test_array_position_nan_and_negzero_spark_equality():
+    s = TpuSession()
+    sch = Schema((StructField("a", ArrayType(DOUBLE)),
+                  StructField("v", DOUBLE)))
+    nan = float("nan")
+    sdf = s.from_pydict(
+        {"a": [[1.0, nan, 3.0], [0.0, 2.0], [-0.0, 5.0]],
+         "v": [nan, -0.0, -0.0]}, sch)
+    got = run1(sdf, F.array_position(col("a"), col("v")))
+    # NaN matches NaN (pos 2); -0.0 does NOT match 0.0; -0.0 matches -0.0
+    assert got == [2, 0, 1]
+    rem = run1(sdf, F.array_remove(col("a"), col("v")))
+    assert rem[0] == [1.0, 3.0]
+    assert rem[1] == [0.0, 2.0]
+    assert rem[2] == [5.0]

@@ -81,8 +81,8 @@ def checksum(batch, prev):
     return total
 
 
-ITERS = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+ITERS = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 2
 
 stages = mk_stages()
 results = {name: [] for name, _ in stages}
@@ -107,6 +107,7 @@ with speculation_scope() as scope:
             float(np.asarray(chk))  # ONE forced sync closes the clock
             dt = (time.perf_counter() - t0) / ITERS * 1e3
             results[name].append(dt)
+            print(f"rep{rep} {name:12s} {dt:9.1f} ms", flush=True)
 
 meds = {name: sorted(results[name])[len(results[name]) // 2]
         for name, _ in stages}

@@ -23,7 +23,8 @@ def gen_configs() -> str:
 def gen_supported_ops() -> str:
     """docs/supported_ops.md from the expression/exec rule tables (the
     reference's TypeChecks-generated support matrix)."""
-    from spark_rapids_tpu.plan.overrides import expression_rules
+    from spark_rapids_tpu.plan.overrides import (aggregate_window_rules,
+        expression_rules)
     lines = [
         "# spark_rapids_tpu supported operations",
         "",
@@ -36,7 +37,8 @@ def gen_supported_ops() -> str:
         "| Expression | Description | Input types | Output types |",
         "|---|---|---|---|",
     ]
-    rules = expression_rules()
+    rules = dict(expression_rules())
+    rules.update(aggregate_window_rules())
     for cls in sorted(rules, key=lambda c: c.__name__):
         r = rules[cls]
         lines.append(
