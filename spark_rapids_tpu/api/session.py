@@ -46,6 +46,16 @@ class TpuSession:
             mesh = device_mesh(mesh_devices)
         self.mesh = mesh
         set_active_mesh(mesh)
+        #: per-query metric roll-up of the LAST collect() on this
+        #: session (exec/task_metrics.py; reference GpuTaskMetrics)
+        self._last_query_metrics = None
+
+    def last_query_metrics(self):
+        """Task-level metrics of the most recent DataFrame.collect():
+        semaphore wait, OOM-retry counts, spill volumes (per-query
+        deltas) plus per-operator metric sums — the engine's
+        GpuTaskMetrics surface (GpuTaskMetrics.scala:81-103)."""
+        return self._last_query_metrics
 
     # -- ingestion ---------------------------------------------------------
     def from_pydict(self, data: Dict, schema: Schema,
@@ -321,7 +331,20 @@ class DataFrame:
         return TpuOverrides(self.session.conf).apply(self._plan)
 
     def collect(self) -> List[tuple]:
-        return self._exec().collect()
+        from ..exec.task_metrics import query_snapshot, query_summary
+        plan = self._exec()
+        before = query_snapshot()
+        try:
+            return plan.collect()
+        finally:
+            # metrics are harvested even on failure: a half-run query's
+            # spill/retry spend is exactly what an operator debugging it
+            # wants to see
+            try:
+                self.session._last_query_metrics = query_summary(
+                    plan, before)
+            except Exception:  # noqa: BLE001 — metrics must never mask
+                pass
 
     def to_arrow(self):
         import pyarrow as pa

@@ -180,6 +180,23 @@ PALLAS_ENABLED = conf_bool(
     "Off-TPU backends always use the XLA path; tests drive the kernel "
     "via the Pallas interpreter for bit-exactness.")
 
+PALLAS_FUSED_TIER = conf_str(
+    "spark.rapids.tpu.pallas.fusedTier", "auto",
+    "Fused Pallas kernel tier for the join-probe and scan-aggregate hot "
+    "paths: 'off' keeps the XLA formulations, 'on' forces the fused "
+    "kernels (interpret-mode off-TPU — the correctness/test setting), "
+    "'auto' (default) consults the per-shape-bucket XLA-vs-Pallas "
+    "timings recorded by tools/kern_bench.py and picks the measured "
+    "winner; with no recorded measurement for a shape the XLA tier "
+    "stays — the tier choice is a measurement, not a guess.",
+    commonly_used=True)
+
+PALLAS_FUSED_BENCH_FILE = conf_str(
+    "spark.rapids.tpu.pallas.fusedTier.benchFile", "",
+    "Path of the kernel-microbenchmark record file driving "
+    "fusedTier=auto (written by tools/kern_bench.py). Empty = "
+    "tools/kern_bench.json next to the package if present.")
+
 DEBUG_DUMP_PATH = conf_str(
     "spark.rapids.sql.debug.dumpPath", "",
     "When set, operators wrapped in dump_on_error write their input "

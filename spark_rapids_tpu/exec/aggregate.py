@@ -164,6 +164,27 @@ class AggregateExec(TpuExec):
         self._jit_step_spec = jax.jit(self._streaming_step)
         self._jit_step_exact = jax.jit(self._fused_update_exact)
 
+        # fused Pallas tier (ISSUE 1): compile the absorbed operator
+        # chain for the one-kernel scan-filter-project-partial-aggregate
+        # when every expression is in the whitelisted elementwise subset;
+        # the measured tier selector decides per shape at trace time
+        self._pallas_agg_spec = None
+        if mode != "final" and self._masked_ok and self.group_exprs:
+            try:
+                from ..ops.pallas_fused import compile_scan_agg_spec
+                agg_op_slots = []
+                for i, (fn, _) in enumerate(self.aggregates):
+                    for (op, slot) in fn.update_ops():
+                        agg_op_slots.append(
+                            (op, self._input_slots[i][slot]
+                             if slot is not None else None))
+                self._pallas_agg_spec = compile_scan_agg_spec(
+                    self._fused_steps, self._pre_bound, self._pre_schema,
+                    self._key_count, agg_op_slots,
+                    self._source.output_schema)
+            except Exception:  # noqa: BLE001 — tier is best-effort
+                self._pallas_agg_spec = None
+
         # round 5: when the child contract (output_grouped_by) already
         # groups rows by this aggregate's keys — e.g. the inner join's
         # key-grouped emission — the exact tier skips its batch sort
@@ -308,7 +329,26 @@ class AggregateExec(TpuExec):
         from ..ops.maskedagg import masked_groupby, masked_reduce
         out_cap = self._small_cap()
 
-        if self.mode == "final":
+        use_pallas = False
+        if self.mode != "final" and self._pallas_agg_spec is not None:
+            from ..ops.pallas_tier import fused_tier_enabled
+            use_pallas = fused_tier_enabled("scan_agg", (batch.capacity,))
+
+        if use_pallas:
+            # ONE Pallas kernel: scan tiles -> filter -> project ->
+            # masked-bucket partials, no intermediate column in HBM
+            # (ops/pallas_fused.py); dirty buckets raise the same
+            # speculation flag as the XLA masked tier
+            from ..ops.pallas_fused import fused_scan_agg_update
+            from ..ops.pallas_kernels import on_tpu
+            out_keys, results, num_groups, leftover = \
+                fused_scan_agg_update(
+                    self._pallas_agg_spec, batch,
+                    min(32, self._slots), out_cap,
+                    interpret=not on_tpu())
+            flag = flag | leftover
+            part = self._build_small_batch(out_keys, results, num_groups)
+        elif self.mode == "final":
             cur, mask = batch, None
             keys, agg_inputs = self._merge_inputs(batch)
         else:
@@ -317,7 +357,9 @@ class AggregateExec(TpuExec):
             keys, agg_inputs = self._update_inputs(pre)
             cur = pre
 
-        if not keys:
+        if use_pallas:
+            pass
+        elif not keys:
             results = [("raw", r) for r in masked_reduce(
                 agg_inputs, cur.num_rows, mask, out_cap)]
             part = self._build_small_batch([], results, jnp.int32(1))

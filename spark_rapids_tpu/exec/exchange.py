@@ -126,9 +126,10 @@ class ShuffleExchangeExec(TpuExec):
                 pid=pid)
             return _expand0(ColumnarBatch(cols, n_recv, schema))
 
-        step = jax.jit(jax.shard_map(
+        from ..parallel.mesh import shard_map_compat
+        step = jax.jit(shard_map_compat(
             spmd, mesh=self._mesh, in_specs=P(DATA_AXIS),
-            out_specs=P(DATA_AXIS), check_vma=False))
+            out_specs=P(DATA_AXIS)))
         self._steps[key] = step
         return step
 

@@ -32,8 +32,8 @@ from ..ops.basic import active_mask, compaction_order, gather_column
 from ..ops.strings import string_equal
 from ..ops.join import (
     BuildTable, cross_pairs, expand_candidates, gather_column_indices,
-    inner_gather_maps, matched_flags, outer_extend_maps, probe_counts,
-    unmatched_indices, verify_pairs,
+    inner_gather_maps, int_key_lanes, matched_flags, outer_extend_maps,
+    probe_counts, unmatched_indices, verify_pairs,
 )
 from ..types import BooleanType, Schema, StructField
 from .base import BUILD_TIME, JOIN_TIME, NUM_INPUT_BATCHES, TpuExec
@@ -150,7 +150,7 @@ class HashJoinExec(TpuExec):
         self._jit_build = jax.jit(self._build_kernel)
         self._jit_counts = jax.jit(self._counts_kernel)
         self._jit_probe = jax.jit(self._probe_kernel,
-                                  static_argnums=(5, 6, 7))
+                                  static_argnums=(5, 6, 7, 8))
         # (stream_cap, build_cap) -> (cand_cap, s_caps, b_caps): lets a
         # speculation scope skip the per-batch sizing sync (round 4)
         self._size_cache = {}
@@ -283,8 +283,14 @@ class HashJoinExec(TpuExec):
         if self._build_filter is not None:
             key_cols = self._mask_keys(
                 key_cols, self._filter_mask(self._build_filter, batch))
+        # prepare the fused probe's key-lane tables only when the tier
+        # selector could ever pick the Pallas kernel (off / auto-without-
+        # a-recorded-win joins pay nothing for them)
+        from ..ops.pallas_tier import family_may_engage
         return BuildTable.build(key_cols, list(batch.columns),
-                                batch.num_rows, batch.capacity)
+                                batch.num_rows, batch.capacity,
+                                with_key_lanes=family_may_engage(
+                                    "join_probe"))
 
     def _build(self) -> Tuple[BuildTable, ColumnarBatch]:
         build_child = self.children[1] if self.build_side == "right" \
@@ -345,72 +351,109 @@ class HashJoinExec(TpuExec):
 
     def _probe_kernel(self, build: BuildTable, build_batch: ColumnarBatch,
                       stream_batch: ColumnarBatch, lo_counts, build_matched,
-                      cand_cap: int, s_caps: Tuple = (), b_caps: Tuple = ()):
+                      cand_cap: int, s_caps: Tuple = (), b_caps: Tuple = (),
+                      use_fused: bool = False):
         """Packed-row probe (round 4): the build side's fixed-width
         keys+payload live in ONE sorted u32 matrix (+ f64 matrix), so the
         whole candidate-verify-compact-emit pipeline is a handful of row
         gathers instead of 2 gathers per column (reference JoinGatherer
-        gathers; measured ~20x on the q3 shape, tools/exp_gather.py)."""
+        gathers; measured ~20x on the q3 shape, tools/exp_gather.py).
+
+        use_fused (static, chosen by the measured tier selector): the
+        expand+verify stage runs as ONE Pallas kernel streaming candidate
+        tiles through VMEM (ops/pallas_join.fused_probe_verify) instead
+        of separate XLA programs with candidate-level full-width
+        intermediates; the payload gather then happens once, at OUTPUT
+        level, after compaction."""
         from ..ops.rowpack import (gather_rows, pack_rows, split_packable,
                                    unpack_rows)
         lo, counts, skey_cols = lo_counts
         s_caps = s_caps or (None,) * len(stream_batch.columns)
         b_caps = b_caps or (None,) * len(build.payload)
         scap = stream_batch.capacity
-        s_idx, b_pos, total_dev = expand_candidates(lo, counts, cand_cap)
-        pair_valid = s_idx >= 0
-        b_pos_m = jnp.where(pair_valid, b_pos, -1)
 
         plan_b, imat_b, fmat_b, kpi, ppi, poi = build.pack
         n_bkeys = len(kpi)
-        # one candidate-level row gather fetches build keys AND payload
-        bi_c, bf_c = gather_rows(plan_b, imat_b, fmat_b, b_pos_m)
 
-        # --- verify: keys packable on BOTH sides compare via the packs,
-        # the rest via the original per-column gather path ---
-        from ..ops.rowpack import is_packable
-        kpi_pos = {ki: pos for pos, ki in enumerate(kpi)}
-        pk = [ki for ki in kpi if is_packable(skey_cols[ki])]
+        # structural eligibility is static per trace: integer keys on
+        # both sides with matching lane widths, i32 candidate space
+        sk_lanes_v = int_key_lanes(skey_cols) if use_fused else None
+        fused = (use_fused and build.key_lanes is not None
+                 and sk_lanes_v is not None
+                 and len(sk_lanes_v[0]) == len(build.key_lanes[0])
+                 and cand_cap < (1 << 31))
 
-        # sorted position -> original build row; only needed for varlen
-        # columns, fallback keys and residual conditions
-        need_b_row = bool(poi) or self.condition is not None or \
-            len(pk) < len(skey_cols)
-        b_row = gather_column_indices(build.perm, b_pos_m) if need_b_row \
-            else None
-        bk_cand = unpack_rows(plan_b, bi_c, bf_c,
-                              only=[kpi_pos[ki] for ki in pk]) if pk else []
-        ok = pair_valid
-        if pk:
-            plan_sk, imat_sk, fmat_sk = pack_rows(
-                [skey_cols[ki] for ki in pk])
-            ski_c, skf_c = gather_rows(
-                plan_sk, imat_sk, fmat_sk,
-                jnp.where(pair_valid, s_idx, -1))
-            sk_cand = unpack_rows(plan_sk, ski_c, skf_c)
-            for b, s in zip(bk_cand, sk_cand):
-                ok = ok & (b.data == s.data) & b.validity & s.validity
-        pk_set = set(pk)
-        for ki in range(len(skey_cols)):
-            if ki in pk_set:
-                continue
-            bk = build.key_cols[ki]
-            sk = skey_cols[ki]
-            b = gather_column(bk, b_row)
-            s = gather_column(sk, jnp.where(pair_valid, s_idx, -1))
-            if isinstance(bk, StringColumn):
-                eq = string_equal(b, s)
-                ok = ok & eq.data & eq.validity
-            else:
-                from ..columnar.column import Decimal128Column
-                if isinstance(bk, Decimal128Column):
-                    # two-limb equality (round 5: decimal128 join keys)
-                    ok = ok & (b.hi.data == s.hi.data) \
-                        & (b.lo.data == s.lo.data) \
-                        & b.validity & s.validity
-                else:
+        if fused:
+            from ..ops.pallas_join import fused_probe_verify
+            from ..ops.pallas_kernels import on_tpu
+            bk_lanes, bvalid = build.key_lanes
+            sk_lanes, svalid = sk_lanes_v
+            verified, s_idx, b_pos, b_row = fused_probe_verify(
+                lo, counts, bk_lanes, bvalid, sk_lanes, svalid,
+                build.perm, cand_cap, interpret=not on_tpu())
+            total_dev = jnp.sum(counts.astype(jnp.int64)) \
+                if counts.shape[0] else jnp.int64(0)
+            pair_valid = s_idx >= 0
+            b_pos_m = jnp.where(pair_valid, b_pos, -1)
+            need_b_row = True  # the kernel emits it in the same pass
+            ok = verified
+            bi_c = bf_c = None
+        else:
+            s_idx, b_pos, total_dev = expand_candidates(lo, counts,
+                                                        cand_cap)
+            pair_valid = s_idx >= 0
+            b_pos_m = jnp.where(pair_valid, b_pos, -1)
+
+            # one candidate-level row gather fetches build keys AND payload
+            bi_c, bf_c = gather_rows(plan_b, imat_b, fmat_b, b_pos_m)
+
+            # --- verify: keys packable on BOTH sides compare via the
+            # packs, the rest via the original per-column gather path ---
+            from ..ops.rowpack import is_packable
+            kpi_pos = {ki: pos for pos, ki in enumerate(kpi)}
+            pk = [ki for ki in kpi if is_packable(skey_cols[ki])]
+
+            # sorted position -> original build row; only needed for
+            # varlen columns, fallback keys and residual conditions
+            need_b_row = bool(poi) or self.condition is not None or \
+                len(pk) < len(skey_cols)
+            b_row = gather_column_indices(build.perm, b_pos_m) \
+                if need_b_row else None
+            bk_cand = unpack_rows(plan_b, bi_c, bf_c,
+                                  only=[kpi_pos[ki] for ki in pk]) \
+                if pk else []
+            ok = pair_valid
+            if pk:
+                plan_sk, imat_sk, fmat_sk = pack_rows(
+                    [skey_cols[ki] for ki in pk])
+                ski_c, skf_c = gather_rows(
+                    plan_sk, imat_sk, fmat_sk,
+                    jnp.where(pair_valid, s_idx, -1))
+                sk_cand = unpack_rows(plan_sk, ski_c, skf_c)
+                for b, s in zip(bk_cand, sk_cand):
                     ok = ok & (b.data == s.data) & b.validity & s.validity
-        verified = ok
+            pk_set = set(pk)
+            for ki in range(len(skey_cols)):
+                if ki in pk_set:
+                    continue
+                bk = build.key_cols[ki]
+                sk = skey_cols[ki]
+                b = gather_column(bk, b_row)
+                s = gather_column(sk, jnp.where(pair_valid, s_idx, -1))
+                if isinstance(bk, StringColumn):
+                    eq = string_equal(b, s)
+                    ok = ok & eq.data & eq.validity
+                else:
+                    from ..columnar.column import Decimal128Column
+                    if isinstance(bk, Decimal128Column):
+                        # two-limb equality (round 5: decimal128 keys)
+                        ok = ok & (b.hi.data == s.hi.data) \
+                            & (b.lo.data == s.lo.data) \
+                            & b.validity & s.validity
+                    else:
+                        ok = ok & (b.data == s.data) \
+                            & b.validity & s.validity
+            verified = ok
         if self.condition is not None:
             verified = verified & self._eval_condition(
                 stream_batch, build_batch, s_idx, b_row, cand_cap,
@@ -452,17 +495,27 @@ class HashJoinExec(TpuExec):
             # sharing a 64-bit hash could interleave by position.
             act_c = active_mask(total_dev, cand_cap)
             kflag = verified & act_c
-            nvl = plan_b.n_valid_lanes
-            klanes = []
-            for ci in kpi:
-                kind, lane = plan_b.kinds[ci]
-                if kind == "f64":
-                    klanes.append(bf_c[:, lane])
-                elif kind == "w2":
-                    klanes.append(bi_c[:, nvl + lane])
-                    klanes.append(bi_c[:, nvl + lane + 1])
-                else:
-                    klanes.append(bi_c[:, nvl + lane])
+            if fused:
+                # the fused probe never materialized candidate-level key
+                # gathers; the sort lanes come straight from the
+                # VMEM-resident u32 key-lane tables (any consistent total
+                # order over key bit patterns groups equal keys)
+                safe_c = jnp.clip(b_pos_m, 0,
+                                  build.key_lanes[0][0].shape[0] - 1)
+                klanes = [jnp.where(kflag, ln[safe_c], jnp.uint32(0))
+                          for ln in build.key_lanes[0]]
+            else:
+                nvl = plan_b.n_valid_lanes
+                klanes = []
+                for ci in kpi:
+                    kind, lane = plan_b.kinds[ci]
+                    if kind == "f64":
+                        klanes.append(bf_c[:, lane])
+                    elif kind == "w2":
+                        klanes.append(bi_c[:, nvl + lane])
+                        klanes.append(bi_c[:, nvl + lane + 1])
+                    else:
+                        klanes.append(bi_c[:, nvl + lane])
             iota_c = jnp.arange(cand_cap, dtype=jnp.int32)
             res = jax.lax.sort(
                 ((~kflag).astype(jnp.uint32), *klanes, iota_c),
@@ -471,11 +524,17 @@ class HashJoinExec(TpuExec):
             n_pairs = jnp.sum(kflag, dtype=jnp.int32)
         else:
             perm_c, n_pairs = compaction_order(verified, total_dev)
-        extra = [jax.lax.bitcast_convert_type(s_idx, jnp.uint32)[:, None]]
-        if need_b_row:
-            extra.append(
-                jax.lax.bitcast_convert_type(b_row, jnp.uint32)[:, None])
-        cand_mat = jnp.concatenate([bi_c] + extra, axis=1)
+        if fused:
+            # compact only the 3 index lanes; the full-width payload
+            # gather happens ONCE, at output level, below
+            lane_mat = jnp.stack([s_idx, b_row, b_pos_m], axis=1)
+            cand_mat = None
+        else:
+            extra = [jax.lax.bitcast_convert_type(s_idx, jnp.uint32)[:, None]]
+            if need_b_row:
+                extra.append(
+                    jax.lax.bitcast_convert_type(b_row, jnp.uint32)[:, None])
+            cand_mat = jnp.concatenate([bi_c] + extra, axis=1)
 
         if stream_preserved:
             smatched = matched_flags(verified, s_idx, scap)
@@ -506,18 +565,32 @@ class HashJoinExec(TpuExec):
             tail = None
             un_part = None
 
-        bmat_out, bfmat_out = gather_rows(plan_b, cand_mat, bf_c, bsel)
-        s_lane = jax.lax.bitcast_convert_type(
-            bmat_out[:, plan_b.n_ilanes], jnp.int32)
-        s_map = jnp.where(from_pairs, s_lane, -1)
-        if tail is not None:
-            s_map = jnp.where(tail, un_part, s_map)
-        if need_b_row:
-            b_lane = jax.lax.bitcast_convert_type(
-                bmat_out[:, plan_b.n_ilanes + 1], jnp.int32)
-            b_map = jnp.where(from_pairs, b_lane, -1)
+        if fused:
+            safe_sel = jnp.clip(bsel, 0, cand_cap - 1)
+            g3 = lane_mat[safe_sel]              # one 3-lane row gather
+            s_map = jnp.where(from_pairs, g3[:, 0], -1)
+            if tail is not None:
+                s_map = jnp.where(tail, un_part, s_map)
+            b_map = jnp.where(from_pairs, g3[:, 1], -1)
+            b_pos_out = jnp.where(from_pairs, g3[:, 2], -1)
+            # output-level packed gather: only SURVIVING pairs move the
+            # full payload width (the XLA tier pays this at candidate
+            # level and again at output level)
+            bmat_out, bfmat_out = gather_rows(plan_b, imat_b, fmat_b,
+                                              b_pos_out)
         else:
-            b_map = None
+            bmat_out, bfmat_out = gather_rows(plan_b, cand_mat, bf_c, bsel)
+            s_lane = jax.lax.bitcast_convert_type(
+                bmat_out[:, plan_b.n_ilanes], jnp.int32)
+            s_map = jnp.where(from_pairs, s_lane, -1)
+            if tail is not None:
+                s_map = jnp.where(tail, un_part, s_map)
+            if need_b_row:
+                b_lane = jax.lax.bitcast_convert_type(
+                    bmat_out[:, plan_b.n_ilanes + 1], jnp.int32)
+                b_map = jnp.where(from_pairs, b_lane, -1)
+            else:
+                b_map = None
 
         # build-side output columns: packable from the compacted matrix,
         # varlen via b_map
@@ -598,9 +671,12 @@ class HashJoinExec(TpuExec):
                 b_caps = tuple(None if c is None else max(c, o)
                                for c, o in zip(b_caps, ob))
             self._size_cache[key] = (cand_cap, s_caps, b_caps)
+        from ..ops.pallas_tier import fused_tier_enabled
+        use_fused = build.key_lanes is not None and fused_tier_enabled(
+            "join_probe", (stream_batch.capacity, build.capacity))
         return self._jit_probe(build, build_batch, stream_batch,
                                (lo, counts, skey_cols), build_matched,
-                               cand_cap, s_caps, b_caps)
+                               cand_cap, s_caps, b_caps, use_fused)
 
     def _emit_build_unmatched(self, build: BuildTable,
                               build_batch: ColumnarBatch, build_matched):

@@ -1,0 +1,68 @@
+"""Task-level metrics roll-up — reference GpuTaskMetrics
+(GpuTaskMetrics.scala:81-103: semWaitTimeNs, retryCount,
+splitAndRetryCount, spill/readSpill sizes accumulated per task and
+published into Spark task metrics).
+
+Standalone, a "task" is one driven query: `query_snapshot()` captures
+the process-global accumulators (admission-semaphore wait, OOM-retry
+counters, spill volumes) before execution, and `query_summary()` diffs
+them after and rolls the per-operator metric registries of the executed
+TpuExec tree into one flat per-query dict. The session API surfaces it
+as `TpuSession.last_query_metrics()` after every `DataFrame.collect()`
+(ISSUE 1 satellite, VERDICT Missing #8).
+
+Shape of the summary:
+- task-scoped globals (diffed):  semWaitTimeNs, retryCount,
+  splitAndRetryCount, spilledDeviceBytes, spilledHostBytes
+- per-metric sums over the operator tree:  total.<metricName>
+- per-operator breakdown:  ops.<Path>.<metricName>  (same addressing as
+  TpuExec.all_metrics)
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .base import TpuExec
+
+
+def query_snapshot() -> Dict[str, int]:
+    """Process-global accumulators BEFORE a query, for delta-ing."""
+    from ..memory.catalog import buffer_catalog
+    from ..memory.retry import task_retry_counts
+    from ..memory.semaphore import tpu_semaphore
+    retry, split_retry = task_retry_counts()
+    cat = buffer_catalog()
+    return {
+        "semWaitTimeNs": tpu_semaphore().total_wait_ns,
+        "retryCount": retry,
+        "splitAndRetryCount": split_retry,
+        "spilledDeviceBytes": cat.spilled_device_bytes,
+        "spilledHostBytes": cat.spilled_host_bytes,
+    }
+
+
+def query_summary(root: TpuExec,
+                  before: Dict[str, int] | None = None) -> Dict[str, int]:
+    """Roll one executed plan's metrics into a per-query summary.
+
+    `before`: a query_snapshot() taken before execution; the summary
+    reports the DELTA of each global accumulator (what THIS query spent,
+    the analog of per-task attribution in GpuTaskMetrics). Without it
+    the raw running totals are reported.
+    """
+    after = query_snapshot()
+    out: Dict[str, int] = {}
+    for k, v in after.items():
+        out[k] = v - (before or {}).get(k, 0)
+
+    per_op = root.all_metrics()
+    totals: Dict[str, int] = {}
+    for path, value in per_op.items():
+        name = path.rsplit(".", 1)[1]
+        totals[name] = totals.get(name, 0) + value
+    for name in sorted(totals):
+        out[f"total.{name}"] = totals[name]
+    for path in sorted(per_op):
+        out[f"ops.{path}"] = per_op[path]
+    return out

@@ -67,6 +67,60 @@ def _bucket_bits(capacity: int) -> int:
     return min(21, max(10, (capacity - 1).bit_length() + 1))
 
 
+def int_key_lanes(key_cols: Sequence[Column]):
+    """Key columns as u32 equality lanes + a combined validity lane, or
+    None when any key is not integer-like (strings/floats/decimals keep
+    the XLA verify: float IEEE `==` and varlen compares are not
+    bit-equality). 32-bit-or-narrower types widen to one i32 lane
+    (injective, so lane equality == value equality); 64-bit types split
+    into (lo, hi) u32 lanes. Shared by the XLA BuildTable and the fused
+    Pallas probe so both compare identical bit patterns."""
+    lanes = []
+    valid = None
+    for c in key_cols:
+        if type(c) is not Column:
+            return None
+        dt = c.data.dtype
+        if dt == jnp.bool_:
+            lanes.append(jax.lax.bitcast_convert_type(
+                c.data.astype(jnp.int32), jnp.uint32))
+        elif jnp.issubdtype(dt, jnp.integer):
+            if jnp.dtype(dt).itemsize <= 4:
+                lanes.append(jax.lax.bitcast_convert_type(
+                    c.data.astype(jnp.int32), jnp.uint32))
+            else:
+                pair = jax.lax.bitcast_convert_type(
+                    c.data.astype(jnp.int64), jnp.uint32)  # (n, 2) lo, hi
+                lanes.append(pair[:, 0])
+                lanes.append(pair[:, 1])
+        else:
+            return None
+        v = c.validity
+        valid = v if valid is None else (valid & v)
+    if valid is None:
+        return None
+    return tuple(lanes), valid.astype(jnp.int32)
+
+
+def candidate_fill_inputs(lo, counts, out_capacity: int):
+    """Shared candidate-expansion inputs for the i32 fast path: the
+    scattered owner-row-index array `seg` (range starts carry their row,
+    disjoint by construction) and the (lo, start) 2-lane matrix. Both the
+    XLA `expand_candidates` and the fused Pallas probe walk these, so the
+    two tiers produce bit-identical (stream_idx, build_pos) layouts."""
+    n_rows = counts.shape[0]
+    cum32 = jnp.cumsum(counts)          # inclusive, i32
+    start = cum32 - counts              # exclusive prefix
+    nonempty = counts > 0
+    pos = jnp.where(nonempty, jnp.minimum(start, out_capacity),
+                    out_capacity)
+    j = jnp.arange(n_rows, dtype=jnp.int32)
+    seg = jnp.zeros((out_capacity,), jnp.int32).at[pos].max(
+        j, mode="drop")
+    ls = jnp.stack([lo, start], axis=1)
+    return seg, ls
+
+
 class BuildTable:
     """Hash-bucketed build side: the TPU analog of the cuDF hash table
     the reference builds once and probes per stream batch. Rows sort by
@@ -82,7 +136,7 @@ class BuildTable:
     def __init__(self, bucket_table, perm, valid_count, num_rows,
                  key_cols: Sequence[Column], payload: Sequence[Column],
                  capacity: int, payload_prefix: Sequence = (),
-                 pair_table=None, pack=None):
+                 pair_table=None, pack=None, key_lanes=None):
         self.bucket_table = bucket_table  # (2^B + 1,) int32 offsets
         self.perm = perm  # sorted position -> original build row
         self.valid_count = valid_count
@@ -102,10 +156,20 @@ class BuildTable:
         #  into one u32 (+ one f64) matrix in SORTED hash order, so the
         #  probe's verify+emit is a couple of row gathers (ops/rowpack)
         self.pack = pack
+        # (u32 lane arrays..., i32 combined-validity lane) in SORTED hash
+        # order, or None for non-integer keys: the fused Pallas probe
+        # keeps these VMEM-resident and verifies candidates in-register
+        # (ops/pallas_join.fused_probe_verify)
+        self.key_lanes = key_lanes
 
     @staticmethod
     def build(key_cols: Sequence[Column], payload: Sequence[Column],
-              num_rows, capacity: int) -> "BuildTable":
+              num_rows, capacity: int,
+              with_key_lanes: bool = True) -> "BuildTable":
+        """with_key_lanes: prepare the fused Pallas probe's u32 key-lane
+        tables (1-2 extra permuted lanes per key). Callers on the default
+        XLA path pass the tier selector's family_may_engage so the
+        common case pays nothing for a kernel it will never run."""
         from .strings import string_lengths
         valid = _keys_valid(key_cols, num_rows, capacity)
         # invalid/inactive rows: push to the end with the max hash AND keep
@@ -150,27 +214,32 @@ class BuildTable:
         imat_s, fmat_s = gather_rows(plan, imat, fmat, perm)
         pack = (plan, imat_s, fmat_s, tuple(key_pack_idx),
                 tuple(payload_pack_idx), tuple(payload_other_idx))
+        key_lanes = None
+        kl = int_key_lanes(key_cols) if with_key_lanes else None
+        if kl is not None:
+            lanes, kvalid = kl
+            key_lanes = (tuple(ln[perm] for ln in lanes), kvalid[perm])
         return BuildTable(bucket_table, perm, valid_count,
                           num_rows, key_cols, payload, capacity, prefixes,
-                          pair_table, pack)
+                          pair_table, pack, key_lanes)
 
 
 def _bt_flatten(bt: BuildTable):
     plan, imat_s, fmat_s, kpi, ppi, poi = bt.pack
     return ((bt.bucket_table, bt.perm, bt.valid_count, bt.num_rows,
              tuple(bt.key_cols), tuple(bt.payload), bt.payload_prefix,
-             bt.pair_table, imat_s, fmat_s),
+             bt.pair_table, imat_s, fmat_s, bt.key_lanes),
             (bt.capacity, plan, kpi, ppi, poi))
 
 
 def _bt_unflatten(aux, children):
     capacity, plan, kpi, ppi, poi = aux
     (bucket_table, perm, valid_count, num_rows, key_cols, payload,
-     payload_prefix, pair_table, imat_s, fmat_s) = children
+     payload_prefix, pair_table, imat_s, fmat_s, key_lanes) = children
     return BuildTable(bucket_table, perm, valid_count, num_rows,
                       list(key_cols), list(payload), capacity,
                       payload_prefix, pair_table,
-                      (plan, imat_s, fmat_s, kpi, ppi, poi))
+                      (plan, imat_s, fmat_s, kpi, ppi, poi), key_lanes)
 
 
 jax.tree_util.register_pytree_node(BuildTable, _bt_flatten, _bt_unflatten)
@@ -220,17 +289,8 @@ def expand_candidates(lo, counts, out_capacity: int):
     total = jnp.sum(counts.astype(jnp.int64)) if counts.shape[0] \
         else jnp.int64(0)
     if counts.shape[0] and out_capacity < (1 << 31):
-        n_rows = counts.shape[0]
-        cum32 = jnp.cumsum(counts)          # inclusive, i32
-        start = cum32 - counts              # exclusive prefix
-        nonempty = counts > 0
-        pos = jnp.where(nonempty, jnp.minimum(start, out_capacity),
-                        out_capacity)
-        j = jnp.arange(n_rows, dtype=jnp.int32)
-        seg = jnp.zeros((out_capacity,), jnp.int32).at[pos].max(
-            j, mode="drop")
+        seg, ls = candidate_fill_inputs(lo, counts, out_capacity)
         row_f = jax.lax.cummax(seg)
-        ls = jnp.stack([lo, start], axis=1)
         g = ls[row_f]                       # one 2-lane row gather
         i = jnp.arange(out_capacity, dtype=jnp.int32)
         in_range = i.astype(jnp.int64) < total

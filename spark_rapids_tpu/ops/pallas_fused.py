@@ -1,0 +1,487 @@
+"""Fused scan-filter-project-partial-aggregate Pallas kernel (ISSUE 1
+tentpole; the q1 shape): ONE kernel reads source column tiles, applies
+the inlined Filter predicates, computes the derived projections, and
+accumulates masked-bucket partial aggregates — no intermediate column
+(filtered, projected, or pre-projected) ever materializes in HBM. The
+reference analog is Flare-style operator-pipeline fusion: one compiled
+kernel per pipeline instead of one device program per relational
+primitive (PAPERS.md).
+
+Structure:
+- an expression COMPILER (`compile_scan_agg_spec`) walks the operator
+  chain the aggregate already absorbs for whole-stage fusion
+  (AggregateExec._fused_steps + its pre-projection) and accepts it when
+  every expression is a whitelisted pure-elementwise form. The kernel
+  body then simply calls the expressions' own `columnar_eval` on
+  tile-shaped Columns — the engine's null semantics hold inside the
+  kernel by construction because it is the same code;
+- the KERNEL runs a (2, n_tiles) grid. TPU grids iterate sequentially,
+  so outputs with constant index maps act as cross-tile accumulators:
+  phase 0 accumulates per-bucket key statistics (lane-wise min/max of
+  the order bits + any-valid/any-null), phase 1 derives the clean-bucket
+  bitmask from those statistics and accumulates the masked aggregates
+  for rows in clean buckets. Dirty buckets (min != max, or a null/value
+  mix) leave their rows out and raise the caller's speculation flag —
+  the same contract as ops/maskedagg.masked_groupby, whose round-0
+  bucket hash this kernel reuses verbatim;
+- a thin XLA WRAPPER reduces the (G, 128) lane-wise accumulators,
+  recovers key values from the order bits, and dense-places slots,
+  returning masked_groupby's exact (out_keys, results, num_groups,
+  leftover) contract so AggregateExec._streaming_step folds the partial
+  with zero special cases.
+
+Bit-exactness: integer aggregates (count/min/max/integer sums) are
+order-independent and match the XLA tier bitwise; float sums accumulate
+lane-wise then reduce, so they agree with the XLA formulation to
+reduction-order rounding (the property tests assert ulp-level closeness
+for floats and bitwise equality for everything else).
+
+Off-TPU the kernel runs under the Pallas interpreter (tier-1 gating);
+on hardware the measured tier selector decides whether it replaces the
+XLA formulation per shape bucket (ops/pallas_tier.py). 64-bit lanes
+(i64/f64 accumulators) rely on Mosaic's emulation on the chip — if a
+shape fails to legalize, the measurement simply never records a Pallas
+win and `auto` keeps the XLA tier.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.column import Column
+from .pallas_kernels import pad_to_tiles
+
+AGG_TILE_ROWS = 64
+
+#: round-0 salt of ops/maskedagg.masked_group_assignment — identical
+#: bucketization keeps the two tiers' resolved-group sets comparable
+_ROUND0_SALT = 0x2545F491
+
+_SUPPORTED_EXPRS = {
+    "BoundReference", "Literal", "Alias",
+    "Add", "Subtract", "Multiply", "Divide", "UnaryMinus", "Abs",
+    "EqualTo", "EqualNullSafe", "LessThan", "LessThanOrEqual",
+    "GreaterThan", "GreaterThanOrEqual",
+    "And", "Or", "Not", "IsNull", "IsNotNull",
+}
+
+_SUPPORTED_OPS = ("sum", "sum_sq", "count", "count_star", "min", "max")
+
+
+class _TileBatch:
+    """Minimal batch shim for columnar_eval inside the kernel: bound
+    expressions only touch .columns and .capacity."""
+
+    def __init__(self, columns: List[Column], capacity: int):
+        self.columns = columns
+        self.capacity = capacity
+
+
+class ScanAggSpec(NamedTuple):
+    steps: Tuple            # (("filter", bound) | ("project", bound, schema))*
+    pre_bound: Tuple        # pre-projection expressions (keys + agg inputs)
+    key_count: int
+    agg_ops: Tuple          # ((op, pre-slot index | None), ...)
+    key_dtypes: Tuple       # engine DataType per key
+    agg_dtypes: Tuple       # input DataType per agg op (None for count_star)
+
+
+def _expr_supported(expr) -> bool:
+    from ..types import DecimalType, StringType
+    name = type(expr).__name__
+    if name not in _SUPPORTED_EXPRS:
+        return False
+    try:
+        dt = expr.data_type
+    except Exception:  # noqa: BLE001 — unresolved/odd expressions
+        return False
+    if isinstance(dt, DecimalType) or isinstance(dt, StringType):
+        return False
+    if name == "Literal" and expr.value is None:
+        return False
+    return all(_expr_supported(c) for c in getattr(expr, "children", ()))
+
+
+def compile_scan_agg_spec(fused_steps, pre_bound, pre_schema, key_count: int,
+                          agg_ops, source_schema) -> Optional[ScanAggSpec]:
+    """Validate the absorbed operator chain for the fused kernel; None
+    when any piece falls outside the whitelisted elementwise subset."""
+    from ..types import DecimalType
+    if key_count == 0 or not agg_ops:
+        return None
+    # EVERY source column rides the kernel as (data, validity) row tiles
+    # (BoundReference ordinals index the full column list), so varlen /
+    # decimal128 source columns — whose .data is a byte buffer or absent
+    # — make the whole shape ineligible even when no expression
+    # references them
+    for f in source_schema.fields:
+        if not f.data_type.is_fixed_width or \
+                isinstance(f.data_type, DecimalType):
+            return None
+    for step in fused_steps:
+        exprs = [step[1]] if step[0] == "filter" else list(step[1])
+        if not all(_expr_supported(e) for e in exprs):
+            return None
+    if not all(_expr_supported(e) for e in pre_bound):
+        return None
+    key_dtypes = []
+    for f in pre_schema.fields[:key_count]:
+        if not f.data_type.is_fixed_width or \
+                isinstance(f.data_type, DecimalType):
+            return None
+        # sub-32-bit keys are excluded: their native order lanes
+        # (u8/u16) would be widened to the u32 accumulator and
+        # _unorder_bits' bitcast back to int8/int16 splits the lane into
+        # extra trailing dims (confirmed trace-time crash); BYTE/SHORT
+        # group keys simply keep the XLA tier
+        jdt = jnp.dtype(f.data_type.jnp_dtype)
+        if jdt == jnp.bool_ or jdt.itemsize < 4:
+            return None
+        key_dtypes.append(f.data_type)
+    agg_dtypes = []
+    for op, slot in agg_ops:
+        if op not in _SUPPORTED_OPS:
+            return None
+        if slot is None:
+            if op != "count_star":
+                return None
+            agg_dtypes.append(None)
+            continue
+        dt = pre_schema.fields[slot].data_type
+        if not dt.is_fixed_width or isinstance(dt, DecimalType):
+            return None
+        jdt = jnp.dtype(dt.jnp_dtype)
+        if op in ("sum", "sum_sq") and not (
+                jnp.issubdtype(jdt, jnp.integer)
+                or jnp.issubdtype(jdt, jnp.floating)):
+            return None
+        agg_dtypes.append(dt)
+    return ScanAggSpec(tuple(fused_steps), tuple(pre_bound), key_count,
+                       tuple(agg_ops), tuple(key_dtypes), tuple(agg_dtypes))
+
+
+def _eval_pipeline(spec: ScanAggSpec, cols: List[Column], capacity: int):
+    """Run the absorbed filter/project chain + pre-projection on (tile or
+    full-width) columns. Returns (mask | None, key columns, agg input
+    columns aligned with spec.agg_ops). Padding rows are NOT sanitized
+    here — the kernel's active mask keeps them out of every bucket and
+    reduction, the same discipline as the masked XLA tier."""
+    cur = list(cols)
+    mask = None
+    for step in spec.steps:
+        batch = _TileBatch(cur, capacity)
+        if step[0] == "filter":
+            pred = step[1].columnar_eval(batch)
+            m = pred.data & pred.validity
+            mask = m if mask is None else (mask & m)
+        else:
+            cur = [e.columnar_eval(batch) for e in step[1]]
+    batch = _TileBatch(cur, capacity)
+    pre = [e.columnar_eval(batch) for e in spec.pre_bound]
+    keys = pre[: spec.key_count]
+    agg_cols = [None if slot is None else pre[slot]
+                for _, slot in spec.agg_ops]
+    return mask, keys, agg_cols
+
+
+def _acc_dtype(op: str, dt) -> jnp.dtype:
+    if op in ("count", "count_star"):
+        return jnp.dtype(jnp.int32)
+    jdt = jnp.dtype(dt.jnp_dtype)
+    if op in ("sum", "sum_sq"):
+        return jnp.dtype(jnp.float64) if jnp.issubdtype(jdt, jnp.floating) \
+            else jnp.dtype(jnp.int64)
+    # min/max: bool rides an int8 lane (ops/maskedagg._slot_reduce_all)
+    return jnp.dtype(jnp.int8) if jdt == jnp.bool_ else jdt
+
+
+def _minmax_neutral(op: str, jdt):
+    if jnp.issubdtype(jdt, jnp.floating):
+        return jnp.full((), jnp.inf if op == "min" else -jnp.inf, jdt)
+    info = jnp.iinfo(jdt)
+    return jnp.full((), info.max if op == "min" else info.min, jdt)
+
+
+def _order_lane_dtype(dt) -> jnp.dtype:
+    jdt = jnp.dtype(dt.jnp_dtype)
+    return jnp.dtype(jnp.uint64) if jdt.itemsize == 8 \
+        else jnp.dtype(jnp.uint32)
+
+
+def _scan_agg_kernel_body(spec: ScanAggSpec, src_dtypes, n_cols: int,
+                          G: int, tile_rows: int):
+    """Kernel factory: phases/columns/aggregates are static structure.
+
+    Discharge discipline (learned on the fused probe): full-slice stores
+    only, no @pl.when around stores, every constant explicitly dtyped.
+    """
+    from .maskedagg import _bucket_hash
+
+    def kernel(nrows_ref, *refs):
+        from jax.experimental import pallas as pl
+        data_refs = refs[:n_cols]
+        valid_refs = refs[n_cols:2 * n_cols]
+        out_refs = refs[2 * n_cols:]
+        p = pl.program_id(0)
+        t = pl.program_id(1)
+        init = (p == jnp.int32(0)) & (t == jnp.int32(0))
+        phase1 = p == jnp.int32(1)
+
+        tr = tile_rows
+        flat = tr * 128
+        # global row index of each tile element (padding rows inactive)
+        i_flat = (jnp.int32(t) * jnp.int32(flat)
+                  + jax.lax.broadcasted_iota(jnp.int32, (tr, 128), 0)
+                  * jnp.int32(128)
+                  + jax.lax.broadcasted_iota(jnp.int32, (tr, 128), 1))
+        act2 = i_flat < nrows_ref[0, 0]
+
+        # --- the fused operator chain on flattened tile columns ---
+        cols = [Column(d[:].reshape(flat), (v[:] != jnp.int32(0))
+                       .reshape(flat), dt)
+                for d, v, dt in zip(data_refs, valid_refs, src_dtypes)]
+        mask, keys, agg_cols = _eval_pipeline(spec, cols, flat)
+        act = act2.reshape(flat)
+        if mask is not None:
+            act = act & mask
+
+        h = _bucket_hash(keys, _ROUND0_SALT, flat)
+        b = (h % jnp.uint32(G)).astype(jnp.int32)
+        b2 = b.reshape(tr, 128)
+        act2d = act.reshape(tr, 128)
+
+        ri = 0  # output-ref cursor
+
+        def nxt():
+            nonlocal ri
+            r = out_refs[ri]
+            ri += 1
+            return r
+
+        # --- phase 0: per-bucket key statistics (always computed; the
+        # stores pass through unchanged during phase 1) ---
+        kstat_refs = []
+        for kc in keys:
+            from .sort import _numeric_order_key
+            lane = _numeric_order_key(kc).reshape(tr, 128)
+            v2 = kc.validity.reshape(tr, 128)
+            mv = act2d & v2
+            mn_n = jnp.full((), jnp.iinfo(lane.dtype).max, lane.dtype)
+            zero_l = jnp.zeros((), lane.dtype)
+            mn_t, mx_t, av_t, an_t = [], [], [], []
+            for g in range(G):
+                mg = mv & (b2 == jnp.int32(g))
+                mn_t.append(jnp.min(jnp.where(mg, lane, mn_n), axis=0))
+                mx_t.append(jnp.max(jnp.where(mg, lane, zero_l), axis=0))
+                av_t.append(jnp.any(mg, axis=0))
+                an_t.append(jnp.any(
+                    act2d & ~v2 & (b2 == jnp.int32(g)), axis=0))
+            mn_c = jnp.stack(mn_t)
+            mx_c = jnp.stack(mx_t)
+            av_c = jnp.stack(av_t).astype(jnp.int32)
+            an_c = jnp.stack(an_t).astype(jnp.int32)
+            r_mn, r_mx, r_av, r_an = nxt(), nxt(), nxt(), nxt()
+            kstat_refs.append((r_mn, r_mx, r_av, r_an))
+            old = jnp.where(init, mn_n, r_mn[:])
+            r_mn[:] = jnp.where(phase1, old, jnp.minimum(old, mn_c))
+            old = jnp.where(init, zero_l, r_mx[:])
+            r_mx[:] = jnp.where(phase1, old, jnp.maximum(old, mx_c))
+            old = jnp.where(init, jnp.int32(0), r_av[:])
+            r_av[:] = jnp.where(phase1, old, old | av_c)
+            old = jnp.where(init, jnp.int32(0), r_an[:])
+            r_an[:] = jnp.where(phase1, old, old | an_c)
+
+        # --- phase 1: clean-bucket bitmask from the finished statistics
+        # (phase 0 wrote them across ALL tiles before any phase-1 step
+        # runs — the grid's minor dimension iterates fastest) ---
+        clean = jnp.ones((G,), jnp.bool_)
+        occupied = jnp.zeros((G,), jnp.bool_)
+        for r_mn, r_mx, r_av, r_an in kstat_refs:
+            mn_g = jnp.min(r_mn[:], axis=1)
+            mx_g = jnp.max(r_mx[:], axis=1)
+            av_g = jnp.any(r_av[:] != jnp.int32(0), axis=1)
+            an_g = jnp.any(r_an[:] != jnp.int32(0), axis=1)
+            clean = clean & ~(av_g & an_g) & (~av_g | (mn_g == mx_g))
+            occupied = occupied | av_g | an_g
+        bits = jnp.sum(jnp.where(clean & occupied,
+                                 jnp.uint32(1) << jnp.arange(
+                                     G, dtype=jnp.uint32),
+                                 jnp.uint32(0)))
+        row_clean = ((bits >> b2.astype(jnp.uint32)) & jnp.uint32(1)) \
+            != jnp.uint32(0)
+        m1 = act2d & row_clean
+
+        # --- phase 1: masked aggregate accumulation over clean buckets ---
+        for (op, _), col, dt in zip(spec.agg_ops, agg_cols,
+                                    spec.agg_dtypes):
+            adt = _acc_dtype(op, dt)
+            r_acc = nxt()
+            if op == "count_star":
+                contrib = jnp.stack([
+                    jnp.sum(m1 & (b2 == jnp.int32(g)),
+                            axis=0, dtype=jnp.int32) for g in range(G)])
+                old = jnp.where(init, jnp.int32(0), r_acc[:])
+                r_acc[:] = jnp.where(phase1, old + contrib, old)
+                continue
+            v2 = col.validity.reshape(tr, 128)
+            d2 = col.data.reshape(tr, 128)
+            mv1 = m1 & v2
+            r_has = None
+            if op in ("sum", "sum_sq", "min", "max"):
+                r_has = nxt()
+                has_c = jnp.stack([
+                    jnp.any(mv1 & (b2 == jnp.int32(g)), axis=0)
+                    for g in range(G)]).astype(jnp.int32)
+                old_h = jnp.where(init, jnp.int32(0), r_has[:])
+                r_has[:] = jnp.where(phase1, old_h | has_c, old_h)
+            if op == "count":
+                contrib = jnp.stack([
+                    jnp.sum(mv1 & (b2 == jnp.int32(g)),
+                            axis=0, dtype=jnp.int32)
+                    for g in range(G)])
+                old = jnp.where(init, jnp.int32(0), r_acc[:])
+                r_acc[:] = jnp.where(phase1, old + contrib, old)
+            elif op in ("sum", "sum_sq"):
+                accv = d2.astype(adt)
+                if op == "sum_sq":
+                    accv = accv * accv
+                zero = jnp.zeros((), adt)
+                contrib = jnp.stack([
+                    jnp.sum(jnp.where(mv1 & (b2 == jnp.int32(g)),
+                                      accv, zero), axis=0)
+                    for g in range(G)])
+                old = jnp.where(init, zero, r_acc[:])
+                r_acc[:] = jnp.where(phase1, old + contrib, old)
+            else:  # min / max
+                dv = d2.astype(jnp.int8) \
+                    if d2.dtype == jnp.bool_ else d2
+                neutral = _minmax_neutral(op, jnp.dtype(adt))
+                fn = jnp.minimum if op == "min" else jnp.maximum
+                red = jnp.min if op == "min" else jnp.max
+                contrib = jnp.stack([
+                    red(jnp.where(mv1 & (b2 == jnp.int32(g)), dv,
+                                  neutral), axis=0)
+                    for g in range(G)])
+                old = jnp.where(init, jnp.full((), neutral, adt),
+                                r_acc[:])
+                r_acc[:] = jnp.where(phase1, fn(old, contrib), old)
+
+    return kernel
+
+
+def fused_scan_agg_update(spec: ScanAggSpec, batch, G: int, out_cap: int,
+                          interpret: bool = False):
+    """ONE kernel pass over a source batch -> masked-bucket partial.
+
+    Returns (out_keys, tagged results, num_groups, leftover) — exactly
+    ops/maskedagg.masked_groupby's contract, dense-placed into an
+    `out_cap` bucket.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from .maskedagg import _unorder_bits
+
+    assert G <= 32, "clean-bucket bitmask is u32"
+    cols = list(batch.columns)
+    tr = AGG_TILE_ROWS
+    tiles = []
+    for c in cols:
+        d2, _ = pad_to_tiles(c.data, tr)
+        v2, _ = pad_to_tiles(c.validity.astype(jnp.int32), tr)
+        tiles.append((d2, v2))
+    rows2d = tiles[0][0].shape[0]
+    n_tiles = rows2d // tr
+
+    kernel = _scan_agg_kernel_body(spec, [c.dtype for c in cols],
+                                   len(cols), G, tr)
+
+    tspec = pl.BlockSpec((tr, 128), lambda p, t: (t, 0),
+                         memory_space=pltpu.VMEM)
+    const = pl.BlockSpec((G, 128), lambda p, t: (0, 0),
+                         memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec((1, 1), lambda p, t: (0, 0),
+                        memory_space=pltpu.SMEM)
+
+    out_shapes = []
+    for dt in spec.key_dtypes:
+        ldt = _order_lane_dtype(dt)
+        out_shapes += [jax.ShapeDtypeStruct((G, 128), ldt),
+                       jax.ShapeDtypeStruct((G, 128), ldt),
+                       jax.ShapeDtypeStruct((G, 128), jnp.int32),
+                       jax.ShapeDtypeStruct((G, 128), jnp.int32)]
+    for op, dt in zip((o for o, _ in spec.agg_ops), spec.agg_dtypes):
+        out_shapes.append(jax.ShapeDtypeStruct((G, 128),
+                                               _acc_dtype(op, dt)))
+        if op in ("sum", "sum_sq", "min", "max"):
+            out_shapes.append(jax.ShapeDtypeStruct((G, 128), jnp.int32))
+
+    nrows = jnp.asarray(batch.num_rows).astype(jnp.int32).reshape(1, 1)
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=tuple(out_shapes),
+        grid=(2, n_tiles),
+        in_specs=[smem] + [tspec] * (2 * len(cols)),
+        out_specs=tuple(const for _ in out_shapes),
+        interpret=interpret,
+    )(nrows, *[d for d, _ in tiles], *[v for _, v in tiles])
+
+    # --- XLA epilogue: reduce lanes, prove cleanliness, place dense ---
+    outs = list(outs)
+
+    def take():
+        return outs.pop(0)
+
+    g_iota = jnp.arange(G, dtype=jnp.int32)
+    clean = jnp.ones((G,), jnp.bool_)
+    occupied = jnp.zeros((G,), jnp.bool_)
+    key_info = []
+    for dt in spec.key_dtypes:
+        mn = jnp.min(take(), axis=1)
+        mx = jnp.max(take(), axis=1)
+        av = jnp.any(take() != 0, axis=1)
+        an = jnp.any(take() != 0, axis=1)
+        clean = clean & ~(av & an) & (~av | (mn == mx))
+        occupied = occupied | av | an
+        key_info.append((mn, av, dt))
+    resolved = clean & occupied
+    leftover = jnp.any(occupied & ~clean)
+    num_groups = jnp.sum(resolved, dtype=jnp.int32)
+    dense = jnp.cumsum(resolved.astype(jnp.int32)) - 1
+    target = jnp.where(resolved, dense, out_cap)
+
+    def place(vals, valids):
+        d = jnp.zeros((out_cap,), vals.dtype).at[target].set(
+            vals, mode="drop")
+        v = jnp.zeros((out_cap,), jnp.bool_).at[target].set(
+            valids & resolved, mode="drop")
+        return d, v
+
+    out_keys = []
+    for mn, av, dt in key_info:
+        vals = _unorder_bits(mn, dt)
+        d, v = place(vals, av)
+        d = jnp.where(v, d, jnp.zeros((), d.dtype))
+        out_keys.append(Column(d, v, dt))
+
+    results = []
+    for (op, _), dt in zip(spec.agg_ops, spec.agg_dtypes):
+        acc = take()
+        if op in ("count", "count_star"):
+            vals = jnp.sum(acc, axis=1, dtype=jnp.int32).astype(jnp.int64)
+            valid = jnp.ones((G,), jnp.bool_)
+        elif op in ("sum", "sum_sq"):
+            has = jnp.any(take() != 0, axis=1)
+            vals = jnp.sum(acc, axis=1)
+            valid = has
+        else:
+            has = jnp.any(take() != 0, axis=1)
+            red = jnp.min if op == "min" else jnp.max
+            vals = red(acc, axis=1)
+            valid = has
+        d, v = place(vals, valid)
+        results.append(("raw", (d, v)))
+    return out_keys, results, num_groups, leftover

@@ -78,26 +78,48 @@ def _one_word_kernel(w_ref, seed_ref, out_ref):
     out_ref[:] = _fmix(_mix_h1(seed_ref[:], _mix_k1(w_ref[:])), 4)
 
 
-def _pad_to_tiles(x: jnp.ndarray):
+def pad_to_tiles(x: jnp.ndarray, tile_rows: int = TILE_ROWS):
+    """Pad a 1-D lane to a whole number of (tile_rows, 128) VMEM tiles and
+    view it 2-D. Returns (tiled view, original length). Shared by every
+    Pallas family (murmur3, fused join probe, fused scan-aggregate) so
+    padding discipline — garbage rows masked by callers — is uniform."""
     n = x.shape[0]
-    per_tile = TILE_ROWS * 128
+    per_tile = tile_rows * 128
     tiles = max(1, -(-n // per_tile))
     padded = tiles * per_tile
     if padded != n:
         x = jnp.pad(x, (0, padded - n))
-    return x.reshape(tiles * TILE_ROWS, 128), n
+    return x.reshape(tiles * tile_rows, 128), n
 
 
-def _tile_spec():
+def tile_spec(tile_rows: int = TILE_ROWS):
+    """BlockSpec for one (tile_rows, 128) VMEM tile of a grid-tiled lane."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-    return pl.BlockSpec((TILE_ROWS, 128), lambda i: (i, 0),
+    return pl.BlockSpec((tile_rows, 128), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
+
+
+def whole_spec():
+    """BlockSpec for an operand resident in full across the whole grid
+    (bucket tables, key lanes, permutations): every grid step sees the
+    same block. Sized by the caller; the fused-tier selector gates shapes
+    so these fit the VMEM budget on hardware."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    return pl.BlockSpec(memory_space=pltpu.VMEM)
+
+
+# back-compat private aliases (murmur3 kernels below predate the shared
+# helpers going public)
+_pad_to_tiles = pad_to_tiles
+_tile_spec = tile_spec
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def murmur3_long_lanes(data_i64, seeds_u32, interpret: bool = False):
     """Per-row murmur3 update over int64 lanes; seeds/result uint32."""
+    from jax.experimental import enable_x64
     from jax.experimental import pallas as pl
 
     pair = jax.lax.bitcast_convert_type(
@@ -108,7 +130,7 @@ def murmur3_long_lanes(data_i64, seeds_u32, interpret: bool = False):
     rows = lo.shape[0]
     # mosaic wants i32 grid/index arithmetic; the engine's global x64
     # mode would trace the index maps as i64 and fail legalization
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             _two_word_kernel,
             out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.uint32),
@@ -122,13 +144,14 @@ def murmur3_long_lanes(data_i64, seeds_u32, interpret: bool = False):
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def murmur3_int_lanes(data_i32, seeds_u32, interpret: bool = False):
+    from jax.experimental import enable_x64
     from jax.experimental import pallas as pl
 
     w, n = _pad_to_tiles(jax.lax.bitcast_convert_type(
         data_i32.astype(jnp.int32), jnp.uint32))
     seeds, _ = _pad_to_tiles(seeds_u32.astype(jnp.uint32))
     rows = w.shape[0]
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             _one_word_kernel,
             out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.uint32),

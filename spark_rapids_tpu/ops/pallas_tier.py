@@ -1,0 +1,141 @@
+"""Measured fused-tier selection (ISSUE 1: "turn the projection into a
+measurement").
+
+`spark.rapids.tpu.pallas.fusedTier` = off | on | auto decides whether the
+fused Pallas kernel families (ops/pallas_join.py, ops/pallas_fused.py)
+replace their XLA formulations. `auto` — the default — is driven by the
+per-kernel microbenchmark harness `tools/kern_bench.py`, which records
+XLA-vs-Pallas wall-clock per (family, backend platform, shape bucket);
+a family only engages for a shape bucket where a recorded measurement
+shows the Pallas kernel winning. No record -> XLA stays, so a fresh
+checkout behaves exactly like the pre-fused engine until someone runs
+the harness on the actual hardware.
+
+Shape buckets are log2 sizes — the same power-of-two discipline as the
+engine's capacity buckets — so one measurement covers every batch that
+compiles to the same program shape.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+_lock = threading.Lock()
+#: path -> (mtime, {(family, platform, bucket): record}) cache
+_cache: Dict[str, Tuple[float, Dict]] = {}
+
+
+def normalize_mode(raw: str) -> str:
+    s = str(raw).strip().lower()
+    if s in ("on", "true", "1", "yes"):
+        return "on"
+    if s in ("off", "false", "0", "no"):
+        return "off"
+    return "auto"
+
+
+def shape_bucket(shape) -> Tuple[int, ...]:
+    """log2-ceiling bucket per dimension (engine capacities are already
+    powers of two, so this is usually exact)."""
+    out = []
+    for s in (shape if isinstance(shape, (tuple, list)) else (shape,)):
+        out.append(max(int(s), 1).bit_length() - (1 if
+                   max(int(s), 1) & (max(int(s), 1) - 1) == 0 else 0))
+    return tuple(out)
+
+
+def default_bench_file() -> str:
+    return str(Path(__file__).resolve().parents[2]
+               / "tools" / "kern_bench.json")
+
+
+def _load_records(path: str) -> Dict:
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return {}
+    with _lock:
+        hit = _cache.get(path)
+        if hit is not None and hit[0] == mtime:
+            return hit[1]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        index = {}
+        for r in doc.get("records", ()):
+            key = (r["family"], r["platform"],
+                   tuple(r["shape_bucket"]))
+            index[key] = r
+    except (OSError, ValueError, KeyError, TypeError):
+        index = {}
+    with _lock:
+        _cache[path] = (mtime, index)
+    return index
+
+
+def bench_record(family: str, shape) -> Optional[Dict]:
+    """The recorded measurement for (family, current platform, bucket),
+    or None."""
+    import jax
+
+    from ..config import PALLAS_FUSED_BENCH_FILE, active_conf
+    path = active_conf().get(PALLAS_FUSED_BENCH_FILE) \
+        or default_bench_file()
+    records = _load_records(path)
+    return records.get((family, jax.default_backend(),
+                        shape_bucket(shape)))
+
+
+def family_may_engage(family: str) -> bool:
+    """Could `family`'s fused kernel engage for ANY shape under the
+    current config? Used to skip preparing kernel-only inputs (e.g. the
+    BuildTable's permuted key lanes) on paths where the tier can never
+    turn on: off -> never; on -> yes; auto -> only if some recorded
+    measurement for this family+platform shows a Pallas win."""
+    import jax
+
+    from ..config import (PALLAS_FUSED_BENCH_FILE, PALLAS_FUSED_TIER,
+                          active_conf)
+    mode = normalize_mode(active_conf().get(PALLAS_FUSED_TIER))
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    path = active_conf().get(PALLAS_FUSED_BENCH_FILE) \
+        or default_bench_file()
+    platform = jax.default_backend()
+    for (fam, plat, _), rec in _load_records(path).items():
+        try:
+            if fam == family and plat == platform and \
+                    float(rec["pallas_ms"]) < float(rec["xla_ms"]):
+                return True
+        except (KeyError, TypeError, ValueError):
+            continue
+    return False
+
+
+def fused_tier_enabled(family: str, shape) -> bool:
+    """Should `family` use its fused Pallas kernel for `shape`?
+
+    Called on the host at trace time (the answer is static per compiled
+    program shape). off -> never; on -> always (callers still fall back
+    when a shape is structurally ineligible, e.g. non-integer join
+    keys); auto -> only where a recorded measurement says Pallas wins.
+    """
+    from ..config import PALLAS_FUSED_TIER, active_conf
+    mode = normalize_mode(active_conf().get(PALLAS_FUSED_TIER))
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    rec = bench_record(family, shape)
+    if not rec:
+        return False
+    try:
+        return float(rec["pallas_ms"]) < float(rec["xla_ms"])
+    except (KeyError, TypeError, ValueError):
+        return False

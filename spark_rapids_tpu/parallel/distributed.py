@@ -127,10 +127,10 @@ def make_distributed_groupby(mesh: Mesh, key_count: int,
         out = ColumnarBatch(out_cols, fgroups, out_schema)
         return _expand0(out)
 
-    mapped = jax.shard_map(spmd, mesh=mesh,
-                           in_specs=P(axis_name),
-                           out_specs=P(axis_name),
-                           check_vma=False)
+    from .mesh import shard_map_compat
+    mapped = shard_map_compat(spmd, mesh=mesh,
+                              in_specs=P(axis_name),
+                              out_specs=P(axis_name))
     jitted = jax.jit(mapped)
 
     def checked(stacked: ColumnarBatch) -> ColumnarBatch:

@@ -1,4 +1,6 @@
 """AdaptiveJoinExec: runtime-measured build side (AQE-lite, r2 item 10)."""
+import pytest
+
 from spark_rapids_tpu.api import functions as F
 from spark_rapids_tpu.api.session import TpuSession
 from spark_rapids_tpu.exec.joins import AdaptiveJoinExec
@@ -54,6 +56,7 @@ def test_adaptive_join_measures_and_runs():
                    (4, 40, None)]
 
 
+@pytest.mark.slow  # minute-scale on a single-core host; nightly tier
 def test_symmetric_build_side_choice():
     # inner join, left much smaller: the runtime measurement must build
     # LEFT (semantics-preserving swap). Post-aggregation sides make the
@@ -78,6 +81,7 @@ def test_symmetric_build_side_choice():
     assert aj is not None and aj._choice == "build_left", aj._choice
 
 
+@pytest.mark.slow  # minute-scale on a single-core host; nightly tier
 def test_symmetric_both_huge_subpartitions_with_spill():
     # both sides over the (tiny, forced) sub-partition threshold: the
     # adaptive join must route through sub-partitioned exchanges

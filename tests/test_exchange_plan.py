@@ -2,10 +2,16 @@
 as partial → ShuffleExchangeExec → final and equi-joins as
 exchange-both-sides → per-partition ShuffledHashJoinExec, and results match
 the single-partition plan exactly (reference analog:
-GpuShuffleExchangeExecBase + GpuShuffledHashJoinExec integration tests)."""
+GpuShuffleExchangeExecBase + GpuShuffledHashJoinExec integration tests).
+
+Marked `slow`: each case drives the 8-virtual-device mesh end to end
+(minutes on one core); the fast distributed-primitive coverage stays in
+tier-1 via tests/test_parallel.py."""
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 
 import jax
 

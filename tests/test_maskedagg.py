@@ -211,6 +211,7 @@ def test_first_last_in_masked_path():
     assert got[2] == (20, 20)
 
 
+@pytest.mark.slow  # minute-scale on a single-core host; nightly tier
 def test_more_than_16_key_columns():
     # beyond the 16-column packed-stats code word: the per-column boolean
     # reductions path must kick in, not an assert/overflow

@@ -1,0 +1,362 @@
+"""Interpret-mode property tests for the fused Pallas kernel tier
+(ISSUE 1): the probe-verify-emit join kernel and the
+scan-filter-project-partial-aggregate kernel must match the existing XLA
+formulations on randomized inputs — including null masks and
+capacity-bucket padding — on every PR, not just TPU rounds.
+
+Bit-exactness contract: everything integer (verified masks, emitted
+indices, counts, min/max, integer sums) compares bitwise; float SUMS
+compare to 1e-9 relative tolerance because the kernel accumulates
+lane-wise then reduces, a different (but per-group-bounded) reduction
+order than the XLA masked sweep.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.ops.join import (
+    BuildTable, expand_candidates, int_key_lanes, probe_counts,
+    verify_pairs,
+)
+from spark_rapids_tpu.ops.pallas_join import fused_probe_verify
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, Schema, StructField
+
+
+def _col(np_arr, dtype, null_every=0, capacity=None):
+    c = Column.from_numpy(np_arr, dtype,
+                          capacity=capacity or bucket_capacity(len(np_arr)))
+    if null_every:
+        v = np.asarray(c.validity).copy()
+        v[::null_every] = False
+        c = Column(c.data, jnp.asarray(v), dtype)
+    return c
+
+
+def _xla_probe(build, skey_cols, lo, counts, cand_cap):
+    s_idx, b_pos, _ = expand_candidates(lo, counts, cand_cap)
+    pair_valid = s_idx >= 0
+    b_pos_m = jnp.where(pair_valid, b_pos, -1)
+    ver, b_row = verify_pairs(build, skey_cols,
+                              jnp.where(pair_valid, s_idx, -1),
+                              b_pos_m, pair_valid)
+    return ver, s_idx, b_pos, b_row
+
+
+def _fused_probe(build, skey_cols, lo, counts, cand_cap):
+    bk_lanes, bvalid = build.key_lanes
+    sk_lanes, svalid = int_key_lanes(skey_cols)
+    return fused_probe_verify(lo, counts, bk_lanes, bvalid, sk_lanes,
+                              svalid, build.perm, cand_cap,
+                              interpret=True)
+
+
+@pytest.mark.parametrize("seed,nb,ns,dom,null_every", [
+    (0, 500, 1500, 200, 7),     # duplicates + nulls
+    (1, 64, 200, 1000, 0),      # sparse matches, no nulls
+    (2, 300, 300, 5, 3),        # heavy duplication (long bucket ranges)
+    (3, 1, 100, 2, 0),          # single-row build
+])
+def test_fused_probe_long_keys_bit_exact(seed, nb, ns, dom, null_every):
+    rng = np.random.default_rng(seed)
+    bk = _col(rng.integers(-dom, dom, nb).astype(np.int64), LONG,
+              null_every)
+    sk = _col(rng.integers(-dom, dom, ns).astype(np.int64), LONG,
+              max(0, null_every - 2))
+    build = BuildTable.build([bk], [bk], jnp.int32(nb), bk.capacity)
+    lo, counts, _ = probe_counts(build, [sk], jnp.int32(ns), sk.capacity)
+    cand_cap = bucket_capacity(max(int(jnp.sum(counts)), 1))
+
+    ver_x, s_x, p_x, row_x = _xla_probe(build, [sk], lo, counts, cand_cap)
+    ver_p, s_p, p_p, row_p = _fused_probe(build, [sk], lo, counts,
+                                          cand_cap)
+    assert (np.asarray(ver_x) == np.asarray(ver_p)).all()
+    assert (np.asarray(s_x) == np.asarray(s_p)).all()
+    pv = np.asarray(s_x) >= 0
+    assert (np.asarray(p_x)[pv] == np.asarray(p_p)[pv]).all()
+    assert (np.asarray(row_x) == np.asarray(row_p)).all()
+
+
+def test_fused_probe_multi_column_int_keys():
+    """Two-column (LONG, INT) keys: 3 u32 lanes, combined validity."""
+    rng = np.random.default_rng(4)
+    nb, ns = 400, 900
+    bk1 = _col(rng.integers(0, 50, nb).astype(np.int64), LONG, 5)
+    bk2 = _col(rng.integers(0, 7, nb).astype(np.int32), INT, 0)
+    sk1 = _col(rng.integers(0, 50, ns).astype(np.int64), LONG, 0)
+    sk2 = _col(rng.integers(0, 7, ns).astype(np.int32), INT, 9)
+    build = BuildTable.build([bk1, bk2], [bk1], jnp.int32(nb),
+                             bk1.capacity)
+    lo, counts, _ = probe_counts(build, [sk1, sk2], jnp.int32(ns),
+                                 sk1.capacity)
+    cand_cap = bucket_capacity(max(int(jnp.sum(counts)), 1))
+    ver_x, s_x, _, row_x = _xla_probe(build, [sk1, sk2], lo, counts,
+                                      cand_cap)
+    ver_p, s_p, _, row_p = _fused_probe(build, [sk1, sk2], lo, counts,
+                                        cand_cap)
+    assert (np.asarray(ver_x) == np.asarray(ver_p)).all()
+    assert (np.asarray(s_x) == np.asarray(s_p)).all()
+    assert (np.asarray(row_x) == np.asarray(row_p)).all()
+    assert int(np.asarray(ver_p).sum()) > 0  # the case exercises matches
+
+
+def test_fused_probe_no_matches_and_overflowed_bucket():
+    """Zero matches; and a cand_cap smaller than the true total (the
+    speculative cached-bucket overflow shape) must truncate identically
+    to the XLA expand."""
+    rng = np.random.default_rng(5)
+    bk = _col(np.arange(100, dtype=np.int64), LONG)
+    sk = _col((np.arange(300) + 1000).astype(np.int64), LONG)
+    build = BuildTable.build([bk], [bk], jnp.int32(100), bk.capacity)
+    lo, counts, _ = probe_counts(build, [sk], jnp.int32(300), sk.capacity)
+    for cand_cap in (128, 256):
+        ver_x, s_x, _, row_x = _xla_probe(build, [sk], lo, counts,
+                                          cand_cap)
+        ver_p, s_p, _, row_p = _fused_probe(build, [sk], lo, counts,
+                                            cand_cap)
+        assert (np.asarray(ver_x) == np.asarray(ver_p)).all()
+        assert (np.asarray(s_x) == np.asarray(s_p)).all()
+        assert (np.asarray(row_x) == np.asarray(row_p)).all()
+
+    # overflow: duplicate-heavy keys, cap below the true candidate count
+    bk = _col(np.zeros(64, np.int64), LONG)
+    sk = _col(np.zeros(64, np.int64), LONG)
+    build = BuildTable.build([bk], [bk], jnp.int32(64), bk.capacity)
+    lo, counts, _ = probe_counts(build, [sk], jnp.int32(64), sk.capacity)
+    assert int(jnp.sum(counts)) == 64 * 64
+    cand_cap = 1024  # < 4096 true candidates
+    ver_x, s_x, p_x, _ = _xla_probe(build, [sk], lo, counts, cand_cap)
+    ver_p, s_p, p_p, _ = _fused_probe(build, [sk], lo, counts, cand_cap)
+    assert (np.asarray(ver_x) == np.asarray(ver_p)).all()
+    assert (np.asarray(s_x) == np.asarray(s_p)).all()
+
+
+def _join_engine(tier, how, null_every=6):
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.expr.core import col
+    sess = TpuSession({"spark.rapids.tpu.pallas.fusedTier": tier})
+    rng = np.random.default_rng(11)
+    no, nl = 180, 500
+    o = {"o_key": rng.integers(0, 150, no).tolist(),
+         "o_flag": rng.integers(0, 10, no).tolist(),
+         "o_name": [f"o{i % 17}" for i in range(no)]}
+    l = {"l_key": [int(k) if i % null_every else None
+                   for i, k in enumerate(rng.integers(0, 150, nl))],
+         "l_val": (rng.random(nl) * 100).round(6).tolist()}
+    from spark_rapids_tpu.types import STRING
+    o_schema = Schema((StructField("o_key", LONG),
+                       StructField("o_flag", INT),
+                       StructField("o_name", STRING)))
+    l_schema = Schema((StructField("l_key", LONG, True),
+                       StructField("l_val", DOUBLE)))
+    df_o = sess.from_pydict(o, o_schema)
+    df_l = sess.from_pydict(l, l_schema)
+    j = df_l.join(df_o, left_on="l_key", right_on="o_key", how=how)
+    return sorted(map(tuple, j.collect()),
+                  key=lambda r: tuple((x is None, x) for x in r))
+
+
+@pytest.mark.parametrize("how", ["inner", "left_outer", "left_semi",
+                                 "left_anti"])
+def test_fused_join_engine_level_matches_xla(how):
+    """Whole-join equality with string payload and null keys: fusedTier
+    'on' vs 'off' produce identical row multisets."""
+    assert _join_engine("off", how) == _join_engine("on", how)
+
+
+# --- scan-filter-project-partial-aggregate family ----------------------
+
+
+def _scan_agg_kernel_pair(seed, n, dom, G, null_every=4,
+                          float_vals=True):
+    """Kernel-level: fused_scan_agg_update vs masked_groupby with ONE
+    round and the same bucket count — identical round-0 bucketization,
+    so resolved groups and the leftover flag must agree."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.expr.core import BoundReference
+    from spark_rapids_tpu.ops.maskedagg import masked_groupby
+    from spark_rapids_tpu.ops.pallas_fused import (
+        compile_scan_agg_spec, fused_scan_agg_update)
+
+    rng = np.random.default_rng(seed)
+    key = _col(rng.integers(0, dom, n).astype(np.int64), LONG, null_every)
+    val = _col((rng.random(n) * 100) if float_vals
+               else rng.integers(-50, 50, n).astype(np.int64),
+               DOUBLE if float_vals else LONG, 3)
+    schema = Schema((StructField("k", LONG, True),
+                     StructField("v", DOUBLE if float_vals else LONG,
+                                 True)))
+    batch = ColumnarBatch([key, val], n, schema)
+    pre = [BoundReference(0, schema.fields[0].data_type, "k"),
+           BoundReference(1, schema.fields[1].data_type, "v")]
+    agg_ops = [("sum", 1), ("count", 1), ("min", 1), ("max", 1),
+               ("count_star", None)]
+    spec = compile_scan_agg_spec([], pre, schema, 1, agg_ops, schema)
+    assert spec is not None
+    out_cap = bucket_capacity(G)
+
+    fk, fres, fng, fleft = fused_scan_agg_update(spec, batch, G, out_cap,
+                                                 interpret=True)
+    xk, xres, xng, xleft = masked_groupby(
+        [key], [(op, None if s is None else [key, val][s])
+                for op, s in agg_ops],
+        batch.num_rows, batch.capacity, None, group_slots=G, rounds=1)
+    return (fk, fres, int(fng), bool(fleft),
+            xk, xres, int(xng), bool(xleft))
+
+
+@pytest.mark.parametrize("seed,dom,floats", [
+    (20, 4, True), (21, 8, False), (22, 1, True),
+])
+def test_fused_scan_agg_kernel_matches_masked_groupby(seed, dom, floats):
+    fk, fres, fng, fleft, xk, xres, xng, xleft = _scan_agg_kernel_pair(
+        seed, 1500, dom, G=16, float_vals=floats)
+    assert fleft == xleft
+    assert fng == xng
+
+    def groups(keys, res, ng):
+        kd = np.asarray(keys[0].data)
+        kv = np.asarray(keys[0].validity)
+        out = {}
+        for i in range(ng):
+            kval = (int(kd[i]) if kv[i] else None)
+            row = []
+            for _, (d, v) in res:
+                row.append((None if not np.asarray(v)[i]
+                            else np.asarray(d)[i]))
+            out[kval] = row
+        return out
+
+    fg = groups(fk, fres, fng)
+    xg = groups(xk, xres, xng)
+    assert set(fg) == set(xg)
+    for k in fg:
+        for a, b in zip(fg[k], xg[k]):
+            if a is None or b is None:
+                assert a is None and b is None, (k, fg[k], xg[k])
+            elif isinstance(a, np.floating) or isinstance(b, np.floating):
+                assert abs(float(a) - float(b)) <= \
+                    1e-9 * max(abs(float(b)), 1.0), (k, a, b)
+            else:
+                assert a == b, (k, fg[k], xg[k])  # bitwise for integers
+
+
+def test_fused_scan_agg_leftover_on_high_cardinality():
+    _, _, _, fleft, _, _, _, xleft = _scan_agg_kernel_pair(
+        23, 1200, 300, G=8, float_vals=False)
+    assert fleft and xleft
+
+
+def _agg_engine(tier, n=1500, nkeys=5):
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.expr.aggexprs import Count, Max, Min, Sum
+    from spark_rapids_tpu.expr.core import col, lit
+    sess = TpuSession({"spark.rapids.tpu.pallas.fusedTier": tier})
+    rng = np.random.default_rng(31)
+    data = {"flag": rng.integers(0, nkeys, n).tolist(),
+            "qty": rng.integers(1, 51, n).tolist(),
+            "price": (rng.random(n) * 1000).tolist(),
+            "disc": (rng.random(n) * 0.1).tolist()}
+    schema = Schema((StructField("flag", INT), StructField("qty", LONG),
+                     StructField("price", DOUBLE),
+                     StructField("disc", DOUBLE)))
+    df = sess.from_pydict(data, schema)
+    q = (df.filter(col("qty") <= lit(45))
+           .select(col("flag"), col("qty"),
+                   (col("price") * (lit(1.0) - col("disc"))).alias("dp"))
+           .group_by("flag")
+           .agg((Sum(col("qty")), "sq"), (Sum(col("dp")), "sd"),
+                (Count(), "cnt"), (Min(col("qty")), "mn"),
+                (Max(col("qty")), "mx")))
+    return sorted(q.collect())
+
+
+def test_fused_scan_agg_engine_level_q1_shape():
+    """The headline q1 shape (filter -> derived projection -> group-by)
+    through the full exec layer: fused tier == XLA tier."""
+    a = _agg_engine("off")
+    b = _agg_engine("on")
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert ra[0] == rb[0] and ra[1] == rb[1] and ra[3] == rb[3] \
+            and ra[4] == rb[4] and ra[5] == rb[5]
+        assert abs(ra[2] - rb[2]) <= 1e-9 * max(abs(ra[2]), 1.0)
+
+
+def test_fused_scan_agg_unreferenced_varlen_column_falls_back():
+    """A STRING source column — even one no expression touches — makes
+    the shape ineligible (every source column rides the kernel as row
+    tiles); the aggregate must silently keep the XLA tier and stay
+    correct with fusedTier=on."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.expr.aggexprs import Sum
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.types import STRING
+    sess = TpuSession({"spark.rapids.tpu.pallas.fusedTier": "on"})
+    rng = np.random.default_rng(41)
+    n = 600
+    data = {"k": rng.integers(0, 4, n).tolist(),
+            "v": rng.integers(0, 100, n).tolist(),
+            "name": [f"s{i % 13}" for i in range(n)]}
+    schema = Schema((StructField("k", INT), StructField("v", LONG),
+                     StructField("name", STRING)))
+    df = sess.from_pydict(data, schema)
+    got = dict(df.group_by("k").agg((Sum(col("v")), "s")).collect())
+    exp = {}
+    for k, v in zip(data["k"], data["v"]):
+        exp[k] = exp.get(k, 0) + v
+    assert got == exp
+
+
+def test_fused_scan_agg_short_key_falls_back():
+    """BYTE/SHORT group keys are structurally ineligible (their u8/u16
+    order lanes don't round-trip the u32 accumulator); the tier must
+    fall back to XLA silently, not crash at trace time."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.expr.aggexprs import Sum
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.types import SHORT
+    sess = TpuSession({"spark.rapids.tpu.pallas.fusedTier": "on"})
+    rng = np.random.default_rng(43)
+    n = 500
+    data = {"k": rng.integers(0, 5, n).tolist(),
+            "v": rng.integers(0, 100, n).tolist()}
+    schema = Schema((StructField("k", SHORT), StructField("v", LONG)))
+    df = sess.from_pydict(data, schema)
+    got = dict(df.group_by("k").agg((Sum(col("v")), "s")).collect())
+    exp = {}
+    for k, v in zip(data["k"], data["v"]):
+        exp[k] = exp.get(k, 0) + v
+    assert got == exp
+
+
+def test_fused_tier_auto_requires_a_measurement(tmp_path):
+    """auto + no record -> XLA; auto + recorded Pallas win -> fused."""
+    import json
+
+    import jax
+
+    from spark_rapids_tpu.config import RapidsConf, set_active_conf
+    from spark_rapids_tpu.ops.pallas_tier import (
+        fused_tier_enabled, shape_bucket)
+    set_active_conf(RapidsConf({
+        "spark.rapids.tpu.pallas.fusedTier": "auto",
+        "spark.rapids.tpu.pallas.fusedTier.benchFile":
+            str(tmp_path / "none.json")}))
+    assert not fused_tier_enabled("join_probe", (1024, 512))
+
+    rec = {"records": [{
+        "family": "join_probe", "platform": jax.default_backend(),
+        "shape_bucket": list(shape_bucket((1024, 512))),
+        "xla_ms": 10.0, "pallas_ms": 2.0}]}
+    p = tmp_path / "kern_bench.json"
+    p.write_text(json.dumps(rec))
+    set_active_conf(RapidsConf({
+        "spark.rapids.tpu.pallas.fusedTier": "auto",
+        "spark.rapids.tpu.pallas.fusedTier.benchFile": str(p)}))
+    assert fused_tier_enabled("join_probe", (1024, 512))
+    assert not fused_tier_enabled("join_probe", (4096, 512))
+    assert not fused_tier_enabled("scan_agg", (1024, 512))
+    set_active_conf(RapidsConf())

@@ -44,6 +44,7 @@ def test_partition_slots_roundtrip():
 
 
 @needs_8
+@pytest.mark.slow  # minute-scale on a single-core host; nightly tier
 def test_distributed_groupby_ints_and_strings():
     mesh = device_mesh(8)
     rng = np.random.default_rng(7)
@@ -94,8 +95,9 @@ def test_exchange_preserves_all_rows():
         out = ColumnarBatch(cols, n, sch)
         return jax.tree_util.tree_map(lambda x: x[None], out)
 
-    step = jax.jit(jax.shard_map(spmd, mesh=mesh, in_specs=P(DATA_AXIS),
-                                 out_specs=P(DATA_AXIS), check_vma=False))
+    from spark_rapids_tpu.parallel.mesh import shard_map_compat
+    step = jax.jit(shard_map_compat(spmd, mesh=mesh, in_specs=P(DATA_AXIS),
+                                    out_specs=P(DATA_AXIS)))
     out = step(stacked)
     received = []
     for i, shard in enumerate(unstack_batches(out, 8)):
@@ -111,6 +113,7 @@ def test_exchange_preserves_all_rows():
 
 
 @needs_8
+@pytest.mark.slow  # minute-scale on a single-core host; nightly tier
 def test_distributed_groupby_long_string_keys():
     """Review regression: keys longer than the default 64-byte exchange
     width must group exactly when string_width is sized to the data."""

@@ -1,0 +1,83 @@
+"""Per-query task-metrics roll-up (ISSUE 1 satellite, VERDICT Missing
+#8): existing per-exec metrics (semaphore wait, spill, retry counts,
+operator times) aggregate into a session-reachable per-query summary —
+the standalone analog of GpuTaskMetrics.scala:81-103."""
+
+import numpy as np
+
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.expr.aggexprs import Count, Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, Schema, StructField
+
+
+def _session_query(sess):
+    rng = np.random.default_rng(0)
+    n = 2000
+    data = {"k": rng.integers(0, 6, n).tolist(),
+            "q": rng.integers(1, 50, n).tolist(),
+            "p": (rng.random(n) * 10).tolist()}
+    schema = Schema((StructField("k", INT), StructField("q", LONG),
+                     StructField("p", DOUBLE)))
+    df = sess.from_pydict(data, schema)
+    return (df.filter(col("q") <= lit(40))
+              .group_by("k").agg((Sum(col("p")), "s"), (Count(), "c")))
+
+
+def test_summary_reachable_from_session_api():
+    sess = TpuSession()
+    assert sess.last_query_metrics() is None
+    q = _session_query(sess)
+    rows = q.collect()
+    assert rows
+    m = sess.last_query_metrics()
+    assert m is not None
+    # GpuTaskMetrics-mirrored task globals are present and sane
+    for key in ("semWaitTimeNs", "retryCount", "splitAndRetryCount",
+                "spilledDeviceBytes", "spilledHostBytes"):
+        assert key in m and m[key] >= 0, (key, m.get(key))
+    # per-metric roll-ups across the operator tree
+    assert m["total.numOutputRows"] >= len(rows)
+    assert m["total.numOutputBatches"] >= 1
+    assert m["total.computeAggTime"] >= 0
+    # per-operator breakdown uses the all_metrics addressing
+    assert any(k.startswith("ops.") and "AggregateExec" in k for k in m)
+
+
+def test_summary_reports_per_query_deltas():
+    """Two queries on one session: each collect's summary reflects ITS
+    run, not a lifetime accumulation of retry counters."""
+    from spark_rapids_tpu.memory.retry import (
+        force_retry_oom, register_task, unregister_task)
+    sess = TpuSession()
+    q = _session_query(sess)
+    register_task(1)
+    try:
+        force_retry_oom(1)  # inject ONE retryable OOM into query 1
+        q.collect()
+        m1 = sess.last_query_metrics()
+        q.collect()
+        m2 = sess.last_query_metrics()
+    finally:
+        unregister_task()
+    assert m1["retryCount"] >= 1
+    assert m2["retryCount"] == 0  # the delta resets per query
+
+
+def test_join_query_rolls_up_join_metrics():
+    sess = TpuSession()
+    rng = np.random.default_rng(1)
+    l_schema = Schema((StructField("k", LONG), StructField("v", DOUBLE)))
+    r_schema = Schema((StructField("k2", LONG), StructField("w", LONG)))
+    df_l = sess.from_pydict(
+        {"k": rng.integers(0, 50, 500).tolist(),
+         "v": rng.random(500).tolist()}, l_schema)
+    df_r = sess.from_pydict(
+        {"k2": rng.integers(0, 50, 200).tolist(),
+         "w": rng.integers(0, 9, 200).tolist()}, r_schema)
+    out = df_l.join(df_r, left_on="k", right_on="k2").collect()
+    m = sess.last_query_metrics()
+    assert m["total.numOutputRows"] >= len(out)
+    assert "total.joinTime" in m
+    assert "total.buildTime" in m
+    assert any("HashJoinExec" in k for k in m)

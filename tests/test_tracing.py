@@ -3,6 +3,8 @@
 import glob
 import os
 
+import pytest
+
 from spark_rapids_tpu.api import functions as F
 from spark_rapids_tpu.api.session import TpuSession
 from spark_rapids_tpu.types import LONG, Schema, StructField
@@ -21,6 +23,7 @@ def test_profile_disabled_is_noop():
             (F.sum("v"), "s")).count() == 2
 
 
+@pytest.mark.slow  # minute-scale on a single-core host; nightly tier
 def test_profile_captures_trace(tmp_path):
     out = str(tmp_path / "trace")
     sess = TpuSession({"spark.rapids.tpu.profile.enabled": True,

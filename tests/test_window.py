@@ -269,6 +269,7 @@ def test_partition_aligned_chunked_window():
     assert got == ref
 
 
+@pytest.mark.slow  # minute-scale on a single-core host; nightly tier
 def test_partition_aligned_chunks_string_keys_with_nulls():
     # string partition keys incl. NULLs across chunk boundaries: the
     # boundary detector compares null rows by validity, not stale bytes

@@ -3,10 +3,16 @@ GpuUnboundedToUnboundedAggWindowExec.scala:1155): when one partition
 exceeds the chunk budget and every window expression is a whole-partition
 aggregate, the exec carries tiny agg state + spillable pieces instead of
 concatenating the partition, and pass 2 emits the pieces with broadcast
-finals."""
+finals.
+
+Marked `slow`: giant-partition shapes are minute-scale on one core;
+bounded-window semantics stay gated in tier-1 (test_window.py,
+test_window_range.py)."""
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.exec.basic import InMemoryScanExec

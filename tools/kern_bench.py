@@ -1,0 +1,287 @@
+"""Per-kernel microbenchmark harness driving the fused-tier selection
+(ISSUE 1: the tier choice is a measurement, not a guess).
+
+For each kernel family and shape bucket, times the XLA formulation
+against the fused Pallas kernel and records both. The records file
+(tools/kern_bench.json by default) is what
+`spark.rapids.tpu.pallas.fusedTier=auto` consults at trace time
+(spark_rapids_tpu/ops/pallas_tier.py): a family only replaces its XLA
+tier for a shape bucket where its recorded time wins.
+
+Timing methodology (docs/perf.md round 5): `block_until_ready` returns
+early under the axon tunnel, so each lane chains every iteration's
+output into a device checksum scalar and the clock stops on the ONE
+device->host fetch of the final checksum. Median of --reps timed runs.
+
+Off-TPU the Pallas lanes run under the interpreter — they will lose by
+orders of magnitude, which is precisely the point: `auto` then keeps the
+XLA tier on CPU while a TPU round's records can flip it per shape.
+
+Usage:
+  python tools/kern_bench.py                          # default shapes
+  python tools/kern_bench.py --families join_probe --shapes 4096x1024
+  python tools/kern_bench.py --out tools/kern_bench.json --iters 20
+
+Prints one JSON line per (family, shape) stage:
+  {"family", "shape", "platform", "xla_ms", "pallas_ms", "winner"}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEFAULT_SHAPES = {
+    "join_probe": [(1 << 12, 1 << 10), (1 << 14, 1 << 12)],
+    "scan_agg": [(1 << 14,), (1 << 16,)],
+    "murmur3": [(1 << 16,), (1 << 20,)],
+}
+
+
+def _timed(step, iters: int, reps: int) -> float:
+    """Median wall-clock (ms) of `reps` runs of `iters` chained steps;
+    step(chk) -> chk must consume and return the device checksum so no
+    iteration can be elided or left queued when the clock stops."""
+    import jax.numpy as jnp
+    chk = step(jnp.float64(0.0))  # warm: compile + one round trip
+    float(np.asarray(chk))
+    times = []
+    for _ in range(reps):
+        chk = jnp.float64(0.0)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            chk = step(chk)
+        float(np.asarray(chk))  # forces completion of all iterations
+        times.append((time.perf_counter() - t0) / iters * 1e3)
+    return sorted(times)[len(times) // 2]
+
+
+def bench_join_probe(shape, iters, reps, interpret):
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+    from spark_rapids_tpu.ops.join import (
+        BuildTable, expand_candidates, int_key_lanes, probe_counts,
+        verify_pairs)
+    from spark_rapids_tpu.ops.pallas_join import fused_probe_verify
+    from spark_rapids_tpu.types import LONG
+
+    ns, nb = shape
+    rng = np.random.default_rng(0)
+    bk = Column.from_numpy(rng.integers(0, nb, nb).astype(np.int64),
+                           LONG, capacity=bucket_capacity(nb))
+    sk = Column.from_numpy(rng.integers(0, nb, ns).astype(np.int64),
+                           LONG, capacity=bucket_capacity(ns))
+    build = BuildTable.build([bk], [bk], jnp.int32(nb), bk.capacity)
+    lo, counts, _ = probe_counts(build, [sk], jnp.int32(ns), sk.capacity)
+    cand_cap = bucket_capacity(max(int(jnp.sum(counts)), 1))
+    bk_lanes, bvalid = build.key_lanes
+    sk_lanes, svalid = int_key_lanes([sk])
+
+    @jax.jit
+    def xla_step(chk):
+        s_idx, b_pos, _ = expand_candidates(lo, counts, cand_cap)
+        pv = s_idx >= 0
+        ver, b_row = verify_pairs(build, [sk],
+                                  jnp.where(pv, s_idx, -1),
+                                  jnp.where(pv, b_pos, -1), pv)
+        return chk + jnp.sum(ver).astype(jnp.float64) \
+            + jnp.sum(b_row).astype(jnp.float64)
+
+    @jax.jit
+    def pallas_step(chk):
+        ver, s_idx, b_pos, b_row = fused_probe_verify(
+            lo, counts, bk_lanes, bvalid, sk_lanes, svalid, build.perm,
+            cand_cap, interpret=interpret)
+        return chk + jnp.sum(ver).astype(jnp.float64) \
+            + jnp.sum(b_row).astype(jnp.float64)
+
+    return (_timed(xla_step, iters, reps),
+            _timed(pallas_step, iters, reps))
+
+
+def bench_scan_agg(shape, iters, reps, interpret, G=32, n_keys=24):
+    """XLA lane = the engine's masked tier at its DEFAULT configuration
+    (32 slots x 2 rounds, exec/aggregate.py), Pallas lane = the fused
+    kernel exactly as AggregateExec._streaming_step calls it (G =
+    min(32, slots), single round) — a recorded 'win' must reflect the
+    real substitution, not a toy baseline. n_keys=24 keeps the bucket
+    table realistically loaded (clean but not trivially sparse); note
+    the auto tier keys records by SHAPE bucket only, so record with
+    data whose cardinality resembles the production workload."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+    from spark_rapids_tpu.expr.core import BoundReference
+    from spark_rapids_tpu.ops.maskedagg import masked_groupby
+    from spark_rapids_tpu.ops.pallas_fused import (
+        compile_scan_agg_spec, fused_scan_agg_update)
+    from spark_rapids_tpu.types import DOUBLE, LONG, Schema, StructField
+
+    (n,) = shape
+    rng = np.random.default_rng(1)
+    key = Column.from_numpy(rng.integers(0, n_keys, n).astype(np.int64),
+                            LONG, capacity=bucket_capacity(n))
+    val = Column.from_numpy(rng.random(n) * 100, DOUBLE,
+                            capacity=bucket_capacity(n))
+    schema = Schema((StructField("k", LONG), StructField("v", DOUBLE)))
+    batch = ColumnarBatch([key, val], n, schema)
+    pre = [BoundReference(0, LONG, "k"), BoundReference(1, DOUBLE, "v")]
+    agg_ops = [("sum", 1), ("count", 1), ("min", 1), ("max", 1)]
+    spec = compile_scan_agg_spec([], pre, schema, 1, agg_ops, schema)
+    assert spec is not None
+    out_cap = bucket_capacity(G)
+
+    def fold(chk, keys, results):
+        for c in keys:
+            chk = chk + jnp.sum(jnp.where(c.validity, c.data, 0)) \
+                .astype(jnp.float64)
+        for _, (d, v) in results:
+            chk = chk + jnp.sum(jnp.where(v, d, jnp.zeros((), d.dtype))) \
+                .astype(jnp.float64)
+        return chk
+
+    @jax.jit
+    def xla_step(chk):
+        # the engine's masked tier at its DEFAULT slots x rounds
+        keys, results, ng, left = masked_groupby(
+            [key], [(op, [key, val][s]) for op, s in agg_ops],
+            batch.num_rows, batch.capacity, None, group_slots=32,
+            rounds=2)
+        return fold(chk, keys, results) + left
+
+    @jax.jit
+    def pallas_step(chk):
+        keys, results, ng, left = fused_scan_agg_update(
+            spec, batch, G, out_cap, interpret=interpret)
+        return fold(chk, keys, results) + left
+
+    return (_timed(xla_step, iters, reps),
+            _timed(pallas_step, iters, reps))
+
+
+def bench_murmur3(shape, iters, reps, interpret):
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops import hashing as H
+    from spark_rapids_tpu.ops.pallas_kernels import murmur3_long_lanes
+
+    (n,) = shape
+    rng = np.random.default_rng(2)
+    data = jnp.asarray(rng.integers(-(2**62), 2**62, n), jnp.int64)
+    seeds = jnp.full((n,), jnp.uint32(42))
+
+    @jax.jit
+    def xla_step(chk):
+        return chk + jnp.sum(H.murmur3_long(data, seeds)
+                             .astype(jnp.float64))
+
+    @jax.jit
+    def pallas_step(chk):
+        return chk + jnp.sum(
+            murmur3_long_lanes(data, seeds, interpret=interpret)
+            .astype(jnp.float64))
+
+    return (_timed(xla_step, iters, reps),
+            _timed(pallas_step, iters, reps))
+
+
+BENCHES = {
+    "join_probe": bench_join_probe,
+    "scan_agg": bench_scan_agg,
+    "murmur3": bench_murmur3,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--families", nargs="*", default=list(BENCHES),
+                    choices=list(BENCHES))
+    ap.add_argument("--shapes", nargs="*", default=None,
+                    help="override shapes, e.g. 4096x1024 (join) or "
+                         "65536 (1-D families)")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "kern_bench.json"))
+    ap.add_argument("--dry-run", action="store_true",
+                    help="measure and print, do not write the record "
+                         "file")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from spark_rapids_tpu.ops.pallas_kernels import on_tpu
+    from spark_rapids_tpu.ops.pallas_tier import shape_bucket
+
+    platform = jax.default_backend()
+    interpret = not on_tpu()
+
+    # merge with existing records so shape coverage accumulates
+    doc = {"records": []}
+    if os.path.exists(args.out) and not args.dry_run:
+        try:
+            with open(args.out) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {"records": []}
+    index = {(r["family"], r["platform"], tuple(r["shape_bucket"])): r
+             for r in doc.get("records", ())}
+
+    if args.shapes and len(args.families) != 1:
+        ap.error("--shapes overrides one family's shape list; pass "
+                 "exactly one --families with it (families differ in "
+                 "shape arity)")
+
+    for family in args.families:
+        shapes = DEFAULT_SHAPES[family]
+        if args.shapes:
+            shapes = [tuple(int(x) for x in s.split("x"))
+                      for s in args.shapes]
+            arity = len(DEFAULT_SHAPES[family][0])
+            bad = [s for s in shapes if len(s) != arity]
+            if bad:
+                ap.error(f"{family} shapes need {arity} dims "
+                         f"(got {bad})")
+        for shape in shapes:
+            xla_ms, pallas_ms = BENCHES[family](
+                shape, args.iters, args.reps, interpret)
+            rec = {
+                "family": family,
+                "platform": platform,
+                "shape": list(shape),
+                "shape_bucket": list(shape_bucket(shape)),
+                "xla_ms": round(xla_ms, 4),
+                "pallas_ms": round(pallas_ms, 4),
+                "winner": "pallas" if pallas_ms < xla_ms else "xla",
+                "iters": args.iters,
+                "interpret": interpret,
+                "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            }
+            index[(family, platform, tuple(rec["shape_bucket"]))] = rec
+            print(json.dumps({k: rec[k] for k in (
+                "family", "shape", "platform", "xla_ms", "pallas_ms",
+                "winner")}))
+
+    if not args.dry_run:
+        doc["records"] = list(index.values())
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({"written": args.out,
+                          "records": len(doc["records"])}))
+
+
+if __name__ == "__main__":
+    main()
