@@ -25,9 +25,33 @@ the driver loop (round-2 verdict item 9).
 """
 
 import json
+import os
 import time
 
 import numpy as np
+
+
+def maybe_enable_event_log():
+    """Opt-in structured event log for bench runs: set
+    SPARK_RAPIDS_TPU_EVENTLOG_DIR to get a JSONL operator-span log
+    (obs/events.py) next to the BENCH records; render it with
+    tools/profile_report.py. Default: off, zero per-batch cost."""
+    d = os.environ.get("SPARK_RAPIDS_TPU_EVENTLOG_DIR")
+    if d:
+        from spark_rapids_tpu.obs import events
+        events.enable(d, os.environ.get("SPARK_RAPIDS_TPU_EVENTLOG_LEVEL",
+                                        "MODERATE"))
+
+
+def query_attribution(plan, before):
+    """Per-operator attribution embedded in each BENCH record (ISSUE 2:
+    BENCH deltas stop being single scalar GB/s numbers): the
+    GpuTaskMetrics-style per-query summary + top operators by time."""
+    try:
+        from spark_rapids_tpu.obs.profile import bench_profile_summary
+        return bench_profile_summary(plan, before)
+    except Exception as e:  # noqa: BLE001 — attribution must never
+        return {"error": f"{type(e).__name__}: {e}"[:200]}  # kill a lane
 
 ROWS = 1 << 24  # 16M rows, ~448 MB
 BATCHES = 1
@@ -161,6 +185,9 @@ def main():
     plan = make_plan()
 
     from spark_rapids_tpu.exec.speculation import speculation_scope
+    from spark_rapids_tpu.exec.task_metrics import query_snapshot
+
+    metrics_before = query_snapshot()
 
     @jax.jit
     def checksum(batch, prev, spec_flags):
@@ -214,6 +241,7 @@ def main():
         "value": round(gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(t_np / dt, 3),
+        "profile": query_attribution(plan, metrics_before),
     }))
 
 
@@ -304,6 +332,9 @@ def q3_bench():
     plan = TopNExec(10, [(col("revenue"), False)], agg)
 
     from spark_rapids_tpu.exec.speculation import speculation_scope
+    from spark_rapids_tpu.exec.task_metrics import query_snapshot
+
+    metrics_before = query_snapshot()
 
     @jax.jit
     def checksum(batch, prev, spec_flags):
@@ -355,9 +386,11 @@ def q3_bench():
         "value": round(bytes_in / dt / 1e9, 3),
         "unit": "GB/s",
         "vs_baseline": round(t_np / dt, 3),
+        "profile": query_attribution(plan, metrics_before),
     }))
 
 
 if __name__ == "__main__":
+    maybe_enable_event_log()
     main()
     q3_bench()

@@ -39,9 +39,11 @@ class TpuSession:
         equi-joins compile to partial → ICI all-to-all exchange → final
         SPMD stages over the device mesh (exec/exchange.py). Default: the
         single-partition plan (no exchange nodes)."""
+        from ..obs import events as obs_events
         from ..parallel.mesh import device_mesh, set_active_mesh
         self.conf = RapidsConf(conf or {})
         set_active_conf(self.conf)
+        obs_events.configure(self.conf)
         if mesh is None and mesh_devices is not None:
             mesh = device_mesh(mesh_devices)
         self.mesh = mesh
@@ -49,13 +51,24 @@ class TpuSession:
         #: per-query metric roll-up of the LAST collect() on this
         #: session (exec/task_metrics.py; reference GpuTaskMetrics)
         self._last_query_metrics = None
+        #: per-query profile of the LAST collect() (obs/profile.py)
+        self._last_query_profile = None
 
     def last_query_metrics(self):
         """Task-level metrics of the most recent DataFrame.collect():
         semaphore wait, OOM-retry counts, spill volumes (per-query
         deltas) plus per-operator metric sums — the engine's
-        GpuTaskMetrics surface (GpuTaskMetrics.scala:81-103)."""
+        GpuTaskMetrics surface (GpuTaskMetrics.scala:81-103). Honors
+        spark.rapids.sql.metrics.level (GpuExec.scala:36-47)."""
         return self._last_query_metrics
+
+    def last_query_profile(self):
+        """QueryProfile of the most recent DataFrame.collect(): the
+        executed plan tree annotated with per-operator metrics, with
+        `.text()` (explain-with-metrics, the Spark-SQL-UI analog),
+        `.to_json()` and `.top_operators()` renderers (obs/profile.py).
+        None before the first collect."""
+        return self._last_query_profile
 
     # -- ingestion ---------------------------------------------------------
     def from_pydict(self, data: Dict, schema: Schema,
@@ -325,26 +338,45 @@ class DataFrame:
 
     # -- actions -----------------------------------------------------------
     def _exec(self):
+        from ..obs import events as obs_events
         from ..parallel.mesh import set_active_mesh
         set_active_conf(self.session.conf)
         set_active_mesh(self.session.mesh)
+        obs_events.configure(self.session.conf)
         return TpuOverrides(self.session.conf).apply(self._plan)
 
     def collect(self) -> List[tuple]:
+        import time as _time
+
         from ..exec.task_metrics import query_snapshot, query_summary
-        plan = self._exec()
-        before = query_snapshot()
-        try:
-            return plan.collect()
-        finally:
-            # metrics are harvested even on failure: a half-run query's
-            # spill/retry spend is exactly what an operator debugging it
-            # wants to see
+        from ..obs import events as obs_events
+        from ..obs.profile import QueryProfile
+        with obs_events.query_scope():
+            # conversion inside the scope: plan_fallback / plan_not_on_tpu
+            # events must carry this query's id
+            plan = self._exec()
+            before = query_snapshot()
+            obs_events.emit("query_start", root=type(plan).__name__)
+            t0 = _time.perf_counter_ns()
+            ok = False
             try:
-                self.session._last_query_metrics = query_summary(
-                    plan, before)
-            except Exception:  # noqa: BLE001 — metrics must never mask
-                pass
+                out = plan.collect()
+                ok = True
+                return out
+            finally:
+                # metrics are harvested even on failure: a half-run
+                # query's spill/retry spend is exactly what an operator
+                # debugging it wants to see
+                try:
+                    summary = query_summary(plan, before)
+                    self.session._last_query_metrics = summary
+                    self.session._last_query_profile = QueryProfile(
+                        plan, summary)
+                except Exception:  # noqa: BLE001 — must never mask
+                    pass
+                obs_events.emit(
+                    "query_end", root=type(plan).__name__, ok=ok,
+                    wall_ns=_time.perf_counter_ns() - t0)
 
     def to_arrow(self):
         import pyarrow as pa

@@ -287,7 +287,35 @@ PROFILE_DIR = conf_str(
 
 METRICS_LEVEL = conf_str(
     "spark.rapids.sql.metrics.level", "MODERATE",
-    "ESSENTIAL | MODERATE | DEBUG (reference GpuExec.scala:36-47).")
+    "ESSENTIAL | MODERATE | DEBUG (reference GpuExec.scala:36-47): "
+    "metric registries report only entries at or below this level — "
+    "TpuExec.all_metrics(), last_query_metrics() and the query profile "
+    "all honor it, so DEBUG metrics (per-operator input row/batch "
+    "counts) stay out of summaries unless asked for.")
+
+EVENT_LOG_ENABLED = conf_bool(
+    "spark.rapids.tpu.eventLog.enabled", False,
+    "Write the structured JSONL query event log (obs/events.py): query "
+    "begin/end, per-operator open/batch/close spans with wall-ns and "
+    "row/byte counts, semaphore waits, spill and OOM-retry events, "
+    "Pallas tier decisions, plan fallback reasons, exchange transfer "
+    "volumes. Off (default) costs one pointer check per batch — the "
+    "analog of the reference's Spark-event/NVTX metric stream.",
+    commonly_used=True)
+
+EVENT_LOG_DIR = conf_str(
+    "spark.rapids.tpu.eventLog.dir", "",
+    "Directory for event-log files (one events-<pid>-<n>.jsonl per "
+    "configured bus); empty = /tmp/spark_rapids_tpu_events. Render a "
+    "log with tools/profile_report.py.")
+
+EVENT_LOG_LEVEL = conf_str(
+    "spark.rapids.tpu.eventLog.level", "MODERATE",
+    "ESSENTIAL | MODERATE | DEBUG: event kinds above this level are "
+    "dropped at emit time. ESSENTIAL = query begin/end only; MODERATE "
+    "adds operator close spans, spills, retries, semaphore waits, tier "
+    "and plan decisions, exchange volumes; DEBUG adds per-batch "
+    "operator spans and span-API records.")
 
 SORT_OOC_ENABLED = conf_bool(
     "spark.rapids.sql.sort.outOfCore.enabled", True,

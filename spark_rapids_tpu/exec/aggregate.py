@@ -36,7 +36,8 @@ from ..ops.aggregate import groupby_aggregate, groupby_aggregate_hash
 from ..ops.basic import active_mask, sanitize
 from ..ops.sort import string_words_for
 from ..types import DataType, LongType, Schema, StructField
-from .base import AGG_TIME, CONCAT_TIME, NUM_INPUT_BATCHES, NUM_INPUT_ROWS, TpuExec
+from .base import (AGG_TIME, CONCAT_TIME, DEBUG, NUM_INPUT_BATCHES,
+                   NUM_INPUT_ROWS, TpuExec)
 from .basic import bind_projection, eval_projection
 from .coalesce import concat_batches
 
@@ -236,7 +237,8 @@ class AggregateExec(TpuExec):
         return Schema(tuple(key_fields + agg_fields))
 
     def additional_metrics(self):
-        return (AGG_TIME, CONCAT_TIME, NUM_INPUT_ROWS, NUM_INPUT_BATCHES)
+        return (AGG_TIME, CONCAT_TIME, (NUM_INPUT_ROWS, DEBUG),
+                (NUM_INPUT_BATCHES, DEBUG))
 
     # -- kernels -----------------------------------------------------------
     def _pre_project(self, batch: ColumnarBatch) -> ColumnarBatch:

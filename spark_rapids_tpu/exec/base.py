@@ -11,15 +11,28 @@ stays in Python exactly where the reference keeps it in Scala.
 
 from __future__ import annotations
 
+import itertools
 import time
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 from ..columnar.batch import ColumnarBatch
 from ..types import Schema
 
+# the same three-level scale as obs/events.py (the single name->int
+# parser lives there: events.parse_level)
 ESSENTIAL = 0
 MODERATE = 1
 DEBUG = 2
+
+
+def metrics_level_from_conf(conf=None) -> int:
+    """spark.rapids.sql.metrics.level as an int (unknown → MODERATE),
+    the visibility cut for all_metrics()/last_query_metrics()
+    (reference GpuExec.scala:36-47)."""
+    from ..config import METRICS_LEVEL, active_conf
+    from ..obs.events import parse_level
+    conf = conf if conf is not None else active_conf()
+    return parse_level(conf.get(METRICS_LEVEL))
 
 
 class TpuMetric:
@@ -92,6 +105,29 @@ BUILD_TIME = "buildTime"
 PEAK_DEVICE_MEMORY = "peakDevMemory"
 NUM_TASKS_FALL_BACKED = "numTasksFallBacked"
 SPILL_TIME = "spillTime"
+PARTITION_SIZE = "dataSize"
+SHUFFLE_WRITE_TIME = "shuffleWriteTime"
+SHUFFLE_READ_TIME = "shuffleReadTime"
+BROADCAST_TIME = "broadcastTime"
+
+#: the closed set of metric names execs may register — one name, one
+#: meaning, exactly like the reference's GpuMetric companion object.
+#: tests/test_docs_lint.py asserts every additional_metrics() entry
+#: resolves here, so a typo'd or duplicate-meaning name fails tier-1.
+CANONICAL_METRICS = frozenset({
+    NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES, NUM_INPUT_ROWS, NUM_INPUT_BATCHES,
+    OP_TIME, SORT_TIME, AGG_TIME, CONCAT_TIME, JOIN_TIME, BUILD_TIME,
+    PEAK_DEVICE_MEMORY, NUM_TASKS_FALL_BACKED, SPILL_TIME, PARTITION_SIZE,
+    SHUFFLE_WRITE_TIME, SHUFFLE_READ_TIME, BROADCAST_TIME,
+})
+
+#: per-operator instance ids for event/span attribution (two
+#: AggregateExecs in one plan stay distinguishable in the event log)
+_OP_IDS = itertools.count(1)
+
+#: an additional_metrics() entry: a bare canonical name (MODERATE) or
+#: (name, level)
+MetricSpec = Union[str, Tuple[str, int]]
 
 
 class TpuExec:
@@ -99,19 +135,22 @@ class TpuExec:
 
     def __init__(self, *children: "TpuExec"):
         self.children: List[TpuExec] = list(children)
+        self._op_id = next(_OP_IDS)
         self.metrics: Dict[str, TpuMetric] = {}
         for name in (NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES):
             self.metrics[name] = TpuMetric(name, ESSENTIAL)
         self.metrics[OP_TIME] = TpuMetric(OP_TIME, MODERATE)
-        for name in self.additional_metrics():
-            self.metrics[name] = TpuMetric(name, MODERATE)
+        for spec in self.additional_metrics():
+            name, level = spec if isinstance(spec, tuple) \
+                else (spec, MODERATE)
+            self.metrics[name] = TpuMetric(name, level)
 
     # -- subclass surface --------------------------------------------------
     @property
     def output_schema(self) -> Schema:
         raise NotImplementedError(type(self).__name__)
 
-    def additional_metrics(self) -> Sequence[str]:
+    def additional_metrics(self) -> Sequence[MetricSpec]:
         return ()
 
     @property
@@ -135,7 +174,16 @@ class TpuExec:
         """Final wrapper (reference GpuExec.doExecuteColumnar:365): counts
         output rows/batches around the operator's own iterator, with an
         xprof trace annotation per batch step (the reference's NVTX
-        range; shows operator names over their XLA ops in timelines)."""
+        range; shows operator names over their XLA ops in timelines).
+
+        With the event log enabled (spark.rapids.tpu.eventLog.enabled)
+        this is also the operator span source: one `op_open` when the
+        iterator starts, one `op_batch` per step (wall-ns around the
+        pull, so INCLUSIVE of child time — the pull model's analog of
+        the reference's NVTX range nesting), and one `op_close` carrying
+        the cumulative totals when it finishes (or is abandoned by a
+        limit). Disabled mode pays exactly one active_bus() check."""
+        from ..obs import events as obs_events
         from ..utils.tracing import annotate_op
         rows = self.metrics[NUM_OUTPUT_ROWS]
         batches = self.metrics[NUM_OUTPUT_BATCHES]
@@ -149,23 +197,79 @@ class TpuExec:
         except Exception:  # noqa: BLE001 — conf unavailable early
             dump_enabled = False
         it = self.internal_execute()
-        while True:
-            with annotate_op(name):
-                try:
-                    batch = next(it)
-                except StopIteration:
-                    return
-                except Exception:
-                    self._dump_failure_inputs(name)
-                    raise
-            batches.add(1)
-            if batch._host_rows is not None:
-                rows.add(batch._host_rows)
-            else:
-                rows.add_device(batch.num_rows)
-            if dump_enabled:
-                self._last_output = batch
-            yield batch
+        bus = obs_events.active_bus()
+        if bus is None:
+            # fast path: bit-identical to the pre-obs loop
+            while True:
+                with annotate_op(name):
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        return
+                    except Exception:
+                        self._dump_failure_inputs(name)
+                        raise
+                batches.add(1)
+                if batch._host_rows is not None:
+                    rows.add(batch._host_rows)
+                else:
+                    rows.add_device(batch.num_rows)
+                if dump_enabled:
+                    self._last_output = batch
+                yield batch
+        # instrumented path
+        bus.emit("op_open", op=name, op_id=self._op_id)
+        # snapshot so op_close reports THIS execution's rows, not the
+        # metric's lifetime total — bench reuses one plan object across
+        # iterations, and profile_report sums rows across closes
+        try:
+            rows_at_open = rows.value
+        except Exception:  # noqa: BLE001
+            rows_at_open = None
+        total_ns = 0
+        nbatches = 0
+        emit_batches = bus.level >= obs_events.DEBUG
+        try:
+            while True:
+                t0 = time.perf_counter_ns()
+                with annotate_op(name):
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        return
+                    except Exception:
+                        self._dump_failure_inputs(name)
+                        bus.emit("op_error", op=name, op_id=self._op_id)
+                        raise
+                step_ns = time.perf_counter_ns() - t0
+                total_ns += step_ns
+                nbatches += 1
+                batches.add(1)
+                if batch._host_rows is not None:
+                    rows.add(batch._host_rows)
+                else:
+                    rows.add_device(batch.num_rows)
+                if emit_batches:
+                    # device_size_bytes() walks the whole pytree — only
+                    # pay it when the DEBUG-level record will be kept
+                    bus.emit("op_batch", op=name, op_id=self._op_id,
+                             wall_ns=step_ns, rows=batch._host_rows,
+                             bytes=batch.device_size_bytes())
+                if dump_enabled:
+                    self._last_output = batch
+                yield batch
+        finally:
+            # reading the metric materializes pending device counts (one
+            # stacked transfer, query-end only); the open-snapshot delta
+            # makes op_close.rows per-execution, and on a fresh plan it
+            # reconciles exactly with last_query_metrics() totals
+            try:
+                out_rows = rows.value - rows_at_open \
+                    if rows_at_open is not None else None
+            except Exception:  # noqa: BLE001 — close is best-effort
+                out_rows = None
+            bus.emit("op_close", op=name, op_id=self._op_id,
+                     wall_ns=total_ns, batches=nbatches, rows=out_rows)
 
     #: most recent batch this operator yielded (= a child's view of its
     #: input); consumed by the failure dump below
@@ -227,13 +331,22 @@ class TpuExec:
     def node_description(self) -> str:
         return type(self).__name__
 
-    def all_metrics(self) -> Dict[str, int]:
+    def all_metrics(self, level: Optional[int] = None) -> Dict[str, int]:
+        """Flat per-operator metric values, filtered to entries at or
+        below `level` (None = the spark.rapids.sql.metrics.level conf) —
+        the reference's ESSENTIAL/MODERATE/DEBUG visibility cut
+        (GpuExec.scala:36-47). Pass DEBUG explicitly to see everything."""
+        if level is None:
+            level = metrics_level_from_conf()
         out = {}
-        def walk(node, path):
-            label = f"{type(node).__name__}"
+        def walk(node, path, label):
             for name, m in node.metrics.items():
-                out[f"{path}{label}.{name}"] = m.value
+                if m.level <= level:
+                    out[f"{path}{label}.{name}"] = m.value
             for i, c in enumerate(node.children):
-                walk(c, f"{path}{label}/")
-        walk(self, "")
+                # the child ordinal disambiguates same-class siblings
+                # (self-joins): without it both sides collide on one
+                # key and one side's metrics silently vanish
+                walk(c, f"{path}{label}/", f"{type(c).__name__}[{i}]")
+        walk(self, "", type(self).__name__)
         return out

@@ -18,7 +18,8 @@ from ..memory.retry import with_retry_no_split
 from ..memory.spillable import SpillableBatch
 from ..ops.basic import concat_columns, sanitize
 from ..types import Schema
-from .base import CONCAT_TIME, NUM_INPUT_BATCHES, NUM_INPUT_ROWS, TpuExec
+from .base import (CONCAT_TIME, DEBUG, NUM_INPUT_BATCHES,
+                   NUM_INPUT_ROWS, TpuExec)
 
 
 from functools import partial
@@ -73,7 +74,8 @@ class CoalesceBatchesExec(TpuExec):
         return self.child.output_schema
 
     def additional_metrics(self):
-        return (CONCAT_TIME, NUM_INPUT_ROWS, NUM_INPUT_BATCHES)
+        return (CONCAT_TIME, (NUM_INPUT_ROWS, DEBUG),
+                (NUM_INPUT_BATCHES, DEBUG))
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         in_rows = self.metrics[NUM_INPUT_ROWS]

@@ -32,12 +32,13 @@ from ..ops.strings import string_lengths
 from ..parallel.exchange import exchange_columns, partition_ids
 from ..parallel.mesh import DATA_AXIS, active_mesh, mesh_axis_size
 from ..types import Schema
-from .base import (NUM_INPUT_BATCHES, NUM_INPUT_ROWS, NUM_OUTPUT_BATCHES,
-                   NUM_OUTPUT_ROWS, OP_TIME, TpuExec)
+from ..obs import events as obs_events
+from .base import (BROADCAST_TIME, DEBUG, ESSENTIAL, NUM_INPUT_BATCHES,
+                   NUM_INPUT_ROWS, NUM_OUTPUT_BATCHES, NUM_OUTPUT_ROWS,
+                   OP_TIME, PARTITION_SIZE, SHUFFLE_READ_TIME,
+                   SHUFFLE_WRITE_TIME, TpuExec)
 from .basic import InMemoryScanExec, bind_projection
 from .coalesce import concat_batches
-
-PARTITION_SIZE = "dataSize"  # reference GpuShuffleExchangeExecBase metric
 
 
 def _squeeze0(tree):
@@ -73,7 +74,8 @@ class ShuffleExchangeExec(TpuExec):
         return self.child.output_schema
 
     def additional_metrics(self):
-        return (NUM_INPUT_BATCHES, NUM_INPUT_ROWS, PARTITION_SIZE)
+        return ((NUM_INPUT_BATCHES, DEBUG), (NUM_INPUT_ROWS, DEBUG),
+                (PARTITION_SIZE, ESSENTIAL))
 
     @property
     def n_partitions(self) -> int:
@@ -261,7 +263,11 @@ class ShuffleExchangeExec(TpuExec):
                 flush()
         flush()
         if self._part_totals is not None:
-            self.metrics[PARTITION_SIZE].add(int(self._part_totals.max()))
+            max_part = int(self._part_totals.max())
+            self.metrics[PARTITION_SIZE].add(max_part)
+            obs_events.emit("exchange", exec="ShuffleExchangeExec",
+                            op_id=self._op_id, partitions=self.n_partitions,
+                            rounds=self.rounds, max_partition_bytes=max_part)
         return staged
 
     def node_description(self):
@@ -312,8 +318,9 @@ class HostShuffleExchangeExec(TpuExec):
         return self.child.output_schema
 
     def additional_metrics(self):
-        return (NUM_INPUT_BATCHES, NUM_INPUT_ROWS, PARTITION_SIZE,
-                "shuffleWriteTime", "shuffleReadTime")
+        return ((NUM_INPUT_BATCHES, DEBUG), (NUM_INPUT_ROWS, DEBUG),
+                (PARTITION_SIZE, ESSENTIAL), SHUFFLE_WRITE_TIME,
+                SHUFFLE_READ_TIME)
 
     def _pid_kernel(self, batch: ColumnarBatch):
         keys = [e.columnar_eval(batch) for e in self._bound]
@@ -451,7 +458,7 @@ class HostShuffleExchangeExec(TpuExec):
                 in_rows.add(n)
                 # time only the shuffle work (partition/serialize/write),
                 # not the upstream compute driving child.execute()
-                with self.metrics["shuffleWriteTime"].ns_timer():
+                with self.metrics[SHUFFLE_WRITE_TIME].ns_timer():
                     pid = self._pid_for(b, n, bounds)
                     parts = partition_batch_host(b, pid, self.n_partitions)
                     writer = HostShuffleWriter(handle, map_id, mgr,
@@ -459,6 +466,12 @@ class HostShuffleExchangeExec(TpuExec):
                     writer.write([[p] if p.num_rows_host else []
                                   for p in parts])
                 self.metrics[PARTITION_SIZE].add(writer.bytes_written)
+                obs_events.emit("exchange",
+                                exec="HostShuffleExchangeExec",
+                                op_id=self._op_id, map_id=map_id,
+                                partitions=self.n_partitions,
+                                bytes=writer.bytes_written,
+                                partitioning=self.partitioning)
                 map_id += 1
             reader = HostShuffleReader(handle, mgr, self._conf)
             n = self.n_partitions
@@ -518,7 +531,7 @@ class HostShuffleExchangeExec(TpuExec):
 
     def _read_partition(self, reader, p: int) -> Iterator[ColumnarBatch]:
         saw = False
-        with self.metrics["shuffleReadTime"].ns_timer():
+        with self.metrics[SHUFFLE_READ_TIME].ns_timer():
             blocks = list(reader.read_partition(p))
         for b in blocks:
             saw = True
@@ -551,11 +564,11 @@ class BroadcastExchangeExec(TpuExec):
         return self.child.output_schema
 
     def additional_metrics(self):
-        return ("broadcastTime", PARTITION_SIZE)
+        return (BROADCAST_TIME, (PARTITION_SIZE, ESSENTIAL))
 
     def materialize(self) -> ColumnarBatch:
         if self._materialized is None:
-            with self.metrics["broadcastTime"].ns_timer():
+            with self.metrics[BROADCAST_TIME].ns_timer():
                 batches = list(self.child.execute())
                 if not batches:
                     self._materialized = empty_batch(self.output_schema)
@@ -564,8 +577,10 @@ class BroadcastExchangeExec(TpuExec):
                 else:
                     self._materialized = concat_batches(
                         batches, self.output_schema)
-            self.metrics[PARTITION_SIZE].add(
-                self._materialized.device_size_bytes())
+            size = self._materialized.device_size_bytes()
+            self.metrics[PARTITION_SIZE].add(size)
+            obs_events.emit("exchange", exec="BroadcastExchangeExec",
+                            op_id=self._op_id, bytes=size)
         return self._materialized
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:

@@ -38,7 +38,7 @@ from ..expr.core import (Alias, BoundReference, Expression, Literal,
 from ..types import (BooleanType, ByteType, DataType, DoubleType, FloatType,
                      IntegerType, LongType, Schema, ShortType, StringType,
                      StructField, TimestampType)
-from .base import NUM_INPUT_BATCHES, OP_TIME, TpuExec
+from .base import DEBUG, NUM_INPUT_BATCHES, OP_TIME, TpuExec
 
 _I64 = (1 << 64)
 
@@ -586,7 +586,7 @@ class RowToColumnarExec(TpuExec):
         return self._schema
 
     def additional_metrics(self):
-        return (NUM_INPUT_BATCHES,)
+        return ((NUM_INPUT_BATCHES, DEBUG),)
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         names = self._schema.names

@@ -20,7 +20,7 @@ from ..columnar.column import (ArrayColumn, Column, MapColumn,
 from ..expr.core import Expression, resolve
 from ..ops.basic import active_mask, compaction_order, gather_column
 from ..types import ArrayType, IntegerType, Schema, StructField
-from .base import NUM_INPUT_BATCHES, OP_TIME, TpuExec
+from .base import DEBUG, NUM_INPUT_BATCHES, OP_TIME, TpuExec
 
 
 class GenerateExec(TpuExec):
@@ -64,7 +64,7 @@ class GenerateExec(TpuExec):
         return Schema(tuple(fields))
 
     def additional_metrics(self):
-        return (NUM_INPUT_BATCHES,)
+        return ((NUM_INPUT_BATCHES, DEBUG),)
 
     def _measure_kernel(self, batch: ColumnarBatch):
         """Exact output payload need per variable-size payload column

@@ -36,7 +36,7 @@ from ..ops.join import (
     probe_counts, unmatched_indices, verify_pairs,
 )
 from ..types import BooleanType, Schema, StructField
-from .base import BUILD_TIME, JOIN_TIME, NUM_INPUT_BATCHES, TpuExec
+from .base import BUILD_TIME, DEBUG, JOIN_TIME, NUM_INPUT_BATCHES, TpuExec
 from .basic import bind_projection, eval_projection, projection_schema
 from .coalesce import concat_batches
 
@@ -210,7 +210,7 @@ class HashJoinExec(TpuExec):
         return Schema(tuple(lf + rf))
 
     def additional_metrics(self):
-        return (BUILD_TIME, JOIN_TIME, NUM_INPUT_BATCHES)
+        return (BUILD_TIME, JOIN_TIME, (NUM_INPUT_BATCHES, DEBUG))
 
     @property
     def output_grouped_by(self):

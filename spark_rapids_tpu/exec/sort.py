@@ -28,7 +28,7 @@ from ..ops.sort import (
     SortOrder, order_key_lanes, sort_batch_columns, string_words_for,
 )
 from ..types import Schema
-from .base import NUM_INPUT_BATCHES, SORT_TIME, TpuExec
+from .base import DEBUG, NUM_INPUT_BATCHES, SORT_TIME, TpuExec
 from .coalesce import concat_batches
 
 
@@ -80,7 +80,7 @@ class SortExec(TpuExec):
         return self.child.output_schema
 
     def additional_metrics(self):
-        return (SORT_TIME, NUM_INPUT_BATCHES)
+        return (SORT_TIME, (NUM_INPUT_BATCHES, DEBUG))
 
     def _string_words(self, batch: ColumnarBatch) -> int:
         return string_words_for(batch.columns,

@@ -145,6 +145,9 @@ class BufferCatalog:
         entry.device_tree = None
         entry.tier = StorageTier.HOST
         self.spilled_device_bytes += entry.nbytes
+        from ..obs import events as obs_events
+        obs_events.emit("spill", tier="device->host", bytes=entry.nbytes,
+                        priority=entry.priority)
 
     def _enforce_host_limit(self):
         limit = active_conf().get(HOST_SPILL_LIMIT)
@@ -166,6 +169,9 @@ class BufferCatalog:
         entry.disk_path = path
         entry.tier = StorageTier.DISK
         self.spilled_host_bytes += entry.nbytes
+        from ..obs import events as obs_events
+        obs_events.emit("spill", tier="host->disk", bytes=entry.nbytes,
+                        priority=entry.priority)
 
     def _unspill_locked(self, entry: _Entry):
         from .budget import memory_budget

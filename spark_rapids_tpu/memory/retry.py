@@ -188,11 +188,19 @@ def with_retry(input_item: T, fn: Callable[[T], R],
                         break
                     except TpuRetryOOM:
                         _state.retry_count += 1
+                        from ..obs import events as obs_events
+                        obs_events.emit("oom_retry", oom="retry",
+                                        attempt=attempts,
+                                        task_id=_state.task_id)
                         if attempts >= max_attempts:
                             raise
                         spill_for_retry()
                     except TpuSplitAndRetryOOM:
                         _state.split_retry_count += 1
+                        from ..obs import events as obs_events
+                        obs_events.emit("oom_retry", oom="split",
+                                        attempt=attempts,
+                                        task_id=_state.task_id)
                         if split_policy is None:
                             raise
                         halves = split_policy(item)

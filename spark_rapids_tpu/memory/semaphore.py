@@ -33,9 +33,13 @@ class TpuSemaphore:
                 return
         t0 = time.monotonic_ns()
         self._sem.acquire()
-        self.total_wait_ns += time.monotonic_ns() - t0
+        waited = time.monotonic_ns() - t0
+        self.total_wait_ns += waited
         with self._lock:
             self._holders[task_id] = self._holders.get(task_id, 0) + 1
+        from ..obs import events as obs_events
+        obs_events.emit("semaphore_acquire", task_id=task_id,
+                        wait_ns=waited)
 
     def release_if_necessary(self, task_id: int):
         with self._lock:

@@ -1,0 +1,21 @@
+"""Structured observability — the engine's analog of the reference's
+GpuMetric/GpuTaskMetrics/NVTX stack joined into one subsystem (ISSUE 2):
+
+  * `events` — process-wide JSONL event bus (query begin/end, operator
+    spans, semaphore waits, spills, OOM retries, Pallas tier decisions,
+    plan fallbacks, exchange volumes), gated by the
+    spark.rapids.tpu.eventLog.{enabled,dir,level} confs and costing one
+    pointer check per batch when disabled.
+  * `span` — op_span(): the NvtxWithMetrics analog — one context manager
+    that emits the xprof TraceAnnotation, bumps a TpuMetric, and appends
+    an event record.
+  * `profile` — QueryProfile: the executed plan tree annotated with
+    per-operator metrics, with text (explain-with-metrics) and JSON
+    renderers; surfaced as TpuSession.last_query_profile().
+
+Render an event-log file with tools/profile_report.py.
+"""
+
+from . import events  # noqa: F401
+from .profile import QueryProfile  # noqa: F401
+from .span import op_span  # noqa: F401

@@ -1,0 +1,222 @@
+"""Process-wide structured event bus (ISSUE 2 tentpole part 1).
+
+Reference analog: the GpuMetric stream merged into the Spark SQL UI plus
+the NVTX range timeline — here, one JSON-lines file per configured bus,
+each line a self-describing record:
+
+    {"ts_ns": ..., "kind": ..., "query": <id or null>, ...fields}
+
+Event kinds and their levels (spark.rapids.tpu.eventLog.level):
+
+  ESSENTIAL  query_start, query_end
+  MODERATE   op_close, semaphore_acquire, spill, oom_retry,
+             pallas_tier, plan_fallback, plan_not_on_tpu, exchange,
+             op_error
+  DEBUG      op_open, op_batch, span
+
+Cost discipline: `active_bus()` returns None when logging is disabled —
+every producer guards with one pointer check, so the steady-state batch
+loop pays nothing (acceptance: per-batch overhead not measurable in the
+kern/bench timings). When enabled, writes are line-buffered behind a
+lock and flushed per record so a crashed query still leaves a parseable
+log.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional
+
+ESSENTIAL = 0
+MODERATE = 1
+DEBUG = 2
+
+_LEVEL_NAMES = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE, "DEBUG": DEBUG}
+
+#: event kind -> minimum eventLog.level at which it is written
+EVENT_LEVELS: Dict[str, int] = {
+    "query_start": ESSENTIAL,
+    "query_end": ESSENTIAL,
+    "op_close": MODERATE,
+    "op_error": MODERATE,
+    "semaphore_acquire": MODERATE,
+    "spill": MODERATE,
+    "oom_retry": MODERATE,
+    "pallas_tier": MODERATE,
+    "plan_fallback": MODERATE,
+    "plan_not_on_tpu": MODERATE,
+    "exchange": MODERATE,
+    "op_open": DEBUG,
+    "op_batch": DEBUG,
+    "span": DEBUG,
+}
+
+DEFAULT_DIR = "/tmp/spark_rapids_tpu_events"
+
+
+def parse_level(name: str, default: int = MODERATE) -> int:
+    return _LEVEL_NAMES.get(str(name).strip().upper(), default)
+
+
+class EventBus:
+    """Append-only JSONL sink. The file is created lazily on the first
+    record, so an enabled-but-silent process leaves no empty files."""
+
+    _seq = 0
+    _seq_lock = threading.Lock()
+
+    def __init__(self, directory: str, level: int = MODERATE):
+        self.directory = directory or DEFAULT_DIR
+        self.level = level
+        with EventBus._seq_lock:
+            EventBus._seq += 1
+            seq = EventBus._seq
+        self.path = os.path.join(
+            self.directory, f"events-{os.getpid()}-{seq}.jsonl")
+        self._lock = threading.Lock()
+        self._file = None
+        self._closed = False
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        if self._closed or EVENT_LEVELS.get(kind, MODERATE) > self.level:
+            return
+        rec = {"ts_ns": time.time_ns(), "kind": kind,
+               "query": current_query_id()}
+        rec.update(fields)
+        try:
+            line = json.dumps(rec, separators=(",", ":"), default=str)
+            with self._lock:
+                if self._closed:
+                    return
+                if self._file is None:
+                    os.makedirs(self.directory, exist_ok=True)
+                    self._file = open(self.path, "a")
+                self._file.write(line + "\n")
+                self._file.flush()
+        except Exception as e:  # noqa: BLE001 — emit runs inside
+            # operator/collect finally blocks: an unwritable event log
+            # must never fail a query or mask its real exception. One
+            # warning, then the bus stays down.
+            import logging
+            logging.getLogger("spark_rapids_tpu.obs").warning(
+                "event log disabled: cannot write %s (%s: %s)",
+                self.path, type(e).__name__, e)
+            self.close()
+            _deactivate(self)  # producers drop back to the fast path
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+_bus: Optional[EventBus] = None
+_bus_lock = threading.Lock()
+
+
+def active_bus() -> Optional[EventBus]:
+    """The configured bus, or None when event logging is disabled. Hot
+    paths call this once and guard on None — the entire disabled-mode
+    cost."""
+    return _bus
+
+
+def emit(kind: str, **fields: Any) -> None:
+    """Emit one event if logging is enabled (cold-path convenience)."""
+    b = _bus
+    if b is not None:
+        b.emit(kind, **fields)
+
+
+def _deactivate(bus: EventBus) -> None:
+    """Uninstall `bus` if it is still the active one (write-failure
+    self-removal: a dead bus must not keep producers instrumented)."""
+    global _bus
+    with _bus_lock:
+        if _bus is bus:
+            _bus = None
+
+
+def configure(conf=None) -> Optional[EventBus]:
+    """(Re)configure the process bus from a RapidsConf (None = the
+    thread's active conf). The bus is PROCESS-wide, like a Spark event
+    log: a conf that leaves eventLog.enabled unset keeps whatever bus
+    another session enabled (a default-conf session must not fragment
+    someone else's log); an EXPLICIT enabled=false tears it down. An
+    enabled conf with unchanged dir+level keeps the current file open
+    rather than starting a new one per query."""
+    global _bus
+    from ..config import (EVENT_LOG_DIR, EVENT_LOG_ENABLED, EVENT_LOG_LEVEL,
+                          active_conf)
+    conf = conf if conf is not None else active_conf()
+    enabled = conf.get(EVENT_LOG_ENABLED)
+    with _bus_lock:
+        if not enabled:
+            if EVENT_LOG_ENABLED.key in conf._settings \
+                    and _bus is not None:
+                _bus.close()
+                _bus = None
+            return _bus
+        directory = conf.get(EVENT_LOG_DIR) or DEFAULT_DIR
+        level = parse_level(conf.get(EVENT_LOG_LEVEL))
+        if _bus is not None and _bus.directory == directory \
+                and _bus.level == level:
+            return _bus
+        if _bus is not None:
+            _bus.close()
+        _bus = EventBus(directory, level)
+        return _bus
+
+
+def enable(directory: str, level: str = "MODERATE") -> EventBus:
+    """Conf-free switch-on (bench / tooling entry)."""
+    global _bus
+    with _bus_lock:
+        if _bus is not None:
+            _bus.close()
+        _bus = EventBus(directory, parse_level(level))
+        return _bus
+
+
+def reset_event_bus() -> None:
+    """Tear down the bus (test isolation)."""
+    global _bus
+    with _bus_lock:
+        if _bus is not None:
+            _bus.close()
+        _bus = None
+
+
+# -- query attribution ------------------------------------------------------
+
+_qlocal = threading.local()
+_query_counter = 0
+_query_counter_lock = threading.Lock()
+
+
+def current_query_id() -> Optional[int]:
+    return getattr(_qlocal, "qid", None)
+
+
+@contextlib.contextmanager
+def query_scope(qid: Optional[int] = None) -> Iterator[int]:
+    """Attribute every event emitted by this thread inside the body to
+    one query id (fresh monotonic id when not given). Nests: an inner
+    scope shadows and restores."""
+    global _query_counter
+    if qid is None:
+        with _query_counter_lock:
+            _query_counter += 1
+            qid = _query_counter
+    prev = getattr(_qlocal, "qid", None)
+    _qlocal.qid = qid
+    try:
+        yield qid
+    finally:
+        _qlocal.qid = prev

@@ -1,0 +1,155 @@
+"""Per-query profile surface (ISSUE 2 tentpole part 3) — the executed
+TpuExec tree annotated with its metric registries, the standalone analog
+of the reference's Spark-SQL-UI plan graph with GpuMetrics merged in.
+
+`QueryProfile` is built by `DataFrame.collect()` (session surface:
+`TpuSession.last_query_profile()`) from the executed plan root plus the
+task-metrics summary. Metric visibility honors
+spark.rapids.sql.metrics.level exactly like `TpuExec.all_metrics()`
+(reference GpuExec.scala:36-47): DEBUG metrics only appear when asked
+for.
+
+Reading a metric value materializes its pending device scalars (one
+stacked d2h transfer per operator) — profiles are built at query end,
+never in the batch loop.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def metrics_level(conf=None) -> int:
+    """spark.rapids.sql.metrics.level as an int level (the one
+    implementation lives at exec.base.metrics_level_from_conf)."""
+    from ..exec.base import metrics_level_from_conf
+    return metrics_level_from_conf(conf)
+
+
+def _fmt_ns(ns: int) -> str:
+    if ns < 1_000:
+        return f"{ns}ns"
+    if ns < 1_000_000:
+        return f"{ns / 1_000:.1f}us"
+    if ns < 1_000_000_000:
+        return f"{ns / 1_000_000:.1f}ms"
+    return f"{ns / 1_000_000_000:.2f}s"
+
+
+def _fmt_bytes(b: int) -> str:
+    if b < (1 << 10):
+        return f"{b}B"
+    if b < (1 << 20):
+        return f"{b / (1 << 10):.1f}KB"
+    if b < (1 << 30):
+        return f"{b / (1 << 20):.1f}MB"
+    return f"{b / (1 << 30):.2f}GB"
+
+
+def _fmt_metric(name: str, value: int) -> str:
+    if name.endswith(("Time", "TimeNs")) or name.endswith("WaitNs"):
+        return _fmt_ns(value)
+    if name.endswith(("Bytes", "Size")) or name == "dataSize":
+        return _fmt_bytes(value)
+    return str(value)
+
+
+def _node(op, level: int) -> Dict[str, Any]:
+    return {
+        "op": type(op).__name__,
+        "op_id": getattr(op, "_op_id", None),
+        "desc": op.node_description(),
+        "metrics": {name: m.value for name, m in op.metrics.items()
+                    if m.level <= level},
+        "children": [_node(c, level) for c in op.children],
+    }
+
+
+class QueryProfile:
+    """Executed-plan profile: `.tree` (nested dict), `.summary` (the
+    per-query task-metrics roll-up), `.text()` (explain-with-metrics)
+    and `.to_json()` renderers."""
+
+    def __init__(self, root, summary: Optional[Dict[str, int]] = None,
+                 level: Optional[int] = None):
+        level = metrics_level() if level is None else level
+        self.tree = _node(root, level)
+        self.summary = dict(summary or {})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"summary": self.summary, "plan": self.tree}
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.to_dict(), indent=indent, default=str)
+
+    def text(self) -> str:
+        """Spark-SQL-UI-style explain with metrics inlined per node."""
+        lines: List[str] = ["== TPU Query Profile =="]
+        task_keys = [k for k in ("semWaitTimeNs", "retryCount",
+                                 "splitAndRetryCount", "spilledDeviceBytes",
+                                 "spilledHostBytes") if k in self.summary]
+        if task_keys:
+            parts = []
+            for k in task_keys:
+                v = self.summary[k]
+                parts.append(f"{k}={_fmt_ns(v)}" if k.endswith("Ns")
+                             else f"{k}={_fmt_bytes(v)}" if
+                             k.endswith("Bytes") else f"{k}={v}")
+            lines.append("task: " + " ".join(parts))
+
+        def walk(node: Dict[str, Any], indent: int):
+            lines.append("  " * indent + node["desc"])
+            if node["metrics"]:
+                body = ", ".join(
+                    f"{n}: {_fmt_metric(n, v)}"
+                    for n, v in sorted(node["metrics"].items()))
+                lines.append("  " * indent + f"  + {body}")
+            for c in node["children"]:
+                walk(c, indent + 1)
+
+        walk(self.tree, 0)
+        return "\n".join(lines)
+
+    def top_operators(self, n: int = 5,
+                      by: str = "time") -> List[Dict[str, Any]]:
+        """Top-N operator rows. by="time" (default) ranks by the sum of
+        the node's *Time metrics — operators time their own work in
+        per-op metrics (computeAggTime, joinTime, ...), so opTime alone
+        under-ranks them; any explicit metric name ranks by that."""
+        rows: List[Dict[str, Any]] = []
+
+        def walk(node):
+            m = node["metrics"]
+            time_ns = sum(v for k, v in m.items() if k.endswith("Time"))
+            rows.append({"op": node["op"], "op_id": node["op_id"],
+                         "time_ns": time_ns,
+                         "rows": m.get("numOutputRows", 0),
+                         "batches": m.get("numOutputBatches", 0),
+                         "rank_key": time_ns if by == "time"
+                         else m.get(by, 0)})
+            for c in node["children"]:
+                walk(c)
+
+        walk(self.tree)
+        rows.sort(key=lambda r: (-r["rank_key"], r["op"],
+                                 r["op_id"] if r["op_id"] is not None
+                                 else -1))
+        for r in rows:
+            r.pop("rank_key", None)
+        return rows[:n]
+
+
+def bench_profile_summary(root, before: Optional[Dict[str, int]] = None,
+                          top: int = 5) -> Dict[str, Any]:
+    """Compact per-query attribution for a BENCH record: the
+    task-metrics summary plus the top-N operators by opTime (ISSUE 2
+    satellite: BENCH deltas stop being single scalar GB/s numbers)."""
+    from ..exec.task_metrics import query_summary
+    summary = query_summary(root, before)
+    prof = QueryProfile(root, summary)
+    return {
+        "query_metrics": {k: v for k, v in summary.items()
+                          if not k.startswith("ops.")},
+        "top_ops": prof.top_operators(top),
+    }

@@ -1,0 +1,44 @@
+"""op_span — the NvtxWithMetrics analog (reference NvtxWithMetrics.scala:
+one object that IS both the NVTX range and the metric scope).
+
+One context manager:
+  * opens a jax.profiler.TraceAnnotation so xprof timelines show the
+    engine-level name over the XLA ops it launched,
+  * times the body with perf_counter_ns and adds the elapsed ns to an
+    optional TpuMetric,
+  * appends a `span` event record (DEBUG level) to the event bus when
+    logging is enabled.
+
+Timing and metric accumulation happen even when the body raises — a
+failed span's time is exactly what an operator debugging it wants
+attributed (same try/finally discipline as TpuMetric.ns_timer).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterator, Optional
+
+from ..utils.tracing import annotate_op
+from . import events
+
+
+@contextlib.contextmanager
+def op_span(name: str, metric=None, kind: str = "span",
+            **fields: Any) -> Iterator[None]:
+    bus = events.active_bus()
+    t0 = time.perf_counter_ns()
+    ok = True
+    try:
+        with annotate_op(name):
+            yield
+    except BaseException:
+        ok = False
+        raise
+    finally:
+        dt = time.perf_counter_ns() - t0
+        if metric is not None:
+            metric.add(dt)
+        if bus is not None:
+            bus.emit(kind, op=name, wall_ns=dt, ok=ok, **fields)

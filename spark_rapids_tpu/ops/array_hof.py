@@ -20,7 +20,12 @@ import jax.numpy as jnp
 from ..columnar.column import ArrayColumn, Column, StringColumn
 from ..types import BOOLEAN, ArrayType
 
-_BIG = jnp.int32(1 << 30)
+# plain Python int, NOT a jnp constant: this module is imported
+# lazily, sometimes inside a jit trace, and a traced-time jnp
+# constant stored in a module global leaks the tracer into every
+# later trace (UnexpectedTracerError). Weak promotion keeps the
+# int32 arithmetic identical.
+_BIG = 1 << 30
 
 
 class _ElemBatch:

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..columnar.column import Column, StringColumn
 from ..types import DATE, INT, LONG, TIMESTAMP
@@ -44,12 +45,15 @@ def _is_leap(y):
     return ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
 
 
-_DAYS_IN_MONTH = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
-                             jnp.int32)
+# numpy, NOT jnp: a module-level jnp constant created while a jit trace
+# is active (lazy import inside a traced function) would store a tracer
+# in this global and poison every later trace (UnexpectedTracerError)
+_DAYS_IN_MONTH = np.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                            np.int32)
 
 
 def days_in_month(y, m):
-    base = _DAYS_IN_MONTH[jnp.clip(m - 1, 0, 11)]
+    base = jnp.asarray(_DAYS_IN_MONTH)[jnp.clip(m - 1, 0, 11)]
     return jnp.where((m == 2) & _is_leap(y), 29, base)
 
 

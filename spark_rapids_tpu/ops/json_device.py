@@ -37,7 +37,12 @@ from ..columnar.column import Column, StringColumn, bucket_capacity
 from .strings import (_rebuild_offsets, _row_of_byte, seg_incl_cumsum,
                       string_lengths)
 
-_BIG = jnp.int32(1 << 30)
+# plain Python int, NOT a jnp constant: this module is imported
+# lazily, sometimes inside a jit trace, and a traced-time jnp
+# constant stored in a module global leaks the tracer into every
+# later trace (UnexpectedTracerError). Weak promotion keeps the
+# int32 arithmetic identical.
+_BIG = 1 << 30
 _WS = (0x20, 0x09, 0x0A, 0x0D)
 
 

@@ -18,7 +18,12 @@ from ..columnar.column import (ArrayColumn, Column, MapColumn,
                                StringColumn, bucket_capacity)
 from ..types import ArrayType, MapType
 
-_BIG = jnp.int32(1 << 30)
+# plain Python int, NOT a jnp constant: this module is imported
+# lazily, sometimes inside a jit trace, and a traced-time jnp
+# constant stored in a module global leaks the tracer into every
+# later trace (UnexpectedTracerError). Weak promotion keeps the
+# int32 arithmetic identical.
+_BIG = 1 << 30
 
 
 def _entry_rows(m: MapColumn):

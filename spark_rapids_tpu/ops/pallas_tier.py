@@ -118,6 +118,19 @@ def family_may_engage(family: str) -> bool:
     return False
 
 
+def _emit_decision(family: str, shape, mode: str, engaged: bool,
+                   reason: str) -> None:
+    """pallas_tier event (obs/events.py): trace-time decisions land in
+    the query event log so a BENCH delta can be attributed to a tier
+    flip, not guessed at. One pointer check when logging is off."""
+    from ..obs import events as obs_events
+    if obs_events.active_bus() is None:
+        return
+    obs_events.emit("pallas_tier", family=family,
+                    bucket=list(shape_bucket(shape)), mode=mode,
+                    engaged=engaged, reason=reason)
+
+
 def fused_tier_enabled(family: str, shape) -> bool:
     """Should `family` use its fused Pallas kernel for `shape`?
 
@@ -129,13 +142,22 @@ def fused_tier_enabled(family: str, shape) -> bool:
     from ..config import PALLAS_FUSED_TIER, active_conf
     mode = normalize_mode(active_conf().get(PALLAS_FUSED_TIER))
     if mode == "off":
+        _emit_decision(family, shape, mode, False, "forced off")
         return False
     if mode == "on":
+        _emit_decision(family, shape, mode, True, "forced on")
         return True
     rec = bench_record(family, shape)
     if not rec:
+        _emit_decision(family, shape, mode, False,
+                       "no recorded measurement")
         return False
     try:
-        return float(rec["pallas_ms"]) < float(rec["xla_ms"])
+        engaged = float(rec["pallas_ms"]) < float(rec["xla_ms"])
+        _emit_decision(family, shape, mode, engaged,
+                       f"measured pallas_ms={rec['pallas_ms']} vs "
+                       f"xla_ms={rec['xla_ms']}")
+        return engaged
     except (KeyError, TypeError, ValueError):
+        _emit_decision(family, shape, mode, False, "unreadable record")
         return False

@@ -21,7 +21,12 @@ from .strings import (_rebuild_offsets, _row_of_byte, _substring_gather,
                       seg_incl_cumsum as _seg_incl_cumsum,
                       select_literal_hits, string_lengths)
 
-_BIG = jnp.int32(1 << 30)
+# plain Python int, NOT a jnp constant: this module is imported
+# lazily, sometimes inside a jit trace, and a traced-time jnp
+# constant stored in a module global leaks the tracer into every
+# later trace (UnexpectedTracerError). Weak promotion keeps the
+# int32 arithmetic identical.
+_BIG = 1 << 30
 
 
 def find_in_set(needle: StringColumn, sets: StringColumn) -> Column:

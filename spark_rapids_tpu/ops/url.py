@@ -31,7 +31,12 @@ from ..columnar.column import StringColumn, bucket_capacity
 from ..types import STRING
 from .strings import _rebuild_offsets, _row_of_byte, string_lengths
 
-_BIG = jnp.int32(1 << 30)
+# plain Python int, NOT a jnp constant: this module is imported
+# lazily, sometimes inside a jit trace, and a traced-time jnp
+# constant stored in a module global leaks the tracer into every
+# later trace (UnexpectedTracerError). Weak promotion keeps the
+# int32 arithmetic identical.
+_BIG = 1 << 30
 
 
 def _u8(ch):

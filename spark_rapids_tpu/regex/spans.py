@@ -32,7 +32,12 @@ from ..types import STRING
 from .parser import (Alt, Empty, Group, Lit, Node, RegexUnsupported, Seq,
                      Star, parse_regex)
 
-_BIG = jnp.int32(1 << 30)
+# plain Python int, NOT a jnp constant: this module is imported
+# lazily, sometimes inside a jit trace, and a traced-time jnp
+# constant stored in a module global leaks the tracer into every
+# later trace (UnexpectedTracerError). Weak promotion keeps the
+# int32 arithmetic identical.
+_BIG = 1 << 30
 
 
 # -- pattern analysis -------------------------------------------------------

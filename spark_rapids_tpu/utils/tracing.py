@@ -2,12 +2,18 @@
 the NVTX ranges + profiler integration in GpuExec/RapidsConf
 spark.rapids.profile.*; SURVEY §5).
 
-Two layers:
+Three layers:
   * `annotate_op(name)` — a jax.profiler.TraceAnnotation around each
     operator's per-batch device work, so xprof timelines show
     engine-level operator names (ProjectExec, AggregateExec, ...) over
     the XLA ops they launched — the TPU equivalent of the reference's
     NVTX ranges in Nsight.
+  * `op_span(name, metric=None, ...)` (re-exported from obs/span.py) —
+    the NvtxWithMetrics analog: the same TraceAnnotation plus TpuMetric
+    ns accumulation plus a structured event record when the
+    spark.rapids.tpu.eventLog confs are on. New metric-scoped call
+    sites should use this instead of pairing annotate_op with
+    ns_timer by hand.
   * `profile_trace(out_dir)` — capture a full profiler trace of a code
     region to `out_dir` for TensorBoard/xprof, gated by
     spark.rapids.tpu.profile.enabled + .dir so production configs can
@@ -18,6 +24,15 @@ from __future__ import annotations
 
 import contextlib
 from typing import Iterator, Optional
+
+
+def __getattr__(name: str):
+    # lazy: obs.span imports annotate_op from here, so the re-export
+    # cannot be a top-level import
+    if name == "op_span":
+        from ..obs.span import op_span
+        return op_span
+    raise AttributeError(name)
 
 
 @contextlib.contextmanager

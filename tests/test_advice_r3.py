@@ -48,6 +48,7 @@ def test_element_at_expression_key_on_map():
 # --- r3 #3: distributed (partial->exchange->final) decimal sums must agree
 # with the single-stage plan on VALUE and RESULT TYPE (Spark: p+10 capped)
 @needs_8
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_decimal_sum_result_type_matches_across_tiers():
     t = DecimalType(7, 2)
     vals = [dec.Decimal(f"{x}.25") for x in range(50)] + [None]
@@ -70,6 +71,7 @@ def test_decimal_sum_result_type_matches_across_tiers():
 
 # --- r3 #4: sub-partition count k must key off the side that is BUILT
 # (right, for non-swappable joins), not min(sizes)
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_adaptive_k_uses_build_side_for_nonswappable():
     sess = TpuSession(conf={
         "spark.rapids.sql.broadcastSizeThreshold": "1",

@@ -27,6 +27,7 @@ def _group_sums(keys, vals, dtype):
     return dict(zip(ks, np.asarray(data)[:ng].tolist()))
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_float_sum_not_prefix_differenced():
     # ADVICE r4 high: a tiny group sorted after huge groups must not lose
     # its sum to global-cumsum cancellation. Group 0: 1e12-scale; group 1:
@@ -38,6 +39,7 @@ def test_float_sum_not_prefix_differenced():
     assert got[0] == pytest.approx(200e12, rel=1e-12)
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_int_sum_prefix_tier_exact():
     # integer sums stay on the cumsum-difference tier and are exact
     rng = np.random.default_rng(7)

@@ -1,0 +1,109 @@
+"""Docs/registry drift lint (ISSUE 2 satellite): every conf key the
+code uses resolves to the registry and is documented in docs/configs.md
+(unless internal), and every additional_metrics() name is canonical and
+unique — one name, one meaning, across the exec tree (reference
+GpuMetric companion discipline)."""
+
+import ast
+import importlib
+import re
+from pathlib import Path
+
+import pytest
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.exec import base as exec_base
+
+ROOT = Path(__file__).resolve().parents[1]
+
+_KEY_RE = re.compile(r"spark\.rapids\.[A-Za-z0-9_.]+$")
+
+
+def _full_key_literals(path: Path):
+    """String literals that ARE a conf key (the whole literal matches),
+    with the AST position of each — f-strings/doc prose don't count."""
+    tree = ast.parse(path.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and _KEY_RE.fullmatch(node.value.strip()):
+            yield node.value.strip(), node.lineno
+
+
+def _source_files():
+    yield from sorted((ROOT / "spark_rapids_tpu").rglob("*.py"))
+    yield from sorted((ROOT / "tools").glob("*.py"))
+    yield ROOT / "bench.py"
+
+
+def test_conf_keys_in_code_are_registered_and_documented():
+    docs = (ROOT / "docs" / "configs.md").read_text()
+    problems = []
+    for path in _source_files():
+        for key, lineno in _full_key_literals(path):
+            where = f"{path.relative_to(ROOT)}:{lineno}"
+            entry = cfg._REGISTRY.get(key)
+            if entry is None:
+                if key.startswith(cfg.RapidsConf._DYNAMIC_PREFIXES):
+                    continue
+                problems.append(f"{where}: {key} not in the config "
+                                "registry")
+                continue
+            if not entry.internal and f"`{key}`" not in docs:
+                problems.append(f"{where}: {key} missing from "
+                                "docs/configs.md — run tools/gen_docs.py")
+    assert not problems, "\n".join(problems)
+
+
+def test_registry_docs_are_current():
+    """docs/configs.md is exactly what generate_docs() renders — a
+    stale file fails here, not in review."""
+    assert (ROOT / "docs" / "configs.md").read_text() \
+        == cfg.generate_docs(), "run tools/gen_docs.py"
+
+
+def _all_exec_classes():
+    pkg_dir = ROOT / "spark_rapids_tpu" / "exec"
+    for py in sorted(pkg_dir.glob("*.py")):
+        importlib.import_module(f"spark_rapids_tpu.exec.{py.stem}")
+
+    def subclasses(cls):
+        for c in cls.__subclasses__():
+            yield c
+            yield from subclasses(c)
+
+    return sorted(set(subclasses(exec_base.TpuExec)),
+                  key=lambda c: c.__name__)
+
+
+def test_additional_metrics_are_canonical_and_unique():
+    classes = _all_exec_classes()
+    assert len(classes) >= 20  # the walk actually found the exec tree
+    problems = []
+    valid_levels = {exec_base.ESSENTIAL, exec_base.MODERATE,
+                    exec_base.DEBUG}
+    for cls in classes:
+        try:
+            # the contract this lint enforces includes additional_metrics
+            # being a static declaration (no self state)
+            specs = list(cls.additional_metrics(None))
+        except Exception as e:  # noqa: BLE001
+            problems.append(f"{cls.__name__}.additional_metrics must be "
+                            f"self-independent (got {type(e).__name__})")
+            continue
+        names = []
+        for spec in specs:
+            name, level = spec if isinstance(spec, tuple) \
+                else (spec, exec_base.MODERATE)
+            names.append(name)
+            if name not in exec_base.CANONICAL_METRICS:
+                problems.append(
+                    f"{cls.__name__}: metric {name!r} is not canonical — "
+                    "add it to exec.base.CANONICAL_METRICS or reuse an "
+                    "existing name")
+            if level not in valid_levels:
+                problems.append(f"{cls.__name__}: metric {name!r} has "
+                                f"invalid level {level!r}")
+        if len(names) != len(set(names)):
+            problems.append(f"{cls.__name__}: duplicate metric names "
+                            f"{names}")
+    assert not problems, "\n".join(problems)

@@ -168,6 +168,7 @@ def test_stddev():
     assert math.isnan(got["b"])  # n==1 -> NaN
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_partial_final_split():
     """partial -> (simulated shuffle) -> final gives same answer."""
     partial = AggregateExec([col("k")], [(Sum(col("v")), "s"),
@@ -199,6 +200,7 @@ def test_first_last_after_sort():
     assert got["c"] == (2, 6)
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_out_of_core_sort_streams_bounded_chunks():
     """>MERGE_FAN_IN runs: the streamed merge must emit multiple bounded
     batches whose concatenation is exactly the global sort (reference

@@ -118,6 +118,7 @@ def test_computed_alias_reusing_key_name_drops_hint():
         assert abs(got[k][0] - exp[k][0]) <= 1e-9 * max(abs(exp[k][0]), 1)
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_duplicate_output_names_cannot_reach_the_hint():
     """Both join key columns named 'k': the duplicate-name hazard the
     hint guards against (out_names.count(n) == 1 in joins.py) cannot
@@ -155,6 +156,7 @@ def test_duplicate_output_names_cannot_reach_the_hint():
         assert abs(got[k][0] - exp[k][0]) <= 1e-9 * max(abs(exp[k][0]), 1)
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_subset_of_keys_grouping_skips_sort_skip_but_stays_correct():
     """Two-key join emits (lk,lk2)-tuple-grouped batches; grouping by lk
     ALONE must not claim pre_grouped (tuple contiguity does not give
@@ -177,6 +179,7 @@ def test_subset_of_keys_grouping_skips_sort_skip_but_stays_correct():
     _check(full, l, r, ["lk", "lk2"], one_key_join=False)
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_bare_rename_keeps_hint_through_projection():
     """SELECT lk AS g, lk, v: the grouping class maps to {g, lk}; a
     group-by on the rename keeps the sort-skip tier and stays correct."""

@@ -72,6 +72,7 @@ def test_low_cardinality():
     _check(keys, vals)
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_high_cardinality_falls_back_exact():
     # cardinality >> bucketSlots * bucketRounds: fast path must flag and
     # the plan re-run must still be exact

@@ -1,0 +1,149 @@
+"""Query-profile surface (ISSUE 2 tentpole part 3): the golden text
+renderer, the session last_query_profile() API, and the
+spark.rapids.sql.metrics.level visibility cut (satellite: DEBUG metrics
+stay out of summaries by default, reference GpuExec.scala:36-47)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.basic import FilterExec, InMemoryScanExec
+from spark_rapids_tpu.expr.aggexprs import Count, Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.obs.profile import QueryProfile
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, Schema, StructField
+
+
+def _session_query(sess, n=3000):
+    rng = np.random.default_rng(0)
+    schema = Schema((StructField("k", INT), StructField("q", LONG),
+                     StructField("p", DOUBLE)))
+    df = sess.from_pydict({"k": rng.integers(0, 6, n).tolist(),
+                           "q": rng.integers(1, 50, n).tolist(),
+                           "p": (rng.random(n) * 10).tolist()}, schema)
+    return (df.filter(col("q") <= lit(40))
+              .group_by("k").agg((Sum(col("p")), "s"), (Count(), "c")))
+
+
+def test_text_renderer_golden():
+    """Exact explain-with-metrics output for a hand-built tree with
+    pinned metric values — the renderer's format is a surface other
+    tooling greps, so it is golden-tested."""
+    schema = Schema((StructField("x", LONG),))
+    batch = ColumnarBatch.from_pydict({"x": [1, 2, 3]}, schema)
+    scan = InMemoryScanExec([batch], schema)
+    filt = FilterExec(col("x") > lit(1), scan)
+    filt.metrics["numOutputRows"].value = 2
+    filt.metrics["numOutputBatches"].value = 1
+    filt.metrics["opTime"].value = 2_000_000
+    scan.metrics["numOutputRows"].value = 3
+    scan.metrics["numOutputBatches"].value = 1
+    scan.metrics["opTime"].value = 1_500
+    prof = QueryProfile(filt, {"semWaitTimeNs": 1_000, "retryCount": 1,
+                               "spilledDeviceBytes": 2048})
+    expected = """== TPU Query Profile ==
+task: semWaitTimeNs=1.0us retryCount=1 spilledDeviceBytes=2.0KB
+FilterExec[(col('x') > lit(1))]
+  + numOutputBatches: 1, numOutputRows: 2, opTime: 2.0ms
+  InMemoryScanExec
+    + numOutputBatches: 1, numOutputRows: 3, opTime: 1.5us"""
+    assert prof.text() == expected
+    # the JSON renderer round-trips the same tree
+    doc = json.loads(prof.to_json())
+    assert doc["plan"]["op"] == "FilterExec"
+    assert doc["plan"]["children"][0]["metrics"]["numOutputRows"] == 3
+    assert doc["summary"]["retryCount"] == 1
+
+
+def test_session_profile_surface():
+    sess = TpuSession()
+    assert sess.last_query_profile() is None
+    rows = _session_query(sess).collect()
+    prof = sess.last_query_profile()
+    assert prof is not None
+    text = prof.text()
+    assert "AggregateExec" in text and "numOutputRows" in text
+    top = prof.top_operators(3)
+    assert top and top[0]["time_ns"] >= top[-1]["time_ns"]
+    assert {"op", "op_id", "rows", "batches"} <= set(top[0])
+    # tree totals agree with the metric roll-up surface
+    m = sess.last_query_metrics()
+    agg_rows = [n for n in _walk(prof.tree) if n["op"] == "AggregateExec"]
+    assert agg_rows[0]["metrics"]["numOutputRows"] == len(rows)
+    assert m["total.numOutputRows"] >= len(rows)
+
+
+def _walk(node):
+    yield node
+    for c in node["children"]:
+        yield from _walk(c)
+
+
+def test_metrics_level_filters_summaries():
+    """satellite: spark.rapids.sql.metrics.level gates all_metrics() /
+    last_query_metrics(). DEBUG shows per-op input counts, MODERATE
+    (default) hides them, ESSENTIAL trims to row/batch counts."""
+    sess = TpuSession()
+    q = _session_query(sess)
+    q.collect()
+    m = sess.last_query_metrics()
+    assert "total.computeAggTime" in m          # MODERATE visible
+    assert not any(k.endswith(".numInputRows") for k in m)  # DEBUG hidden
+
+    sess_dbg = TpuSession({"spark.rapids.sql.metrics.level": "DEBUG"})
+    _session_query(sess_dbg).collect()
+    m_dbg = sess_dbg.last_query_metrics()
+    assert any(k.endswith(".numInputRows") for k in m_dbg)
+    assert "total.numInputBatches" in m_dbg
+
+    # ESSENTIAL: metric KEYS are the cut, so the conversion alone (no
+    # re-execution/compile) exercises both the conf-driven and the
+    # explicit-level paths
+    from spark_rapids_tpu.config import RapidsConf, set_active_conf
+    from spark_rapids_tpu.exec.base import DEBUG as DBG, ESSENTIAL as ESS
+    plan = q._exec()
+    try:
+        set_active_conf(RapidsConf(
+            {"spark.rapids.sql.metrics.level": "ESSENTIAL"}))
+        m_ess = plan.all_metrics()              # conf-driven cut
+        assert any(k.endswith(".numOutputRows") for k in m_ess)
+        assert not any(k.endswith((".computeAggTime", ".opTime"))
+                       for k in m_ess)
+        # explicit level overrides the conf
+        assert any(k.endswith(".numInputRows")
+                   for k in plan.all_metrics(level=DBG))
+        assert all(k.endswith((".numOutputRows", ".numOutputBatches",
+                               ".dataSize"))
+                   for k in plan.all_metrics(level=ESS))
+    finally:
+        set_active_conf(sess.conf)
+
+
+def test_sibling_operators_do_not_collide_in_roll_up():
+    """Same-class siblings (every join has two scan-side subtrees) must
+    keep distinct ops.* keys — the pre-fix walk collided them and one
+    side's metrics silently vanished from the totals."""
+    sess = TpuSession()
+    l_schema = Schema((StructField("k", LONG), StructField("v", LONG)))
+    r_schema = Schema((StructField("k2", LONG), StructField("w", LONG)))
+    df_l = sess.from_pydict({"k": [1, 2, 3], "v": [10, 20, 30]}, l_schema)
+    df_r = sess.from_pydict({"k2": [1, 2], "w": [7, 8]}, r_schema)
+    out = df_l.join(df_r, left_on="k", right_on="k2").collect()
+    assert len(out) == 2
+    m = sess.last_query_metrics()
+    scan_keys = [k for k in m if "InMemoryScanExec" in k
+                 and k.endswith(".numOutputRows")]
+    assert len(scan_keys) == 2, scan_keys       # both sides present
+    assert sum(m[k] for k in scan_keys) == 3 + 2
+
+
+def test_profile_respects_metrics_level():
+    sess = TpuSession({"spark.rapids.sql.metrics.level": "ESSENTIAL"})
+    _session_query(sess).collect()
+    prof = sess.last_query_profile()
+    for node in _walk(prof.tree):
+        assert "opTime" not in node["metrics"]
+        assert "numInputRows" not in node["metrics"]

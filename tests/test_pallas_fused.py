@@ -273,6 +273,7 @@ def _agg_engine(tier, n=1500, nkeys=5):
     return sorted(q.collect())
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_fused_scan_agg_engine_level_q1_shape():
     """The headline q1 shape (filter -> derived projection -> group-by)
     through the full exec layer: fused tier == XLA tier."""

@@ -254,6 +254,7 @@ def _chunked_vs_reference(ks, vs, sch, num_batches=12):
     return outs, got, ref
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_partition_aligned_chunked_window():
     # >MERGE_FAN_IN child batches engage the out-of-core sorted stream:
     # the window must emit MULTIPLE batches (concat-all is gone) with

@@ -118,6 +118,7 @@ def test_range_sum_bound_shapes(prec, foll):
     _run("sum", prec, foll)
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_range_descending_order():
     _run("sum", 2, 2, ascending=False)
     _run("min", 3, 0, ascending=False)
@@ -128,6 +129,7 @@ def test_range_float_keys():
     _run("sum", 1.0, 1.0, keys=keys, key_type=DOUBLE)
 
 
+@pytest.mark.slow  # minute-scale single-core; nightly tier (-m slow)
 def test_range_empty_frames_yield_null_sum_zero_count():
     # frame strictly in the future past the last key: empty for the max key
     parts = ["a", "a", "a"]

@@ -1,0 +1,162 @@
+"""Render a spark_rapids_tpu event log (JSONL, obs/events.py) into a
+top-N operator time/bytes table — the offline half of the query-profile
+surface (ISSUE 2; reference analog: the qualification/profiling tool
+over Spark event logs).
+
+Usage:
+    python tools/profile_report.py EVENTS.jsonl [--top N] [--query QID]
+
+Reads `op_close` spans (cumulative wall-ns / rows / batches per
+operator instance), `op_batch` spans (per-batch bytes), and the
+query/task events (spill, oom_retry, semaphore_acquire, exchange) and
+prints one aggregated report. Wall-ns are INCLUSIVE of child time (the
+pull model), so percentages are of the slowest root span, not a sum.
+Stdlib only — runs anywhere the log file lands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Optional
+
+
+def read_events(lines: Iterable[str]) -> List[Dict[str, Any]]:
+    out = []
+    bad = 0
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            out.append(json.loads(ln))
+        except ValueError:
+            # a SIGKILL'd process can leave a truncated final line; the
+            # parseable prefix is exactly what a crash profile needs
+            bad += 1
+    if bad:
+        print(f"warning: skipped {bad} unparseable line(s)",
+              file=sys.stderr)
+    return out
+
+
+def _fmt_ns(ns: float) -> str:
+    if ns < 1_000:
+        return f"{ns:.0f}ns"
+    if ns < 1_000_000:
+        return f"{ns / 1_000:.1f}us"
+    if ns < 1_000_000_000:
+        return f"{ns / 1_000_000:.1f}ms"
+    return f"{ns / 1_000_000_000:.2f}s"
+
+
+def _fmt_bytes(b: float) -> str:
+    if b < (1 << 10):
+        return f"{b:.0f}B"
+    if b < (1 << 20):
+        return f"{b / (1 << 10):.1f}KB"
+    if b < (1 << 30):
+        return f"{b / (1 << 20):.1f}MB"
+    return f"{b / (1 << 30):.2f}GB"
+
+
+def build_report(events: List[Dict[str, Any]], top: int = 10,
+                 query: Optional[int] = None) -> str:
+    if query is not None:
+        events = [e for e in events if e.get("query") == query]
+
+    # per-operator-instance aggregation
+    ops: Dict[Any, Dict[str, Any]] = {}
+    for e in events:
+        kind = e.get("kind")
+        if kind not in ("op_close", "op_batch"):
+            continue
+        key = (e.get("op"), e.get("op_id"))
+        agg = ops.setdefault(key, {"op": e.get("op"),
+                                   "op_id": e.get("op_id"),
+                                   "wall_ns": 0, "rows": 0, "batches": 0,
+                                   "bytes": 0})
+        if kind == "op_close":
+            agg["wall_ns"] += e.get("wall_ns") or 0
+            agg["rows"] += e.get("rows") or 0
+            agg["batches"] += e.get("batches") or 0
+        else:
+            agg["bytes"] += e.get("bytes") or 0
+
+    lines: List[str] = []
+    queries = sorted({e.get("query") for e in events
+                      if e.get("query") is not None})
+    n_end = sum(1 for e in events if e.get("kind") == "query_end")
+    lines.append(f"event log: {len(events)} events, "
+                 f"{len(queries)} queries ({n_end} completed)")
+
+    rows = sorted(ops.values(), key=lambda r: -r["wall_ns"])
+    total_ns = max((r["wall_ns"] for r in rows), default=0)
+    if rows:
+        lines.append("")
+        lines.append(f"top {min(top, len(rows))} operators by inclusive "
+                     "wall time:")
+        hdr = (f"{'#':>3} {'operator':<28} {'id':>4} {'time':>10} "
+               f"{'%root':>6} {'rows':>12} {'batches':>8} {'bytes':>10}")
+        lines.append(hdr)
+        lines.append("-" * len(hdr))
+        for i, r in enumerate(rows[:top], 1):
+            pct = 100.0 * r["wall_ns"] / total_ns if total_ns else 0.0
+            lines.append(
+                f"{i:>3} {r['op']:<28} "
+                f"{r['op_id'] if r['op_id'] is not None else '-':>4} "
+                f"{_fmt_ns(r['wall_ns']):>10} {pct:>5.1f}% "
+                f"{r['rows']:>12} {r['batches']:>8} "
+                f"{_fmt_bytes(r['bytes']):>10}")
+
+    # task-scoped roll-ups
+    def total(kind, field):
+        return sum(e.get(field) or 0 for e in events
+                   if e.get("kind") == kind)
+
+    extras = []
+    n_spill = sum(1 for e in events if e.get("kind") == "spill")
+    if n_spill:
+        extras.append(f"spills: {n_spill} "
+                      f"({_fmt_bytes(total('spill', 'bytes'))})")
+    n_retry = sum(1 for e in events if e.get("kind") == "oom_retry")
+    if n_retry:
+        extras.append(f"oom retries: {n_retry}")
+    sem_ns = total("semaphore_acquire", "wait_ns")
+    if sem_ns:
+        extras.append(f"semaphore wait: {_fmt_ns(sem_ns)}")
+    exch = total("exchange", "bytes")
+    if exch:
+        extras.append(f"exchange bytes: {_fmt_bytes(exch)}")
+    n_fb = sum(1 for e in events
+               if e.get("kind") in ("plan_fallback", "plan_not_on_tpu"))
+    if n_fb:
+        extras.append(f"plan fallback/why-not records: {n_fb}")
+    tiers = [e for e in events if e.get("kind") == "pallas_tier"]
+    if tiers:
+        on = sum(1 for e in tiers if e.get("engaged"))
+        extras.append(f"pallas tier decisions: {len(tiers)} "
+                      f"({on} engaged)")
+    if extras:
+        lines.append("")
+        lines.extend(extras)
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("log", help="events-*.jsonl file (obs/events.py)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="operators to show (default 10)")
+    ap.add_argument("--query", type=int, default=None,
+                    help="restrict to one query id")
+    args = ap.parse_args(argv)
+    with open(args.log) as f:
+        events = read_events(f)
+    print(build_report(events, top=args.top, query=args.query))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
