@@ -26,6 +26,7 @@ the driver loop (round-2 verdict item 9).
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -52,6 +53,28 @@ def query_attribution(plan, before):
         return bench_profile_summary(plan, before)
     except Exception as e:  # noqa: BLE001 — attribution must never
         return {"error": f"{type(e).__name__}: {e}"[:200]}  # kill a lane
+
+def pipeline_attribution():
+    """{"pipeline": ...} block for each BENCH record (ISSUE 3
+    satellite): the synthetic slow-producer/slow-consumer overlap
+    microbench (tools/pipeline_bench.py), run once per process — cheap
+    (<1s) and device-free, it tracks whether the bounded stage boundary
+    still buys its overlap on this host alongside the engine numbers."""
+    global _PIPELINE_SUMMARY
+    if _PIPELINE_SUMMARY is None:
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            from pipeline_bench import run_bench
+            _PIPELINE_SUMMARY = run_bench(items=30, produce_s=0.01,
+                                          consume_s=0.01, depth=2)
+        except Exception as e:  # noqa: BLE001 — attribution must never
+            _PIPELINE_SUMMARY = {  # kill a lane
+                "error": f"{type(e).__name__}: {e}"[:200]}
+    return _PIPELINE_SUMMARY
+
+
+_PIPELINE_SUMMARY = None
 
 ROWS = 1 << 24  # 16M rows, ~448 MB
 BATCHES = 1
@@ -91,13 +114,28 @@ def with_backend_retry(fn, attempts: int = INIT_ATTEMPTS,
     raise SystemExit(0)
 
 
-def init_backend():
-    """Import jax and force real backend initialization (device probe)."""
-    def probe():
-        import jax
-        assert jax.devices(), "no jax devices"
-        return jax
-    return with_backend_retry(probe)
+def backend_probe():
+    """Import jax and force REAL backend initialization.
+
+    `jax.devices()` alone is not enough: the axon/TPU plugin can
+    enumerate devices and still fail at the first dispatched program
+    ("TPU backend setup/compile error" inside `lax._convert_element_type`
+    — the BENCH_r05 rc=1 mode, where the first cast of the data upload
+    crashed OUTSIDE the retry guard). The probe therefore dispatches a
+    tiny cast and blocks on its result, so every backend setup/compile
+    failure surfaces HERE, inside with_backend_retry — and nowhere
+    downstream gets wrapped, so a mid-run crash still fails loudly
+    instead of being masked as an {"error_kind": ...} record."""
+    import jax
+    import jax.numpy as jnp
+    assert jax.devices(), "no jax devices"
+    jax.block_until_ready(
+        jnp.arange(8, dtype=jnp.int32).astype(jnp.float32).sum())
+    return jax
+
+
+def init_backend(sleep=time.sleep):
+    return with_backend_retry(backend_probe, sleep=sleep)
 
 
 def build_data():
@@ -242,6 +280,7 @@ def main():
         "unit": "GB/s",
         "vs_baseline": round(t_np / dt, 3),
         "profile": query_attribution(plan, metrics_before),
+        "pipeline": pipeline_attribution(),
     }))
 
 
@@ -387,6 +426,7 @@ def q3_bench():
         "unit": "GB/s",
         "vs_baseline": round(t_np / dt, 3),
         "profile": query_attribution(plan, metrics_before),
+        "pipeline": pipeline_attribution(),
     }))
 
 

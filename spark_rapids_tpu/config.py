@@ -272,7 +272,44 @@ PARQUET_PUSHDOWN_ENABLED = conf_bool(
 MULTITHREADED_READ_NUM_THREADS = conf_int(
     "spark.rapids.sql.multiThreadedRead.numThreads", 8,
     "Threads for the cloud multi-file readers (reference "
-    "GpuMultiFileReader.scala:345).")
+    "GpuMultiFileReader.scala:345). Sizes the ONE process-wide decode "
+    "pool shared by every scan (io/multifile.py): concurrent scans and "
+    "pipeline producer threads draw from it instead of multiplying "
+    "thread counts with per-call pools.")
+
+MULTITHREADED_READ_FETCH_AHEAD = conf_int(
+    "spark.rapids.sql.multiThreadedRead.fetchAheadWindow", 0,
+    "Decode tasks a multi-file reader may have in flight ahead of the "
+    "consumer (the fetch-ahead window of the multithreaded cloud "
+    "reader). 0 (default) = 2 x the reader's own thread count (its "
+    "num_threads argument, not multiThreadedRead.numThreads).")
+
+PIPELINE_ENABLED = conf_bool(
+    "spark.rapids.tpu.pipeline.enabled", True,
+    "Asynchronous pipelined execution (exec/pipeline.py): bounded "
+    "producer threads overlap file decode + host->device transfer, "
+    "shuffle-partition deserialization and coalesce accumulation with "
+    "downstream device compute — the engine analog of the reference's "
+    "multithreaded reader / async shuffle overlap. Results are "
+    "bit-identical with pipelining on or off (tier-1 asserted); off "
+    "degrades every boundary to the plain synchronous iterator.",
+    commonly_used=True)
+
+PIPELINE_DEPTH = conf_int(
+    "spark.rapids.tpu.pipeline.depth", 2,
+    "Batches a pipeline producer may queue ahead of its consumer at "
+    "each pipelined stage boundary (the bounded prefetch window). "
+    "Higher overlaps more at the cost of holding more batches live; "
+    "0 behaves like pipeline.enabled=false.")
+
+SPILL_ASYNC_WRITE = conf_bool(
+    "spark.rapids.tpu.spill.asyncWrite", True,
+    "Background spill writeback (memory/catalog.py): a tier hop hands "
+    "the buffer to a single writer thread and releases the triggering "
+    "operator immediately (device->host copy and host->disk write+fsync "
+    "run behind the operator); readers of an in-flight buffer block "
+    "until its writeback completes, so results are identical with the "
+    "writer on or off. False restores fully synchronous spilling.")
 
 PROFILE_ENABLED = conf_bool(
     "spark.rapids.tpu.profile.enabled", False,

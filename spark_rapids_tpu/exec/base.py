@@ -109,6 +109,9 @@ PARTITION_SIZE = "dataSize"
 SHUFFLE_WRITE_TIME = "shuffleWriteTime"
 SHUFFLE_READ_TIME = "shuffleReadTime"
 BROADCAST_TIME = "broadcastTime"
+PIPELINE_WAIT = "pipelineWaitNs"
+PIPELINE_FULL_WAIT = "pipelineFullWaitNs"
+PIPELINE_WALL = "pipelineWallNs"
 
 #: the closed set of metric names execs may register — one name, one
 #: meaning, exactly like the reference's GpuMetric companion object.
@@ -119,6 +122,7 @@ CANONICAL_METRICS = frozenset({
     OP_TIME, SORT_TIME, AGG_TIME, CONCAT_TIME, JOIN_TIME, BUILD_TIME,
     PEAK_DEVICE_MEMORY, NUM_TASKS_FALL_BACKED, SPILL_TIME, PARTITION_SIZE,
     SHUFFLE_WRITE_TIME, SHUFFLE_READ_TIME, BROADCAST_TIME,
+    PIPELINE_WAIT, PIPELINE_FULL_WAIT, PIPELINE_WALL,
 })
 
 #: per-operator instance ids for event/span attribution (two
@@ -128,6 +132,13 @@ _OP_IDS = itertools.count(1)
 #: an additional_metrics() entry: a bare canonical name (MODERATE) or
 #: (name, level)
 MetricSpec = Union[str, Tuple[str, int]]
+
+#: the metric triple every exec that runs a pipelined() input stage
+#: registers (include in additional_metrics(); bind with
+#: TpuExec.pipeline_stage)
+PIPELINE_STAGE_METRICS = ((PIPELINE_WAIT, MODERATE),
+                          (PIPELINE_FULL_WAIT, MODERATE),
+                          (PIPELINE_WALL, MODERATE))
 
 
 class TpuExec:
@@ -166,8 +177,32 @@ class TpuExec:
         (ops/aggregate.groupby_aggregate pre_grouped)."""
         return None
 
+    @property
+    def runs_own_pipeline_stage(self) -> bool:
+        """True when this exec's execute() already drives a pipelined()
+        producer stage of its own. A consumer that would wrap its input
+        in another stage (e.g. CoalesceBatchesExec) skips it then —
+        stacking two stages on one edge doubles threads and live
+        prefetched batches for zero extra overlap. Wrapper execs that
+        delegate execution to a child should forward the child's value."""
+        return False
+
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         raise NotImplementedError(type(self).__name__)
+
+    def pipeline_stage(self, source, label: str, depth=None):
+        """The one way an exec wraps an input in a pipelined() stage:
+        binds this operator's three PIPELINE_STAGE_METRICS (which its
+        additional_metrics() must register) and tags the stage label
+        with the op id. Callers drive the returned stage inside
+        try/finally with stage.close() — close/metric conventions live
+        here so all wired boundaries change together."""
+        from .pipeline import pipelined
+        return pipelined(source, depth=depth,
+                         label=f"{label}-{self._op_id}",
+                         wait_metric=self.metrics[PIPELINE_WAIT],
+                         full_metric=self.metrics[PIPELINE_FULL_WAIT],
+                         wall_metric=self.metrics[PIPELINE_WALL])
 
     # -- public ------------------------------------------------------------
     def execute(self) -> Iterator[ColumnarBatch]:

@@ -46,6 +46,16 @@ class CachedRelation:
         self.compressed_bytes = sum(map(len, frames))
         self.raw_bytes = raw
 
+    def ensure_materialized(self) -> None:
+        """SourceScanExec calls this BEFORE taking the admission permit:
+        materialization drives a full child plan whose own scan needs a
+        permit — running it under the outer scan's permit deadlocks at
+        spark.rapids.sql.concurrentGpuTasks=1 (the inner acquire waits
+        forever on the permit the outer producer holds)."""
+        with self._lock:
+            if self._frames is None:
+                self._materialize()
+
     def batches(self) -> Iterator[ColumnarBatch]:
         from ..shuffle.serializer import deserialize_batch
         with self._lock:

@@ -19,7 +19,7 @@ from ..memory.spillable import SpillableBatch
 from ..ops.basic import concat_columns, sanitize
 from ..types import Schema
 from .base import (CONCAT_TIME, DEBUG, NUM_INPUT_BATCHES,
-                   NUM_INPUT_ROWS, TpuExec)
+                   NUM_INPUT_ROWS, PIPELINE_STAGE_METRICS, TpuExec)
 
 
 from functools import partial
@@ -75,7 +75,15 @@ class CoalesceBatchesExec(TpuExec):
 
     def additional_metrics(self):
         return (CONCAT_TIME, (NUM_INPUT_ROWS, DEBUG),
-                (NUM_INPUT_BATCHES, DEBUG))
+                (NUM_INPUT_BATCHES, DEBUG)) + PIPELINE_STAGE_METRICS
+
+    @property
+    def runs_own_pipeline_stage(self) -> bool:
+        # wraps its input in a stage of its own — or, when the child
+        # already runs one, that stage feeds this exec directly: either
+        # way the output edge is covered and a consumer must not stack
+        # another stage on it
+        return True
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         in_rows = self.metrics[NUM_INPUT_ROWS]
@@ -98,24 +106,42 @@ class CoalesceBatchesExec(TpuExec):
                     finally:
                         for s in items:
                             s.release()
-                out = with_retry_no_split(spillables, do)
-                for s in spillables:
-                    s.close()
-                return out
+                try:
+                    return with_retry_no_split(spillables, do)
+                finally:
+                    # close on BOTH paths: an exhausted retry must not
+                    # leave the swapped-out set registered in the
+                    # catalog (the outer finally only sees `pending`)
+                    for s in spillables:
+                        s.close()
 
-        for batch in self.child.execute():
-            in_batches.add(1)
-            if batch._host_rows is not None:
-                in_rows.add(batch._host_rows)
-            else:
-                in_rows.add_device(batch.num_rows)
-            size = batch.device_size_bytes()
-            if pending and pending_bytes + size > self.target_bytes:
-                yield flush()
-            pending.append(SpillableBatch.from_batch(batch))
-            pending_bytes += size
-            if pending_bytes >= self.target_bytes:
-                yield flush()
-        tail = flush()
-        if tail is not None:
-            yield tail
+        # pipelined input (ISSUE 3): upstream compute of batch N+1 runs
+        # on the producer thread while this operator accumulates /
+        # concatenates batch N — unless the child already runs its own
+        # stage (TpuExec.runs_own_pipeline_stage): stacking a second one
+        # on the same edge would double threads and live prefetched
+        # device batches for zero extra overlap.
+        depth = 0 if self.child.runs_own_pipeline_stage else None
+        stage = self.pipeline_stage(self.child.execute(), "coalesce",
+                                    depth=depth)
+        try:
+            for batch in stage:
+                in_batches.add(1)
+                if batch._host_rows is not None:
+                    in_rows.add(batch._host_rows)
+                else:
+                    in_rows.add_device(batch.num_rows)
+                size = batch.device_size_bytes()
+                if pending and pending_bytes + size > self.target_bytes:
+                    yield flush()
+                pending.append(SpillableBatch.from_batch(batch))
+                pending_bytes += size
+                if pending_bytes >= self.target_bytes:
+                    yield flush()
+            tail = flush()
+            if tail is not None:
+                yield tail
+        finally:
+            stage.close()
+            for s in pending:
+                s.close()

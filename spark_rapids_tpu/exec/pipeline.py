@@ -1,0 +1,303 @@
+"""Bounded asynchronous stage boundary for the pull-model operator chain
+(ISSUE 3 tentpole).
+
+The engine is a synchronous pull-model iterator chain: while the host
+decodes/deserializes/uploads the NEXT batch, the device sits idle. The
+reference accelerator hides that host cost everywhere — the
+multithreaded cloud reader fetches ahead, shuffle fetches overlap kernel
+launches, spill writes back asynchronously. `pipelined(it, depth)` is
+the one primitive that buys the same overlap here: it moves an input
+iterator onto a background producer thread feeding a bounded FIFO queue,
+so the producer works `depth` batches ahead of the consumer.
+
+Contracts (tests/test_pipeline.py):
+
+* strict FIFO — items arrive in exactly the source order;
+* exception propagation — a producer error is re-raised at the consumer
+  call site AFTER the items produced before it (the original traceback
+  is preserved on the exception object);
+* clean shutdown — `close()` (or abandoning the wrapping generator,
+  whose ``finally`` calls it) unblocks a producer stuck on a full queue,
+  closes the source iterator, and joins the thread: no leaked threads,
+  asserted via ``threading.enumerate()``;
+* degradation — depth <= 0 (or pipeline.enabled=false) returns the
+  plain synchronous iterator, bit-identical behavior.
+
+Thread-local context (active conf, event-log query id, speculation
+scope) is captured at the consumer and re-installed in the producer, so
+operators running behind the boundary keep their conf, their query
+attribution and their speculation-flag scope.
+
+Observability: the boundary accumulates consumer stall (`wait_ns`,
+blocked on an empty queue) and producer stall (`full_ns`, blocked on a
+full queue), optionally into the owning operator's `pipelineWaitNs` /
+`pipelineFullWaitNs` metrics, and emits one `pipeline_wait` + one
+`pipeline_full` event record when the stage finishes. The overlap ratio
+derived from these is surfaced by `QueryProfile.top_operators()`.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Iterable, Iterator, Optional
+
+from ..config import PIPELINE_DEPTH, PIPELINE_ENABLED, active_conf
+
+_END = object()
+
+
+class StageCancelled(RuntimeError):
+    """Raised by a stage consumer running on an OUTER closed stage's
+    producer thread (nested stages). Deliberately NOT StopIteration: a
+    consumer that materializes its input as a complete result (e.g.
+    CachedRelation) must see the cut as an error, or it would cache the
+    truncated stream as if it were the whole relation."""
+
+#: shutdown poll period: a blocked producer/waiter re-checks the closed
+#: flag this often (latency of an abandoned query's teardown, never of
+#: the steady state)
+_POLL_S = 0.05
+
+_tls = threading.local()
+
+
+def cancelled() -> bool:
+    """True on a pipeline producer thread whose consumer closed the
+    stage (False anywhere else). Long blocking waits inside producer
+    code (e.g. the admission semaphore) poll this so an abandoned query
+    can always tear down."""
+    ev = getattr(_tls, "cancel_event", None)
+    return ev is not None and ev.is_set()
+
+
+def pipeline_depth(conf=None) -> int:
+    """The configured prefetch depth, or 0 when pipelining is disabled."""
+    conf = conf if conf is not None else active_conf()
+    if not conf.get(PIPELINE_ENABLED):
+        return 0
+    return max(0, conf.get(PIPELINE_DEPTH))
+
+
+def pipelined(source: Iterable[Any], depth: Optional[int] = None,
+              label: str = "stage", wait_metric=None, full_metric=None,
+              wall_metric=None, conf=None,
+              emit_events: bool = True) -> Iterator[Any]:
+    """Wrap `source` in a bounded background-producer iterator.
+
+    depth None = the conf (spark.rapids.tpu.pipeline.{enabled,depth});
+    depth <= 0 = the plain synchronous iterator (zero threads, zero
+    behavior change). The returned object always has ``close()`` —
+    consumers call it from a ``finally`` so early abandonment joins the
+    producer thread. ``emit_events=False`` keeps a stage that is not an
+    engine operator (e.g. tools/pipeline_bench driven in-process by
+    bench.py) out of the query event log — its synthetic stalls would
+    otherwise contaminate the real pipeline_wait/pipeline_full totals.
+    """
+    d = pipeline_depth(conf) if depth is None else depth
+    if d <= 0:
+        return _SyncStage(source)
+    return PipelinedIterator(source, d, label=label,
+                             wait_metric=wait_metric,
+                             full_metric=full_metric,
+                             wall_metric=wall_metric,
+                             emit_events=emit_events)
+
+
+class _SyncStage:
+    """Degraded (synchronous) stage: the source iterator plus the
+    close() and stall-counter surface the pipelined wiring (and
+    tools/pipeline_bench.py) expect — a sync stage never stalls, so the
+    counters stay 0."""
+
+    __slots__ = ("_it", "wait_ns", "full_ns", "wall_ns", "batches")
+
+    def __init__(self, source: Iterable[Any]):
+        self._it = iter(source)
+        self.wait_ns = 0
+        self.full_ns = 0
+        self.wall_ns = 0
+        self.batches = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = next(self._it)
+        self.batches += 1
+        return item
+
+    def close(self) -> None:
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+
+
+class PipelinedIterator:
+    """Background producer thread + bounded FIFO queue (one stage
+    boundary). Single producer, single consumer."""
+
+    def __init__(self, source: Iterable[Any], depth: int,
+                 label: str = "stage", wait_metric=None, full_metric=None,
+                 wall_metric=None, emit_events: bool = True):
+        self._source = source
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, depth))
+        self._label = label
+        self._closed = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._finished = False
+        self._stats_done = False
+        self._wait_metric = wait_metric
+        self._full_metric = full_metric
+        self._wall_metric = wall_metric
+        self._emit_events = emit_events
+        #: consumer ns blocked on an empty queue / producer ns blocked
+        #: on a full one — the two stall signals overlap analysis needs
+        self.wait_ns = 0
+        self.full_ns = 0
+        #: stage lifetime (construction -> finish/close): the overlap
+        #: denominator, 1 - wait/wall = fraction of the stage NOT
+        #: stalled on its input
+        self.wall_ns = 0
+        self.batches = 0
+        self._t0 = time.perf_counter_ns()
+        # producer-side thread-local context, captured HERE (the
+        # consumer thread) and re-installed in the producer
+        self._conf = active_conf()
+        from ..obs import events as obs_events
+        self._qid = obs_events.current_query_id()
+        from .speculation import capture_context
+        self._spec_ctx = capture_context()
+        self._thread = threading.Thread(
+            target=self._run, name=f"pipeline-{label}", daemon=True)
+        self._thread.start()
+
+    # -- producer ----------------------------------------------------------
+    def _run(self) -> None:
+        # EVERYTHING runs inside the try: a failure in context install
+        # or iter(source) must still reach the except/finally, or _END
+        # is never posted and the consumer hangs on q.get() forever
+        it = None
+        try:
+            from ..config import set_active_conf
+            from ..obs import events as obs_events
+            set_active_conf(self._conf)
+            obs_events.adopt_query_id(self._qid)
+            from .speculation import adopt_context
+            adopt_context(*self._spec_ctx)
+            _tls.cancel_event = self._closed
+            it = iter(self._source)
+            while not self._closed.is_set():
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                t0 = time.perf_counter_ns()
+                if not self._offer(item):
+                    break
+                self.full_ns += time.perf_counter_ns() - t0
+        except BaseException as e:  # noqa: BLE001 — carried to consumer
+            self._exc = e
+        finally:
+            if self._closed.is_set() and it is not None:
+                # early shutdown: close the abandoned source so its
+                # finally blocks (spillable handles, shuffle files) run
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:  # noqa: BLE001 — teardown only
+                        pass
+            self._offer(_END)
+
+    def _offer(self, item: Any) -> bool:
+        """put() that a consumer-side close() can always unblock."""
+        while not self._closed.is_set():
+            try:
+                self._q.put(item, timeout=_POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # -- consumer ----------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        t0 = time.perf_counter_ns()
+        while True:
+            try:
+                item = self._q.get(timeout=_POLL_S)
+                break
+            except queue.Empty:
+                if cancelled():
+                    # this consumer IS an outer stage's producer and
+                    # that stage was closed: stop pulling so the outer
+                    # close() can join. The outer producer's teardown
+                    # closes our source generator (and through it, this
+                    # stage) — without this check, nested stages could
+                    # wedge an abandoning close() forever. Raised as an
+                    # error, not StopIteration: a materializing consumer
+                    # (CachedRelation) must not mistake the cut stream
+                    # for a complete one.
+                    self.wait_ns += time.perf_counter_ns() - t0
+                    raise StageCancelled(self._label)
+        self.wait_ns += time.perf_counter_ns() - t0
+        if item is _END:
+            self._finished = True
+            self._thread.join()
+            self._finish_stats()
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                # re-raise the producer's error AT THE CONSUMER call
+                # site; the original producer traceback travels on
+                # exc.__traceback__
+                raise exc
+            raise StopIteration
+        self.batches += 1
+        return item
+
+    def close(self) -> None:
+        """Shut the stage down (idempotent): unblock + join the
+        producer, drain the queue, report stats. Safe to call whether
+        the stage finished, failed, or was abandoned mid-stream."""
+        self._closed.set()
+        self._drain()
+        while self._thread.is_alive():
+            self._thread.join(timeout=_POLL_S)
+            self._drain()
+        self._finished = True
+        self._finish_stats()
+
+    def _drain(self) -> None:
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                return
+
+    def _finish_stats(self) -> None:
+        if self._stats_done:
+            return
+        self._stats_done = True
+        self.wall_ns = time.perf_counter_ns() - self._t0
+        if self._wait_metric is not None:
+            self._wait_metric.add(self.wait_ns)
+        if self._full_metric is not None:
+            self._full_metric.add(self.full_ns)
+        if self._wall_metric is not None:
+            self._wall_metric.add(self.wall_ns)
+        if not self._emit_events:
+            return
+        from ..obs import events as obs_events
+        bus = obs_events.active_bus()
+        if bus is not None:
+            bus.emit("pipeline_wait", stage=self._label,
+                     wait_ns=self.wait_ns, wall_ns=self.wall_ns,
+                     batches=self.batches)
+            bus.emit("pipeline_full", stage=self._label,
+                     full_ns=self.full_ns, batches=self.batches)

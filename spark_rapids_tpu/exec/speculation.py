@@ -56,6 +56,20 @@ def current_scope() -> Optional[SpeculationScope]:
     return _state.scope
 
 
+def capture_context():
+    """(scope, forced_exact) of this thread — captured at a pipeline
+    stage boundary so the producer thread inherits it."""
+    return _state.scope, _state.forced_exact
+
+
+def adopt_context(scope, forced_exact: bool) -> None:
+    """Install a captured speculation context on this (producer)
+    thread: aggregates running behind the boundary record their
+    overflow flags into the CONSUMER's scope."""
+    _state.scope = scope
+    _state.forced_exact = forced_exact
+
+
 def speculation_allowed() -> bool:
     return _state.scope is not None and not _state.forced_exact
 

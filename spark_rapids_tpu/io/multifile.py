@@ -12,11 +12,14 @@ from __future__ import annotations
 
 import glob
 import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Iterator, List, Sequence
+from typing import Callable, Iterator, List, Optional, Sequence
 
 from ..columnar.batch import ColumnarBatch
-from ..config import RapidsConf
+from ..config import (MULTITHREADED_READ_FETCH_AHEAD,
+                      MULTITHREADED_READ_NUM_THREADS, RapidsConf,
+                      active_conf)
 
 
 def expand_paths(path) -> List[str]:
@@ -36,24 +39,81 @@ def expand_paths(path) -> List[str]:
     return [path]
 
 
+#: ONE process-wide decode pool shared by every scan (ISSUE 3
+#: satellite): per-call pools multiplied thread counts once pipeline
+#: producer threads drove several scans at once, and paid pool
+#: setup/teardown per batches() drive. Sized by
+#: spark.rapids.sql.multiThreadedRead.numThreads; grows (never shrinks)
+#: if a later conf asks for more.
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_size = 0
+_pool_lock = threading.Lock()
+#: replaced-on-growth pools, kept alive for their in-flight drives
+_retired: list = []
+
+
+def shared_read_pool(num_threads: Optional[int] = None
+                     ) -> ThreadPoolExecutor:
+    """The process-wide multi-file decode pool (lazily created)."""
+    global _pool, _pool_size
+    if num_threads is None:
+        num_threads = active_conf().get(MULTITHREADED_READ_NUM_THREADS)
+    num_threads = max(1, int(num_threads))
+    with _pool_lock:
+        if _pool is None or num_threads > _pool_size:
+            # grow-only, and the old pool is RETIRED, never shut down:
+            # an in-flight threaded_chunks drive still submits to its
+            # captured pool reference — shutdown() would raise
+            # RuntimeError mid-scan. Growth is a rare conf event; a
+            # retired pool's idle workers are an accepted cost.
+            if _pool is not None:
+                _retired.append(_pool)
+            _pool = ThreadPoolExecutor(
+                max_workers=num_threads,
+                thread_name_prefix="multifile-read")
+            _pool_size = num_threads
+        return _pool
+
+
+def fetch_ahead_window(num_threads: int,
+                       conf: Optional[RapidsConf] = None) -> int:
+    """Decode tasks a reader keeps in flight ahead of its consumer
+    (spark.rapids.sql.multiThreadedRead.fetchAheadWindow; 0 = the
+    classic 2 x numThreads)."""
+    conf = conf if conf is not None else active_conf()
+    window = conf.get(MULTITHREADED_READ_FETCH_AHEAD)
+    return window if window > 0 else 2 * max(1, num_threads)
+
+
 def threaded_chunks(tasks: Sequence[Callable[[], "object"]],
-                    num_threads: int) -> Iterator["object"]:
-    """Decode `tasks` with a bounded look-ahead pool, yielding in order
-    (the multithreaded cloud reader: fetch ahead, emit in sequence)."""
+                    num_threads: int,
+                    window: Optional[int] = None) -> Iterator["object"]:
+    """Decode `tasks` with a bounded look-ahead window on the shared
+    pool, yielding in order (the multithreaded cloud reader: fetch
+    ahead, emit in sequence)."""
     if num_threads <= 1 or len(tasks) <= 1:
         for t in tasks:
             yield t()
         return
-    with ThreadPoolExecutor(max_workers=num_threads) as pool:
-        window = 2 * num_threads
-        futures = [pool.submit(t) for t in tasks[:window]]
-        next_submit = window
+    pool = shared_read_pool(max(
+        num_threads, active_conf().get(MULTITHREADED_READ_NUM_THREADS)))
+    if window is None:
+        window = fetch_ahead_window(num_threads)
+    futures = [pool.submit(t) for t in tasks[:window]]
+    next_submit = window
+    try:
         for i in range(len(tasks)):
             yield futures[i].result()
             futures[i] = None  # release
             if next_submit < len(tasks):
                 futures.append(pool.submit(tasks[next_submit]))
                 next_submit += 1
+    finally:
+        # abandoned mid-drive (limit/short-circuit): cancel what never
+        # started so the shared pool isn't left decoding dead work
+        for f in futures:
+            if f is not None:
+                f.cancel()
 
 
 def arrow_to_batches(table, target_rows: int) -> Iterator[ColumnarBatch]:

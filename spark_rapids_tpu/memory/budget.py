@@ -35,8 +35,15 @@ class MemoryBudget:
         self.peak = 0
         self.spill_requests = 0
 
-    def reserve(self, nbytes: int):
-        """Reserve accounting space; spill-then-raise on pressure."""
+    def reserve(self, nbytes: int, wait_for_writeback: bool = True):
+        """Reserve accounting space; spill-then-raise on pressure.
+
+        `wait_for_writeback=False` is REQUIRED when the caller holds the
+        buffer-catalog lock (catalog._unspill_locked): draining waits on
+        the spill-writer thread, which needs that lock to finalize — a
+        guaranteed deadlock. Without the drain, pressure surfaces as
+        TpuRetryOOM and the retry loop waits the writebacks out instead.
+        """
         with self._lock:
             if self.used + nbytes <= self.limit:
                 self.used += nbytes
@@ -45,13 +52,36 @@ class MemoryBudget:
         # out of budget: try to make room by spilling catalog buffers
         from .catalog import buffer_catalog
         needed = nbytes - (self.limit - self.used)
-        freed = buffer_catalog().synchronous_spill(needed)
+        hops: list = []
+        freed = buffer_catalog().synchronous_spill(needed, events_out=hops)
         with self._lock:
             self.spill_requests += 1
             if self.used + nbytes <= self.limit:
                 self.used += nbytes
                 self.peak = max(self.peak, self.used)
                 return
+        # async writeback (spill.asyncWrite) frees the budget only when
+        # each device->host copy LANDS: wait the in-flight hops out
+        # before declaring OOM
+        if wait_for_writeback:
+            # first only the copies THIS spill queued — a full-queue
+            # drain would serialize the reserve behind unrelated (and
+            # later-enqueued) hops from concurrently spilling threads
+            for ev in hops:
+                ev.wait()
+            with self._lock:
+                if self.used + nbytes <= self.limit:
+                    self.used += nbytes
+                    self.peak = max(self.peak, self.used)
+                    return
+            # last resort: hops queued by OTHER threads' spills may
+            # still hold the bytes this reservation needs
+            buffer_catalog().drain_writeback()
+            with self._lock:
+                if self.used + nbytes <= self.limit:
+                    self.used += nbytes
+                    self.peak = max(self.peak, self.used)
+                    return
         raise TpuRetryOOM(
             f"HBM budget exhausted: need {nbytes}, used {self.used} of "
             f"{self.limit} (freed {freed} by spill)")
@@ -97,6 +127,22 @@ def reset_memory_budget(limit_bytes: Optional[int] = None):
 
 def spill_for_retry():
     """Between OOM retries, aggressively push device buffers down a tier
-    (reference: synchronous spill in DeviceMemoryEventHandler)."""
+    (reference: synchronous spill in DeviceMemoryEventHandler).
+
+    With spill.asyncWrite the hand-offs queued here (and writebacks
+    already in flight — including the ones behind a
+    reserve(wait_for_writeback=False) TpuRetryOOM from the
+    unspill-under-catalog-lock path, which cannot drain itself) only
+    free budget when the writer lands each device->host copy. No
+    catalog lock is held between retry attempts, so this is the one
+    safe place to wait the writer out before the next attempt —
+    otherwise the retry loop spins through its attempts in microseconds
+    while the bytes it needs are still queued behind the writer thread.
+    """
     from .catalog import buffer_catalog
-    buffer_catalog().synchronous_spill(None)
+    cat = buffer_catalog()
+    hops: list = []
+    cat.synchronous_spill(None, events_out=hops)
+    for ev in hops:
+        ev.wait()
+    cat.drain_writeback()

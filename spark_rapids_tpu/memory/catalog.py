@@ -7,11 +7,23 @@ files. The catalog is the single registry; SpillableBatch handles point into
 it. Spill policy: spillable (not in-use) entries, lowest priority first,
 moved one tier down until the requested bytes are freed
 (SpillPriorities.scala semantics).
+
+Background writeback (ISSUE 3, conf spark.rapids.tpu.spill.asyncWrite,
+reference analog: the async spill path of RapidsBufferCatalog): a tier
+hop marks the entry's TARGET tier under the catalog lock and hands the
+actual byte movement (device->host copy / host->disk write + fsync) to a
+single writer thread, releasing the triggering operator immediately. A
+reader (`acquire`) of an entry whose writeback is still in flight waits
+for it to land first, so results are identical with the writer on or
+off. Catalog state transitions stay under the existing lock; the writer
+takes it only for the brief finalize step, never waits on events, and
+disk files are fsync'd before the hop counts as complete.
 """
 
 from __future__ import annotations
 
 import os
+import queue
 import tempfile
 import threading
 import uuid
@@ -21,7 +33,8 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
-from ..config import HOST_SPILL_LIMIT, SPILL_DIR, active_conf
+from ..config import (HOST_SPILL_LIMIT, SPILL_ASYNC_WRITE, SPILL_DIR,
+                      active_conf)
 
 
 class StorageTier(IntEnum):
@@ -44,7 +57,8 @@ def _leaf_nbytes(tree) -> int:
 
 class _Entry:
     __slots__ = ("handle_id", "tier", "device_tree", "host_leaves", "treedef",
-                 "disk_path", "nbytes", "priority", "in_use", "closed")
+                 "disk_path", "nbytes", "priority", "in_use", "closed",
+                 "writeback", "pending_device")
 
     def __init__(self, handle_id, tree, priority):
         self.handle_id = handle_id
@@ -57,6 +71,18 @@ class _Entry:
         self.priority = priority
         self.in_use = 0
         self.closed = False
+        #: event of the in-flight async tier hop, None when settled
+        self.writeback: Optional[threading.Event] = None
+        #: device leaves handed to the writer (to_host hop in flight)
+        self.pending_device = None
+
+
+def _write_npz(path: str, host_leaves) -> None:
+    """Spill file write, durable before the hop counts as complete."""
+    with open(path, "wb") as f:
+        np.savez(f, **{str(i): a for i, a in enumerate(host_leaves)})
+        f.flush()
+        os.fsync(f.fileno())
 
 
 class BufferCatalog:
@@ -66,6 +92,8 @@ class BufferCatalog:
         self.spilled_device_bytes = 0
         self.spilled_host_bytes = 0
         self._spill_dir: Optional[str] = None
+        self._write_q: Optional["queue.Queue"] = None
+        self._writer: Optional[threading.Thread] = None
 
     # -- registration ------------------------------------------------------
     def add(self, tree, priority: int = ACTIVE_BATCHING_PRIORITY) -> str:
@@ -81,15 +109,21 @@ class BufferCatalog:
 
     def acquire(self, handle: str):
         """Return the device pytree, promoting back up tiers if spilled.
-        Marks the entry in-use (unspillable) until `release`."""
-        from .budget import memory_budget
-        with self._lock:
-            entry = self._entries[handle]
-            assert not entry.closed, "acquire after close"
-            if entry.tier != StorageTier.DEVICE:
-                self._unspill_locked(entry)
-            entry.in_use += 1
-            return entry.device_tree
+        Marks the entry in-use (unspillable) until `release`. An entry
+        whose async writeback is still in flight is waited for OUTSIDE
+        the lock (the writer needs the lock to finish the hop)."""
+        while True:
+            with self._lock:
+                entry = self._entries[handle]
+                assert not entry.closed, "acquire after close"
+                ev = entry.writeback
+                if ev is None or ev.is_set():
+                    entry.writeback = None
+                    if entry.tier != StorageTier.DEVICE:
+                        self._unspill_locked(entry)
+                    entry.in_use += 1
+                    return entry.device_tree
+            ev.wait()
 
     def release(self, handle: str):
         with self._lock:
@@ -101,9 +135,10 @@ class BufferCatalog:
         from .budget import memory_budget
         with self._lock:
             entry = self._entries.pop(handle, None)
-        if entry is None or entry.closed:
-            return
-        entry.closed = True
+            if entry is None or entry.closed:
+                return
+            entry.closed = True  # an in-flight writeback sees this and
+            # discards its result (incl. unlinking a just-written file)
         if entry.tier == StorageTier.DEVICE:
             memory_budget().release(entry.nbytes)
         if entry.disk_path and os.path.exists(entry.disk_path):
@@ -118,12 +153,20 @@ class BufferCatalog:
             return self._entries[handle].nbytes
 
     # -- spilling ----------------------------------------------------------
-    def synchronous_spill(self, target_bytes: Optional[int]) -> int:
+    def synchronous_spill(self, target_bytes: Optional[int],
+                          events_out: Optional[List[threading.Event]] = None
+                          ) -> int:
         """Move spillable DEVICE entries to HOST (lowest priority first)
         until target_bytes are freed (None = spill everything spillable).
         Overflows HOST to DISK past the host limit. Returns bytes freed from
-        device (reference DeviceMemoryEventHandler.scala:58-90 loop)."""
+        device (reference DeviceMemoryEventHandler.scala:58-90 loop). With
+        spill.asyncWrite the copies run on the writer thread and this
+        returns as soon as the hand-offs are queued; `events_out` then
+        collects each queued device->host hop's completion event, so a
+        caller under budget pressure can wait for exactly the copies ITS
+        spill started instead of draining the whole writer queue."""
         from .budget import memory_budget
+        async_write = bool(active_conf().get(SPILL_ASYNC_WRITE))
         freed = 0
         while target_bytes is None or freed < target_bytes:
             with self._lock:
@@ -133,23 +176,39 @@ class BufferCatalog:
                 if not candidates:
                     break
                 victim = min(candidates, key=lambda e: e.priority)
-                self._spill_to_host_locked(victim)
+                self._spill_to_host_locked(victim, async_write)
+                if async_write and events_out is not None:
+                    events_out.append(victim.writeback)
                 freed += victim.nbytes
-            memory_budget().release(victim.nbytes)
-        self._enforce_host_limit()
+            if not async_write:
+                # async: the device buffer is still physically alive in
+                # entry.pending_device until the writer's device_get
+                # lands — the writer releases the budget then, so the
+                # accounting never under-reports live HBM
+                memory_budget().release(victim.nbytes)
+        self._enforce_host_limit(async_write)
         return freed
 
-    def _spill_to_host_locked(self, entry: _Entry):
+    def _spill_to_host_locked(self, entry: _Entry, async_write: bool = False):
         leaves = jax.tree_util.tree_leaves(entry.device_tree)
-        entry.host_leaves = [np.asarray(jax.device_get(x)) for x in leaves]
         entry.device_tree = None
         entry.tier = StorageTier.HOST
+        if async_write:
+            # hand the device buffers to the writer and return: the
+            # triggering operator is released as soon as the copy starts
+            entry.pending_device = leaves
+            entry.writeback = threading.Event()
+            self._enqueue_writeback("to_host", entry, None,
+                                    entry.writeback)
+        else:
+            entry.host_leaves = [np.asarray(jax.device_get(x))
+                                 for x in leaves]
         self.spilled_device_bytes += entry.nbytes
         from ..obs import events as obs_events
         obs_events.emit("spill", tier="device->host", bytes=entry.nbytes,
-                        priority=entry.priority)
+                        priority=entry.priority, background=async_write)
 
-    def _enforce_host_limit(self):
+    def _enforce_host_limit(self, async_write: bool = False):
         limit = active_conf().get(HOST_SPILL_LIMIT)
         with self._lock:
             host_entries = [e for e in self._entries.values()
@@ -158,20 +217,34 @@ class BufferCatalog:
             for e in sorted(host_entries, key=lambda x: x.priority):
                 if host_total <= limit:
                     break
-                self._spill_to_disk_locked(e)
+                self._spill_to_disk_locked(e, async_write)
                 host_total -= e.nbytes
 
-    def _spill_to_disk_locked(self, entry: _Entry):
+    def _spill_to_disk_locked(self, entry: _Entry,
+                              async_write: bool = False):
         path = os.path.join(self._spill_dir_path(),
                             f"spill-{entry.handle_id}.npz")
-        np.savez(path, **{str(i): a for i, a in enumerate(entry.host_leaves)})
-        entry.host_leaves = None
-        entry.disk_path = path
         entry.tier = StorageTier.DISK
+        if entry.writeback is not None and not entry.writeback.is_set():
+            # a device->host copy for this entry is still in flight
+            # (asyncWrite toggled off mid-query): the disk hop must go
+            # through the writer queue too — FIFO lands it after the
+            # copy; waiting here would deadlock on the catalog lock
+            async_write = True
+        if async_write:
+            # FIFO on the single writer thread: a pending to_host hop
+            # for this entry lands before this job runs
+            entry.writeback = threading.Event()
+            self._enqueue_writeback("to_disk", entry, path,
+                                    entry.writeback)
+        else:
+            _write_npz(path, entry.host_leaves)
+            entry.host_leaves = None
+            entry.disk_path = path
         self.spilled_host_bytes += entry.nbytes
         from ..obs import events as obs_events
         obs_events.emit("spill", tier="host->disk", bytes=entry.nbytes,
-                        priority=entry.priority)
+                        priority=entry.priority, background=async_write)
 
     def _unspill_locked(self, entry: _Entry):
         from .budget import memory_budget
@@ -183,7 +256,10 @@ class BufferCatalog:
             entry.disk_path = None
             entry.tier = StorageTier.HOST
         if entry.tier == StorageTier.HOST:
-            memory_budget().reserve(entry.nbytes)
+            # caller holds self._lock: must NOT drain the writer (it
+            # needs this lock to finalize) — see MemoryBudget.reserve
+            memory_budget().reserve(entry.nbytes,
+                                    wait_for_writeback=False)
             leaves = [jnp.asarray(a) for a in entry.host_leaves]
             entry.device_tree = jax.tree_util.tree_unflatten(
                 entry.treedef, leaves)
@@ -196,6 +272,155 @@ class BufferCatalog:
             self._spill_dir = conf_dir or tempfile.mkdtemp(prefix="srtpu-spill-")
             os.makedirs(self._spill_dir, exist_ok=True)
         return self._spill_dir
+
+    # -- background writer -------------------------------------------------
+    def _enqueue_writeback(self, kind: str, entry: _Entry,
+                           path: Optional[str], ev: threading.Event
+                           ) -> None:
+        """Queue one tier hop's byte movement (caller holds the lock;
+        `ev` is THAT hop's completion event — entry.writeback may point
+        at a later hop by the time the job runs)."""
+        if self._write_q is None:
+            self._write_q = queue.Queue()
+            self._writer = threading.Thread(
+                target=self._writer_loop, args=(self._write_q,),
+                name="spill-writer", daemon=True)
+            self._writer.start()
+        self._write_q.put((kind, entry, path, ev))
+
+    def _writer_loop(self, q: "queue.Queue") -> None:
+        # the queue travels as an argument, not through self._write_q:
+        # shutdown_writer detaches the attribute while this thread may
+        # still be finishing the drained jobs
+        while True:
+            job = q.get()
+            if job is None:
+                q.task_done()
+                return
+            kind, entry, path, ev = job
+            try:
+                self._run_writeback(kind, entry, path)
+            except Exception:  # noqa: BLE001 — a failed writeback must
+                # not kill the writer; the event is still set so waiters
+                # don't hang (they will fail loudly on the missing data)
+                pass
+            finally:
+                ev.set()
+                q.task_done()
+
+    def _run_writeback(self, kind: str, entry: _Entry,
+                       path: Optional[str]) -> None:
+        """One hop's data movement. The expensive part (d2h copy / file
+        write + fsync) runs WITHOUT the catalog lock; only the state
+        finalize takes it."""
+        if kind == "to_host":
+            from .budget import memory_budget
+            with self._lock:
+                pending = entry.pending_device
+                if entry.closed:
+                    # removed before the copy started: don't waste a
+                    # full d2h transfer on a dead buffer — drop it,
+                    # free the budget it still held, and un-count the
+                    # hop (no bytes ever moved; keeps the counters
+                    # consistent with the failure branches below)
+                    entry.pending_device = None
+                    if pending is not None:
+                        memory_budget().release(entry.nbytes)
+                        self.spilled_device_bytes -= entry.nbytes
+                    return
+            if pending is None:
+                return
+            try:
+                host = [np.asarray(jax.device_get(x)) for x in pending]
+            except Exception:  # noqa: BLE001 — transient device error:
+                # the data never left the device; put the entry back on
+                # the DEVICE tier intact (budget was never released)
+                with self._lock:
+                    entry.pending_device = None
+                    if not entry.closed:
+                        entry.device_tree = jax.tree_util.tree_unflatten(
+                            entry.treedef, pending)
+                        entry.tier = StorageTier.DEVICE
+                        # the hop never happened: un-count it so a
+                        # retried spill of this entry isn't double-counted
+                        self.spilled_device_bytes -= entry.nbytes
+                        return
+                memory_budget().release(entry.nbytes)
+                return
+            with self._lock:
+                entry.pending_device = None
+                if not entry.closed:
+                    entry.host_leaves = host
+            # the device buffers are dropped HERE (copy landed or entry
+            # closed): only now is the HBM actually free
+            memory_budget().release(entry.nbytes)
+            return
+        # to_disk: by single-writer FIFO the to_host hop (if any) has
+        # already landed, so host_leaves is populated
+        with self._lock:
+            host = entry.host_leaves
+            closed = entry.closed
+            if host is None or closed:
+                # the disk write will never run (the preceding to_host
+                # copy failed and restored the entry to DEVICE, or the
+                # buffer was removed first): un-count the bytes
+                # _spill_to_disk_locked charged for the hop
+                self.spilled_host_bytes -= entry.nbytes
+        if closed or host is None:
+            return
+        try:
+            _write_npz(path, host)
+        except Exception:  # noqa: BLE001 — disk full/unwritable: the
+            # host copy is still intact, so the entry simply stays on
+            # the HOST tier; drop any partial file
+            with self._lock:
+                if not entry.closed:
+                    entry.tier = StorageTier.HOST
+                    # un-count the hop that never landed (a retried
+                    # disk spill would double-count this entry)
+                    self.spilled_host_bytes -= entry.nbytes
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return
+        with self._lock:
+            if entry.closed:
+                unlink = True
+            else:
+                entry.host_leaves = None
+                entry.disk_path = path
+                unlink = False
+        if unlink:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def drain_writeback(self) -> None:
+        """Block until every queued writeback has landed (test/bench
+        hook; queries never need it — acquire() waits per entry)."""
+        with self._lock:  # snapshot: shutdown_writer detaches under
+            q = self._write_q  # the same lock
+        if q is not None:
+            q.join()
+
+    def shutdown_writer(self) -> None:
+        """Stop the writer thread after draining (test isolation). The
+        queue is DETACHED under the catalog lock first: _enqueue runs
+        under that lock, so a concurrent spill either lands its job
+        before the drain below or sees _write_q None and starts a fresh
+        writer — it can never enqueue onto a queue whose writer already
+        exited (that hop's completion event would never be set and a
+        later acquire() of the entry would wait forever)."""
+        with self._lock:
+            q, writer = self._write_q, self._writer
+            self._write_q = None
+            self._writer = None
+        if q is not None:
+            q.join()
+            q.put(None)
+            writer.join()
 
     # -- introspection (test surface) -------------------------------------
     def device_bytes(self) -> int:
@@ -223,5 +448,10 @@ def buffer_catalog() -> BufferCatalog:
 def reset_buffer_catalog() -> BufferCatalog:
     global _catalog
     with _catalog_lock:
-        _catalog = BufferCatalog()
-        return _catalog
+        old, _catalog = _catalog, BufferCatalog()
+    if old is not None:
+        try:
+            old.shutdown_writer()
+        except Exception:  # noqa: BLE001 — teardown only
+            pass
+    return _catalog

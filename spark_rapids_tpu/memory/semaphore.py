@@ -4,52 +4,159 @@ TPU programs serialize per core, so this is an admission queue into the
 per-chip executor: at most `spark.rapids.sql.concurrentGpuTasks` tasks may
 hold the device; others block (and their operator state, held as
 SpillableBatch, remains stealable). Wait time is tracked for task metrics
-(reference GpuTaskMetrics semWaitTime)."""
+(reference GpuTaskMetrics semWaitTime).
+
+Re-entrant ACROSS THREADS per task (ISSUE 3): a pipeline producer thread
+uploading batches for the same task as its consumer shares that task's
+one permit — when two threads race the task's FIRST acquire, the loser
+waits for the winner instead of taking a second permit (the reference
+has the same property: one semaphore acquisition per Spark task however
+many threads serve it). A producer blocked waiting for a permit polls an
+optional `cancel` predicate so an abandoned pipelined query can always
+tear down.
+"""
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from ..config import CONCURRENT_TPU_TASKS, active_conf
+
+_POLL_S = 0.05
+
+
+class _TaskHold:
+    __slots__ = ("count", "ready", "abandoned")
+
+    def __init__(self):
+        self.count = 0                  # re-entrant depth (one permit)
+        self.ready = threading.Event()  # set once the permit is held
+        self.abandoned = False          # task released mid-first-acquire
 
 
 class TpuSemaphore:
     def __init__(self, permits: Optional[int] = None):
         self._permits = permits or active_conf().get(CONCURRENT_TPU_TASKS)
         self._sem = threading.Semaphore(self._permits)
-        self._holders: Dict[int, int] = {}
+        self._holders: Dict[int, _TaskHold] = {}
         self._lock = threading.Lock()
         self.total_wait_ns = 0
 
-    def acquire_if_necessary(self, task_id: int):
+    def acquire_if_necessary(self, task_id: int,
+                             cancel: Optional[Callable[[], bool]] = None
+                             ) -> bool:
         """Idempotent per task (reference acquireIfNecessary
-        GpuSemaphore.scala:100): first call blocks for a permit, reentrant
-        calls are free."""
-        with self._lock:
-            if self._holders.get(task_id, 0) > 0:
-                self._holders[task_id] += 1
-                return
+        GpuSemaphore.scala:100): the task's first call blocks for a
+        permit, re-entrant calls — from ANY thread — are free. Returns
+        False — with the permit NOT held — when `cancel()` went true
+        while waiting, or when another thread released the task's hold
+        (task end) while this first acquire was still blocked."""
         t0 = time.monotonic_ns()
-        self._sem.acquire()
+        raced = False
+        while True:
+            with self._lock:
+                hold = self._holders.get(task_id)
+                if hold is not None and hold.count > 0:
+                    hold.count += 1
+                    if not raced:
+                        return True
+                    # this thread LOST the race for the task's first
+                    # acquire and parked in the waiter loop below: its
+                    # blocked time is real semaphore wait and must show
+                    # up in semWaitTimeNs like the winner's does
+                    waited = time.monotonic_ns() - t0
+                    self.total_wait_ns += waited
+                    break
+                if hold is None:
+                    hold = _TaskHold()
+                    self._holders[task_id] = hold
+                    raced = False
+                    break  # this thread owns the first acquire
+            # another thread is mid-first-acquire for this task: wait
+            # for it (or for its cancellation) and re-check
+            raced = True
+            hold.ready.wait(_POLL_S)
+            if cancel is not None and cancel():
+                return False
+            if hold.abandoned:
+                # release_if_necessary (task end) ran while the first
+                # acquire this thread was waiting on was still blocked:
+                # re-racing a fresh acquire for the ended task would
+                # take a permit nobody ever releases
+                return False
+        if raced:
+            # re-entrant success after losing the first-acquire race:
+            # the permit is the winner's, but the wait was this
+            # thread's — attribute it
+            from ..obs import events as obs_events
+            obs_events.emit("semaphore_acquire", task_id=task_id,
+                            wait_ns=waited)
+            return True
+        while not self._sem.acquire(timeout=_POLL_S):
+            if hold.abandoned:
+                # release_if_necessary (task end) ran while this first
+                # acquire was still blocked: the outcome is already
+                # False — stop competing for a permit that would only
+                # be handed straight back (the holder entry is gone)
+                hold.ready.set()
+                return False
+            if cancel is not None and cancel():
+                with self._lock:
+                    if self._holders.get(task_id) is hold:
+                        del self._holders[task_id]
+                hold.ready.set()  # waiters re-race a fresh first acquire
+                return False
         waited = time.monotonic_ns() - t0
-        self.total_wait_ns += waited
         with self._lock:
-            self._holders[task_id] = self._holders.get(task_id, 0) + 1
+            abandoned = hold.abandoned
+            if abandoned:
+                if self._holders.get(task_id) is hold:
+                    del self._holders[task_id]
+            else:
+                # under the lock: concurrent producer threads' first
+                # acquires would otherwise lose updates to this counter
+                self.total_wait_ns += waited
+                hold.count = 1
+        if abandoned:
+            # release_if_necessary ran while we were blocked: keeping
+            # this permit would leak it forever (the task never
+            # releases again), so hand it straight back
+            self._sem.release()
+            hold.ready.set()
+            return False
+        hold.ready.set()
         from ..obs import events as obs_events
         obs_events.emit("semaphore_acquire", task_id=task_id,
                         wait_ns=waited)
+        return True
 
     def release_if_necessary(self, task_id: int):
+        """Release the task's permit entirely (task end — the reference
+        releases the whole task's hold, not one nesting level)."""
         with self._lock:
-            count = self._holders.pop(task_id, 0)
-        if count > 0:
+            hold = self._holders.get(task_id)
+            if hold is not None:
+                del self._holders[task_id]
+                # any thread still parked in the waiter loop holds a
+                # stale reference to this hold: abandoned stops a late
+                # wake-up from re-racing a fresh acquire for the ended
+                # task (which would take a permit nobody ever releases)
+                hold.abandoned = True
+                if hold.count == 0:
+                    # a first acquire for this task is still blocked on
+                    # another thread: it must hand its permit straight
+                    # back when it lands (no permit is held right now)
+                    hold = None
+        if hold is not None:
+            hold.ready.set()
             self._sem.release()
 
     def held_by(self, task_id: int) -> bool:
         with self._lock:
-            return self._holders.get(task_id, 0) > 0
+            hold = self._holders.get(task_id)
+            return hold is not None and hold.count > 0
 
     @property
     def available(self) -> int:

@@ -11,7 +11,7 @@ Event kinds and their levels (spark.rapids.tpu.eventLog.level):
   ESSENTIAL  query_start, query_end
   MODERATE   op_close, semaphore_acquire, spill, oom_retry,
              pallas_tier, plan_fallback, plan_not_on_tpu, exchange,
-             op_error
+             pipeline_wait, pipeline_full, op_error
   DEBUG      op_open, op_batch, span
 
 Cost discipline: `active_bus()` returns None when logging is disabled —
@@ -50,6 +50,8 @@ EVENT_LEVELS: Dict[str, int] = {
     "plan_fallback": MODERATE,
     "plan_not_on_tpu": MODERATE,
     "exchange": MODERATE,
+    "pipeline_wait": MODERATE,
+    "pipeline_full": MODERATE,
     "op_open": DEBUG,
     "op_batch": DEBUG,
     "span": DEBUG,
@@ -202,6 +204,13 @@ _query_counter_lock = threading.Lock()
 
 def current_query_id() -> Optional[int]:
     return getattr(_qlocal, "qid", None)
+
+
+def adopt_query_id(qid: Optional[int]) -> None:
+    """Attribute this thread's events to an existing query id — used by
+    pipeline producer threads (exec/pipeline.py) so events emitted
+    behind a stage boundary carry their consumer's query."""
+    _qlocal.qid = qid
 
 
 @contextlib.contextmanager

@@ -48,7 +48,7 @@ def _fmt_bytes(b: int) -> str:
 
 
 def _fmt_metric(name: str, value: int) -> str:
-    if name.endswith(("Time", "TimeNs")) or name.endswith("WaitNs"):
+    if name.endswith(("Time", "Ns")):
         return _fmt_ns(value)
     if name.endswith(("Bytes", "Size")) or name == "dataSize":
         return _fmt_bytes(value)
@@ -116,18 +116,33 @@ class QueryProfile:
         """Top-N operator rows. by="time" (default) ranks by the sum of
         the node's *Time metrics — operators time their own work in
         per-op metrics (computeAggTime, joinTime, ...), so opTime alone
-        under-ranks them; any explicit metric name ranks by that."""
+        under-ranks them; any explicit metric name ranks by that.
+
+        Pipelined stages (ISSUE 3) additionally carry `overlap`: the
+        fraction of the stage's lifetime NOT spent stalled waiting on
+        its pipelined input, 1 - pipelineWaitNs / pipelineWallNs. 1.0
+        means the producer fully hid the input latency; low values mean
+        the stage is input-bound (raise pipeline.depth or speed the
+        producer). Only meaningful while pipeline.enabled is on — a
+        synchronous stage records neither wait nor wall."""
         rows: List[Dict[str, Any]] = []
 
         def walk(node):
             m = node["metrics"]
             time_ns = sum(v for k, v in m.items() if k.endswith("Time"))
-            rows.append({"op": node["op"], "op_id": node["op_id"],
-                         "time_ns": time_ns,
-                         "rows": m.get("numOutputRows", 0),
-                         "batches": m.get("numOutputBatches", 0),
-                         "rank_key": time_ns if by == "time"
-                         else m.get(by, 0)})
+            row = {"op": node["op"], "op_id": node["op_id"],
+                   "time_ns": time_ns,
+                   "rows": m.get("numOutputRows", 0),
+                   "batches": m.get("numOutputBatches", 0),
+                   "rank_key": time_ns if by == "time"
+                   else m.get(by, 0)}
+            if "pipelineWaitNs" in m:
+                wait = m["pipelineWaitNs"]
+                wall = m.get("pipelineWallNs", 0)
+                row["pipeline_wait_ns"] = wait
+                if wall > 0:
+                    row["overlap"] = round(1.0 - min(wait, wall) / wall, 4)
+            rows.append(row)
             for c in node["children"]:
                 walk(c)
 

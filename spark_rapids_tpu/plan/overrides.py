@@ -18,8 +18,8 @@ from ..config import RapidsConf, active_conf
 from ..exec.aggregate import AggregateExec
 from ..exec.base import TpuExec
 from ..exec.basic import (
-    ExpandExec, FilterExec, GlobalLimitExec, InMemoryScanExec, ProjectExec,
-    RangeExec, UnionExec,
+    ExpandExec, FilterExec, GlobalLimitExec, ProjectExec, RangeExec,
+    SourceScanExec, UnionExec,
 )
 from ..exec.coalesce import CoalesceBatchesExec
 from ..exec.joins import HashJoinExec, NestedLoopJoinExec
@@ -988,13 +988,15 @@ class PlanMeta(BaseMeta):
                 pushed = extract_pushable_filters(p.condition, scan.schema)
                 if pushed:
                     src = src.with_filters(pushed)
+            # SourceScanExec streams source.batches() lazily: with
+            # pipelining enabled, decode + upload of batch N+1 overlap
+            # the device compute of batch N (ISSUE 3)
             scan_exec = CoalesceBatchesExec(
-                InMemoryScanExec(list(src.batches()), scan.schema))
+                SourceScanExec(src, scan.schema))
             return FilterExec(p.condition, scan_exec)
         kids = [c.convert() for c in self.children]
         if isinstance(p, L.LogicalScan):
-            batches = list(p.source.batches())
-            exec_node: TpuExec = InMemoryScanExec(batches, p.schema)
+            exec_node: TpuExec = SourceScanExec(p.source, p.schema)
             return CoalesceBatchesExec(exec_node)
         if isinstance(p, L.LogicalRange):
             return RangeExec(p.start, p.end, p.step, name=p.name)

@@ -2,6 +2,7 @@
 GpuInMemoryTableScanExec, cache_test.py in integration tests)."""
 
 import numpy as np
+import pytest
 
 
 def _sorted(rows):
@@ -41,6 +42,7 @@ def test_cache_roundtrip_and_single_materialization():
     assert dict(again) == expect
 
 
+@pytest.mark.slow  # ~6s; compression detail nightly, roundtrip kept tier-1 (round-7 budget move)
 def test_cache_is_compressed():
     sess = TpuSession()
     cached = _df(sess, 2000).cache()

@@ -65,6 +65,7 @@ def test_shifts_java_semantics():
 STR_SCH = Schema((StructField("s", STRING),))
 
 
+@pytest.mark.slow  # ~7s; device json parity kept tier-1 in test_json_device (round-7 budget move)
 def test_get_json_object():
     sess = TpuSession()
     data = {"s": ['{"a":{"b":[1,2,3]},"x":"y"}', '{"a":1}',

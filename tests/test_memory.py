@@ -70,6 +70,9 @@ def test_spill_to_disk(tmp_path):
         cat = buffer_catalog()
         cat.synchronous_spill(None)  # device -> host -> (limit 1k) -> disk
         assert cat.tier_of(sb._handle) == StorageTier.DISK
+        # spill.asyncWrite (default on) hands the write to the
+        # background writer; drain before asserting the file landed
+        cat.drain_writeback()
         assert list(tmp_path.glob("spill-*.npz"))
         got = sb.get_batch()
         assert got.to_pydict()["a"][5] == 5
@@ -167,33 +170,39 @@ def test_retry_gives_up_after_max_attempts():
 
 def test_semaphore_admission():
     sem = reset_tpu_semaphore(2)
-    sem.acquire_if_necessary(1)
-    sem.acquire_if_necessary(1)  # reentrant, no deadlock
-    sem.acquire_if_necessary(2)
-    assert sem.available == 0
-    sem.release_if_necessary(1)
-    assert sem.available == 1
-    sem.release_if_necessary(2)
-    assert sem.available == 2
+    try:
+        sem.acquire_if_necessary(1)
+        sem.acquire_if_necessary(1)  # reentrant, no deadlock
+        sem.acquire_if_necessary(2)
+        assert sem.available == 0
+        sem.release_if_necessary(1)
+        assert sem.available == 1
+        sem.release_if_necessary(2)
+        assert sem.available == 2
+    finally:
+        reset_tpu_semaphore()  # don't leak a 2-permit sem to later tests
 
 
 def test_semaphore_blocks_third_task():
     import threading
     sem = reset_tpu_semaphore(1)
-    sem.acquire_if_necessary(1)
-    acquired = threading.Event()
+    try:
+        sem.acquire_if_necessary(1)
+        acquired = threading.Event()
 
-    def worker():
-        sem.acquire_if_necessary(2)
-        acquired.set()
-        sem.release_if_necessary(2)
+        def worker():
+            sem.acquire_if_necessary(2)
+            acquired.set()
+            sem.release_if_necessary(2)
 
-    t = threading.Thread(target=worker)
-    t.start()
-    assert not acquired.wait(0.1)
-    sem.release_if_necessary(1)
-    assert acquired.wait(2.0)
-    t.join()
+        t = threading.Thread(target=worker)
+        t.start()
+        assert not acquired.wait(0.1)
+        sem.release_if_necessary(1)
+        assert acquired.wait(2.0)
+        t.join()
+    finally:
+        reset_tpu_semaphore()  # don't leak a 1-permit sem to later tests
 
 
 def test_config_docs_generation():

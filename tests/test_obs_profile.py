@@ -134,7 +134,7 @@ def test_sibling_operators_do_not_collide_in_roll_up():
     out = df_l.join(df_r, left_on="k", right_on="k2").collect()
     assert len(out) == 2
     m = sess.last_query_metrics()
-    scan_keys = [k for k in m if "InMemoryScanExec" in k
+    scan_keys = [k for k in m if "SourceScanExec" in k
                  and k.endswith(".numOutputRows")]
     assert len(scan_keys) == 2, scan_keys       # both sides present
     assert sum(m[k] for k in scan_keys) == 3 + 2

@@ -113,6 +113,7 @@ def test_cbo_keeps_large_section_on_device():
     assert "HostProjectExec" not in tree
 
 
+@pytest.mark.slow  # ~11s: nightly tier (round-7 budget move, redundant tier-1 coverage)
 def test_subpartitioned_join_for_big_build_side():
     """Both sides over the sub-partition threshold: the planner splits
     the join into hash sub-partitions through the host shuffle

@@ -1,0 +1,970 @@
+"""Asynchronous pipelined execution (ISSUE 3): the bounded stage
+boundary's fault paths, thread hygiene and context propagation, the
+cross-thread re-entrant admission semaphore, background spill
+writeback, and engine-level on/off equality. Deterministic on
+single-core CPU: ordering and thread hygiene are asserted, never
+timing."""
+
+import threading
+import time
+import traceback
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.exec.pipeline import (PipelinedIterator, _SyncStage,
+                                            pipeline_depth, pipelined)
+
+
+def _pipeline_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("pipeline-")]
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_threads():
+    """Every test in this file must leave zero pipeline threads."""
+    assert not _pipeline_threads()
+    yield
+    assert not _pipeline_threads()
+
+
+# -- the primitive ----------------------------------------------------------
+
+def test_fifo_ordering():
+    stage = pipelined(iter(range(500)), depth=3)
+    try:
+        assert list(stage) == list(range(500))
+    finally:
+        stage.close()
+
+
+def test_depth_zero_is_synchronous():
+    stage = pipelined(iter([1, 2, 3]), depth=0)
+    assert isinstance(stage, _SyncStage)
+    assert list(stage) == [1, 2, 3]
+    stage.close()
+
+
+def test_enabled_false_degrades_to_sync():
+    conf = C.RapidsConf({"spark.rapids.tpu.pipeline.enabled": False})
+    assert pipeline_depth(conf) == 0
+    assert isinstance(pipelined(iter([]), conf=conf), _SyncStage)
+    conf_on = C.RapidsConf({"spark.rapids.tpu.pipeline.depth": "5"})
+    assert pipeline_depth(conf_on) == 5
+
+
+def test_producer_error_surfaces_at_consumer_with_traceback():
+    """Items produced before the error arrive first (queue drained),
+    then the error re-raises at the consumer with the producer's
+    original traceback; the thread is joined."""
+    def boom():
+        yield 10
+        yield 20
+        raise ValueError("injected producer failure")
+
+    stage = pipelined(boom(), depth=2)
+    got = []
+    with pytest.raises(ValueError, match="injected producer failure") as ei:
+        for x in stage:
+            got.append(x)
+    assert got == [10, 20]
+    tb = "".join(traceback.format_tb(ei.value.__traceback__))
+    assert "boom" in tb  # original producer frames preserved
+    stage.close()
+    assert not _pipeline_threads()  # joined, not abandoned
+
+
+def test_consumer_abandons_early_producer_unblocks():
+    """A consumer walking away (limit/short-circuit) must unblock a
+    producer stuck on the full queue and join it; the source iterator's
+    finally runs."""
+    state = {"produced": 0, "closed": False}
+
+    def endless():
+        try:
+            while True:
+                state["produced"] += 1
+                yield state["produced"]
+        finally:
+            state["closed"] = True
+
+    stage = pipelined(endless(), depth=2)
+    assert next(stage) == 1
+    stage.close()  # producer is blocked on the full queue right now
+    assert not _pipeline_threads()
+    assert state["closed"]  # source generator finalized
+    # bounded prefetch: the producer never ran unboundedly ahead
+    assert state["produced"] <= 2 + 2 + 1  # depth + in-flight slack
+
+
+def test_close_is_idempotent_and_next_after_close_stops():
+    stage = pipelined(iter(range(10)), depth=2)
+    assert next(stage) == 0
+    stage.close()
+    stage.close()
+    with pytest.raises(StopIteration):
+        next(stage)
+
+
+def test_producer_inherits_conf_query_id_and_speculation_scope():
+    from spark_rapids_tpu.exec.speculation import (current_scope,
+                                                   speculation_scope)
+    from spark_rapids_tpu.obs import events as obs_events
+
+    conf = C.RapidsConf({"spark.rapids.tpu.pipeline.depth": "3"})
+    C.set_active_conf(conf)
+    try:
+        with obs_events.query_scope() as qid:
+            with speculation_scope() as scope:
+                seen = {}
+
+                def probe():
+                    seen["conf"] = C.active_conf()
+                    seen["qid"] = obs_events.current_query_id()
+                    seen["scope"] = current_scope()
+                    yield 1
+
+                stage = pipelined(probe(), depth=2)
+                try:
+                    assert list(stage) == [1]
+                finally:
+                    stage.close()
+                assert seen["conf"] is conf
+                assert seen["qid"] == qid
+                assert seen["scope"] is scope
+    finally:
+        C.set_active_conf(C.RapidsConf())
+
+
+def test_pipeline_events_emitted(tmp_path):
+    import json
+
+    from spark_rapids_tpu.obs import events as obs_events
+    obs_events.enable(str(tmp_path), "MODERATE")
+    try:
+        stage = pipelined(iter(range(5)), depth=2, label="evt-test")
+        assert list(stage) == list(range(5))
+        stage.close()
+    finally:
+        obs_events.reset_event_bus()
+    recs = [json.loads(ln) for f in tmp_path.glob("*.jsonl")
+            for ln in f.read_text().splitlines()]
+    kinds = {r["kind"] for r in recs if r.get("stage") == "evt-test"}
+    assert kinds == {"pipeline_wait", "pipeline_full"}
+    wait = [r for r in recs if r["kind"] == "pipeline_wait"
+            and r["stage"] == "evt-test"]
+    assert len(wait) == 1 and wait[0]["batches"] == 5
+    assert wait[0]["wait_ns"] >= 0
+
+
+def test_non_operator_stage_stays_out_of_event_log(tmp_path):
+    """emit_events=False (tools/pipeline_bench driven in-process by
+    bench.py): the synthetic stage's deliberate sleep-stalls must not
+    land in an active engine event log, where profile_report's
+    'pipeline stages' roll-up would misattribute them to real
+    boundaries."""
+    import json
+    import sys
+    from pathlib import Path
+
+    from spark_rapids_tpu.obs import events as obs_events
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    import pipeline_bench  # noqa: E402
+    obs_events.enable(str(tmp_path), "MODERATE")
+    try:
+        out = pipeline_bench.run_bench(items=3, produce_s=0.001,
+                                       consume_s=0.001, depth=2)
+    finally:
+        obs_events.reset_event_bus()
+    assert out["items"] == 3
+    recs = [json.loads(ln) for f in tmp_path.glob("*.jsonl")
+            for ln in f.read_text().splitlines()]
+    assert not [r for r in recs
+                if r["kind"].startswith("pipeline_")]  # log uncontaminated
+
+
+# -- cross-thread re-entrant semaphore --------------------------------------
+
+def test_semaphore_shared_permit_across_threads():
+    """Two threads racing a task's FIRST acquire take ONE permit; the
+    re-entrant call from a second thread is free."""
+    from spark_rapids_tpu.memory.semaphore import reset_tpu_semaphore
+    sem = reset_tpu_semaphore(1)
+    done = []
+
+    def worker():
+        assert sem.acquire_if_necessary(42)
+        done.append(threading.current_thread().name)
+
+    threads = [threading.Thread(target=worker) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)
+    assert len(done) == 3          # nobody deadlocked on a 1-permit sem
+    assert sem.available == 0      # exactly one permit taken
+    sem.release_if_necessary(42)
+    assert sem.available == 1
+    reset_tpu_semaphore()
+
+
+def test_semaphore_cancellable_wait():
+    from spark_rapids_tpu.memory.semaphore import reset_tpu_semaphore
+    sem = reset_tpu_semaphore(1)
+    assert sem.acquire_if_necessary(1)
+    stop = threading.Event()
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(
+            sem.acquire_if_necessary(2, cancel=stop.is_set)))
+    t.start()
+    stop.set()
+    t.join(5)
+    assert out == [False]
+    assert not sem.held_by(2)      # no permit, no stale holder record
+    sem.release_if_necessary(1)
+    assert sem.acquire_if_necessary(2)  # task 2 can acquire normally now
+    sem.release_if_necessary(2)
+    reset_tpu_semaphore()
+
+
+def test_semaphore_release_during_blocked_first_acquire_leaks_no_permit():
+    """release_if_necessary (task end) while another thread's FIRST
+    acquire for that task is still blocked for a permit: the
+    late-landing acquire must hand its permit straight back and report
+    failure — keeping it would leak the permit forever (the ended task
+    never releases again)."""
+    from spark_rapids_tpu.memory.semaphore import reset_tpu_semaphore
+    sem = reset_tpu_semaphore(1)
+    assert sem.acquire_if_necessary(1)   # exhaust the only permit
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(sem.acquire_if_necessary(2)))
+    t.start()
+    for _ in range(500):                 # until t's first acquire is in
+        with sem._lock:                  # flight (registered, blocked)
+            if sem._holders.get(2) is not None:
+                break
+        time.sleep(0.01)
+    else:
+        pytest.fail("first acquire never registered")
+    sem.release_if_necessary(2)          # task 2 ends while t is blocked
+    sem.release_if_necessary(1)          # a permit frees up; t's acquire
+    t.join(5)                            # lands and must give it back
+    assert out == [False]
+    assert sem.available == 1            # nothing leaked
+    assert not sem.held_by(2)
+    assert sem.acquire_if_necessary(2)   # fresh lifecycle still works
+    sem.release_if_necessary(2)
+    reset_tpu_semaphore()
+
+
+def test_semaphore_abandoned_waiters_do_not_reacquire():
+    """Waiters parked BEHIND a task's blocked first acquire when
+    release_if_necessary (task end) lands must not re-race a fresh
+    acquire for the dead task — the owner's hand-back alone is not
+    enough: a re-racing waiter would install a new hold and take a
+    permit nobody ever releases."""
+    from spark_rapids_tpu.memory.semaphore import reset_tpu_semaphore
+    sem = reset_tpu_semaphore(1)
+    assert sem.acquire_if_necessary(1)   # exhaust the only permit
+    out = []
+    threads = [threading.Thread(
+        target=lambda: out.append(sem.acquire_if_necessary(2)))
+        for _ in range(3)]               # 1 first-acquire owner + 2 waiters
+    for t in threads:
+        t.start()
+    for _ in range(500):                 # until the first acquire is in
+        with sem._lock:                  # flight (registered, blocked)
+            if sem._holders.get(2) is not None:
+                break
+        time.sleep(0.01)
+    else:
+        pytest.fail("first acquire never registered")
+    sem.release_if_necessary(2)          # task 2 ends while all blocked
+    sem.release_if_necessary(1)          # a permit frees up
+    for t in threads:
+        t.join(5)
+    assert out == [False, False, False]  # nobody acquired for the dead task
+    assert sem.available == 1            # nothing leaked
+    assert not sem.held_by(2)
+    assert sem.acquire_if_necessary(2)   # fresh lifecycle still works
+    sem.release_if_necessary(2)
+    reset_tpu_semaphore()
+
+
+# -- background spill writeback ---------------------------------------------
+
+@pytest.fixture
+def spill_env(tmp_path):
+    from spark_rapids_tpu.memory.budget import reset_memory_budget
+    from spark_rapids_tpu.memory.catalog import reset_buffer_catalog
+    prev_conf = C.active_conf()
+
+    def setup(async_write, host_limit="4g"):
+        C.set_active_conf(C.RapidsConf({
+            "spark.rapids.tpu.spill.asyncWrite": async_write,
+            "spark.rapids.memory.host.spillStorageSize": host_limit,
+            "spark.rapids.memory.spillDirectory": str(tmp_path),
+        }))
+        reset_memory_budget(512 * 1024)
+        return reset_buffer_catalog()
+
+    yield setup
+    C.set_active_conf(prev_conf)
+    reset_buffer_catalog()
+    reset_memory_budget()
+
+
+def _batch(n, seed=0):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.types import LONG, Schema
+    return ColumnarBatch.from_pydict(
+        {"a": list(range(seed, seed + n))}, Schema.of(a=LONG))
+
+
+def test_async_writeback_host_hop_roundtrip(spill_env):
+    from spark_rapids_tpu.memory.catalog import StorageTier
+    from spark_rapids_tpu.memory.spillable import SpillableBatch
+    cat = spill_env(True)
+    sb = SpillableBatch.from_batch(_batch(128))
+    cat.synchronous_spill(None)
+    assert cat.tier_of(sb._handle) == StorageTier.HOST
+    # acquire blocks until the in-flight device->host copy lands, then
+    # promotes back — identical data, async or not
+    assert sb.get_batch().to_pydict()["a"][:3] == [0, 1, 2]
+    assert cat.tier_of(sb._handle) == StorageTier.DEVICE
+    sb.release()
+    sb.close()
+
+
+def test_async_writeback_disk_hop_is_durable(spill_env, tmp_path):
+    from spark_rapids_tpu.memory.catalog import StorageTier
+    from spark_rapids_tpu.memory.spillable import SpillableBatch
+    cat = spill_env(True, host_limit="1k")
+    sb = SpillableBatch.from_batch(_batch(128))
+    cat.synchronous_spill(None)  # device -> host -> (1k limit) -> disk
+    assert cat.tier_of(sb._handle) == StorageTier.DISK
+    cat.drain_writeback()
+    assert list(tmp_path.glob("spill-*.npz"))  # written + fsync'd
+    assert sb.get_batch().to_pydict()["a"][5] == 5
+    sb.release()
+    sb.close()
+
+
+def test_remove_during_inflight_writeback_leaks_nothing(spill_env,
+                                                        tmp_path):
+    from spark_rapids_tpu.memory.spillable import SpillableBatch
+    cat = spill_env(True, host_limit="1k")
+    sb = SpillableBatch.from_batch(_batch(256))
+    cat.synchronous_spill(None)
+    sb.close()  # remove while to_host/to_disk jobs may still be queued
+    cat.drain_writeback()
+    assert cat.num_entries() == 0
+    assert not list(tmp_path.glob("spill-*.npz"))  # file discarded
+
+
+def test_sync_vs_async_spill_same_data(spill_env):
+    from spark_rapids_tpu.memory.spillable import SpillableBatch
+    out = {}
+    for mode in (False, True):
+        cat = spill_env(mode, host_limit="1k")
+        sb = SpillableBatch.from_batch(_batch(200, seed=7))
+        cat.synchronous_spill(None)
+        out[mode] = sb.get_batch().to_pydict()["a"]
+        sb.release()
+        sb.close()
+    assert out[True] == out[False] == list(range(7, 207))
+
+
+def test_spill_events_out_collects_own_hops(spill_env):
+    """synchronous_spill(events_out=...) hands back the completion
+    events of exactly the device->host copies IT queued; once those are
+    set the spilled bytes are out of the budget — the surface
+    budget.reserve uses to avoid draining the whole writer queue under
+    pressure."""
+    from spark_rapids_tpu.memory.budget import memory_budget
+    from spark_rapids_tpu.memory.spillable import SpillableBatch
+    cat = spill_env(True)
+    sbs = [SpillableBatch.from_batch(_batch(256, seed=i)) for i in range(4)]
+    assert memory_budget().used > 0
+    events = []
+    freed = cat.synchronous_spill(None, events_out=events)
+    assert freed > 0 and len(events) == 4
+    for ev in events:
+        assert ev.wait(5)
+    assert memory_budget().used == 0  # every copy landed -> bytes freed
+    for sb in sbs:
+        sb.close()
+
+
+def test_spill_for_retry_waits_out_async_writebacks(spill_env):
+    """Between OOM retries spill_for_retry must leave the budget
+    actually freed, not just hand hops to the writer: the TpuRetryOOM
+    that triggered it can come from reserve(wait_for_writeback=False)
+    (unspill under the catalog lock — cannot drain itself), whose
+    pressure only clears when the writer lands the copies. A
+    non-waiting spill_for_retry lets the retry loop spin through all
+    its attempts in microseconds while the bytes it needs are still
+    queued behind the writer, failing a query asyncWrite=false would
+    have completed."""
+    from spark_rapids_tpu.memory.budget import (memory_budget,
+                                                spill_for_retry)
+    from spark_rapids_tpu.memory.spillable import SpillableBatch
+    spill_env(True)
+    sbs = [SpillableBatch.from_batch(_batch(256, seed=i)) for i in range(4)]
+    assert memory_budget().used > 0
+    spill_for_retry()
+    assert memory_budget().used == 0   # copies LANDED before returning
+    for sb in sbs:
+        sb.close()
+
+
+def test_failed_async_host_hop_restores_entry_and_counters(spill_env,
+                                                           monkeypatch):
+    """A d2h copy failure on the writer puts the entry back on DEVICE
+    intact AND un-counts the spill, so a retried (healthy) spill of the
+    same entry is reported exactly once."""
+    import jax
+    from spark_rapids_tpu.memory.catalog import StorageTier
+    from spark_rapids_tpu.memory.spillable import SpillableBatch
+    cat = spill_env(True)
+    sb = SpillableBatch.from_batch(_batch(64))
+    real_device_get = jax.device_get
+
+    def boom(x):
+        raise RuntimeError("injected d2h failure")
+
+    monkeypatch.setattr(jax, "device_get", boom)
+    cat.synchronous_spill(None)
+    cat.drain_writeback()
+    monkeypatch.setattr(jax, "device_get", real_device_get)
+    assert cat.tier_of(sb._handle) == StorageTier.DEVICE
+    assert cat.spilled_device_bytes == 0       # the hop never happened
+    cat.synchronous_spill(None)                # retry, now healthy
+    cat.drain_writeback()
+    assert cat.tier_of(sb._handle) == StorageTier.HOST
+    assert cat.spilled_device_bytes == cat.size_of(sb._handle)
+    assert sb.get_batch().to_pydict()["a"][:3] == [0, 1, 2]
+    sb.release()
+    sb.close()
+
+
+def test_failed_async_disk_hop_restores_counters(spill_env, monkeypatch):
+    """A disk-write failure keeps the entry on HOST (partial file
+    dropped) and un-counts the host->disk hop; a later healthy pass
+    counts it exactly once."""
+    from spark_rapids_tpu.memory import catalog as cat_mod
+    from spark_rapids_tpu.memory.catalog import StorageTier
+    from spark_rapids_tpu.memory.spillable import SpillableBatch
+    cat = spill_env(True, host_limit="1k")
+    sb = SpillableBatch.from_batch(_batch(128))
+    real_write = cat_mod._write_npz
+
+    def boom(path, host_leaves):
+        raise OSError("injected disk-full")
+
+    monkeypatch.setattr(cat_mod, "_write_npz", boom)
+    cat.synchronous_spill(None)   # device -> host -> (1k limit) -> disk
+    cat.drain_writeback()
+    monkeypatch.setattr(cat_mod, "_write_npz", real_write)
+    assert cat.tier_of(sb._handle) == StorageTier.HOST
+    assert cat.spilled_host_bytes == 0         # the disk hop never landed
+    assert cat.spilled_device_bytes == cat.size_of(sb._handle)
+    cat.synchronous_spill(None)                # host limit re-enforced
+    cat.drain_writeback()
+    assert cat.tier_of(sb._handle) == StorageTier.DISK
+    assert cat.spilled_host_bytes == cat.size_of(sb._handle)
+    assert sb.get_batch().to_pydict()["a"][5] == 5
+    sb.release()
+    sb.close()
+
+
+# -- engine-level equality --------------------------------------------------
+
+@pytest.fixture(scope="module")
+def q_files(tmp_path_factory):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    d = tmp_path_factory.mktemp("pipe_q")
+    rng = np.random.default_rng(3)
+    n_l, n_o = 4000, 500
+    lines = pa.table({
+        "l_key": pa.array(rng.integers(0, n_o, n_l), pa.int64()),
+        "l_val": pa.array(rng.random(n_l) * 100.0, pa.float64()),
+        "l_flag": pa.array(rng.integers(0, 4, n_l), pa.int64()),
+    })
+    orders = pa.table({
+        "o_key": pa.array(np.arange(n_o), pa.int64()),
+        "o_flag": pa.array(rng.integers(0, 10, n_o), pa.int64()),
+    })
+    lp, op = str(d / "lines.parquet"), str(d / "orders.parquet")
+    pq.write_table(lines, lp, row_group_size=512)
+    pq.write_table(orders, op, row_group_size=128)
+    return lp, op
+
+
+def _drive_query(lp, op, settings):
+    """scan -> filter -> join -> agg -> sort through the session."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col, lit
+    from spark_rapids_tpu.api.session import TpuSession
+    sess = TpuSession(settings)
+    lines = sess.read_parquet(lp).filter(col("l_flag") != lit(0))
+    orders = sess.read_parquet(op).filter(col("o_flag") < lit(5))
+    j = lines.join(orders, left_on=["l_key"], right_on=["o_key"])
+    agg = j.group_by("l_key").agg((F.sum("l_val"), "rev"),
+                                  (F.count(), "cnt"))
+    return agg.sort(("rev", False)).collect()
+
+
+def test_cache_materialization_under_one_permit_no_deadlock():
+    """A cached relation materializes by driving a full child plan —
+    whose own SourceScanExec needs an admission permit — from inside
+    the outer scan's producer. With concurrentGpuTasks=1 that nested
+    acquire deadlocked until the producer learned to pre-materialize
+    exec-driving sources BEFORE taking its permit."""
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.memory.semaphore import reset_tpu_semaphore
+    from spark_rapids_tpu.types import LONG, Schema, StructField
+    reset_tpu_semaphore(1)
+    try:
+        sess = TpuSession()
+        sch = Schema((StructField("k", LONG),))
+        df = sess.from_pydict({"k": list(range(200))}, sch, batch_rows=64)
+        cached = df.filter(col("k") < 150).cache()
+        out = {}
+        done = threading.Event()
+
+        def drive():  # a deadlock must fail the test, not hang the suite
+            out["rows"] = cached.collect()
+            done.set()
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+        assert done.wait(60), "cache materialization deadlocked"
+        t.join(5)
+        assert len(out["rows"]) == 150
+        assert not _pipeline_threads()
+    finally:
+        reset_tpu_semaphore()
+
+
+def test_host_shuffle_limit_short_circuit_thread_hygiene():
+    """A LIMIT that abandons host-shuffle partition streams mid-read
+    must join the pipelined readers BEFORE the shuffle files are
+    unregistered (part_stream closes its inner reader first) and leak
+    no pipeline threads."""
+    from spark_rapids_tpu.api.functions import col  # noqa: F401
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.types import LONG, Schema, StructField
+    rng = np.random.default_rng(9)
+    ldata = {"k": [int(x) for x in rng.integers(0, 10, 200)],
+             "v": [int(x) for x in rng.integers(0, 50, 200)]}
+    rdata = {"k": [int(x) for x in rng.integers(0, 10, 150)],
+             "w": [int(x) for x in rng.integers(0, 9, 150)]}
+    lsch = Schema((StructField("k", LONG), StructField("v", LONG)))
+    rsch = Schema((StructField("k", LONG), StructField("w", LONG)))
+    sess = TpuSession({
+        "spark.rapids.sql.shuffle.partitions": "3",
+        "spark.rapids.sql.broadcastSizeThreshold": "-1",
+    })
+    left = sess.from_pydict(ldata, lsch, batch_rows=32)
+    right = sess.from_pydict(rdata, rsch, batch_rows=32)
+    out = left.join(right, on="k").limit(5).collect()
+    assert len(out) == 5
+    assert not _pipeline_threads()
+
+
+def test_engine_equality_pipeline_on_off(q_files):
+    lp, op = q_files
+    on = _drive_query(lp, op, {"spark.rapids.tpu.pipeline.enabled": True})
+    off = _drive_query(lp, op, {"spark.rapids.tpu.pipeline.enabled": False})
+    assert on == off
+    assert len(on) > 0
+    assert not _pipeline_threads()
+
+
+def _rows_equal_float_tolerant(xs, ys, float_cols=(1,)):
+    """Exact on keys/counts, 1e-9-relative on float sums: under a
+    forced-spill budget the OOM-retry SPLIT points depend on thread
+    interleaving, so float reduction order may differ between runs —
+    the engine's documented improvedFloatOps divergence class. Integer
+    results must still match bit-exactly."""
+    if len(xs) != len(ys):
+        return False
+    for x, y in zip(xs, ys):
+        for i, (a, b) in enumerate(zip(x, y)):
+            if i in float_cols:
+                if abs(a - b) > 1e-9 * max(abs(a), abs(b), 1.0):
+                    return False
+            elif a != b:
+                return False
+    return True
+
+
+def test_engine_equality_async_spill_on_off(q_files, tmp_path):
+    """Forced-spill budget: the whole query runs under a budget small
+    enough that coalesce/join staging spills; results are identical
+    with background writeback on and off (float sums to reduction-order
+    tolerance — see _rows_equal_float_tolerant)."""
+    from spark_rapids_tpu.memory.budget import reset_memory_budget
+    from spark_rapids_tpu.memory.catalog import (buffer_catalog,
+                                                 reset_buffer_catalog)
+    lp, op = q_files
+    prev = C.active_conf()
+    results = {}
+    spilled = {}
+    try:
+        for mode in (True, False):
+            reset_buffer_catalog()
+            reset_memory_budget(192 * 1024)  # fits one batch, not the query
+            results[mode] = _drive_query(lp, op, {
+                "spark.rapids.tpu.spill.asyncWrite": mode,
+                "spark.rapids.memory.spillDirectory": str(tmp_path),
+            })
+            spilled[mode] = buffer_catalog().spilled_device_bytes
+    finally:
+        C.set_active_conf(prev)
+        reset_buffer_catalog()
+        reset_memory_budget()
+    assert _rows_equal_float_tolerant(results[True], results[False])
+    assert spilled[True] > 0 and spilled[False] > 0  # the budget DID bite
+
+
+# -- shared multi-file decode pool ------------------------------------------
+
+def test_threaded_chunks_shared_pool_and_conf_window():
+    """ISSUE 3 satellite: one process-wide decode pool (sized by
+    multiThreadedRead.numThreads, grow-only) instead of a pool per
+    call, and a conf-driven fetch-ahead window."""
+    from spark_rapids_tpu.io import multifile
+
+    p1 = multifile.shared_read_pool(4)
+    assert multifile.shared_read_pool(2) is p1   # smaller ask reuses
+    assert multifile.shared_read_pool(4) is p1
+
+    # in-order emission with a small explicit window
+    tasks = [lambda i=i: i for i in range(20)]
+    assert list(multifile.threaded_chunks(tasks, 4, window=3)) \
+        == list(range(20))
+
+    # repeated drives don't multiply pool threads (the old per-call
+    # ThreadPoolExecutor did)
+    for _ in range(5):
+        list(multifile.threaded_chunks(tasks, 4, window=4))
+    decode_threads = [t for t in threading.enumerate()
+                      if t.name.startswith("multifile-read")]
+    assert len(decode_threads) <= 8
+
+    conf = C.RapidsConf(
+        {"spark.rapids.sql.multiThreadedRead.fetchAheadWindow": "5"})
+    assert multifile.fetch_ahead_window(4, conf) == 5
+    assert multifile.fetch_ahead_window(4, C.RapidsConf()) == 8  # 2 x n
+
+
+def test_sync_stage_close_closes_source_generator():
+    state = {"closed": False}
+
+    def gen():
+        try:
+            yield 1
+            yield 2
+        finally:
+            state["closed"] = True
+
+    stage = pipelined(gen(), depth=0)  # synchronous degradation
+    assert next(stage) == 1
+    stage.close()
+    assert state["closed"]
+
+
+def test_cancelled_is_false_outside_producer_threads():
+    from spark_rapids_tpu.exec.pipeline import cancelled
+    assert cancelled() is False
+
+
+def test_wall_metric_accumulates_on_finish():
+    from spark_rapids_tpu.exec.base import TpuMetric
+    wall = TpuMetric("pipelineWallNs")
+    stage = pipelined(iter(range(3)), depth=2, wall_metric=wall)
+    try:
+        assert list(stage) == [0, 1, 2]
+    finally:
+        stage.close()
+    assert wall.value > 0
+    assert stage.wall_ns >= stage.wait_ns  # wall bounds the stall
+
+
+def test_no_events_when_bus_disabled(tmp_path):
+    from spark_rapids_tpu.obs import events as obs_events
+    obs_events.reset_event_bus()
+    stage = pipelined(iter(range(3)), depth=2, label="no-bus")
+    assert list(stage) == [0, 1, 2]
+    stage.close()
+    assert not list(tmp_path.iterdir())  # nothing written anywhere
+
+
+def test_nested_stage_abandonment_propagates_cancellation():
+    """An outer stage's producer may itself be blocked pulling from an
+    INNER stage (planner stacks become nested stages): abandoning the
+    outer one must still tear everything down — the inner consumer
+    polls its thread's cancel event, and the unwinding source generator
+    closes the inner stage."""
+    inner_state = {"closed": False}
+
+    def inner_src():
+        try:
+            while True:
+                yield 1
+        finally:
+            inner_state["closed"] = True
+
+    def outer_src(inner):
+        try:
+            for x in inner:
+                yield x
+        finally:
+            inner.close()
+
+    inner = pipelined(inner_src(), depth=1, label="inner")
+    outer = pipelined(outer_src(inner), depth=1, label="outer")
+    assert next(outer) == 1
+    outer.close()
+    assert not _pipeline_threads()
+    assert inner_state["closed"]
+
+
+def test_scan_producer_releases_permit_between_batches():
+    """SourceScanExec holds the admission permit only around one
+    batch's decode+upload — a scan idling on its full prefetch queue
+    must not starve other tasks of the semaphore."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.exec.basic import SourceScanExec
+    from spark_rapids_tpu.memory.semaphore import (reset_tpu_semaphore,
+                                                   tpu_semaphore)
+    from spark_rapids_tpu.types import LONG, Schema
+
+    sem = reset_tpu_semaphore(1)
+    schema = Schema.of(a=LONG)
+    produced = threading.Event()
+
+    class Src:
+        def batches(self):
+            for i in range(3):
+                yield ColumnarBatch.from_pydict({"a": [i]}, schema)
+                produced.set()
+
+    scan = SourceScanExec(Src(), schema)
+    it = scan.execute()
+    first = next(it)
+    assert first.num_rows_host == 1
+    produced.wait(5)
+    # with depth=2 the producer has prefetched ahead and is now idle:
+    # another task must be able to take the single permit
+    deadline = 100
+    while sem.available == 0 and deadline:
+        threading.Event().wait(0.02)
+        deadline -= 1
+    assert sem.available == 1
+    assert tpu_semaphore().acquire_if_necessary(999)
+    tpu_semaphore().release_if_necessary(999)
+    it.close()
+    assert not _pipeline_threads()
+    reset_tpu_semaphore()
+
+
+# -- review hardening: cancellation vs end-of-stream, race-loser wait -------
+
+def test_cancelled_consumer_raises_error_not_end_of_stream():
+    """A consumer running on a closed outer stage's producer thread
+    must see StageCancelled, NOT a bare StopIteration — downstream code
+    that materializes its input (CachedRelation) would otherwise treat
+    the truncated stream as complete."""
+    from spark_rapids_tpu.exec import pipeline as P
+    cancel = threading.Event()
+    cancel.set()
+
+    def src():
+        yield 1
+        yield 2
+        # park until THIS stage is closed (the producer-side cancel),
+        # so the consumer deterministically finds the queue empty
+        while not P.cancelled():
+            time.sleep(0.005)
+
+    stage = pipelined(src(), depth=1, label="inner")
+    got, err = [], []
+
+    def consume():
+        P._tls.cancel_event = cancel
+        try:
+            for x in stage:
+                got.append(x)
+        except BaseException as e:  # noqa: BLE001 — asserting the type
+            err.append(e)
+        finally:
+            P._tls.cancel_event = None
+            stage.close()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(30)
+    assert not t.is_alive()
+    assert got == [1, 2][:len(got)]  # a strict prefix, never junk
+    assert len(err) == 1 and isinstance(err[0], P.StageCancelled)
+    assert not _pipeline_threads()
+
+
+def test_cancel_does_not_truncate_cached_relation():
+    """Regression: the cancel cut used to raise StopIteration, so
+    CachedRelation._materialize caching on a cancelled producer thread
+    stored the PARTIAL stream as the complete relation — every later
+    scan of the cached DataFrame silently returned truncated results."""
+    from spark_rapids_tpu.exec import pipeline as P
+    from spark_rapids_tpu.exec.cache import CachedRelation
+    from spark_rapids_tpu.types import LONG, Schema
+    sch = Schema.of(a=LONG)
+    cancel = threading.Event()
+    cancel.set()
+
+    def src():
+        yield _batch(4)
+        yield _batch(4, seed=4)
+        while not P.cancelled():
+            time.sleep(0.005)
+
+    class ChildExec:
+        def execute(self):
+            stage = pipelined(src(), depth=1, label="inner")
+            try:
+                yield from stage
+            finally:
+                stage.close()
+
+    rel = CachedRelation(lambda: ChildExec(), sch)
+    err = []
+
+    def drive():
+        P._tls.cancel_event = cancel
+        try:
+            rel.ensure_materialized()
+        except BaseException as e:  # noqa: BLE001 — asserting the type
+            err.append(e)
+        finally:
+            P._tls.cancel_event = None
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    t.join(30)
+    assert not t.is_alive()
+    assert len(err) == 1 and isinstance(err[0], P.StageCancelled)
+    assert not rel.is_materialized  # never cache a truncated stream
+    assert not _pipeline_threads()
+
+
+def test_semaphore_race_loser_records_wait_time(monkeypatch):
+    """The thread that LOSES a task's first-acquire race parks in the
+    waiter loop; its blocked time must land in total_wait_ns (and emit
+    a semaphore_acquire event) just like the winner's does."""
+    from spark_rapids_tpu.memory import semaphore as S
+    from spark_rapids_tpu.obs import events as obs_events
+    calls = []
+    monkeypatch.setattr(obs_events, "emit",
+                        lambda kind, **kw: calls.append((kind, kw)))
+    sem = S.reset_tpu_semaphore(1)
+    in_wait = threading.Event()
+
+    class SpyEvent(threading.Event):
+        def wait(self, timeout=None):
+            in_wait.set()  # the loser reached the waiter loop
+            return super().wait(timeout)
+
+    # hand-install task 7's in-flight first acquire (what a racing
+    # winner holds), so this thread deterministically loses the race
+    hold = S._TaskHold()
+    hold.ready = SpyEvent()
+    sem._holders[7] = hold
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(sem.acquire_if_necessary(7)),
+        daemon=True)
+    t.start()
+    assert in_wait.wait(10)
+    with sem._lock:  # the winner's acquire lands
+        hold.count = 1
+    hold.ready.set()
+    t.join(10)
+    assert out == [True]
+    assert sem.total_wait_ns > 0
+    assert any(k == "semaphore_acquire" and kw["wait_ns"] > 0
+               for k, kw in calls)
+    S.reset_tpu_semaphore()
+
+
+def test_unspill_failure_drops_failing_piece(monkeypatch):
+    """A staged shuffle piece whose host->device promotion fails must
+    still be closed (its catalog entry dropped) — not just the
+    unreached tail of the partition."""
+    from spark_rapids_tpu.exec.basic import InMemoryScanExec
+    from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.types import LONG, Schema
+    sch = Schema.of(a=LONG)
+
+    class FakePiece:
+        def __init__(self, fail=False):
+            self.fail = fail
+            self.closed = False
+
+        def get_batch(self):
+            if self.fail:
+                raise RuntimeError("promotion failed")
+            return _batch(4)
+
+        def release(self):
+            pass
+
+        def close(self):
+            self.closed = True
+
+    ok, bad, tail = FakePiece(), FakePiece(fail=True), FakePiece()
+    ex = ShuffleExchangeExec([], InMemoryScanExec([], sch))
+    it = ex._drain_partition([ok, bad, tail], sch)
+    assert next(it).num_rows_host == 4
+    with pytest.raises(RuntimeError, match="promotion failed"):
+        list(it)
+    assert ok.closed and bad.closed and tail.closed
+    assert not _pipeline_threads()
+
+
+def test_writer_shutdown_then_spill_starts_fresh_writer(spill_env):
+    """shutdown_writer detaches the queue under the catalog lock; a
+    spill after (or racing) the detach starts a FRESH writer instead of
+    enqueueing onto a queue whose writer already exited — that hop's
+    completion event would never fire and acquire() would hang."""
+    from spark_rapids_tpu.memory.catalog import StorageTier
+    from spark_rapids_tpu.memory.spillable import SpillableBatch
+    cat = spill_env(True)
+    sb = SpillableBatch.from_batch(_batch(64))
+    cat.synchronous_spill(None)
+    cat.drain_writeback()
+    cat.shutdown_writer()
+    sb2 = SpillableBatch.from_batch(_batch(64, seed=100))
+    cat.synchronous_spill(None)  # must revive the writer
+    done = threading.Event()
+    out = {}
+
+    def fetch():  # a hang must fail the test, not wedge the suite
+        out["batch"] = sb2.get_batch()
+        done.set()
+
+    t = threading.Thread(target=fetch, daemon=True)
+    t.start()
+    assert done.wait(60), "acquire hung on a dead writer queue"
+    assert out["batch"].to_pydict()["a"][:2] == [100, 101]
+    sb2.release()
+    sb2.close()
+    sb.close()

@@ -174,6 +174,7 @@ def _sums(keys, vals, dtype):
     return dict(zip(ks, zip(sums, counts)))
 
 
+@pytest.mark.slow  # ~9s: nightly tier (round-7 budget move, redundant tier-1 coverage)
 def test_prefix_tier_null_and_all_null_groups():
     keys = [1, 1, 2, 2, 2, 3]
     vals = [10, None, None, None, 7, None]

@@ -202,6 +202,7 @@ def _host_shuffle_session(parts=4):
     })
 
 
+@pytest.mark.slow  # ~11s; host-shuffle equality kept tier-1 via the join variant (round-7 budget move)
 def test_host_shuffled_aggregate_matches_single():
     rng = np.random.default_rng(3)
     n = 500

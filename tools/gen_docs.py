@@ -84,7 +84,10 @@ def gen_supported_ops() -> str:
         ("SampleExec", "LogicalSample", "Bernoulli sampling, threefry RNG"),
         ("PartitionWiseSortExec", "planner-inserted",
          "global sort via range exchange + per-partition sort"),
-        ("CoalesceBatchesExec", "transition pass", "target-bucket concat"),
+        ("SourceScanExec", "LogicalScan",
+         "streaming file-source scan; pipelined decode + upload"),
+        ("CoalesceBatchesExec", "transition pass",
+         "target-bucket concat; pipelined input"),
         ("ColumnarToRowExec / RowToColumnarExec", "transition pass",
          "host row-engine fallback boundary"),
         ("HostProjectExec / HostFilterExec", "CPU fallback",

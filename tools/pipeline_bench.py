@@ -1,0 +1,160 @@
+"""Microbenchmark for the bounded pipeline stage boundary (ISSUE 3
+satellite): drives a synthetic slow-producer / slow-consumer pair
+through `exec.pipeline.pipelined()` and reports achieved overlap
+against the ideal `max(P, C)` bound.
+
+The workload is pure sleeps (no jax, no numpy on the hot path), so the
+numbers measure exactly the boundary: a producer that takes
+`items * produce_s` and a consumer that takes `items * consume_s` run
+in `P + C` when synchronous; a perfect pipeline runs them in
+`max(P, C)`. The achieved overlap ratio is
+
+    overlap = (sync_s - pipelined_s) / min(P, C)      (1.0 = perfect)
+
+and the stage's own stall counters reconcile with it: the pipelined
+wall is ~`C + wait_ns` seen from the consumer and ~`P + full_ns` seen
+from the producer. With an event log enabled the same totals arrive as
+`pipeline_wait` / `pipeline_full` records, which this tool cross-checks.
+
+Usage:
+    python tools/pipeline_bench.py [--items N] [--produce-ms F]
+        [--consume-ms F] [--depth D] [--events DIR]
+
+Stdlib-only workload and reporting — the only non-stdlib import is the
+engine's own `exec.pipeline` module under test (no pyarrow, no numpy on
+the hot path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _produce(items: int, produce_s: float):
+    for i in range(items):
+        time.sleep(produce_s)
+        yield i
+
+
+def _drive(it, consume_s: float) -> int:
+    n = 0
+    for item in it:
+        time.sleep(consume_s)
+        n += 1
+    return n
+
+
+def run_bench(items: int = 30, produce_s: float = 0.01,
+              consume_s: float = 0.01, depth: int = 2,
+              events_dir: Optional[str] = None) -> Dict[str, Any]:
+    from spark_rapids_tpu.exec.pipeline import pipelined
+    from spark_rapids_tpu.obs import events as obs_events
+
+    if events_dir:
+        obs_events.enable(events_dir, "MODERATE")
+    run_start_ns = time.time_ns()
+
+    # synchronous baseline: P + C
+    t0 = time.perf_counter()
+    n_sync = _drive(_produce(items, produce_s), consume_s)
+    sync_s = time.perf_counter() - t0
+
+    # pipelined: ideally max(P, C). The synthetic stage only emits
+    # event records into a log THIS tool set up (--events cross-check);
+    # driven in-process by bench.py with the engine's event log active,
+    # its deliberate sleep-stalls would otherwise contaminate the real
+    # pipeline_wait/pipeline_full totals in the profile report.
+    t0 = time.perf_counter()
+    stage = pipelined(_produce(items, produce_s), depth=depth,
+                      label="pipeline-bench",
+                      emit_events=bool(events_dir))
+    try:
+        n_pipe = _drive(stage, consume_s)
+    finally:
+        stage.close()
+    pipelined_s = time.perf_counter() - t0
+    assert n_sync == n_pipe == items
+
+    P = items * produce_s
+    C = items * consume_s
+    ideal_s = max(P, C)
+    overlap = (sync_s - pipelined_s) / min(P, C) if min(P, C) > 0 else 0.0
+    out: Dict[str, Any] = {
+        "items": items,
+        "produce_ms": produce_s * 1e3,
+        "consume_ms": consume_s * 1e3,
+        "depth": depth,
+        "sync_s": round(sync_s, 4),
+        "pipelined_s": round(pipelined_s, 4),
+        "ideal_s": round(ideal_s, 4),
+        "speedup": round(sync_s / pipelined_s, 3) if pipelined_s else 0.0,
+        "overlap": round(max(0.0, min(1.0, overlap)), 3),
+        "wait_ns": stage.wait_ns,
+        "full_ns": stage.full_ns,
+    }
+    # the stage's stall counters must reconcile with the wall clock:
+    # consumer wall = busy (C) + blocked-on-empty (wait_ns)
+    out["consumer_wall_check_s"] = round(C + stage.wait_ns / 1e9, 4)
+    if events_dir:
+        out["events"] = _event_totals(events_dir, run_start_ns)
+        obs_events.reset_event_bus()
+    return out
+
+
+def _event_totals(events_dir: str, since_ns: int) -> Dict[str, int]:
+    """Sum the pipeline_wait/pipeline_full records THIS run wrote
+    (cross-check: they carry the same totals as the stage counters).
+    `since_ns` excludes records a previous run left in a reused dir."""
+    wait = full = 0
+    for name in os.listdir(events_dir):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(events_dir, name)) as f:
+            for ln in f:
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue
+                if rec.get("stage") != "pipeline-bench" \
+                        or (rec.get("ts_ns") or 0) < since_ns:
+                    continue
+                if rec.get("kind") == "pipeline_wait":
+                    wait += rec.get("wait_ns") or 0
+                elif rec.get("kind") == "pipeline_full":
+                    full += rec.get("full_ns") or 0
+    return {"pipeline_wait_ns": wait, "pipeline_full_ns": full}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--items", type=int, default=30)
+    ap.add_argument("--produce-ms", type=float, default=10.0)
+    ap.add_argument("--consume-ms", type=float, default=10.0)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--events", default=None,
+                    help="also write + cross-check an event log here")
+    args = ap.parse_args(argv)
+    out = run_bench(args.items, args.produce_ms / 1e3,
+                    args.consume_ms / 1e3, args.depth,
+                    events_dir=args.events)
+    print(json.dumps(out, indent=2))
+    ok = out["speedup"] >= 1.5
+    print(f"speedup {out['speedup']}x vs synchronous "
+          f"(overlap {out['overlap']} of ideal max(P,C)="
+          f"{out['ideal_s']}s) -> {'OK' if ok else 'BELOW 1.5x TARGET'}")
+    if out.get("events") is not None:
+        drift = abs(out["events"]["pipeline_wait_ns"] - out["wait_ns"])
+        print(f"event reconcile: pipeline_wait {out['events']}"
+              f" vs stage wait_ns={out['wait_ns']} (drift {drift}ns)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -126,6 +126,14 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
     sem_ns = total("semaphore_acquire", "wait_ns")
     if sem_ns:
         extras.append(f"semaphore wait: {_fmt_ns(sem_ns)}")
+    pipe_wait = total("pipeline_wait", "wait_ns")
+    pipe_full = total("pipeline_full", "full_ns")
+    n_stage = sum(1 for e in events if e.get("kind") == "pipeline_wait")
+    if n_stage:
+        extras.append(
+            f"pipeline stages: {n_stage} (consumer stalled "
+            f"{_fmt_ns(pipe_wait)} on empty, producer stalled "
+            f"{_fmt_ns(pipe_full)} on full)")
     exch = total("exchange", "bytes")
     if exch:
         extras.append(f"exchange bytes: {_fmt_bytes(exch)}")
