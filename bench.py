@@ -32,6 +32,93 @@ import time
 import numpy as np
 
 
+def maybe_enable_faults(argv=None):
+    """`bench.py --fault-rate R` (ISSUE 4 satellite): run the standard
+    bench under seeded chaos injection at every registered fault point
+    with per-call probability R, so nightly rounds track recovery
+    overhead alongside throughput. The seed comes from
+    SPARK_RAPIDS_TPU_FAULT_SEED (default 42) — a failing chaos round
+    replays exactly. Returns the rate (None = injection off)."""
+    global _FAULT_RATE
+    argv = sys.argv if argv is None else argv
+    if "--fault-rate" not in argv:
+        return None
+    idx = argv.index("--fault-rate")
+    try:
+        rate = float(argv[idx + 1])
+    except (IndexError, ValueError):
+        print(json.dumps({"error_kind": "usage",
+                          "error": "--fault-rate requires a numeric "
+                                   "probability argument"}))
+        raise SystemExit(2)
+    seed = int(os.environ.get("SPARK_RAPIDS_TPU_FAULT_SEED", "42"))
+    from spark_rapids_tpu import faults
+    faults.install(faults.uniform_spec(rate, seed))
+    _FAULT_RATE = rate
+    return rate
+
+
+_FAULT_RATE = None
+
+#: counter snapshot at the previous chaos_attribution() call — the
+#: underlying counters are process-cumulative, each BENCH record must
+#: report only ITS OWN lane's deltas
+_chaos_prev = {"points": {}, "io": 0, "task": 0}
+
+
+def chaos_attribution():
+    """{"chaos": ...} block for each BENCH record under --fault-rate:
+    which points fired DURING THIS LANE, and how many recoveries each
+    layer (IO retry / task re-execution) absorbed to keep it green."""
+    global _chaos_prev
+    if _FAULT_RATE is None:
+        return None
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.exec.task_retry import task_retry_total
+    from spark_rapids_tpu.io.retrying import io_retry_recoveries
+    points = faults.stats()
+    io_rec, task_rec = io_retry_recoveries(), task_retry_total()
+    prev = _chaos_prev
+    points_hit = {p: c - prev["points"].get(p, 0)
+                  for p, c in points.items()
+                  if c - prev["points"].get(p, 0)}
+    rec = {
+        "fault_rate": _FAULT_RATE,
+        "points_hit": points_hit,
+        "injections": sum(points_hit.values()),
+        "recoveries": {"io_retry": io_rec - prev["io"],
+                       "task_retry": task_rec - prev["task"]},
+        "task_retries": task_rec - prev["task"],
+    }
+    _chaos_prev = {"points": points, "io": io_rec, "task": task_rec}
+    return rec
+
+
+def guarded_run(fn):
+    """Run one bench iteration under the task-attempt layer: a
+    transient failure (injected or real) re-executes the iteration
+    instead of killing the lane. With injection off this is one
+    function call of overhead.
+
+    maxAttempts is raised well past the session default: chaos arming
+    here is prob-only (no per-point max caps — nightly rounds want a
+    SUSTAINED injection rate, not a budget that runs dry mid-lane), so
+    convergence is probabilistic. The plan's call indexes advance across
+    attempts, each retry faces fresh seeded draws, and at 20 attempts
+    even a 50% per-attempt kill rate fails a lane ~1e-6 of the time."""
+    from spark_rapids_tpu.config import RapidsConf, active_conf
+    from spark_rapids_tpu.exec.task_retry import with_task_retry
+    conf = None
+    if _FAULT_RATE is not None:
+        # OVERLAY on the active conf, don't replace it: a chaos round
+        # that set task.retryBackoffMs must keep it, or retry sleeps
+        # land inside the timed loops at the 100ms default
+        conf = RapidsConf(dict(
+            active_conf()._settings,
+            **{"spark.rapids.tpu.task.maxAttempts": "20"}))
+    return with_task_retry(lambda attempt: fn(), conf=conf)
+
+
 def maybe_enable_event_log():
     """Opt-in structured event log for bench runs: set
     SPARK_RAPIDS_TPU_EVENTLOG_DIR to get a JSONL operator-span log
@@ -252,7 +339,7 @@ def main():
     # assertion failure from leaking the thread-local scope into later
     # benchmarks in the same process
     with speculation_scope() as scope:
-        outs, chk = run_once(jnp.float64(0.0), scope)
+        outs, chk = guarded_run(lambda: run_once(jnp.float64(0.0), scope))
         rows = [r for b in outs for r in b.to_pylist()]
         got = {r[0]: (r[1], r[2], r[3]) for r in rows}
         for k, (sq, sd, c) in oracle.items():
@@ -264,7 +351,7 @@ def main():
         t0 = time.perf_counter()
         chk = jnp.float64(0.0)
         for _ in range(ITERS):
-            _, chk = run_once(chk, scope)
+            _, chk = guarded_run(lambda c=chk: run_once(c, scope))
         final_chk = float(np.asarray(chk))  # forces completion of all ITERS
         dt = (time.perf_counter() - t0) / ITERS
 
@@ -274,14 +361,18 @@ def main():
 
     bytes_in = sum(v.nbytes for v in d.values())
     gbps = bytes_in / dt / 1e9
-    print(json.dumps({
+    rec = {
         "metric": "q1_agg_throughput",
         "value": round(gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(t_np / dt, 3),
         "profile": query_attribution(plan, metrics_before),
         "pipeline": pipeline_attribution(),
-    }))
+    }
+    chaos = chaos_attribution()
+    if chaos is not None:
+        rec["chaos"] = chaos
+    print(json.dumps(rec))
 
 
 N_ORDERS = 1 << 19   # 512K orders
@@ -397,7 +488,8 @@ def q3_bench():
                 flags = ()
             return outs, prev
 
-        outs, chk = run_once(jnp.float64(0.0))  # warm + verify (sync sizing)
+        outs, chk = guarded_run(
+            lambda: run_once(jnp.float64(0.0)))  # warm + verify
         rows = [r for b in outs for r in b.to_pylist()]
         got = {r[0]: r[1] for r in rows}
         assert set(got) == set(oracle), (sorted(got)[:3], sorted(oracle)[:3])
@@ -405,7 +497,7 @@ def q3_bench():
             assert abs(got[k] - v) / max(abs(v), 1) < 1e-9
         # second warm pass compiles the speculative (cached-bucket) probe
         # path
-        _, chk2 = run_once(jnp.float64(0.0))
+        _, chk2 = guarded_run(lambda: run_once(jnp.float64(0.0)))
         assert abs(float(np.asarray(chk2)) - float(np.asarray(chk))) \
             <= 1e-9 * max(abs(float(np.asarray(chk))), 1.0)
         expect1 = float(np.asarray(chk))
@@ -414,23 +506,28 @@ def q3_bench():
         t0 = time.perf_counter()
         chk = jnp.float64(0.0)
         for _ in range(iters):
-            _, chk = run_once(chk)
+            _, chk = guarded_run(lambda c=chk: run_once(c))
         final = float(np.asarray(chk))
         dt = (time.perf_counter() - t0) / iters
     assert abs(final - iters * expect1) <= 1e-9 * max(abs(final), 1.0)
 
     bytes_in = sum(v.nbytes for v in d.values())
-    print(json.dumps({
+    rec = {
         "metric": "q3_join_topn_throughput",
         "value": round(bytes_in / dt / 1e9, 3),
         "unit": "GB/s",
         "vs_baseline": round(t_np / dt, 3),
         "profile": query_attribution(plan, metrics_before),
         "pipeline": pipeline_attribution(),
-    }))
+    }
+    chaos = chaos_attribution()
+    if chaos is not None:
+        rec["chaos"] = chaos
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":
     maybe_enable_event_log()
+    maybe_enable_faults()
     main()
     q3_bench()

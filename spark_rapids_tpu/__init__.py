@@ -26,4 +26,8 @@ _jax.config.update("jax_enable_x64", True)
 from . import types  # noqa: E402
 from .columnar.column import Column, StringColumn, bucket_capacity  # noqa: E402
 from .columnar.batch import ColumnarBatch  # noqa: E402
+# the error taxonomy is public API: callers catching engine failures
+# distinguish the OOM lane (memory.retry.TpuOOMError) from transient
+# task-lane failures and integrity quarantines (docs/robustness.md)
+from .faults import IntegrityError, TpuTaskRetryError  # noqa: E402
 from .version import __version__  # noqa: E402

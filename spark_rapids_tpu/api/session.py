@@ -39,11 +39,13 @@ class TpuSession:
         equi-joins compile to partial → ICI all-to-all exchange → final
         SPMD stages over the device mesh (exec/exchange.py). Default: the
         single-partition plan (no exchange nodes)."""
+        from .. import faults
         from ..obs import events as obs_events
         from ..parallel.mesh import device_mesh, set_active_mesh
         self.conf = RapidsConf(conf or {})
         set_active_conf(self.conf)
         obs_events.configure(self.conf)
+        faults.configure(self.conf)
         if mesh is None and mesh_devices is not None:
             mesh = device_mesh(mesh_devices)
         self.mesh = mesh
@@ -338,14 +340,28 @@ class DataFrame:
 
     # -- actions -----------------------------------------------------------
     def _exec(self):
+        from .. import faults
         from ..obs import events as obs_events
         from ..parallel.mesh import set_active_mesh
         set_active_conf(self.session.conf)
         set_active_mesh(self.session.mesh)
         obs_events.configure(self.session.conf)
+        faults.configure(self.session.conf)
         return TpuOverrides(self.session.conf).apply(self._plan)
 
     def collect(self) -> List[tuple]:
+        """Materialize results, with task-level re-execution (ISSUE 4):
+        a transient failure — an injected/real device error outside the
+        OOM lane, a checksum-quarantined spill file or shuffle block, a
+        dying IO path past its bounded retries — discards the attempt
+        and re-runs the whole plan from the sources, up to
+        spark.rapids.tpu.task.maxAttempts times. Every attempt rebuilds
+        its exec tree in _collect_once, so attempts share no state."""
+        from ..exec.task_retry import with_task_retry
+        return with_task_retry(lambda attempt: self._collect_once(),
+                               conf=self.session.conf)
+
+    def _collect_once(self) -> List[tuple]:
         import time as _time
 
         from ..exec.task_metrics import query_snapshot, query_summary

@@ -378,6 +378,55 @@ TEST_RETRY_OOM_INJECTION_MODE = conf_str(
     "(reference RmmSpark fault injection, RmmSparkRetrySuiteBase).",
     internal=True)
 
+TEST_FAULTS = conf_str(
+    "spark.rapids.tpu.test.faults", "",
+    "Seeded chaos injection at the registered fault points (faults.py): "
+    "'<point>:prob=P,seed=S,kind=io|device|corrupt[,max=N][;...]'. "
+    "Decisions are a pure hash of (seed, point, task_id, call_index), "
+    "so any chaos failure replays exactly. Empty (default) = injection "
+    "off, one pointer check per site.", internal=True)
+
+IO_RETRIES = conf_int(
+    "spark.rapids.tpu.io.retries", 3,
+    "Bounded retries on transient OSErrors in the multi-file readers "
+    "and the shuffle block fetch (io/retrying.py) before the failure "
+    "surfaces; each retry sleeps retryBackoffMs * 2^attempt plus "
+    "deterministic jitter and emits a structured io_retry event. "
+    "0 disables IO retry.")
+
+IO_RETRY_BACKOFF_MS = conf_int(
+    "spark.rapids.tpu.io.retryBackoffMs", 50,
+    "Base backoff between IO retry attempts (doubled per attempt, "
+    "capped at 2000ms, plus up to 25% deterministic jitter).")
+
+TASK_MAX_ATTEMPTS = conf_int(
+    "spark.rapids.tpu.task.maxAttempts", 3,
+    "Attempts a task (one driven query) gets before a transient "
+    "failure — TpuTaskRetryError, an injected device fault, a non-OOM "
+    "XLA runtime error, a checksum-quarantined buffer — becomes fatal "
+    "(exec/task_retry.py; the engine analog of Spark's "
+    "task-attempt re-execution). 1 disables task retry.")
+
+TASK_RETRY_BACKOFF_MS = conf_int(
+    "spark.rapids.tpu.task.retryBackoffMs", 100,
+    "Base backoff between task attempts (doubled per attempt, capped "
+    "at 5000ms, plus deterministic jitter).")
+
+OOM_RETRY_BACKOFF_MS = conf_int(
+    "spark.rapids.tpu.retry.backoffMs", 5,
+    "Base sleep between OOM-retry attempts in with_retry (doubled per "
+    "attempt, capped at 200ms): gives in-flight spill writebacks and "
+    "concurrent tasks time to actually free memory instead of "
+    "re-spinning through all attempts in microseconds. 0 restores "
+    "immediate retry.")
+
+PIPELINE_CLOSE_TIMEOUT_MS = conf_int(
+    "spark.rapids.tpu.pipeline.closeTimeoutMs", 10000,
+    "Watchdog on pipeline stage close(): how long to wait for a "
+    "producer thread to join before giving up, emitting a "
+    "pipeline_stuck event and detaching the (daemon) thread instead of "
+    "hanging the query teardown / interpreter exit.")
+
 DECIMAL_ENABLED = conf_bool(
     "spark.rapids.sql.decimalType.enabled", True,
     "Enable decimal offload (decimal128 columns stay on CPU until the "

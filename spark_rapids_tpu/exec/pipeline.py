@@ -43,7 +43,9 @@ import threading
 import time
 from typing import Any, Iterable, Iterator, Optional
 
-from ..config import PIPELINE_DEPTH, PIPELINE_ENABLED, active_conf
+from ..config import (PIPELINE_CLOSE_TIMEOUT_MS, PIPELINE_DEPTH,
+                      PIPELINE_ENABLED, active_conf)
+from .. import faults
 
 _END = object()
 
@@ -152,6 +154,11 @@ class PipelinedIterator:
         self._full_metric = full_metric
         self._wall_metric = wall_metric
         self._emit_events = emit_events
+        #: close() watchdog budget (conf, read at stage construction)
+        self._close_timeout_s = max(
+            0.1, active_conf().get(PIPELINE_CLOSE_TIMEOUT_MS) / 1000.0)
+        #: True once close() gave up joining a wedged producer
+        self.stuck = False
         #: consumer ns blocked on an empty queue / producer ns blocked
         #: on a full one — the two stall signals overlap analysis needs
         self.wait_ns = 0
@@ -169,6 +176,8 @@ class PipelinedIterator:
         self._qid = obs_events.current_query_id()
         from .speculation import capture_context
         self._spec_ctx = capture_context()
+        from .task_retry import capture_attempt
+        self._attempt = capture_attempt()
         self._thread = threading.Thread(
             target=self._run, name=f"pipeline-{label}", daemon=True)
         self._thread.start()
@@ -186,10 +195,27 @@ class PipelinedIterator:
             obs_events.adopt_query_id(self._qid)
             from .speculation import adopt_context
             adopt_context(*self._spec_ctx)
+            # the task-attempt number too: an exchange WRITE driven from
+            # this producer tags its shuffle temp files with it — left
+            # un-adopted, attempt 2's producer would reuse attempt 1's
+            # temp names and a detached (pipeline_stuck) attempt-1
+            # producer could tear its files
+            from .task_retry import adopt_attempt
+            adopt_attempt(self._attempt)
             _tls.cancel_event = self._closed
             it = iter(self._source)
             while not self._closed.is_set():
                 try:
+                    # chaos fault point — engine operator stages only:
+                    # emit_events=False stages (tools/pipeline_bench run
+                    # in-process by bench.py) are synthetic, and a fault
+                    # injected there would corrupt the bench's pipeline
+                    # summary instead of exercising any recovery path
+                    if self._emit_events:
+                        # keyed by stage label: each stage draws its own
+                        # deterministic injection sequence regardless of
+                        # how the OS interleaves producer threads
+                        faults.check("pipeline.produce", key=self._label)
                     item = next(it)
                 except StopIteration:
                     break
@@ -264,10 +290,24 @@ class PipelinedIterator:
     def close(self) -> None:
         """Shut the stage down (idempotent): unblock + join the
         producer, drain the queue, report stats. Safe to call whether
-        the stage finished, failed, or was abandoned mid-stream."""
+        the stage finished, failed, or was abandoned mid-stream.
+
+        Watchdog (ISSUE 4): a producer wedged somewhere cancellation
+        can't reach (a blocking C call, a deadlocked external resource)
+        must not hang query teardown or interpreter exit — after
+        spark.rapids.tpu.pipeline.closeTimeoutMs the stage gives up,
+        emits `pipeline_stuck`, and detaches the (daemon) thread."""
         self._closed.set()
         self._drain()
+        deadline = time.monotonic() + self._close_timeout_s
         while self._thread.is_alive():
+            if time.monotonic() >= deadline:
+                self.stuck = True
+                from ..obs import events as obs_events
+                obs_events.emit(
+                    "pipeline_stuck", stage=self._label,
+                    timeout_ms=int(self._close_timeout_s * 1000))
+                break
             self._thread.join(timeout=_POLL_S)
             self._drain()
         self._finished = True

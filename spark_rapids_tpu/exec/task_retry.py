@@ -1,0 +1,130 @@
+"""Task-attempt re-execution (ISSUE 4 tentpole part 2) — the engine
+analog of Spark's task scheduler retrying a failed task attempt.
+
+A "task" here is one driven query (DataFrame.collect / a bench lane):
+when an attempt dies with a *transient* failure — TpuTaskRetryError, an
+injected device fault, a non-RESOURCE_EXHAUSTED XLA runtime error, a
+checksum-quarantined spill file or shuffle block — the attempt's outputs
+are discarded and the plan re-executes from the sources, up to
+`spark.rapids.tpu.task.maxAttempts` attempts with capped exponential
+backoff. OOM stays on the with_retry spill/split lane (memory/retry.py);
+everything classified "fatal" surfaces immediately.
+
+Attempt isolation: `task_attempt()` exposes the current attempt number
+thread-locally; the shuffle writer tags its temp files with it and
+commits atomically (write-then-rename, index last), so a failed
+attempt's partial shards are never visible to readers — the reference's
+shuffle commit protocol, single-process edition.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, TypeVar
+
+from ..config import (TASK_MAX_ATTEMPTS, TASK_RETRY_BACKOFF_MS, RapidsConf,
+                      active_conf)
+from .. import faults
+from ..faults import TpuTaskRetryError, classify  # noqa: F401 — re-export
+
+T = TypeVar("T")
+
+_BACKOFF_CAP_MS = 5000
+
+_tls = threading.local()
+
+#: total task re-executions this process (bench chaos record)
+_retry_count = 0
+_retry_lock = threading.Lock()
+
+
+def task_attempt() -> int:
+    """The current task attempt number (1-based; 1 outside any
+    with_task_retry scope). Consumed by the shuffle writer's
+    attempt-tagged commit protocol."""
+    return getattr(_tls, "attempt", 1)
+
+
+def capture_attempt() -> Optional[int]:
+    """The raw attempt thread-local (None outside a retry scope) — the
+    pipeline stage boundary captures it on the consumer and adopts it in
+    the producer thread, like conf/query-id/speculation context: an
+    exchange write driven from a producer must tag its shuffle files
+    with the REAL attempt."""
+    return getattr(_tls, "attempt", None)
+
+
+def adopt_attempt(attempt: Optional[int]) -> None:
+    """Install a captured attempt on this (producer) thread."""
+    if attempt is None:
+        if hasattr(_tls, "attempt"):
+            del _tls.attempt
+    else:
+        _tls.attempt = attempt
+
+
+def task_retry_total() -> int:
+    return _retry_count
+
+
+def _backoff_s(attempt: int, base_ms: int, label: str) -> float:
+    # label in the jitter key: concurrent tasks retrying at the same
+    # attempt number spread out instead of re-herding in lockstep
+    return faults.backoff_s(attempt, base_ms, _BACKOFF_CAP_MS,
+                            f"task:{label}:{attempt}")
+
+
+def _settle_between_attempts() -> None:
+    """Let the failed attempt's async machinery land before re-running:
+    in-flight spill writebacks finish (their budget releases land), so
+    the fresh attempt starts from settled accounting. Pipeline producer
+    threads were already joined by the exception's finally chain."""
+    from ..memory.catalog import buffer_catalog
+    try:
+        buffer_catalog().drain_writeback()
+    except Exception:  # noqa: BLE001 — settling is best-effort; the
+        pass           # retry itself decides whether the state is usable
+
+
+def with_task_retry(run: Callable[[int], T],
+                    conf: Optional[RapidsConf] = None,
+                    label: str = "query") -> T:
+    """Execute `run(attempt)` with bounded task-level re-execution.
+
+    `run` must be restartable from the sources (every attempt rebuilds
+    its exec tree / re-reads its inputs — exactly what DataFrame.collect
+    does). Non-transient errors and exhausted attempts propagate with
+    the original traceback."""
+    global _retry_count
+    conf = conf if conf is not None else active_conf()
+    max_attempts = max(1, conf.get(TASK_MAX_ATTEMPTS))
+    base_ms = max(1, conf.get(TASK_RETRY_BACKOFF_MS))
+    prev = getattr(_tls, "attempt", None)
+    try:
+        attempt = 0
+        while True:
+            attempt += 1
+            _tls.attempt = attempt
+            try:
+                return run(attempt)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if classify(e) != "task" or attempt >= max_attempts:
+                    raise
+                with _retry_lock:
+                    _retry_count += 1
+                backoff = _backoff_s(attempt, base_ms, label)
+                from ..obs import events as obs_events
+                obs_events.emit(
+                    "task_retry", label=label, attempt=attempt,
+                    max_attempts=max_attempts,
+                    backoff_ns=int(backoff * 1e9),
+                    error=f"{type(e).__name__}: {e}"[:200])
+                _settle_between_attempts()
+                time.sleep(backoff)
+    finally:
+        if prev is None:
+            if hasattr(_tls, "attempt"):
+                del _tls.attempt
+        else:
+            _tls.attempt = prev

@@ -1,0 +1,379 @@
+"""Cross-layer fault subsystem (ISSUE 4 tentpole): one registry of named
+fault points, one seeded deterministic injection surface, and one error
+taxonomy for everything that can die at runtime besides OOM.
+
+The reference engine spreads this across RmmSpark fault injection
+(RmmSparkRetrySuiteBase.scala forceRetryOOM/forceSplitAndRetryOOM and the
+JNI error-state machine), Spark's task re-execution, and the shuffle
+commit protocol. Rebuilt here for a single-process multi-thread engine:
+
+* **Fault points** (`FAULT_POINTS`) name every async/IO seam the engine
+  crosses: spill byte movement, shuffle fetch/decode, multi-file reads,
+  guarded device dispatch, pipeline producers. Each real call site runs
+  `apply(point)` / `apply(point, data)`; with injection off that is ONE
+  module-global pointer check (`_PLAN is None`).
+
+* **Injection** is driven by one conf
+  (`spark.rapids.tpu.test.faults = "<point>:prob=P,seed=S,kind=K[,max=N][;...]"`,
+  kind in io|device|corrupt) and keyed on (task_id, work-item key,
+  per-sequence call index): the decision is a pure hash of
+  (seed, point, task, key, index) — no wall clock, no RNG state. Sites
+  evaluated on pool/producer threads pass their work-item identity as
+  the key (chunk index, map-file:partition, stage label), so replay is
+  per-item exact there too; the few keyless multi-threaded sites (the
+  spill writer) replay the injection count deterministically but thread
+  scheduling may move WHICH call fires.
+
+* **Taxonomy**: `TpuRetryOOM`/`TpuSplitAndRetryOOM` (memory/retry.py)
+  stay the OOM lane. Everything else transient becomes
+  `TpuTaskRetryError` — injected device faults, XLA runtime errors that
+  are not RESOURCE_EXHAUSTED, integrity failures (checksum mismatch =
+  the data is gone; recompute is the only recovery). `classify()` maps
+  an arbitrary exception into "oom" | "task" | "fatal";
+  exec/task_retry.py re-executes "task" failures with bounded attempts.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Dict, Optional
+
+#: the closed registry: point name -> (site, what an injected fault means).
+#: docs/robustness.md documents this table and tests/test_docs_lint.py
+#: asserts the two never drift.
+FAULT_POINTS: Dict[str, str] = {
+    "spill.d2h_copy": "device->host copy of a spilling buffer "
+                      "(memory/catalog.py, sync + async writeback)",
+    "spill.disk_write": "host->disk spill file write "
+                        "(memory/catalog.py _write_npz)",
+    "spill.disk_read": "disk->host spill file read "
+                       "(memory/catalog.py _read_npz)",
+    "shuffle.fetch": "shuffle block segment fetch "
+                     "(shuffle/manager.py HostShuffleReader)",
+    "shuffle.decode": "shuffle frame decode "
+                      "(shuffle/manager.py read_partition)",
+    "io.multifile_read": "multi-file decode task "
+                         "(io/multifile.py threaded_chunks)",
+    "device.dispatch": "guarded device section "
+                       "(memory/retry.py oom_guard)",
+    "pipeline.produce": "pipeline producer step "
+                        "(exec/pipeline.py PipelinedIterator)",
+}
+
+KINDS = ("io", "device", "corrupt")
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy
+# ---------------------------------------------------------------------------
+
+class TpuTaskRetryError(RuntimeError):
+    """Transient non-OOM failure: the current task attempt is lost but a
+    re-execution from the sources is expected to succeed (the engine
+    analog of a Spark task-attempt failure)."""
+
+
+class IntegrityError(TpuTaskRetryError):
+    """Checksum mismatch on a spill file or shuffle block: the bytes are
+    quarantined, the only recovery is recomputation (task retry)."""
+
+
+class InjectedIOError(OSError):
+    """Injected `kind=io` fault (a transient OSError look-alike)."""
+
+    def __init__(self, point: str):
+        import errno
+        super().__init__(errno.EIO, f"injected io fault at {point}")
+        self.fault_point = point
+
+
+class InjectedDeviceError(RuntimeError):
+    """Injected `kind=device` fault (an XLA runtime error look-alike)."""
+
+    def __init__(self, point: str):
+        super().__init__(f"injected device fault at {point}")
+        self.fault_point = point
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """XLA surfaces allocator exhaustion as a runtime error whose status
+    is RESOURCE_EXHAUSTED; map it onto the engine's OOM-retry lane
+    (reference: RMM's async OOM callback feeding RmmRapidsRetryIterator)."""
+    return type(exc).__name__ == "XlaRuntimeError" \
+        and "RESOURCE_EXHAUSTED" in str(exc)
+
+
+def is_task_transient(exc: BaseException) -> bool:
+    """Errors a task re-execution is expected to clear: injected faults
+    (device look-alikes, and io look-alikes that escaped a site with no
+    io-retry lane of its own, e.g. pipeline.produce), integrity
+    failures, and XLA runtime errors that are not resource exhaustion
+    (device resets, interconnect hiccups, preempted programs —
+    UNAVAILABLE/INTERNAL/ABORTED/DATA_LOSS and friends). A REAL OSError
+    stays fatal at this level: it either already exhausted the bounded
+    IO retry (persistently unreadable bytes re-read the same way on a
+    fresh attempt) or names a non-transient condition."""
+    if isinstance(exc, (TpuTaskRetryError, InjectedDeviceError,
+                        InjectedIOError)):
+        return True
+    return type(exc).__name__ == "XlaRuntimeError" \
+        and "RESOURCE_EXHAUSTED" not in str(exc)
+
+
+def classify(exc: BaseException) -> str:
+    """"oom" | "task" | "fatal" — the one classification both the
+    OOM-retry loop (memory/retry.py) and the task-attempt layer
+    (exec/task_retry.py) consult."""
+    from .memory.retry import TpuOOMError
+    if isinstance(exc, TpuOOMError) or is_oom_error(exc):
+        return "oom"
+    if is_task_transient(exc):
+        return "task"
+    return "fatal"
+
+
+# ---------------------------------------------------------------------------
+# injection plan
+# ---------------------------------------------------------------------------
+
+class _PointSpec:
+    __slots__ = ("point", "prob", "seed", "kind", "max_injections")
+
+    def __init__(self, point: str, prob: float, seed: int, kind: str,
+                 max_injections: Optional[int]):
+        self.point = point
+        self.prob = prob
+        self.seed = seed
+        self.kind = kind
+        self.max_injections = max_injections
+
+
+class FaultPlan:
+    """Parsed injection plan. Decisions are pure in (seed, point,
+    task_id, call_index); the per-(point, task) call counters live here
+    so the k-th guarded call of a task draws the same verdict on every
+    replay."""
+
+    def __init__(self, specs: Dict[str, _PointSpec], spec_string: str = ""):
+        self.specs = specs
+        #: the normalized conf string this plan was parsed from —
+        #: configure() uses it to keep ONE plan alive across task
+        #: re-executions of the same chaos run
+        self.spec_string = spec_string
+        self._lock = threading.Lock()
+        self._calls: Dict[tuple, int] = {}
+        #: injections actually fired, per point (bench chaos record)
+        self.injected: Dict[str, int] = {}
+
+    def _task_id(self) -> int:
+        from .memory.retry import current_task_id
+        tid = current_task_id()
+        return 0 if tid is None else int(tid)
+
+    def decide(self, point: str, corruptible: bool = True,
+               key: Optional[str] = None) -> Optional[str]:
+        """The armed kind if this call injects, else None. Always
+        consumes one call index for (point, task, key) — the decision
+        sequence stays aligned across replay — but an armed `corrupt`
+        kind at a call with no bytes flowing (`corruptible=False`) is
+        NOT fired: it would perturb nothing, so it must not consume the
+        max-injection budget, count in stats() or emit fault_inject.
+
+        `key` is the work-item identity for sites evaluated on POOL or
+        PRODUCER threads (a chunk index, a map-file:partition pair, a
+        stage label): it gives each work item its own call-index
+        sequence, so OS thread scheduling cannot permute which item
+        draws which verdict and a seeded chaos failure replays on the
+        same item. Keyless multi-threaded sites replay the injection
+        COUNT deterministically (the draw is a pure hash) but may place
+        injections on different calls across runs."""
+        spec = self.specs.get(point)
+        if spec is None:
+            return None
+        task = self._task_id()
+        with self._lock:
+            ckey = (point, task, key)
+            idx = self._calls.get(ckey, 0)
+            self._calls[ckey] = idx + 1
+            if spec.kind == "corrupt" and not corruptible:
+                return None
+            fired = self.injected.get(point, 0)
+            if spec.max_injections is not None \
+                    and fired >= spec.max_injections:
+                return None
+            draw = zlib.crc32(
+                f"{spec.seed}:{point}:{task}:{key or ''}:{idx}"
+                .encode()) / 2 ** 32
+            if draw >= spec.prob:
+                return None
+            self.injected[point] = fired + 1
+        from .obs import events as obs_events
+        obs_events.emit("fault_inject", point=point, fault_kind=spec.kind,
+                        task_id=task, call_index=idx, seed=spec.seed)
+        return spec.kind
+
+    def apply(self, point: str, data: Optional[bytes] = None,
+              key: Optional[str] = None) -> Optional[bytes]:
+        kind = self.decide(
+            point, corruptible=data is not None and len(data) > 0,
+            key=key)
+        if kind is None:
+            return data
+        if kind == "io":
+            raise InjectedIOError(point)
+        if kind == "device":
+            raise InjectedDeviceError(point)
+        pos = zlib.crc32(f"pos:{point}:{len(data)}".encode()) % len(data)
+        out = bytearray(data)
+        out[pos] ^= 0xFF
+        return bytes(out)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.injected)
+
+
+def parse_faults(spec: str) -> Optional[FaultPlan]:
+    """Parse the conf grammar:
+    `<point>:prob=P,seed=S,kind=io|device|corrupt[,max=N][;<point>:...]`.
+    Unknown points or kinds fail loudly — a typo'd chaos spec silently
+    injecting nothing is worse than an error."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    specs: Dict[str, _PointSpec] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, kvs = part.partition(":")
+        point = point.strip()
+        if point not in FAULT_POINTS:
+            raise ValueError(f"unknown fault point {point!r}; known: "
+                             f"{sorted(FAULT_POINTS)}")
+        prob, seed, kind, max_inj = 1.0, 0, "io", None
+        for kv in kvs.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            k, v = k.strip(), v.strip()
+            if k == "prob":
+                prob = float(v)
+            elif k == "seed":
+                seed = int(v)
+            elif k == "kind":
+                if v not in KINDS:
+                    raise ValueError(f"unknown fault kind {v!r} for "
+                                     f"{point}; known: {KINDS}")
+                kind = v
+            elif k == "max":
+                max_inj = int(v)
+            else:
+                raise ValueError(f"unknown fault option {k!r} for {point}")
+        specs[point] = _PointSpec(point, prob, seed, kind, max_inj)
+    return FaultPlan(specs, spec) if specs else None
+
+
+# ---------------------------------------------------------------------------
+# process-wide activation (the one-pointer-check fast path)
+# ---------------------------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+_plan_lock = threading.Lock()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def install(spec: Optional[str]) -> Optional[FaultPlan]:
+    """Install (or with None/empty, clear) the process fault plan from a
+    spec string (test/bench entry)."""
+    global _PLAN
+    plan = parse_faults(spec) if spec else None
+    with _plan_lock:
+        _PLAN = plan
+    return plan
+
+
+def configure(conf=None) -> Optional[FaultPlan]:
+    """(Re)configure injection from a RapidsConf — the session/collect
+    hook, mirroring obs.events.configure. A conf that does not mention
+    spark.rapids.tpu.test.faults leaves the current plan alone (a
+    default-conf session must not disarm another session's chaos run);
+    an explicit empty value clears it."""
+    from .config import TEST_FAULTS, active_conf
+    conf = conf if conf is not None else active_conf()
+    if TEST_FAULTS.key not in conf._settings:
+        return _PLAN
+    spec = (conf.get(TEST_FAULTS) or "").strip()
+    cur = _PLAN
+    if cur is not None and cur.spec_string == spec:
+        # same chaos run: keep the armed plan. Re-installing would reset
+        # the per-(point, task) call counters and max-injection budgets,
+        # so every task RE-EXECUTION (which reconfigures on its way back
+        # through _exec) would replay exactly the faults that killed the
+        # previous attempt — recovery could never converge.
+        return cur
+    return install(spec)
+
+
+def apply(point: str, data: Optional[bytes] = None,
+          key: Optional[str] = None) -> Optional[bytes]:
+    """The one call every fault-point site makes. Injection off =
+    exactly this pointer check. Sites that run on pool/producer threads
+    pass `key` (their work-item identity) so replay is per-item exact —
+    see FaultPlan.decide."""
+    plan = _PLAN
+    if plan is None:
+        return data
+    return plan.apply(point, data, key=key)
+
+
+def check(point: str, key: Optional[str] = None) -> None:
+    """apply() for data-free sites."""
+    plan = _PLAN
+    if plan is not None:
+        plan.apply(point, key=key)
+
+
+def stats() -> Dict[str, int]:
+    """Per-point injection counts of the active plan ({} when off)."""
+    plan = _PLAN
+    return plan.stats() if plan is not None else {}
+
+
+def backoff_s(attempt: int, base_ms: int, cap_ms: int,
+              jitter_key: str) -> float:
+    """Capped exponential backoff with deterministic jitter, shared by
+    all three retry lanes (io/retrying.py, memory/retry.py,
+    exec/task_retry.py): min(base * 2^(attempt-1), cap) plus up to 25%
+    jitter that is a pure hash of `jitter_key` — a seeded chaos run
+    replays with identical timing decisions."""
+    ms = min(base_ms * (1 << (attempt - 1)), cap_ms)
+    frac = zlib.crc32(jitter_key.encode()) / 2 ** 32
+    return ms * (1.0 + 0.25 * frac) / 1000.0
+
+
+def uniform_spec(prob: float, seed: int, points=None) -> str:
+    """A spec string arming every (or the given) fault point at one
+    probability with sensible per-point kinds — the bench.py
+    --fault-rate entry. Corruption goes where checksums guard the read
+    path; device faults where XLA dispatches; io everywhere else."""
+    default_kind = {
+        "device.dispatch": "device",
+        "spill.d2h_copy": "device",
+        "pipeline.produce": "io",
+        "spill.disk_read": "io",
+        "spill.disk_write": "corrupt",
+        "shuffle.decode": "corrupt",
+        "shuffle.fetch": "io",
+        "io.multifile_read": "io",
+    }
+    parts = []
+    for point in (points or sorted(FAULT_POINTS)):
+        parts.append(f"{point}:prob={prob},seed={seed},"
+                     f"kind={default_kind.get(point, 'io')}")
+    return ";".join(parts)

@@ -90,23 +90,37 @@ def threaded_chunks(tasks: Sequence[Callable[[], "object"]],
                     window: Optional[int] = None) -> Iterator["object"]:
     """Decode `tasks` with a bounded look-ahead window on the shared
     pool, yielding in order (the multithreaded cloud reader: fetch
-    ahead, emit in sequence)."""
+    ahead, emit in sequence). Every decode task runs under bounded IO
+    retry (io/retrying.py): a transient OSError — a flaky mount, an
+    object-store hiccup, an injected `io.multifile_read` fault — backs
+    off and re-reads instead of killing the scan."""
+    from .retrying import with_io_retry
+    conf = active_conf()  # captured HERE: pool threads see default conf
+
+    def retrying(t: Callable[[], "object"], i: int) -> "object":
+        # per-chunk jitter salt: concurrent decode tasks on one flaky
+        # mount must not back off in lockstep
+        return with_io_retry(t, "multifile_read", conf=conf,
+                             fault_point="io.multifile_read", salt=str(i))
+
     if num_threads <= 1 or len(tasks) <= 1:
-        for t in tasks:
-            yield t()
+        for i, t in enumerate(tasks):
+            yield retrying(t, i)
         return
     pool = shared_read_pool(max(
-        num_threads, active_conf().get(MULTITHREADED_READ_NUM_THREADS)))
+        num_threads, conf.get(MULTITHREADED_READ_NUM_THREADS)))
     if window is None:
         window = fetch_ahead_window(num_threads)
-    futures = [pool.submit(t) for t in tasks[:window]]
+    futures = [pool.submit(retrying, t, i)
+               for i, t in enumerate(tasks[:window])]
     next_submit = window
     try:
         for i in range(len(tasks)):
             yield futures[i].result()
             futures[i] = None  # release
             if next_submit < len(tasks):
-                futures.append(pool.submit(tasks[next_submit]))
+                futures.append(pool.submit(retrying, tasks[next_submit],
+                                           next_submit))
                 next_submit += 1
     finally:
         # abandoned mid-drive (limit/short-circuit): cancel what never

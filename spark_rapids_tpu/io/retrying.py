@@ -1,0 +1,87 @@
+"""Bounded IO retry with exponential backoff (ISSUE 4 satellite) — the
+engine-side analog of the bench backend-probe retry shipped in PR 1/3:
+transient OSErrors in the multi-file readers and the shuffle block fetch
+get `spark.rapids.tpu.io.retries` more chances before the failure
+surfaces, each retry emitting a structured `io_retry` event.
+
+Only *transient-looking* OSErrors retry: a missing file, a directory in
+a file's place or a permission wall will fail identically on every
+attempt — retrying those just delays the real error."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, TypeVar
+
+from ..config import IO_RETRIES, IO_RETRY_BACKOFF_MS, RapidsConf, active_conf
+from .. import faults
+
+T = TypeVar("T")
+
+#: OSError subclasses no retry can fix
+_NON_TRANSIENT = (FileNotFoundError, IsADirectoryError, NotADirectoryError,
+                  PermissionError)
+
+_BACKOFF_CAP_MS = 2000
+
+#: successful-after-retry recoveries (bench chaos record); locked —
+#: shuffle/multifile retries run concurrently on pool threads
+_recoveries = 0
+_recoveries_lock = threading.Lock()
+
+
+def io_retry_recoveries() -> int:
+    return _recoveries
+
+
+def _backoff_s(what: str, salt: str, attempt: int, base_ms: int) -> float:
+    return faults.backoff_s(attempt, base_ms, _BACKOFF_CAP_MS,
+                            f"io:{what}:{salt}:{attempt}")
+
+
+def with_io_retry(fn: Callable[[], T], what: str,
+                  conf: Optional[RapidsConf] = None,
+                  fault_point: Optional[str] = None,
+                  salt: str = "") -> T:
+    """Run `fn` with bounded retry on transient OSErrors.
+
+    `conf` must be passed when the caller runs on a pool thread (the
+    active conf is thread-local). `fault_point` names a registered
+    injection point checked INSIDE the attempt loop, so injected IO
+    faults exercise exactly the retry path a real flaky read would.
+    `salt` differentiates the backoff jitter between CONCURRENT callers
+    of the same `what` (e.g. per shuffle map file + partition): without
+    it, N pool threads hitting one flaky mount would sleep identical
+    durations and re-herd on every attempt. Keep it a pure function of
+    the work item, never a thread id — chaos replays must reproduce
+    timing decisions."""
+    conf = conf if conf is not None else active_conf()
+    retries = max(0, conf.get(IO_RETRIES))
+    base_ms = max(1, conf.get(IO_RETRY_BACKOFF_MS))
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            if fault_point is not None:
+                # the salt doubles as the injection work-item key: the
+                # chaos verdict follows the work item, not pool-thread
+                # scheduling (see FaultPlan.decide)
+                faults.check(fault_point, key=salt or None)
+            result = fn()
+        except OSError as e:
+            if isinstance(e, _NON_TRANSIENT) or attempt > retries:
+                raise
+            backoff = _backoff_s(what, salt, attempt, base_ms)
+            from ..obs import events as obs_events
+            obs_events.emit("io_retry", what=what, attempt=attempt,
+                            max_attempts=retries + 1,
+                            backoff_ns=int(backoff * 1e9),
+                            error=f"{type(e).__name__}: {e}"[:200])
+            time.sleep(backoff)
+            continue
+        if attempt > 1:
+            global _recoveries
+            with _recoveries_lock:
+                _recoveries += 1
+        return result

@@ -9,9 +9,9 @@ from .catalog import (
 )
 from .retry import (
     CpuRetryOOM, TpuOOMError, TpuRetryOOM, TpuSplitAndRetryOOM,
-    force_retry_oom, force_split_and_retry_oom, oom_guard, register_task,
-    split_in_half_by_rows, task_retry_counts, unregister_task, with_retry,
-    with_retry_no_split,
+    current_task_id, force_retry_oom, force_split_and_retry_oom, oom_guard,
+    register_task, split_in_half_by_rows, task_retry_counts,
+    unregister_task, with_retry, with_retry_no_split,
 )
 from .semaphore import TpuSemaphore, reset_tpu_semaphore, tpu_semaphore
 from .spillable import SpillableBatch

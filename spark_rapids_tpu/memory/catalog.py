@@ -22,11 +22,14 @@ disk files are fsync'd before the hop counts as complete.
 
 from __future__ import annotations
 
+import io
 import os
 import queue
+import struct
 import tempfile
 import threading
 import uuid
+import zlib
 from enum import IntEnum
 from typing import Dict, List, Optional
 
@@ -35,6 +38,7 @@ import numpy as np
 
 from ..config import (HOST_SPILL_LIMIT, SPILL_ASYNC_WRITE, SPILL_DIR,
                       active_conf)
+from .. import faults
 
 
 class StorageTier(IntEnum):
@@ -77,12 +81,52 @@ class _Entry:
         self.pending_device = None
 
 
+#: spill file container (ISSUE 4 integrity): magic | u32 crc32 |
+#: u64 payload length | npz payload. The CRC is stamped at write and
+#: verified at read; a mismatch (bit rot, torn write, injected
+#: corruption) quarantines the file and recovers by recompute.
+_SPILL_MAGIC = b"SRTPUSP1"
+_SPILL_HEADER = struct.Struct("<8sIQ")
+
+
+class SpillFileCorruption(faults.IntegrityError):
+    """Spill file failed its CRC32 / structure check at read."""
+
+
 def _write_npz(path: str, host_leaves) -> None:
-    """Spill file write, durable before the hop counts as complete."""
+    """Spill file write: CRC32-stamped container, durable (fsync'd)
+    before the hop counts as complete."""
+    buf = io.BytesIO()
+    np.savez(buf, **{str(i): a for i, a in enumerate(host_leaves)})
+    payload = buf.getvalue()
+    # fault point: kind=io dies here (the entry stays on HOST);
+    # kind=corrupt flips a byte of the STORED payload after the true CRC
+    # is taken, so the damage is exactly what the read-side check catches
+    crc = zlib.crc32(payload)
+    payload = faults.apply("spill.disk_write", payload)
     with open(path, "wb") as f:
-        np.savez(f, **{str(i): a for i, a in enumerate(host_leaves)})
+        f.write(_SPILL_HEADER.pack(_SPILL_MAGIC, crc, len(payload)))
+        f.write(payload)
         f.flush()
         os.fsync(f.fileno())
+
+
+def _read_npz(path: str) -> List[np.ndarray]:
+    """Verified spill file read: any structural or checksum failure
+    raises SpillFileCorruption (the caller quarantines + recomputes)."""
+    faults.check("spill.disk_read")
+    with open(path, "rb") as f:
+        header = f.read(_SPILL_HEADER.size)
+        if len(header) < _SPILL_HEADER.size:
+            raise SpillFileCorruption(f"truncated spill header: {path}")
+        magic, crc, length = _SPILL_HEADER.unpack(header)
+        if magic != _SPILL_MAGIC:
+            raise SpillFileCorruption(f"bad spill magic: {path}")
+        payload = f.read(length)
+    if len(payload) != length or zlib.crc32(payload) != crc:
+        raise SpillFileCorruption(f"spill file checksum mismatch: {path}")
+    with np.load(io.BytesIO(payload)) as z:
+        return [z[str(i)] for i in range(len(z.files))]
 
 
 class BufferCatalog:
@@ -123,7 +167,10 @@ class BufferCatalog:
                         self._unspill_locked(entry)
                     entry.in_use += 1
                     return entry.device_tree
-            ev.wait()
+            # bounded wait + watchdog: a writer that died with this
+            # hop still queued would otherwise park us here forever
+            if not ev.wait(timeout=1.0):
+                self._writer_ok()
 
     def release(self, handle: str):
         with self._lock:
@@ -201,8 +248,23 @@ class BufferCatalog:
             self._enqueue_writeback("to_host", entry, None,
                                     entry.writeback)
         else:
-            entry.host_leaves = [np.asarray(jax.device_get(x))
-                                 for x in leaves]
+            try:
+                faults.check("spill.d2h_copy")
+                entry.host_leaves = [np.asarray(jax.device_get(x))
+                                     for x in leaves]
+            except Exception as e:  # noqa: BLE001 — transient device
+                # error mid-copy: the data never left the device — put
+                # the entry back intact and surface a task-level retry
+                # (the classified recovery for a failed movement)
+                entry.device_tree = jax.tree_util.tree_unflatten(
+                    entry.treedef, leaves)
+                entry.tier = StorageTier.DEVICE
+                from ..obs import events as obs_events
+                obs_events.emit("spill_error", stage="d2h_copy",
+                                sync=True, error=str(e)[:200])
+                from ..faults import TpuTaskRetryError
+                raise TpuTaskRetryError(
+                    f"device->host spill copy failed: {e}") from e
         self.spilled_device_bytes += entry.nbytes
         from ..obs import events as obs_events
         obs_events.emit("spill", tier="device->host", bytes=entry.nbytes,
@@ -217,11 +279,17 @@ class BufferCatalog:
             for e in sorted(host_entries, key=lambda x: x.priority):
                 if host_total <= limit:
                     break
-                self._spill_to_disk_locked(e, async_write)
-                host_total -= e.nbytes
+                # a sync disk-write failure leaves the entry on HOST
+                # (returns False): don't count those bytes as moved, or
+                # the pass stops early without trying other candidates
+                if self._spill_to_disk_locked(e, async_write):
+                    host_total -= e.nbytes
 
     def _spill_to_disk_locked(self, entry: _Entry,
-                              async_write: bool = False):
+                              async_write: bool = False) -> bool:
+        """Returns True when the hop landed (or was queued to the
+        writer); False when a sync write failed and the entry stayed on
+        the HOST tier."""
         path = os.path.join(self._spill_dir_path(),
                             f"spill-{entry.handle_id}.npz")
         entry.tier = StorageTier.DISK
@@ -238,20 +306,57 @@ class BufferCatalog:
             self._enqueue_writeback("to_disk", entry, path,
                                     entry.writeback)
         else:
-            _write_npz(path, entry.host_leaves)
+            try:
+                _write_npz(path, entry.host_leaves)
+            except Exception as e:  # noqa: BLE001 — disk full/
+                # unwritable: the host copy is intact, so staying on the
+                # HOST tier (over its soft limit) beats failing the
+                # query; the next enforcement pass will try again
+                entry.tier = StorageTier.HOST
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                from ..obs import events as obs_events
+                obs_events.emit("spill_error", stage="disk_write",
+                                sync=True, error=str(e)[:200])
+                return False
             entry.host_leaves = None
             entry.disk_path = path
         self.spilled_host_bytes += entry.nbytes
         from ..obs import events as obs_events
         obs_events.emit("spill", tier="host->disk", bytes=entry.nbytes,
                         priority=entry.priority, background=async_write)
+        return True
 
     def _unspill_locked(self, entry: _Entry):
         from .budget import memory_budget
         import jax.numpy as jnp
         if entry.tier == StorageTier.DISK:
-            with np.load(entry.disk_path) as z:
-                entry.host_leaves = [z[str(i)] for i in range(len(z.files))]
+            try:
+                entry.host_leaves = _read_npz(entry.disk_path)
+            except SpillFileCorruption as e:
+                # integrity failure: quarantine the evidence (never feed
+                # corrupt bytes downstream) and recover by recompute —
+                # the task-attempt layer re-executes from the sources
+                qpath = entry.disk_path + ".quarantined"
+                try:
+                    os.replace(entry.disk_path, qpath)
+                    entry.disk_path = qpath  # remove() still cleans up
+                except OSError:
+                    pass
+                from ..obs import events as obs_events
+                obs_events.emit("integrity_fail", what="spill_file",
+                                path=entry.disk_path, bytes=entry.nbytes,
+                                error=str(e)[:200])
+                raise
+            except OSError as e:
+                from ..obs import events as obs_events
+                obs_events.emit("spill_error", stage="disk_read",
+                                sync=True, error=str(e)[:200])
+                from ..faults import TpuTaskRetryError
+                raise TpuTaskRetryError(
+                    f"spill file unreadable: {e}") from e
             os.unlink(entry.disk_path)
             entry.disk_path = None
             entry.tier = StorageTier.HOST
@@ -279,7 +384,13 @@ class BufferCatalog:
                            ) -> None:
         """Queue one tier hop's byte movement (caller holds the lock;
         `ev` is THAT hop's completion event — entry.writeback may point
-        at a later hop by the time the job runs)."""
+        at a later hop by the time the job runs). A dead writer thread
+        (killed by something harsher than the per-job except) is
+        detected here: its stranded queue is drained synchronously and a
+        fresh writer spawned, so one writer death never wedges spilling
+        for the rest of the process."""
+        if self._writer is not None and not self._writer.is_alive():
+            self._recover_dead_writer_locked()
         if self._write_q is None:
             self._write_q = queue.Queue()
             self._writer = threading.Thread(
@@ -287,6 +398,45 @@ class BufferCatalog:
                 name="spill-writer", daemon=True)
             self._writer.start()
         self._write_q.put((kind, entry, path, ev))
+
+    def _recover_dead_writer_locked(self) -> None:
+        """Caller holds the catalog lock. Drain the dead writer's queue
+        synchronously (running each stranded hop's byte movement on THIS
+        thread — the 'queue drained synchronously' watchdog of ISSUE 4)
+        and detach it so the next enqueue starts a fresh writer."""
+        q, self._write_q, self._writer = self._write_q, None, None
+        from ..obs import events as obs_events
+        obs_events.emit("spill_writer_dead",
+                        pending=q.qsize() if q is not None else 0)
+        if q is None:
+            return
+        while True:
+            try:
+                job = q.get_nowait()
+            except queue.Empty:
+                return
+            if job is None:
+                q.task_done()
+                continue
+            kind, entry, path, ev = job
+            try:
+                # NOTE: we already hold self._lock (RLock) — fine, the
+                # writeback takes it re-entrantly for its finalize steps
+                self._run_writeback(kind, entry, path)
+            except Exception:  # noqa: BLE001 — same contract as the
+                pass           # writer loop: the event must still set
+            finally:
+                ev.set()
+                q.task_done()
+
+    def _writer_ok(self) -> None:
+        """Watchdog probe used by waiters and the drain/shutdown entry
+        points: if the writer died with jobs still queued, drain them
+        synchronously. No return value — callers re-check their own
+        wait condition afterwards."""
+        with self._lock:
+            if self._writer is not None and not self._writer.is_alive():
+                self._recover_dead_writer_locked()
 
     def _writer_loop(self, q: "queue.Queue") -> None:
         # the queue travels as an argument, not through self._write_q:
@@ -331,10 +481,14 @@ class BufferCatalog:
             if pending is None:
                 return
             try:
+                faults.check("spill.d2h_copy")
                 host = [np.asarray(jax.device_get(x)) for x in pending]
-            except Exception:  # noqa: BLE001 — transient device error:
-                # the data never left the device; put the entry back on
-                # the DEVICE tier intact (budget was never released)
+            except Exception as e:  # noqa: BLE001 — transient device
+                # error: the data never left the device; put the entry
+                # back on the DEVICE tier intact (budget never released)
+                from ..obs import events as obs_events
+                obs_events.emit("spill_error", stage="d2h_copy",
+                                sync=False, error=str(e)[:200])
                 with self._lock:
                     entry.pending_device = None
                     if not entry.closed:
@@ -370,9 +524,12 @@ class BufferCatalog:
             return
         try:
             _write_npz(path, host)
-        except Exception:  # noqa: BLE001 — disk full/unwritable: the
-            # host copy is still intact, so the entry simply stays on
-            # the HOST tier; drop any partial file
+        except Exception as e:  # noqa: BLE001 — disk full/unwritable:
+            # the host copy is still intact, so the entry simply stays
+            # on the HOST tier; drop any partial file
+            from ..obs import events as obs_events
+            obs_events.emit("spill_error", stage="disk_write",
+                            sync=False, error=str(e)[:200])
             with self._lock:
                 if not entry.closed:
                     entry.tier = StorageTier.HOST
@@ -400,6 +557,7 @@ class BufferCatalog:
     def drain_writeback(self) -> None:
         """Block until every queued writeback has landed (test/bench
         hook; queries never need it — acquire() waits per entry)."""
+        self._writer_ok()  # a dead writer is drained synchronously here
         with self._lock:  # snapshot: shutdown_writer detaches under
             q = self._write_q  # the same lock
         if q is not None:
@@ -413,6 +571,7 @@ class BufferCatalog:
         writer — it can never enqueue onto a queue whose writer already
         exited (that hop's completion event would never be set and a
         later acquire() of the entry would wait forever)."""
+        self._writer_ok()  # a dead writer's stranded jobs drain here
         with self._lock:
             q, writer = self._write_q, self._writer
             self._write_q = None

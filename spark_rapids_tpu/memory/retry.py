@@ -20,9 +20,13 @@ and the backbone of the chaos-test suites in tests/test_retry.py.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Iterator, List, Optional, Sequence, TypeVar
 
-from ..config import RETRY_MAX_ATTEMPTS, TEST_RETRY_OOM_INJECTION_MODE, active_conf
+from ..config import (OOM_RETRY_BACKOFF_MS, RETRY_MAX_ATTEMPTS,
+                      TEST_RETRY_OOM_INJECTION_MODE, active_conf)
+from ..faults import check as _fault_check
+from ..faults import is_oom_error
 
 
 class TpuOOMError(MemoryError):
@@ -80,6 +84,12 @@ def unregister_task():
     _state.inject_mode = None
 
 
+def current_task_id() -> Optional[int]:
+    """This thread's registered task id (None outside a task) — the key
+    the fault-injection plan (faults.py) uses for deterministic replay."""
+    return _state.task_id
+
+
 def force_retry_oom(num_ooms: int = 1):
     """Arm injection on this thread for the next `num_ooms` guarded
     sections (test API, reference RmmSpark.forceRetryOOM)."""
@@ -97,7 +107,9 @@ def force_split_and_retry_oom(num_ooms: int = 1):
 
 
 def oom_guard():
-    """Called at the top of every guarded device section; applies injection."""
+    """Called at the top of every guarded device section; applies OOM
+    injection (the legacy injectRetryOOM path) and the registered
+    `device.dispatch` chaos fault point (faults.py)."""
     _state.guarded_calls += 1
     if (_state.inject_mode and _state.inject_remaining > 0
             and _state.guarded_calls >= _state.inject_at):
@@ -107,6 +119,7 @@ def oom_guard():
             raise TpuRetryOOM("injected retry OOM")
         if _state.inject_mode == "split":
             raise TpuSplitAndRetryOOM("injected split-and-retry OOM")
+    _fault_check("device.dispatch")
 
 
 def task_retry_counts():
@@ -115,6 +128,22 @@ def task_retry_counts():
 
 T = TypeVar("T")
 R = TypeVar("R")
+
+#: OOM backoff cap: the point of the sleep is to let in-flight frees
+#: land, not to stall a query for seconds
+_OOM_BACKOFF_CAP_MS = 200
+
+
+def _oom_backoff_ns(attempt: int) -> int:
+    """Capped exponential backoff with deterministic jitter for OOM
+    retry attempt N (1-based). Jitter is a pure hash of (task, attempt)
+    so chaos runs replay exactly."""
+    from ..faults import backoff_s
+    base_ms = active_conf().get(OOM_RETRY_BACKOFF_MS)
+    if base_ms <= 0:
+        return 0
+    return int(backoff_s(attempt, base_ms, _OOM_BACKOFF_CAP_MS,
+                         f"oom:{_state.task_id}:{attempt}") * 1e9)
 
 
 def split_in_half_by_rows(item):
@@ -173,6 +202,25 @@ def with_retry(input_item: T, fn: Callable[[T], R],
             owned.discard(id(item))
             item.close()
 
+    def handle_retry_oom(attempts: int):
+        """Shared TpuRetryOOM bookkeeping: count, emit (with the
+        attempt/backoff surface ISSUE 4 added), spill, then sleep a
+        capped exponential backoff — CHANGES PR 3 round-5 observed the
+        loop spinning through all 10 attempts in microseconds while the
+        bytes it needed were still in flight."""
+        _state.retry_count += 1
+        backoff_ns = _oom_backoff_ns(attempts)
+        from ..obs import events as obs_events
+        obs_events.emit("oom_retry", oom="retry", attempt=attempts,
+                        max_attempts=max_attempts, backoff_ns=backoff_ns,
+                        task_id=_state.task_id)
+        if attempts >= max_attempts:
+            return False
+        spill_for_retry()
+        if backoff_ns:
+            time.sleep(backoff_ns / 1e9)
+        return True
+
     try:
         while queue:
             item = queue.pop(0)
@@ -187,19 +235,15 @@ def with_retry(input_item: T, fn: Callable[[T], R],
                         yield result
                         break
                     except TpuRetryOOM:
-                        _state.retry_count += 1
-                        from ..obs import events as obs_events
-                        obs_events.emit("oom_retry", oom="retry",
-                                        attempt=attempts,
-                                        task_id=_state.task_id)
-                        if attempts >= max_attempts:
+                        if not handle_retry_oom(attempts):
                             raise
-                        spill_for_retry()
                     except TpuSplitAndRetryOOM:
                         _state.split_retry_count += 1
                         from ..obs import events as obs_events
                         obs_events.emit("oom_retry", oom="split",
                                         attempt=attempts,
+                                        max_attempts=max_attempts,
+                                        backoff_ns=0,
                                         task_id=_state.task_id)
                         if split_policy is None:
                             raise
@@ -208,6 +252,15 @@ def with_retry(input_item: T, fn: Callable[[T], R],
                         owned.update(id(h) for h in halves)
                         queue = halves + queue
                         break
+                    except Exception as e:
+                        # taxonomy (faults.py): XLA RESOURCE_EXHAUSTED is
+                        # an OOM in runtime-error clothing — recover it on
+                        # the spill-and-retry lane here, at the guarded
+                        # section, instead of failing the whole task
+                        if not is_oom_error(e):
+                            raise
+                        if not handle_retry_oom(attempts):
+                            raise TpuRetryOOM(str(e)) from e
             except BaseException:
                 _close_owned(item)  # the in-flight item, if owned
                 raise

@@ -11,7 +11,9 @@ Event kinds and their levels (spark.rapids.tpu.eventLog.level):
   ESSENTIAL  query_start, query_end
   MODERATE   op_close, semaphore_acquire, spill, oom_retry,
              pallas_tier, plan_fallback, plan_not_on_tpu, exchange,
-             pipeline_wait, pipeline_full, op_error
+             pipeline_wait, pipeline_full, op_error, fault_inject,
+             io_retry, task_retry, integrity_fail, pipeline_stuck,
+             spill_error, spill_writer_dead
   DEBUG      op_open, op_batch, span
 
 Cost discipline: `active_bus()` returns None when logging is disabled —
@@ -52,6 +54,16 @@ EVENT_LEVELS: Dict[str, int] = {
     "exchange": MODERATE,
     "pipeline_wait": MODERATE,
     "pipeline_full": MODERATE,
+    # robustness events (ISSUE 4): injected faults, retries at every
+    # level (IO -> OOM -> task), integrity quarantines and watchdog
+    # trips — the failure-story records a production operator reads
+    "fault_inject": MODERATE,
+    "io_retry": MODERATE,
+    "task_retry": MODERATE,
+    "integrity_fail": MODERATE,
+    "pipeline_stuck": MODERATE,
+    "spill_error": MODERATE,
+    "spill_writer_dead": MODERATE,
     "op_open": DEBUG,
     "op_batch": DEBUG,
     "span": DEBUG,

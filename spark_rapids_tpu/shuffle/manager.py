@@ -25,7 +25,10 @@ from ..columnar.batch import ColumnarBatch
 from ..config import (SHUFFLE_READER_THREADS, SHUFFLE_WRITER_THREADS,
                       SPILL_DIR, RapidsConf, active_conf)
 from ..types import Schema
-from .serializer import deserialize_batch, host_gather_batch, serialize_batch
+from .. import faults
+from ..io.retrying import with_io_retry
+from .serializer import (CorruptFrameError, deserialize_batch,
+                         host_gather_batch, serialize_batch)
 
 
 class HostShuffleHandle:
@@ -56,7 +59,16 @@ class HostShuffleWriter:
         """partitioned[p] = list of batches for partition p. Serialization
         (the expensive part: host gather + LZ4) fans out on the writer
         pool; the file write is sequential in partition order so the index
-        stays a flat range table."""
+        stays a flat range table.
+
+        Commit protocol (ISSUE 4): both files are written under
+        ATTEMPT-TAGGED temp names and renamed into place atomically,
+        data first, index last; the map output is only registered with
+        the handle after both renames land. A task attempt that dies
+        mid-write leaves only `.attempt-K.tmp` droppings (cleaned below)
+        — a reader can never observe a partial shard, and two attempts
+        of one map task never collide on a temp name (the reference's
+        shuffle write-then-commit discipline, single-process edition)."""
         n = self.handle.n_partitions
         assert len(partitioned) == n
         jobs = [(p, i, self._pool.submit(serialize_batch, b))
@@ -66,19 +78,31 @@ class HostShuffleWriter:
             frames[(p, i)] = fut.result()
         data_path = self.manager.map_data_path(self.handle.shuffle_id,
                                                self.map_id)
+        from ..exec.task_retry import task_attempt
+        tag = f".attempt-{task_attempt()}.tmp"
+        tmp_data, tmp_index = data_path + tag, data_path + ".index" + tag
         offsets = [0] * (n + 1)
-        with open(data_path + ".tmp", "wb") as f:
-            pos = 0
-            for p in range(n):
-                for i in range(len(partitioned[p])):
-                    frame = frames[(p, i)]
-                    f.write(struct.pack("<Q", len(frame)))
-                    f.write(frame)
-                    pos += 8 + len(frame)
-                offsets[p + 1] = pos
-        os.replace(data_path + ".tmp", data_path)
-        with open(data_path + ".index", "wb") as f:
-            f.write(struct.pack(f"<{n + 1}Q", *offsets))
+        try:
+            with open(tmp_data, "wb") as f:
+                pos = 0
+                for p in range(n):
+                    for i in range(len(partitioned[p])):
+                        frame = frames[(p, i)]
+                        f.write(struct.pack("<Q", len(frame)))
+                        f.write(frame)
+                        pos += 8 + len(frame)
+                    offsets[p + 1] = pos
+            with open(tmp_index, "wb") as f:
+                f.write(struct.pack(f"<{n + 1}Q", *offsets))
+            os.replace(tmp_data, data_path)
+            os.replace(tmp_index, data_path + ".index")
+        except BaseException:
+            for t in (tmp_data, tmp_index):
+                try:
+                    os.unlink(t)
+                except OSError:
+                    pass
+            raise
         self.bytes_written = offsets[n]
         self.handle.map_outputs.append(data_path)
 
@@ -92,8 +116,11 @@ class HostShuffleReader:
                  conf: Optional[RapidsConf] = None):
         self.handle = handle
         self.manager = manager
-        conf = conf or active_conf()
-        self._pool = manager.reader_pool(conf)
+        #: captured for the pool threads (active_conf is thread-local):
+        #: the IO-retry policy must follow the query's conf, not the
+        #: worker's default
+        self._conf = conf or active_conf()
+        self._pool = manager.reader_pool(self._conf)
         #: per-map index table cache: one parse per map output, not one
         #: per (map, partition) pair
         self._index_cache: Dict[str, Tuple[int, ...]] = {}
@@ -108,28 +135,63 @@ class HostShuffleReader:
         return cached
 
     def _fetch_segment(self, data_path: str, partition: int) -> List[bytes]:
-        offsets = self._index(data_path)
-        lo, hi = offsets[partition], offsets[partition + 1]
-        frames: List[bytes] = []
-        if hi > lo:
-            with open(data_path, "rb") as f:
-                f.seek(lo)
-                seg = f.read(hi - lo)
-            p = 0
-            while p < len(seg):
-                (ln,) = struct.unpack_from("<Q", seg, p)
-                frames.append(seg[p + 8: p + 8 + ln])
-                p += 8 + ln
-        return frames
+        """One partition's frames from one map output, with bounded IO
+        retry (ISSUE 4 satellite): a transient read failure — or an
+        injected `shuffle.fetch` fault — re-fetches with backoff
+        instead of killing the query."""
+        def fetch() -> List[bytes]:
+            # the index read lives INSIDE the retry lane too: a flaky
+            # mount fails the .index open just as readily as the data
+            # segment, and the cache makes the re-read free afterwards
+            offsets = self._index(data_path)
+            lo, hi = offsets[partition], offsets[partition + 1]
+            frames: List[bytes] = []
+            if hi > lo:
+                with open(data_path, "rb") as f:
+                    f.seek(lo)
+                    seg = f.read(hi - lo)
+                p = 0
+                while p < len(seg):
+                    (ln,) = struct.unpack_from("<Q", seg, p)
+                    frames.append(seg[p + 8: p + 8 + ln])
+                    p += 8 + ln
+            return frames
+
+        return with_io_retry(
+            fetch, "shuffle.fetch", conf=self._conf,
+            fault_point="shuffle.fetch",
+            # per-(map file, partition) jitter: concurrent pool threads
+            # on one flaky mount must not re-herd in lockstep
+            salt=f"{os.path.basename(data_path)}:{partition}")
+
+    def _decode(self, frame: bytes, key: str = "") -> ColumnarBatch:
+        """Integrity-checked decode: the frame's xxh64 (stamped at
+        write over header + size table + payload) is verified inside
+        deserialize_batch; a corrupt block is quarantined — an
+        `integrity_fail` event, never propagated downstream — and the
+        failure surfaces as a task-retry so the query recomputes."""
+        frame = faults.apply("shuffle.decode", frame, key=key or None)
+        try:
+            return deserialize_batch(frame, self.handle.schema)
+        except CorruptFrameError as e:
+            from ..obs import events as obs_events
+            obs_events.emit("integrity_fail", what="shuffle_block",
+                            shuffle_id=self.handle.shuffle_id,
+                            bytes=len(frame), error=str(e)[:200])
+            raise faults.IntegrityError(
+                f"corrupt shuffle block (shuffle {self.handle.shuffle_id}): "
+                f"{e}") from e
 
     def read_partition(self, partition: int) -> Iterator[ColumnarBatch]:
         segs = list(self._pool.map(
             lambda path: self._fetch_segment(path, partition),
             self.handle.map_outputs))
         frames = [fr for seg in segs for fr in seg]
-        schema = self.handle.schema
+        # per-frame injection key (partition + frame ordinal): the chaos
+        # verdict follows the frame, not decode-pool scheduling
         yield from self._pool.map(
-            lambda fr: deserialize_batch(fr, schema), frames)
+            lambda args: self._decode(args[1], key=f"p{partition}:{args[0]}"),
+            enumerate(frames))
 
 
 class HostShuffleManager:

@@ -43,6 +43,12 @@ VERSION = 1
 CODEC_COPY = 0  # reference CopyCompressionCodec
 CODEC_LZ4 = 1   # reference NvcompLZ4CompressionCodec (host analog)
 
+
+class CorruptFrameError(ValueError):
+    """The frame's structure or checksum failed verification: the block
+    is damaged (torn write, bit rot, injected corruption). The reader
+    quarantines it and recovers by recompute (ISSUE 4 integrity)."""
+
 _HEADER = struct.Struct("<8sBBHQQQQQI")
 
 
@@ -198,26 +204,46 @@ def serialize_batch(batch: ColumnarBatch, codec: int = None) -> bytes:
             codec, payload = CODEC_COPY, raw
     else:
         payload = raw
-    header = _HEADER.pack(
-        MAGIC, VERSION, codec, 0, n, schema_fingerprint(batch.schema),
-        len(raw), len(payload), xxh64(payload), len(raw_parts))
     sizes = struct.pack(f"<{len(raw_parts)}Q", *map(len, raw_parts))
+    # the checksum covers the WHOLE frame — header (with the checksum
+    # field zeroed), size table and payload. Header fields are live
+    # decode inputs (codec selects decompression, raw_len sizes it, the
+    # size table is sliced by n/nbuf): a flipped bit in any of them must
+    # be a detected corruption, not garbage buffers or a misclassified
+    # schema mismatch
+    shash = schema_fingerprint(batch.schema)
+    hdr0 = _HEADER.pack(MAGIC, VERSION, codec, 0, n, shash,
+                        len(raw), len(payload), 0, len(raw_parts))
+    chk = xxh64(hdr0 + sizes + payload)
+    header = _HEADER.pack(MAGIC, VERSION, codec, 0, n, shash,
+                          len(raw), len(payload), chk, len(raw_parts))
     return header + sizes + payload
 
 
 def deserialize_batch(frame: bytes, schema: Schema) -> ColumnarBatch:
-    (magic, version, codec, _flags, n, shash, raw_len, comp_len, chk,
+    if len(frame) < _HEADER.size:
+        raise CorruptFrameError("truncated shuffle frame header")
+    (magic, version, codec, flags, n, shash, raw_len, comp_len, chk,
      nbuf) = _HEADER.unpack_from(frame, 0)
     if magic != MAGIC or version != VERSION:
-        raise ValueError("not a TPU shuffle frame")
-    if shash != schema_fingerprint(schema):
-        raise ValueError("shuffle frame schema mismatch")
+        raise CorruptFrameError("not a TPU shuffle frame")
     off = _HEADER.size
+    if len(frame) < off + 8 * nbuf:
+        raise CorruptFrameError("truncated shuffle frame size table")
     sizes = struct.unpack_from(f"<{nbuf}Q", frame, off)
+    sizes_bytes = frame[off: off + 8 * nbuf]
     off += 8 * nbuf
     payload = frame[off: off + comp_len]
-    if xxh64(payload) != chk:
-        raise ValueError("shuffle frame checksum mismatch (corrupt block)")
+    hdr0 = _HEADER.pack(magic, version, codec, flags, n, shash,
+                        raw_len, comp_len, 0, nbuf)
+    if len(payload) != comp_len or xxh64(hdr0 + sizes_bytes + payload) != chk:
+        raise CorruptFrameError(
+            "shuffle frame checksum mismatch (corrupt block)")
+    # checksum verified: a fingerprint mismatch now is a REAL schema
+    # disagreement (an engine bug), not bit rot — fail loudly, don't
+    # quarantine-and-recompute our way past it
+    if shash != schema_fingerprint(schema):
+        raise ValueError("shuffle frame schema mismatch")
     raw = lz4_decompress(payload, raw_len) if codec == CODEC_LZ4 else payload
     bufs: List[bytes] = []
     p = 0

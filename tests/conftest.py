@@ -27,3 +27,17 @@ import pytest
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_leaked_fault_plan():
+    """A chaos plan (spark.rapids.tpu.test.faults) leaked by one module
+    would silently inject faults into every later suite — disarm at
+    module boundaries and fail the offender loudly (ISSUE 4)."""
+    from spark_rapids_tpu import faults
+    faults.install(None)
+    yield
+    leaked = faults.active_plan()
+    faults.install(None)
+    assert leaked is None, (
+        f"module leaked an armed fault plan: {leaked.spec_string!r}")

@@ -75,6 +75,44 @@ def _all_exec_classes():
                   key=lambda c: c.__name__)
 
 
+def test_fault_point_registry_matches_docs():
+    """docs/robustness.md's fault-point table lists exactly the points
+    registered in faults.FAULT_POINTS (ISSUE 4: the same drift lint the
+    conf registry gets) — and every registered point appears at its
+    real call site somewhere in the package."""
+    from spark_rapids_tpu import faults
+    docs = (ROOT / "docs" / "robustness.md").read_text()
+    documented = set(re.findall(r"^\|\s*`([a-z_]+\.[a-z_0-9]+)`\s*\|",
+                                docs, re.MULTILINE))
+    registered = set(faults.FAULT_POINTS)
+    assert documented == registered, (
+        f"docs/robustness.md fault table drifted: "
+        f"missing={sorted(registered - documented)} "
+        f"stale={sorted(documented - registered)}")
+    # every point is wired: its name appears as a literal in a real
+    # call site (outside faults.py itself)
+    src = "".join(p.read_text()
+                  for p in (ROOT / "spark_rapids_tpu").rglob("*.py")
+                  if p.name != "faults.py")
+    unwired = [p for p in registered if f'"{p}"' not in src]
+    assert not unwired, f"registered fault points with no call site: {unwired}"
+
+
+def test_robustness_event_kinds_are_registered():
+    """Every event kind the robustness layer emits is in
+    obs.events.EVENT_LEVELS (an unregistered kind silently defaults to
+    MODERATE — fine at runtime, but the schema table must know it)."""
+    from spark_rapids_tpu.obs import events
+    for kind in ("fault_inject", "io_retry", "task_retry",
+                 "integrity_fail", "pipeline_stuck", "spill_error",
+                 "spill_writer_dead"):
+        assert kind in events.EVENT_LEVELS, kind
+    docs = (ROOT / "docs" / "observability.md").read_text()
+    for kind in events.EVENT_LEVELS:
+        assert f"`{kind}`" in docs, (
+            f"event kind {kind} missing from docs/observability.md")
+
+
 def test_additional_metrics_are_canonical_and_unique():
     classes = _all_exec_classes()
     assert len(classes) >= 20  # the walk actually found the exec tree

@@ -141,6 +141,31 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
                if e.get("kind") in ("plan_fallback", "plan_not_on_tpu"))
     if n_fb:
         extras.append(f"plan fallback/why-not records: {n_fb}")
+    # robustness roll-up (docs/robustness.md): how much chaos the run
+    # absorbed, and at which recovery layer
+    n_inject = sum(1 for e in events if e.get("kind") == "fault_inject")
+    if n_inject:
+        by_point: Dict[str, int] = {}
+        for e in events:
+            if e.get("kind") == "fault_inject":
+                by_point[e.get("point", "?")] = \
+                    by_point.get(e.get("point", "?"), 0) + 1
+        detail = ", ".join(f"{p}:{n}" for p, n in sorted(by_point.items()))
+        extras.append(f"injected faults: {n_inject} ({detail})")
+    n_io = sum(1 for e in events if e.get("kind") == "io_retry")
+    if n_io:
+        extras.append(f"io retries: {n_io}")
+    n_task = sum(1 for e in events if e.get("kind") == "task_retry")
+    if n_task:
+        extras.append(f"task re-executions: {n_task}")
+    n_integ = sum(1 for e in events if e.get("kind") == "integrity_fail")
+    if n_integ:
+        extras.append(f"integrity quarantines: {n_integ}")
+    n_watch = sum(1 for e in events
+                  if e.get("kind") in ("pipeline_stuck",
+                                       "spill_writer_dead"))
+    if n_watch:
+        extras.append(f"watchdog trips: {n_watch}")
     tiers = [e for e in events if e.get("kind") == "pallas_tier"]
     if tiers:
         on = sum(1 for e in tiers if e.get("engaged"))
