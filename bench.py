@@ -60,6 +60,58 @@ def maybe_enable_faults(argv=None):
 
 _FAULT_RATE = None
 
+#: per-lane query deadline (--query-timeout-ms): every guarded_run
+#: iteration runs under a lifecycle QueryContext with this deadline, so
+#: a chaos soak proves BOUNDED per-query wall-clock, not just eventual
+#: convergence (ISSUE 6)
+_QUERY_TIMEOUT_MS = None
+
+
+def maybe_query_timeout(argv=None):
+    """`bench.py --query-timeout-ms N`: run every bench iteration under
+    the lifecycle governor with an N-ms deadline (exec/lifecycle.py). A
+    lane that would exceed it raises QueryCancelledError and fails
+    loudly instead of wedging a nightly round. Returns the timeout
+    (None = no deadline)."""
+    global _QUERY_TIMEOUT_MS
+    argv = sys.argv if argv is None else argv
+    if "--query-timeout-ms" not in argv:
+        return None
+    idx = argv.index("--query-timeout-ms")
+    try:
+        ms = int(argv[idx + 1])
+        assert ms > 0
+    except (IndexError, ValueError, AssertionError):
+        print(json.dumps({"error_kind": "usage",
+                          "error": "--query-timeout-ms requires a "
+                                   "positive integer millisecond "
+                                   "argument"}))
+        raise SystemExit(2)
+    _QUERY_TIMEOUT_MS = ms
+    return ms
+
+
+#: lifecycle-counter snapshot at the previous lifecycle_attribution()
+#: call (process-cumulative, reported as per-record deltas like chaos)
+_lifecycle_prev = None
+
+
+def lifecycle_attribution():
+    """{"lifecycle": ...} block for each BENCH record: cancellations,
+    breaker transitions and partition-vs-whole-plan recovery counts
+    this lane absorbed (exec/lifecycle.py counters, as deltas since the
+    previous record)."""
+    global _lifecycle_prev
+    from spark_rapids_tpu.exec import lifecycle
+    cur = lifecycle.counters()
+    prev = _lifecycle_prev if _lifecycle_prev is not None else {}
+    _lifecycle_prev = cur
+    out = {k: v - prev.get(k, 0) for k, v in cur.items()}
+    if _QUERY_TIMEOUT_MS is not None:
+        out["query_timeout_ms"] = _QUERY_TIMEOUT_MS
+    return out
+
+
 #: counter snapshot at the previous chaos_attribution() call — the
 #: underlying counters are process-cumulative, each BENCH record must
 #: report only ITS OWN lane's deltas
@@ -116,6 +168,15 @@ def guarded_run(fn):
         conf = RapidsConf(dict(
             active_conf()._settings,
             **{"spark.rapids.tpu.task.maxAttempts": "20"}))
+    if _QUERY_TIMEOUT_MS is not None:
+        # --query-timeout-ms: the deadline spans the iteration's whole
+        # retry chain (exec/lifecycle.py), proving bounded per-query
+        # wall-clock under chaos instead of just eventual convergence
+        from spark_rapids_tpu.exec import lifecycle
+        with lifecycle.governed(conf if conf is not None
+                                else active_conf(),
+                                timeout_ms=_QUERY_TIMEOUT_MS):
+            return with_task_retry(lambda attempt: fn(), conf=conf)
     return with_task_retry(lambda attempt: fn(), conf=conf)
 
 
@@ -368,6 +429,7 @@ def main():
         "vs_baseline": round(t_np / dt, 3),
         "profile": query_attribution(plan, metrics_before),
         "pipeline": pipeline_attribution(),
+        "lifecycle": lifecycle_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:
@@ -519,6 +581,7 @@ def q3_bench():
         "vs_baseline": round(t_np / dt, 3),
         "profile": query_attribution(plan, metrics_before),
         "pipeline": pipeline_attribution(),
+        "lifecycle": lifecycle_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:
@@ -529,5 +592,6 @@ def q3_bench():
 if __name__ == "__main__":
     maybe_enable_event_log()
     maybe_enable_faults()
+    maybe_query_timeout()
     main()
     q3_bench()

@@ -30,4 +30,7 @@ from .columnar.batch import ColumnarBatch  # noqa: E402
 # distinguish the OOM lane (memory.retry.TpuOOMError) from transient
 # task-lane failures and integrity quarantines (docs/robustness.md)
 from .faults import IntegrityError, TpuTaskRetryError  # noqa: E402
+# a deadline-expired or user-cancelled governed query unwinds with this
+# (exec/lifecycle.py; TpuSession.cancel_query / query.timeoutMs)
+from .exec.lifecycle import QueryCancelledError  # noqa: E402
 from .version import __version__  # noqa: E402

@@ -55,6 +55,29 @@ class TpuSession:
         self._last_query_metrics = None
         #: per-query profile of the LAST collect() (obs/profile.py)
         self._last_query_profile = None
+        #: lifecycle-governor ownership token: every governed collect
+        #: registers its QueryContext under it, so cancel_query() (from
+        #: any thread) can find and cancel THIS session's queries
+        self._lifecycle_owner = object()
+
+    def cancel_query(self) -> int:
+        """Cooperatively cancel every query this session is currently
+        running (exec/lifecycle.py): their cancellation tokens are set,
+        each blocked or computing thread raises QueryCancelledError at
+        its next batch boundary / wait-loop poll, and the queries
+        unwind through their normal try/finally chains — no leaked
+        pipeline/spill threads, settled budget and catalog counters.
+        Returns the number of queries cancelled (0 = none running)."""
+        from ..exec import lifecycle
+        return lifecycle.cancel_owner(self._lifecycle_owner)
+
+    def health(self) -> Dict:
+        """Engine health surface (exec/lifecycle.py): degradation
+        circuit-breaker states per fault domain, governed-query count,
+        and the cumulative lifecycle counters (cancellations, breaker
+        trips, partition-granular vs whole-plan recoveries)."""
+        from ..exec import lifecycle
+        return lifecycle.health()
 
     def last_query_metrics(self):
         """Task-level metrics of the most recent DataFrame.collect():
@@ -356,10 +379,19 @@ class DataFrame:
         dying IO path past its bounded retries — discards the attempt
         and re-runs the whole plan from the sources, up to
         spark.rapids.tpu.task.maxAttempts times. Every attempt rebuilds
-        its exec tree in _collect_once, so attempts share no state."""
+        its exec tree in _collect_once, so attempts share no state.
+
+        Lifecycle governor (ISSUE 6): the whole drive — including every
+        retry attempt and its backoff — runs under one QueryContext, so
+        spark.rapids.tpu.query.timeoutMs bounds the query's total
+        wall-clock and TpuSession.cancel_query() can unwind it
+        cooperatively from another thread."""
+        from ..exec import lifecycle
         from ..exec.task_retry import with_task_retry
-        return with_task_retry(lambda attempt: self._collect_once(),
-                               conf=self.session.conf)
+        with lifecycle.governed(self.session.conf,
+                                owner=self.session._lifecycle_owner):
+            return with_task_retry(lambda attempt: self._collect_once(),
+                                   conf=self.session.conf)
 
     def _collect_once(self) -> List[tuple]:
         import time as _time

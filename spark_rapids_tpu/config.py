@@ -427,6 +427,66 @@ PIPELINE_CLOSE_TIMEOUT_MS = conf_int(
     "pipeline_stuck event and detaching the (daemon) thread instead of "
     "hanging the query teardown / interpreter exit.")
 
+QUERY_TIMEOUT_MS = conf_int(
+    "spark.rapids.tpu.query.timeoutMs", 0,
+    "Per-query deadline for session-driven collects (exec/lifecycle.py "
+    "query lifecycle governor): a query still running after this many "
+    "ms is cooperatively cancelled — the cancellation token is checked "
+    "at every batch boundary and inside semaphore / pipeline / spill-"
+    "writeback waits, and the query unwinds with QueryCancelledError "
+    "(a query_cancelled event records the phase that noticed it). The "
+    "deadline spans ALL task re-execution attempts, so one query's "
+    "wall-clock is bounded even under chaos. 0 (default) disables the "
+    "deadline; TpuSession.cancel_query() works either way.",
+    commonly_used=True)
+
+QUERY_CANCEL_CHECK_BATCHES = conf_int(
+    "spark.rapids.tpu.query.cancelCheckBatches", 8,
+    "How many operator batch boundaries pass between cancellation/"
+    "deadline checks of a governed query (exec/lifecycle.py). 1 checks "
+    "every batch (lowest cancellation latency); higher values shave "
+    "the already-tiny per-batch cost. Outside a governed query each "
+    "boundary pays exactly one pointer check.")
+
+PARTITION_RECOVERY_ENABLED = conf_bool(
+    "spark.rapids.tpu.task.partitionRecovery.enabled", True,
+    "Partition-granular recovery for host-shuffle block corruption "
+    "(exec/lifecycle.py + shuffle/manager.py): the exchange captures "
+    "per-map-output lineage at write time, and a checksum-quarantined "
+    "shuffle block re-executes ONLY the producing sub-plan (the "
+    "exchange child) to rewrite that one map output, instead of "
+    "re-running the whole query through the task-retry lane. Ambiguous "
+    "provenance (spill files, missing lineage, repeated corruption of "
+    "one map output) still falls back to whole-plan re-execution.")
+
+BREAKER_ENABLED = conf_bool(
+    "spark.rapids.tpu.breaker.enabled", False,
+    "Degradation circuit breakers (exec/lifecycle.py): track classified-"
+    "transient failures per fault domain (pallas_fused / pallas_join / "
+    "device_dispatch); after breaker.threshold failures inside "
+    "breaker.windowMs a domain's breaker opens and the domain is "
+    "demoted to its safe path (the XLA kernel tier) for "
+    "breaker.cooldownMs, then half-opens for one probe. Off (default): "
+    "failure recording is skipped entirely and every tier consult is "
+    "one empty-dict check.")
+
+BREAKER_THRESHOLD = conf_int(
+    "spark.rapids.tpu.breaker.threshold", 3,
+    "Classified-transient failures of one fault domain inside "
+    "breaker.windowMs that open its circuit breaker.")
+
+BREAKER_WINDOW_MS = conf_int(
+    "spark.rapids.tpu.breaker.windowMs", 60000,
+    "Sliding failure-count window per fault domain for the degradation "
+    "circuit breakers; failures older than this no longer count toward "
+    "breaker.threshold.")
+
+BREAKER_COOLDOWN_MS = conf_int(
+    "spark.rapids.tpu.breaker.cooldownMs", 30000,
+    "How long an open breaker keeps its domain demoted before "
+    "half-opening for one probe (probe success closes the breaker, "
+    "probe failure re-opens it for another cooldown).")
+
 DECIMAL_ENABLED = conf_bool(
     "spark.rapids.sql.decimalType.enabled", True,
     "Enable decimal offload (decimal128 columns stay on CPU until the "

@@ -219,7 +219,6 @@ class TpuExec:
         the cumulative totals when it finishes (or is abandoned by a
         limit). Disabled mode pays exactly one active_bus() check."""
         from ..obs import events as obs_events
-        from ..utils.tracing import annotate_op
         rows = self.metrics[NUM_OUTPUT_ROWS]
         batches = self.metrics[NUM_OUTPUT_BATCHES]
         name = type(self).__name__
@@ -233,9 +232,39 @@ class TpuExec:
             dump_enabled = False
         it = self.internal_execute()
         bus = obs_events.active_bus()
+        # lifecycle governor (ISSUE 6): the ONE batch-boundary
+        # cancellation hook for every operator — outside a governed
+        # query (tests/bench driving exec trees directly) qctx is None
+        # and each batch pays exactly this pointer check; inside one,
+        # tick() checks the deadline/cancel token every
+        # query.cancelCheckBatches batches and raises
+        # QueryCancelledError at the boundary
+        from . import lifecycle
+        qctx = lifecycle.current_context()
+        try:
+            yield from self._drive(it, bus, qctx, name, rows, batches,
+                                   dump_enabled)
+        finally:
+            # synchronous teardown (ISSUE 6): when an exception (a
+            # cancellation tick, a downstream operator error) unwinds
+            # THROUGH this frame, the internal iterator below us may be
+            # left suspended — closing it here runs its try/finally
+            # chain NOW (pipeline stages join their producer threads,
+            # staged spillables close), instead of whenever GC drops
+            # the suspended frames. Exhausted iterators close as a
+            # no-op, so the steady state is unchanged.
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+
+    def _drive(self, it, bus, qctx, name, rows, batches, dump_enabled):
+        from ..obs import events as obs_events
+        from ..utils.tracing import annotate_op
         if bus is None:
             # fast path: bit-identical to the pre-obs loop
             while True:
+                if qctx is not None:
+                    qctx.tick()
                 with annotate_op(name):
                     try:
                         batch = next(it)
@@ -266,6 +295,8 @@ class TpuExec:
         emit_batches = bus.level >= obs_events.DEBUG
         try:
             while True:
+                if qctx is not None:
+                    qctx.tick()
                 t0 = time.perf_counter_ns()
                 with annotate_op(name):
                     try:

@@ -487,6 +487,14 @@ class HostShuffleExchangeExec(TpuExec):
             else:
                 source = self.child.execute()
                 bounds = None
+            from ..config import PARTITION_RECOVERY_ENABLED
+            # lineage capture (ISSUE 6): range mode is excluded — its
+            # partition bounds come from sampling a spillable buffer
+            # that is consumed by the write pass, so a later recompute
+            # could not replay the identical pid assignment
+            capture_lineage = (
+                self.partitioning != "range"
+                and bool(self._conf.get(PARTITION_RECOVERY_ENABLED)))
             map_id = 0
             for b in source:
                 in_batches.add(1)
@@ -501,6 +509,10 @@ class HostShuffleExchangeExec(TpuExec):
                                                self._conf)
                     writer.write([[p] if p.num_rows_host else []
                                   for p in parts])
+                if capture_lineage:
+                    handle.lineage[mgr.map_data_path(
+                        handle.shuffle_id, map_id)] = \
+                        self._make_recompute(handle, mgr, map_id)
                 self.metrics[PARTITION_SIZE].add(writer.bytes_written)
                 obs_events.emit("exchange",
                                 exec="HostShuffleExchangeExec",
@@ -569,6 +581,61 @@ class HostShuffleExchangeExec(TpuExec):
                 state["closed"] = True
                 mgr.unregister(handle)
             raise
+
+    def _make_recompute(self, handle, mgr, map_id: int):
+        """Partition-granular recovery lineage (ISSUE 6): a zero-arg
+        closure that re-executes ONLY this exchange's child sub-plan
+        from its sources and atomically rewrites the one damaged map
+        output — the engine analog of Spark recomputing a single lost
+        map task instead of the whole job. Runs at shuffle READ time
+        (possibly on the pipelined shuffle-read producer thread, which
+        has adopted conf/query-id/attempt/lifecycle context); the
+        round-robin offset is replayed from zero so the recomputed pid
+        assignment is bit-identical to the original write."""
+        from ..shuffle.manager import (HostShuffleWriter,
+                                       partition_batch_host)
+
+        def recompute() -> None:
+            # serialization: the reader invokes lineage closures under
+            # the handle's recover_lock (shuffle/manager.py), so two
+            # corrupt map outputs read through the PIPELINED partition
+            # streams never run this concurrently — the mutable
+            # round-robin offset replay below relies on that
+            saved_rr = self._rr_offset
+            self._rr_offset = 0
+            try:
+                src = self.child.execute()
+                try:
+                    for i, b in enumerate(src):
+                        n = b.num_rows_host
+                        if i < map_id:
+                            # skipped map tasks only advance the
+                            # round-robin cursor; hash/single pids are
+                            # stateless, so no device work is spent
+                            if self.partitioning == "roundrobin":
+                                self._rr_offset = int(
+                                    (self._rr_offset + n)
+                                    % self.n_partitions)
+                            continue
+                        pid = self._pid_for(b, n, None)
+                        parts = partition_batch_host(
+                            b, pid, self.n_partitions)
+                        writer = HostShuffleWriter(handle, map_id,
+                                                   mgr, self._conf)
+                        writer.write([[p] if p.num_rows_host else []
+                                      for p in parts], register=False)
+                        return
+                    raise RuntimeError(
+                        f"partition recovery: child produced no "
+                        f"batch {map_id} on re-execution")
+                finally:
+                    close = getattr(src, "close", None)
+                    if close is not None:
+                        close()
+            finally:
+                self._rr_offset = saved_rr
+
+        return recompute
 
     def _read_partition(self, reader, p: int) -> Iterator[ColumnarBatch]:
         """Stream one partition's decoded blocks. Pipelined (ISSUE 3):

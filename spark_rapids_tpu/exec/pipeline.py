@@ -178,6 +178,9 @@ class PipelinedIterator:
         self._spec_ctx = capture_context()
         from .task_retry import capture_attempt
         self._attempt = capture_attempt()
+        from . import lifecycle
+        self._lctx = lifecycle.current_context()
+        self._engaged = lifecycle.capture_engagement()
         self._thread = threading.Thread(
             target=self._run, name=f"pipeline-{label}", daemon=True)
         self._thread.start()
@@ -202,9 +205,25 @@ class PipelinedIterator:
             # producer could tear its files
             from .task_retry import adopt_attempt
             adopt_attempt(self._attempt)
+            # the lifecycle context too (ISSUE 6): operators running
+            # behind this boundary tick the consumer's cancellation
+            # token, and nested blocking waits (semaphore, inner
+            # stages) notice a cancelled query from this thread
+            from . import lifecycle
+            lifecycle.adopt_context(self._lctx)
+            lifecycle.adopt_engagement(self._engaged)
             _tls.cancel_event = self._closed
             it = iter(self._source)
             while not self._closed.is_set():
+                if self._lctx is not None and self._lctx.cancelled():
+                    # cancelled query: stop starting new producer work.
+                    # check() RAISES (caught below into self._exc, so
+                    # the consumer re-raises at _END) — a bare break
+                    # would post a clean end-of-stream and a truncated
+                    # tail could read as normal completion (the same
+                    # silent-truncation class the PR 3 StageCancelled
+                    # fix closed for stage-close cuts)
+                    self._lctx.check("compute")
                 try:
                     # chaos fault point — engine operator stages only:
                     # emit_events=False stages (tools/pipeline_bench run
@@ -226,9 +245,26 @@ class PipelinedIterator:
         except BaseException as e:  # noqa: BLE001 — carried to consumer
             self._exc = e
         finally:
-            if self._closed.is_set() and it is not None:
-                # early shutdown: close the abandoned source so its
-                # finally blocks (spillable handles, shuffle files) run
+            if self._exc is None and not self._closed.is_set() \
+                    and self._lctx is not None and self._lctx.cancelled():
+                # the loop exited via an _offer() that noticed the
+                # cancellation (returned False on a full queue): the
+                # stream IS truncated, so _END must not read as normal
+                # completion — carry the cancellation to the consumer.
+                # Derived via check() (review r3), not hand-built: the
+                # shared path emits the ONE query_cancelled event and
+                # bumps the lifecycle counter like every other checker.
+                try:
+                    self._lctx.check("compute")
+                except BaseException as e:  # noqa: BLE001 — the
+                    self._exc = e           # cancellation itself
+            if it is not None and (
+                    self._closed.is_set()
+                    or (self._lctx is not None and self._lctx.cancelled())):
+                # early shutdown (stage closed, or the governed query
+                # was cancelled and this loop broke out): close the
+                # abandoned source so its finally blocks (spillable
+                # handles, shuffle files) run
                 close = getattr(it, "close", None)
                 if close is not None:
                     try:
@@ -244,6 +280,10 @@ class PipelinedIterator:
                 self._q.put(item, timeout=_POLL_S)
                 return True
             except queue.Full:
+                if self._lctx is not None and self._lctx.cancelled():
+                    # a cancelled query's consumer stopped draining:
+                    # don't park on its full queue until close() lands
+                    return False
                 continue
         return False
 
@@ -260,6 +300,13 @@ class PipelinedIterator:
                 item = self._q.get(timeout=_POLL_S)
                 break
             except queue.Empty:
+                # lifecycle governor: a consumer parked on an empty
+                # queue is exactly where a stalled producer wedges a
+                # query — the deadline/cancel token is checked here so
+                # an expired query unwinds with phase attribution
+                # instead of waiting out the stall
+                from . import lifecycle
+                lifecycle.check_current("pipeline-wait")
                 if cancelled():
                     # this consumer IS an outer stage's producer and
                     # that stage was closed: stop pulling so the outer

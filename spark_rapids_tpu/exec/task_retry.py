@@ -79,12 +79,18 @@ def _settle_between_attempts() -> None:
     """Let the failed attempt's async machinery land before re-running:
     in-flight spill writebacks finish (their budget releases land), so
     the fresh attempt starts from settled accounting. Pipeline producer
-    threads were already joined by the exception's finally chain."""
+    threads were already joined by the exception's finally chain.
+    Settling stays best-effort — the retry itself decides whether the
+    state is usable — but a settling failure is no longer silent: a
+    catalog wedged between attempts is exactly what an operator
+    debugging a non-converging retry loop needs to see."""
     from ..memory.catalog import buffer_catalog
     try:
         buffer_catalog().drain_writeback()
-    except Exception:  # noqa: BLE001 — settling is best-effort; the
-        pass           # retry itself decides whether the state is usable
+    except Exception as e:  # noqa: BLE001 — settling is best-effort
+        from ..obs import events as obs_events
+        obs_events.emit("task_retry_settle_error",
+                        error=f"{type(e).__name__}: {e}"[:200])
 
 
 def with_task_retry(run: Callable[[int], T],
@@ -101,27 +107,62 @@ def with_task_retry(run: Callable[[int], T],
     max_attempts = max(1, conf.get(TASK_MAX_ATTEMPTS))
     base_ms = max(1, conf.get(TASK_RETRY_BACKOFF_MS))
     prev = getattr(_tls, "attempt", None)
+    from . import lifecycle
     try:
         attempt = 0
         while True:
             attempt += 1
             _tls.attempt = attempt
+            lifecycle.begin_attempt()
             try:
-                return run(attempt)
+                result = run(attempt)
+                # a half-open breaker whose domain this attempt engaged
+                # (probed) closes on success (exec/lifecycle.py)
+                lifecycle.attempt_succeeded()
+                return result
             except Exception as e:  # noqa: BLE001 — classified below
-                if classify(e) != "task" or attempt >= max_attempts:
+                # degradation breakers FIRST: every classified-
+                # transient failure counts, INCLUDING the final
+                # exhausted attempt (the strongest persistence signal —
+                # and with maxAttempts=1 it is the only one; review r2)
+                transient = classify(e) == "task"
+                if transient:
+                    lifecycle.attempt_failed(e)
+                if not transient or attempt >= max_attempts:
                     raise
+                # a cancelled/expired governed query must not burn
+                # further attempts (or sleep a backoff past its
+                # deadline): surface the cancellation instead
+                lifecycle.check_current("task-retry")
                 with _retry_lock:
                     _retry_count += 1
                 backoff = _backoff_s(attempt, base_ms, label)
                 from ..obs import events as obs_events
+                # provenance travels into the event (ISSUE 6): shuffle
+                # blocks with captured lineage recover on the
+                # partition-granular lane in shuffle/manager.py and
+                # never reach here; everything landing on THIS lane is
+                # a whole-plan re-execution (provenance ambiguous or
+                # absent — docs/robustness.md)
+                prov = getattr(e, "provenance", None)
+                extra = {"provenance": prov} if prov else {}
                 obs_events.emit(
                     "task_retry", label=label, attempt=attempt,
                     max_attempts=max_attempts,
-                    backoff_ns=int(backoff * 1e9),
-                    error=f"{type(e).__name__}: {e}"[:200])
+                    backoff_ns=int(backoff * 1e9), lane="whole_plan",
+                    error=f"{type(e).__name__}: {e}"[:200], **extra)
                 _settle_between_attempts()
-                time.sleep(backoff)
+                # deadline-aware backoff (review r4): a governed
+                # query's deadline can expire mid-sleep — a blind
+                # time.sleep(capped at 5s) would overshoot the
+                # documented wall-clock bound by the whole backoff
+                end = time.monotonic() + backoff
+                while True:
+                    lifecycle.check_current("task-retry")
+                    remaining = end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    time.sleep(min(0.05, remaining))
     finally:
         if prev is None:
             if hasattr(_tls, "attempt"):

@@ -73,6 +73,14 @@ class TpuTaskRetryError(RuntimeError):
     re-execution from the sources is expected to succeed (the engine
     analog of a Spark task-attempt failure)."""
 
+    #: recovery provenance (ISSUE 6): a dict naming what was lost —
+    #: {"kind": "shuffle_block", "shuffle_id", "partition", "map_path"}
+    #: or {"kind": "spill_file", "handle"} — or None when unknown. A
+    #: shuffle block with captured lineage recovers on the
+    #: partition-granular lane (shuffle/manager.py); everything else is
+    #: ambiguous and takes the whole-plan lane (exec/task_retry.py).
+    provenance = None
+
 
 class IntegrityError(TpuTaskRetryError):
     """Checksum mismatch on a spill file or shuffle block: the bytes are

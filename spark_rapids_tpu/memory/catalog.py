@@ -168,9 +168,16 @@ class BufferCatalog:
                     entry.in_use += 1
                     return entry.device_tree
             # bounded wait + watchdog: a writer that died with this
-            # hop still queued would otherwise park us here forever
+            # hop still queued would otherwise park us here forever.
+            # The lifecycle governor checks here too (ISSUE 6): a
+            # cancelled/expired query blocked on an in-flight writeback
+            # unwinds with spill-wait phase attribution instead of
+            # waiting the hop out
+            from ..exec import lifecycle
+            lifecycle.check_current("spill-wait")
             if not ev.wait(timeout=1.0):
                 self._writer_ok()
+            lifecycle.check_current("spill-wait")
 
     def release(self, handle: str):
         with self._lock:
@@ -349,6 +356,12 @@ class BufferCatalog:
                 obs_events.emit("integrity_fail", what="spill_file",
                                 path=entry.disk_path, bytes=entry.nbytes,
                                 error=str(e)[:200])
+                # provenance (ISSUE 6): a spill entry is intermediate
+                # state with no captured lineage — the task-retry layer
+                # sees this as AMBIGUOUS provenance and takes the
+                # whole-plan lane (docs/robustness.md)
+                e.provenance = {"kind": "spill_file",
+                                "handle": entry.handle_id}
                 raise
             except OSError as e:
                 from ..obs import events as obs_events

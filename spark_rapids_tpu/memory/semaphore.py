@@ -80,6 +80,11 @@ class TpuSemaphore:
             hold.ready.wait(_POLL_S)
             if cancel is not None and cancel():
                 return False
+            # lifecycle governor (ISSUE 6): a cancelled/expired query
+            # must not keep parking here — nothing is registered for
+            # this thread yet, so raising is clean
+            from ..exec import lifecycle
+            lifecycle.check_current("sem-wait")
             if hold.abandoned:
                 # release_if_necessary (task end) ran while the first
                 # acquire this thread was waiting on was still blocked:
@@ -108,6 +113,17 @@ class TpuSemaphore:
                         del self._holders[task_id]
                 hold.ready.set()  # waiters re-race a fresh first acquire
                 return False
+            from ..exec import lifecycle
+            if lifecycle.current_cancelled():
+                # governed-query cancellation while blocked for a
+                # permit: same cleanup as the cancel predicate (this
+                # thread owns the pending hold entry but no permit),
+                # then raise with sem-wait phase attribution
+                with self._lock:
+                    if self._holders.get(task_id) is hold:
+                        del self._holders[task_id]
+                hold.ready.set()
+                lifecycle.check_current("sem-wait")
         waited = time.monotonic_ns() - t0
         with self._lock:
             abandoned = hold.abandoned

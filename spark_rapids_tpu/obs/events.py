@@ -8,12 +8,14 @@ each line a self-describing record:
 
 Event kinds and their levels (spark.rapids.tpu.eventLog.level):
 
-  ESSENTIAL  query_start, query_end
+  ESSENTIAL  query_start, query_end, query_cancelled
   MODERATE   op_close, semaphore_acquire, spill, oom_retry,
              pallas_tier, plan_fallback, plan_not_on_tpu, exchange,
              pipeline_wait, pipeline_full, op_error, fault_inject,
              io_retry, task_retry, integrity_fail, pipeline_stuck,
-             spill_error, spill_writer_dead
+             spill_error, spill_writer_dead, task_retry_settle_error,
+             partition_recompute, breaker_open, breaker_half_open,
+             breaker_close, peer_dead
   DEBUG      op_open, op_batch, span
 
 Cost discipline: `active_bus()` returns None when logging is disabled —
@@ -64,6 +66,17 @@ EVENT_LEVELS: Dict[str, int] = {
     "pipeline_stuck": MODERATE,
     "spill_error": MODERATE,
     "spill_writer_dead": MODERATE,
+    # lifecycle-governor events (ISSUE 6): cancellations are headline
+    # (ESSENTIAL, like query begin/end); breaker transitions, the
+    # partition-granular recovery lane, settle failures between task
+    # attempts and heartbeat liveness transitions are MODERATE
+    "query_cancelled": ESSENTIAL,
+    "task_retry_settle_error": MODERATE,
+    "partition_recompute": MODERATE,
+    "breaker_open": MODERATE,
+    "breaker_half_open": MODERATE,
+    "breaker_close": MODERATE,
+    "peer_dead": MODERATE,
     "op_open": DEBUG,
     "op_batch": DEBUG,
     "span": DEBUG,

@@ -90,18 +90,38 @@ def bench_record(family: str, shape) -> Optional[Dict]:
                         shape_bucket(shape)))
 
 
+def _breaker_allows(family: str) -> bool:
+    """Degradation circuit breaker consult (ISSUE 6): an open breaker
+    on this family's fault domain demotes it to the XLA safe path.
+    With no breaker ever tripped this is one empty-dict check."""
+    from ..exec import lifecycle
+    return lifecycle.breaker_allows(
+        lifecycle.FAMILY_DOMAINS.get(family, family))
+
+
+def _note_engaged(family: str) -> None:
+    """Record the engagement on the current task attempt so a
+    classified-transient failure attributes to this family's fault
+    domain (and a half-open breaker's probe can close on success)."""
+    from ..exec import lifecycle
+    lifecycle.note_engagement(family)
+
+
 def family_may_engage(family: str) -> bool:
     """Could `family`'s fused kernel engage for ANY shape under the
     current config? Used to skip preparing kernel-only inputs (e.g. the
     BuildTable's permuted key lanes) on paths where the tier can never
     turn on: off -> never; on -> yes; auto -> only if some recorded
-    measurement for this family+platform shows a Pallas win."""
+    measurement for this family+platform shows a Pallas win. An open
+    circuit breaker on the family's domain answers no in every mode."""
     import jax
 
     from ..config import (PALLAS_FUSED_BENCH_FILE, PALLAS_FUSED_TIER,
                           active_conf)
     mode = normalize_mode(active_conf().get(PALLAS_FUSED_TIER))
     if mode == "off":
+        return False
+    if not _breaker_allows(family):
         return False
     if mode == "on":
         return True
@@ -144,7 +164,15 @@ def fused_tier_enabled(family: str, shape) -> bool:
     if mode == "off":
         _emit_decision(family, shape, mode, False, "forced off")
         return False
+    if not _breaker_allows(family):
+        # demotion (ISSUE 6): the domain's breaker is open — the XLA
+        # formulation is the safe path until the cooldown's half-open
+        # probe closes it again
+        _emit_decision(family, shape, mode, False,
+                       "circuit breaker open")
+        return False
     if mode == "on":
+        _note_engaged(family)
         _emit_decision(family, shape, mode, True, "forced on")
         return True
     rec = bench_record(family, shape)
@@ -154,6 +182,8 @@ def fused_tier_enabled(family: str, shape) -> bool:
         return False
     try:
         engaged = float(rec["pallas_ms"]) < float(rec["xla_ms"])
+        if engaged:
+            _note_engaged(family)
         _emit_decision(family, shape, mode, engaged,
                        f"measured pallas_ms={rec['pallas_ms']} vs "
                        f"xla_ms={rec['xla_ms']}")

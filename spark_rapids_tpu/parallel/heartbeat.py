@@ -40,21 +40,34 @@ class HeartbeatManager:
         self._lock = threading.Lock()
         self._peers: Dict[str, PeerInfo] = {}
         self._next_slot = 0
+        #: peers already reported dead (one peer_dead event per
+        #: live->dead transition; a returning beat re-arms it)
+        self._reported_dead: set = set()
+
+    def _register_locked(self, executor_id: str,
+                         host: str = "local") -> List[PeerInfo]:
+        """Registration body — caller holds self._lock. Extracted so
+        heartbeat() can register an unknown executor WITHOUT re-taking
+        the non-reentrant lock (ISSUE 6 satellite: heartbeat() used to
+        call register() while already holding it, so an unregistered
+        executor's first beat deadlocked forever)."""
+        now = time.monotonic()
+        if executor_id not in self._peers:
+            self._peers[executor_id] = PeerInfo(
+                executor_id, host, self._next_slot, now)
+            self._next_slot += 1
+        else:
+            self._peers[executor_id].last_beat = now
+        self._reported_dead.discard(executor_id)
+        return [p for p in self._peers.values()
+                if p.executor_id != executor_id]
 
     def register(self, executor_id: str, host: str = "local") -> List[PeerInfo]:
         """Executor start: returns all currently-known peers (the
         reference's RegisterExecutor reply carries peer identities so
         clients can connect eagerly)."""
-        now = time.monotonic()
         with self._lock:
-            if executor_id not in self._peers:
-                self._peers[executor_id] = PeerInfo(
-                    executor_id, host, self._next_slot, now)
-                self._next_slot += 1
-            else:
-                self._peers[executor_id].last_beat = now
-            return [p for p in self._peers.values()
-                    if p.executor_id != executor_id]
+            return self._register_locked(executor_id, host)
 
     def heartbeat(self, executor_id: str) -> List[PeerInfo]:
         """Periodic beat: refreshes liveness, returns peers registered
@@ -64,9 +77,10 @@ class HeartbeatManager:
         with self._lock:
             me = self._peers.get(executor_id)
             if me is None:
-                return self.register(executor_id)
+                return self._register_locked(executor_id)
             prev = me.last_beat
             me.last_beat = now
+            self._reported_dead.discard(executor_id)
             return [p for p in self._peers.values()
                     if p.executor_id != executor_id
                     and p.registered_at > prev]
@@ -80,8 +94,19 @@ class HeartbeatManager:
     def dead_peers(self) -> List[str]:
         now = time.monotonic()
         with self._lock:
-            return [p.executor_id for p in self._peers.values()
+            dead = [p.executor_id for p in self._peers.values()
                     if now - p.last_beat > self.timeout_s]
+            fresh = [(e, now - self._peers[e].last_beat) for e in dead
+                     if e not in self._reported_dead]
+            self._reported_dead.update(e for e, _ in fresh)
+        # liveness is observable (ISSUE 6 satellite): one peer_dead
+        # event per live->dead transition — emitted outside the lock
+        for executor_id, silent_s in fresh:
+            from ..obs import events as obs_events
+            obs_events.emit("peer_dead", executor_id=executor_id,
+                            silent_ms=int(silent_s * 1000),
+                            timeout_ms=int(self.timeout_s * 1000))
+        return dead
 
 
 class HeartbeatEndpoint:

@@ -39,6 +39,20 @@ class HostShuffleHandle:
         self.n_partitions = n_partitions
         self.schema = schema
         self.map_outputs: List[str] = []  # data file per completed map task
+        #: partition-granular recovery lineage (ISSUE 6): data path ->
+        #: zero-arg recompute that re-executes ONLY the producing
+        #: sub-plan (the exchange child) and atomically rewrites that
+        #: one map output. Captured by HostShuffleExchangeExec at write
+        #: time when spark.rapids.tpu.task.partitionRecovery.enabled.
+        self.lineage: Dict[str, object] = {}
+        #: map outputs already recomputed once — a SECOND corruption of
+        #: the same output means the lineage itself is producing bad
+        #: bytes (or the disk is gone); fall back to the whole-plan
+        #: lane. Guarded by recover_lock: partitions read concurrently
+        #: through the pipelined streams may hit the same damaged map
+        #: output at once (review r3).
+        self.recovered: set = set()
+        self.recover_lock = threading.Lock()
 
 
 class HostShuffleWriter:
@@ -55,7 +69,8 @@ class HostShuffleWriter:
         self._pool = manager.writer_pool(conf)
         self.bytes_written = 0
 
-    def write(self, partitioned: Sequence[List[ColumnarBatch]]) -> None:
+    def write(self, partitioned: Sequence[List[ColumnarBatch]],
+              register: bool = True) -> None:
         """partitioned[p] = list of batches for partition p. Serialization
         (the expensive part: host gather + LZ4) fans out on the writer
         pool; the file write is sequential in partition order so the index
@@ -104,7 +119,11 @@ class HostShuffleWriter:
                     pass
             raise
         self.bytes_written = offsets[n]
-        self.handle.map_outputs.append(data_path)
+        if register:
+            self.handle.map_outputs.append(data_path)
+        # register=False is the partition-recovery rewrite path: the map
+        # output is already registered — the atomic renames above simply
+        # replaced the damaged files in place
 
 
 class HostShuffleReader:
@@ -183,15 +202,97 @@ class HostShuffleReader:
                 f"{e}") from e
 
     def read_partition(self, partition: int) -> Iterator[ColumnarBatch]:
+        paths = list(self.handle.map_outputs)
         segs = list(self._pool.map(
-            lambda path: self._fetch_segment(path, partition),
-            self.handle.map_outputs))
-        frames = [fr for seg in segs for fr in seg]
-        # per-frame injection key (partition + frame ordinal): the chaos
+            lambda path: self._fetch_segment(path, partition), paths))
+        # per-frame injection key (partition + GLOBAL frame ordinal in
+        # map-output order — identical to the pre-ISSUE-6 flattened
+        # scheme, so seeded chaos draws replay unchanged): the chaos
         # verdict follows the frame, not decode-pool scheduling
-        yield from self._pool.map(
-            lambda args: self._decode(args[1], key=f"p{partition}:{args[0]}"),
-            enumerate(frames))
+        jobs = []
+        ordinal = 0
+        for path, frames in zip(paths, segs):
+            for i, fr in enumerate(frames):
+                jobs.append((path, i, self._pool.submit(
+                    self._decode, fr, f"p{partition}:{ordinal}")))
+                ordinal += 1
+        for path, frame_idx, fut in jobs:
+            try:
+                yield fut.result()
+            except faults.IntegrityError as e:
+                # partition-granular recovery (ISSUE 6): the lineage the
+                # exchange captured at write time can rewrite just this
+                # map output — consult it before surrendering the whole
+                # attempt to the task-retry lane
+                yield self._recover_block(path, partition, frame_idx, e)
+
+    def _recover_block(self, path: str, partition: int, frame_idx: int,
+                       err: "faults.IntegrityError") -> ColumnarBatch:
+        """Recover ONE quarantined shuffle block by re-executing only
+        its producing sub-plan (the handle's captured lineage), then
+        re-fetching + re-decoding the rewritten map output. Falls back
+        to the whole-plan lane (re-raising with provenance attached)
+        when lineage is missing, the conf gates it off, this map output
+        already recovered once, or the recomputed block is corrupt
+        again."""
+        import time as _time
+
+        from ..config import PARTITION_RECOVERY_ENABLED
+        recompute = self.handle.lineage.get(path)
+        if recompute is None \
+                or not self._conf.get(PARTITION_RECOVERY_ENABLED):
+            raise self._with_provenance(err, path, partition)
+        # check-then-recompute under the handle lock (review r3):
+        # concurrent partition streams hitting one damaged map output
+        # must produce exactly ONE recompute — the loser waits the
+        # rewrite out here and then simply re-fetches below (its frame
+        # came from a stale pre-rewrite read). Recovery stays bounded:
+        # the post-recovery re-decode raises straight out of
+        # read_partition with provenance (it is not wrapped by the
+        # recovery handler), so a map output whose REWRITE is bad
+        # escalates to the whole-plan lane instead of recomputing
+        # forever.
+        with self.handle.recover_lock:
+            if path not in self.handle.recovered:
+                self.handle.recovered.add(path)
+                t0 = _time.perf_counter_ns()
+                try:
+                    recompute()
+                except Exception:  # noqa: BLE001 — the recompute
+                    # itself died (its sub-plan re-raises real
+                    # failures): the original integrity error is what
+                    # the task-retry lane should see
+                    raise self._with_provenance(err, path, partition)
+                # the file changed under us: drop the cached index table
+                self._index_cache.pop(path, None)
+                from ..exec import lifecycle
+                from ..obs import events as obs_events
+                lifecycle.note_partition_recompute()
+                obs_events.emit(
+                    "partition_recompute",
+                    shuffle_id=self.handle.shuffle_id,
+                    partition=partition,
+                    map_path=os.path.basename(path),
+                    wall_ns=_time.perf_counter_ns() - t0)
+        try:
+            frames = self._fetch_segment(path, partition)
+            if frame_idx >= len(frames):
+                raise self._with_provenance(err, path, partition)
+            # fresh injection key: the recovered decode draws its own
+            # deterministic verdicts instead of replaying the one that
+            # just quarantined this block
+            return self._decode(frames[frame_idx],
+                                key=f"recover:p{partition}:{frame_idx}")
+        except faults.IntegrityError as e2:
+            raise self._with_provenance(e2, path, partition)
+
+    def _with_provenance(self, err: "faults.IntegrityError", path: str,
+                         partition: int) -> "faults.IntegrityError":
+        err.provenance = {"kind": "shuffle_block",
+                          "shuffle_id": self.handle.shuffle_id,
+                          "partition": partition,
+                          "map_path": os.path.basename(path)}
+        return err
 
 
 class HostShuffleManager:

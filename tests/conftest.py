@@ -41,3 +41,22 @@ def _no_leaked_fault_plan():
     faults.install(None)
     assert leaked is None, (
         f"module leaked an armed fault plan: {leaked.spec_string!r}")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_leaked_lifecycle_state():
+    """Lifecycle-governor hygiene (ISSUE 6, same pattern as the leaked
+    fault plan): a breaker left open would silently demote a kernel
+    tier for every later suite, and a QueryContext left registered
+    means some query never unwound its governed scope — reset at module
+    boundaries and fail the offender loudly."""
+    from spark_rapids_tpu.exec import lifecycle
+    lifecycle.reset_lifecycle()
+    yield
+    leaked_queries = lifecycle.active_query_ids()
+    leaked_breakers = lifecycle.open_breakers()
+    lifecycle.reset_lifecycle()
+    assert not leaked_queries, (
+        f"module leaked registered query contexts: {leaked_queries}")
+    assert not leaked_breakers, (
+        f"module left circuit breakers open: {leaked_breakers}")

@@ -1046,14 +1046,13 @@ def test_e2e_spill_corruption_recovered_by_recompute(spill_q_files,
 @pytest.mark.slow
 def test_e2e_shuffle_corruption_recovered_by_recompute(q_files, spy):
     """Same criterion for a shuffle block: host-shuffled join/agg, one
-    corrupted frame at decode, correct results via recompute (checked
-    against the out-of-engine oracle — an engine baseline under these
-    settings would double this test's runtime for no extra teeth).
-    `slow`: the host-shuffled plan costs ~26s on the 1-core box and the
-    870s tier-1 gate is the binding constraint — the quarantine +
-    task-retry recovery lane stays tier-1 via
-    test_point_shuffle_decode_corrupt_quarantined and the spill-file
-    e2e drive, and this query-level drive runs nightly."""
+    corrupted frame at decode, correct results — since ISSUE 6 via the
+    PARTITION-GRANULAR lane: the exchange's captured lineage recomputes
+    the one damaged map output in place, and no task attempt is spent
+    (tests/test_lifecycle.py covers the same contract at tier-1 on a
+    smaller plan; the conf-off fallback to the whole-plan lane is
+    tier-1 there too). `slow`: the host-shuffled plan costs ~26s on the
+    1-core box and the 870s tier-1 gate is the binding constraint."""
     lp, op, oracle = q_files
     settings = dict(CHAOS, **{
         "spark.rapids.sql.shuffle.partitions": "3",
@@ -1065,7 +1064,10 @@ def test_e2e_shuffle_corruption_recovered_by_recompute(q_files, spy):
     assert _matches_oracle(got, oracle)
     evs = _kinds(spy, "integrity_fail")
     assert evs and evs[0]["what"] == "shuffle_block"
-    assert _kinds(spy, "task_retry")
+    assert _kinds(spy, "partition_recompute"), \
+        "the partition-granular lane did not engage"
+    assert not _kinds(spy, "task_retry"), \
+        "recovery escalated to the whole-plan lane"
 
 
 @pytest.mark.slow

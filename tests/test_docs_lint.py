@@ -98,6 +98,28 @@ def test_fault_point_registry_matches_docs():
     assert not unwired, f"registered fault points with no call site: {unwired}"
 
 
+def test_breaker_tables_match_registry():
+    """docs/robustness.md's circuit-breaker domain and state tables
+    list exactly lifecycle.BREAKER_DOMAINS / BREAKER_STATES (ISSUE 6:
+    the same drift lint the fault-point table gets). The check is
+    scoped to the breaker section so taxonomy/fault tables elsewhere in
+    the doc can't collide."""
+    from spark_rapids_tpu.exec import lifecycle
+    docs = (ROOT / "docs" / "robustness.md").read_text()
+    m = re.search(r"## Degradation circuit breakers\n(.*?)(?:\n## |\Z)",
+                  docs, re.DOTALL)
+    assert m, "docs/robustness.md lost its circuit-breaker section"
+    section = m.group(1)
+    rows = set(re.findall(r"^\|\s*`([a-z_]+)`\s*\|", section,
+                          re.MULTILINE))
+    expected = set(lifecycle.BREAKER_DOMAINS) | set(
+        lifecycle.BREAKER_STATES)
+    assert rows == expected, (
+        f"docs/robustness.md breaker tables drifted: "
+        f"missing={sorted(expected - rows)} "
+        f"stale={sorted(rows - expected)}")
+
+
 def test_robustness_event_kinds_are_registered():
     """Every event kind the robustness layer emits is in
     obs.events.EVENT_LEVELS (an unregistered kind silently defaults to
@@ -105,7 +127,10 @@ def test_robustness_event_kinds_are_registered():
     from spark_rapids_tpu.obs import events
     for kind in ("fault_inject", "io_retry", "task_retry",
                  "integrity_fail", "pipeline_stuck", "spill_error",
-                 "spill_writer_dead"):
+                 "spill_writer_dead", "query_cancelled",
+                 "task_retry_settle_error", "partition_recompute",
+                 "breaker_open", "breaker_half_open", "breaker_close",
+                 "peer_dead"):
         assert kind in events.EVENT_LEVELS, kind
     docs = (ROOT / "docs" / "observability.md").read_text()
     for kind in events.EVENT_LEVELS:

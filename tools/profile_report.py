@@ -158,6 +158,32 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
     n_task = sum(1 for e in events if e.get("kind") == "task_retry")
     if n_task:
         extras.append(f"task re-executions: {n_task}")
+    # lifecycle-governor roll-up (ISSUE 6): cancellations by phase,
+    # breaker transitions, and which recovery lane paid for failures
+    cancels = [e for e in events if e.get("kind") == "query_cancelled"]
+    if cancels:
+        by_phase: Dict[str, int] = {}
+        for e in cancels:
+            by_phase[e.get("phase", "?")] = \
+                by_phase.get(e.get("phase", "?"), 0) + 1
+        detail = ", ".join(f"{p}:{n}" for p, n in sorted(by_phase.items()))
+        extras.append(f"query cancellations: {len(cancels)} ({detail})")
+    n_bopen = sum(1 for e in events if e.get("kind") == "breaker_open")
+    n_bhalf = sum(1 for e in events
+                  if e.get("kind") == "breaker_half_open")
+    n_bclose = sum(1 for e in events if e.get("kind") == "breaker_close")
+    if n_bopen or n_bhalf or n_bclose:
+        extras.append(f"breaker trips: {n_bopen} open, {n_bhalf} "
+                      f"half-open, {n_bclose} close")
+    # only when the partition lane actually engaged — the whole-plan
+    # count already prints as "task re-executions" above, and repeating
+    # it alone would state the same figure twice
+    n_part = sum(1 for e in events
+                 if e.get("kind") == "partition_recompute")
+    if n_part:
+        extras.append(f"recovery lanes: {n_part} partition-granular "
+                      f"recompute(s), {n_task} whole-plan "
+                      "re-execution(s)")
     n_integ = sum(1 for e in events if e.get("kind") == "integrity_fail")
     if n_integ:
         extras.append(f"integrity quarantines: {n_integ}")
