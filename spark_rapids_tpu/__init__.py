@@ -33,4 +33,8 @@ from .faults import IntegrityError, TpuTaskRetryError  # noqa: E402
 # a deadline-expired or user-cancelled governed query unwinds with this
 # (exec/lifecycle.py; TpuSession.cancel_query / query.timeoutMs)
 from .exec.lifecycle import QueryCancelledError  # noqa: E402
+# the workload governor refused to start the query (queue full /
+# admission timeout / known-degraded device) — carries reason and a
+# retry_after_ms hint (exec/workload.py; spark.rapids.tpu.workload.*)
+from .exec.workload import QueryAdmissionError  # noqa: E402
 from .version import __version__  # noqa: E402

@@ -74,8 +74,11 @@ class TpuSession:
     def health(self) -> Dict:
         """Engine health surface (exec/lifecycle.py): degradation
         circuit-breaker states per fault domain, governed-query count,
-        and the cumulative lifecycle counters (cancellations, breaker
-        trips, partition-granular vs whole-plan recoveries)."""
+        the cumulative lifecycle counters (cancellations, breaker
+        trips, partition-granular vs whole-plan recoveries), and the
+        workload governor's admission surface — queue depth, admitted
+        count, queued/admitted/shed/quota-spill counters
+        (exec/workload.py)."""
         from ..exec import lifecycle
         return lifecycle.health()
 
@@ -385,13 +388,23 @@ class DataFrame:
         retry attempt and its backoff — runs under one QueryContext, so
         spark.rapids.tpu.query.timeoutMs bounds the query's total
         wall-clock and TpuSession.cancel_query() can unwind it
-        cooperatively from another thread."""
-        from ..exec import lifecycle
+        cooperatively from another thread.
+
+        Workload governor (ISSUE 7): with
+        spark.rapids.tpu.workload.enabled the query is admitted through
+        the process-wide fair admission queue first — inside the
+        governed scope, so the deadline spans queue wait and
+        cancel_query() dequeues a queued query (phase admission-wait).
+        A shed arrival (queue full / admission timeout / known-degraded
+        device) raises QueryAdmissionError fast."""
+        from ..exec import lifecycle, workload
         from ..exec.task_retry import with_task_retry
         with lifecycle.governed(self.session.conf,
-                                owner=self.session._lifecycle_owner):
-            return with_task_retry(lambda attempt: self._collect_once(),
-                                   conf=self.session.conf)
+                                owner=self.session._lifecycle_owner) as ctx:
+            with workload.admitted(self.session.conf, ctx):
+                return with_task_retry(
+                    lambda attempt: self._collect_once(),
+                    conf=self.session.conf)
 
     def _collect_once(self) -> List[tuple]:
         import time as _time

@@ -487,6 +487,57 @@ BREAKER_COOLDOWN_MS = conf_int(
     "half-opening for one probe (probe success closes the breaker, "
     "probe failure re-opens it for another cooldown).")
 
+WORKLOAD_ENABLED = conf_bool(
+    "spark.rapids.tpu.workload.enabled", False,
+    "Concurrent workload governor (exec/workload.py): gate query start "
+    "through a bounded admission queue (at most "
+    "workload.maxConcurrentQueries admitted, workload.queueDepth "
+    "queued), carve the device budget into soft per-admitted-query "
+    "shares (workload.memoryQuotaFraction), and shed work fast — "
+    "QueryAdmissionError with a retry-after hint — when the queue is "
+    "full or the device is known-degraded (an open device_dispatch "
+    "circuit breaker). Off (default): collect() pays one conf read and "
+    "admission is a no-op, exactly the single-tenant behavior.",
+    commonly_used=True)
+
+WORKLOAD_MAX_CONCURRENT = conf_int(
+    "spark.rapids.tpu.workload.maxConcurrentQueries", 4,
+    "Queries allowed to run concurrently under the workload governor; "
+    "further arrivals queue (up to workload.queueDepth) in weighted-"
+    "fair priority order (exec/workload.py).")
+
+WORKLOAD_QUEUE_DEPTH = conf_int(
+    "spark.rapids.tpu.workload.queueDepth", 16,
+    "Queries that may wait in the admission queue; an arrival past this "
+    "bound is shed immediately with QueryAdmissionError (reason "
+    "queue_full) instead of piling onto an already-saturated engine.")
+
+WORKLOAD_ADMISSION_TIMEOUT_MS = conf_int(
+    "spark.rapids.tpu.workload.admissionTimeoutMs", 0,
+    "Longest a query may wait in the admission queue before it is shed "
+    "with QueryAdmissionError (reason timeout). 0 (default) waits "
+    "indefinitely — still bounded by the query's own "
+    "spark.rapids.tpu.query.timeoutMs deadline, which spans queue wait "
+    "(phase admission-wait).")
+
+WORKLOAD_MEMORY_QUOTA_FRACTION = conf_float(
+    "spark.rapids.tpu.workload.memoryQuotaFraction", 0.5,
+    "Soft per-admitted-query share of the device budget under the "
+    "workload governor: a query over max(fraction * budget, budget / "
+    "admitted_count) that hits budget pressure spills ITS OWN buffers "
+    "first (a quota_spill event) and surfaces pressure on its own "
+    "OOM-retry lane, instead of pushing a neighbor's buffers down a "
+    "tier. Shares rebalance as queries finish; a lone admitted query "
+    "always gets the whole budget.")
+
+WORKLOAD_PRIORITY = conf_str(
+    "spark.rapids.tpu.workload.priority", "interactive",
+    "Priority class of this session's queries under the workload "
+    "governor: 'interactive' is preferred by admission and semaphore "
+    "ordering, 'batch' yields to it — but ages: every few grants the "
+    "oldest waiter wins regardless of class, so batch never starves "
+    "(exec/workload.py PRIORITIES).")
+
 DECIMAL_ENABLED = conf_bool(
     "spark.rapids.sql.decimalType.enabled", True,
     "Enable decimal offload (decimal128 columns stay on CPU until the "

@@ -66,9 +66,10 @@ class QueryCancelledError(RuntimeError):
         self.reason = reason
 
 
-#: phases a cancellation can be noticed in (docs/robustness.md)
+#: phases a cancellation can be noticed in (docs/robustness.md);
+#: admission-wait is the workload governor's queue (exec/workload.py)
 CANCEL_PHASES = ("compute", "sem-wait", "pipeline-wait", "spill-wait",
-                 "task-retry")
+                 "task-retry", "admission-wait")
 
 
 # ---------------------------------------------------------------------------
@@ -126,7 +127,7 @@ class QueryContext:
 
     __slots__ = ("ctx_id", "owner", "t0", "deadline", "check_every",
                  "_cancel", "reason", "_ticks", "_emit_lock", "_emitted",
-                 "engaged_domains")
+                 "engaged_domains", "workload_ticket")
 
     def __init__(self, timeout_ms: int = 0, check_every: int = 8,
                  owner: Any = None):
@@ -144,6 +145,10 @@ class QueryContext:
         #: fault domains this attempt engaged (pallas tiers note at
         #: trace time); cleared per task attempt by begin_attempt()
         self.engaged_domains: set = set()
+        #: workload-governor admission ticket (exec/workload.py) —
+        #: rides the context so producer threads that adopt_context
+        #: resolve the same per-query memory quota
+        self.workload_ticket = None
 
     def cancel(self, reason: str = "user") -> None:
         if not self._cancel.is_set():
@@ -311,10 +316,10 @@ _breaker_lock = threading.Lock()
 _breakers: Dict[str, _Breaker] = {}
 
 
-def _breaker_conf():
+def _breaker_conf(conf=None):
     from ..config import (BREAKER_COOLDOWN_MS, BREAKER_ENABLED,
                           BREAKER_THRESHOLD, BREAKER_WINDOW_MS, active_conf)
-    conf = active_conf()
+    conf = conf if conf is not None else active_conf()
     return (bool(conf.get(BREAKER_ENABLED)),
             max(1, conf.get(BREAKER_THRESHOLD)),
             max(1, conf.get(BREAKER_WINDOW_MS)) / 1000.0,
@@ -407,6 +412,31 @@ def record_domain_success(domain: str) -> None:
             closed = br
     if closed is not None:
         _emit_breaker("breaker_close", closed)
+
+
+def breaker_shed_hint_ms(domain: str, conf=None) -> Optional[int]:
+    """Read-only admission consult (exec/workload.py, ISSUE 7): while
+    `domain`'s breaker is OPEN and still inside its cooldown, return the
+    remaining cooldown in ms (the shed retry-after hint); None
+    otherwise. Unlike breaker_allows this never transitions state —
+    half-open probes belong to already-running attempts; admission must
+    not consume (or block behind) the single probe slot. `conf` is the
+    ADMITTING conf: admission runs before collect installs the session
+    conf thread-locally, so active_conf() could answer for the wrong
+    session."""
+    if not _breakers:
+        return None
+    enabled, _thr, _window, cooldown = _breaker_conf(conf)
+    if not enabled:
+        return None
+    with _breaker_lock:
+        br = _breakers.get(domain)
+        if br is None or br.state != "open":
+            return None
+        remaining = cooldown - (time.monotonic() - br.opened_at)
+        if remaining <= 0:
+            return None
+        return max(1, int(remaining * 1000))
 
 
 def open_breakers() -> List[str]:
@@ -528,7 +558,8 @@ def attempt_succeeded() -> None:
 
 def health() -> Dict[str, Any]:
     """The TpuSession.health() payload: breaker states, governed-query
-    count, and the cumulative lifecycle counters."""
+    count, the cumulative lifecycle counters, and the workload
+    governor's admission surface (queue depth / admitted / shed)."""
     now = time.monotonic()
     with _breaker_lock:
         breakers = {
@@ -537,9 +568,11 @@ def health() -> Dict[str, Any]:
                 "open_for_ms": int((now - b.opened_at) * 1000)
                 if b.state != "closed" else 0}
             for d, b in _breakers.items()}
+    from . import workload
     return {"breakers": breakers,
             "active_queries": len(active_query_ids()),
-            "counters": counters()}
+            "counters": counters(),
+            "workload": workload.snapshot()}
 
 
 def reset_lifecycle() -> None:

@@ -19,10 +19,15 @@ commit protocol. Rebuilt here for a single-process multi-thread engine:
   per-sequence call index): the decision is a pure hash of
   (seed, point, task, key, index) — no wall clock, no RNG state. Sites
   evaluated on pool/producer threads pass their work-item identity as
-  the key (chunk index, map-file:partition, stage label), so replay is
-  per-item exact there too; the few keyless multi-threaded sites (the
-  spill writer) replay the injection count deterministically but thread
-  scheduling may move WHICH call fires.
+  the key (chunk index, map-file:partition, stage label, and — ISSUE 7
+  — the spill catalog entry's registration ordinal for every
+  spill.{d2h_copy,disk_write,disk_read} site): injection PLACEMENT,
+  not just count, no longer moves with which THREAD runs a spill
+  (writer vs sync, any processing order). The ordinal itself is
+  assigned in catalog.add order, so placement is fully run-to-run
+  exact when entry registration is deterministic (a single driven
+  query); concurrent lanes racing catalog.add still replay counts
+  exactly but may map ordinals onto different lanes' entries.
 
 * **Taxonomy**: `TpuRetryOOM`/`TpuSplitAndRetryOOM` (memory/retry.py)
   stay the OOM lane. Everything else transient becomes
@@ -218,7 +223,8 @@ class FaultPlan:
             self.injected[point] = fired + 1
         from .obs import events as obs_events
         obs_events.emit("fault_inject", point=point, fault_kind=spec.kind,
-                        task_id=task, call_index=idx, seed=spec.seed)
+                        task_id=task, call_index=idx, seed=spec.seed,
+                        key=key)
         return spec.kind
 
     def apply(self, point: str, data: Optional[bytes] = None,

@@ -43,6 +43,14 @@ class MemoryBudget:
         the spill-writer thread, which needs that lock to finalize — a
         guaranteed deadlock. Without the drain, pressure surfaces as
         TpuRetryOOM and the retry loop waits the writebacks out instead.
+
+        Per-query quota (ISSUE 7): under the workload governor, a query
+        past its soft share of the budget that hits THIS pressure path
+        spills its OWN catalog entries (quota_spill event) and raises
+        its own TpuRetryOOM when that is not enough — it must not push a
+        neighbor's working set down a tier. The quota is consulted only
+        here (pressure), never on the in-budget fast path, so a lone or
+        ungoverned query pays nothing.
         """
         with self._lock:
             if self.used + nbytes <= self.limit:
@@ -51,9 +59,23 @@ class MemoryBudget:
                 return
         # out of budget: try to make room by spilling catalog buffers
         from .catalog import buffer_catalog
+        from ..exec import workload
         needed = nbytes - (self.limit - self.used)
         hops: list = []
-        freed = buffer_catalog().synchronous_spill(needed, events_out=hops)
+        ticket = workload.current_ticket()
+        quota = workload.quota_bytes(self.limit) \
+            if ticket is not None else None
+        over_quota = quota is not None \
+            and ticket.device_bytes + nbytes > quota
+        if over_quota:
+            # the offender spills the offender: only entries owned by
+            # THIS query's ticket are candidates
+            freed = buffer_catalog().synchronous_spill(
+                needed, events_out=hops, owner=ticket)
+            workload.note_quota_spill(ticket, nbytes, quota, freed)
+        else:
+            freed = buffer_catalog().synchronous_spill(needed,
+                                                       events_out=hops)
         with self._lock:
             self.spill_requests += 1
             if self.used + nbytes <= self.limit:
@@ -74,14 +96,25 @@ class MemoryBudget:
                     self.used += nbytes
                     self.peak = max(self.peak, self.used)
                     return
-            # last resort: hops queued by OTHER threads' spills may
-            # still hold the bytes this reservation needs
-            buffer_catalog().drain_writeback()
-            with self._lock:
-                if self.used + nbytes <= self.limit:
-                    self.used += nbytes
-                    self.peak = max(self.peak, self.used)
-                    return
+            if not over_quota:
+                # last resort: hops queued by OTHER threads' spills may
+                # still hold the bytes this reservation needs. An
+                # over-quota query skips it — waiting out NEIGHBORS'
+                # writebacks to grab the bytes they freed is exactly the
+                # stealing the quota exists to stop; its own retry lane
+                # (spill_for_retry between attempts) settles instead.
+                buffer_catalog().drain_writeback()
+                with self._lock:
+                    if self.used + nbytes <= self.limit:
+                        self.used += nbytes
+                        self.peak = max(self.peak, self.used)
+                        return
+        if over_quota:
+            raise TpuRetryOOM(
+                f"per-query memory quota exceeded under pressure: need "
+                f"{nbytes}, query holds {ticket.device_bytes} of a "
+                f"{quota}-byte share ({self.used} of {self.limit} total; "
+                f"freed {freed} from own entries)")
         raise TpuRetryOOM(
             f"HBM budget exhausted: need {nbytes}, used {self.used} of "
             f"{self.limit} (freed {freed} by spill)")
@@ -138,10 +171,28 @@ def spill_for_retry():
     safe place to wait the writer out before the next attempt —
     otherwise the retry loop spins through its attempts in microseconds
     while the bytes it needs are still queued behind the writer thread.
+
+    Per-query quota (ISSUE 7): the isolation reserve() enforces must
+    hold on THIS lane too — a quota TpuRetryOOM lands exactly here one
+    frame up, and an unfiltered pass would push every neighbor's
+    working set down a tier and wait their writebacks out so the
+    offender can take the bytes they freed. While the current query is
+    still over its share, only its own entries spill and only its own
+    hops are waited; once it drops back under, it is no longer the
+    offender and the global pass applies.
     """
     from .catalog import buffer_catalog
+    from ..exec import workload
     cat = buffer_catalog()
     hops: list = []
+    ticket = workload.current_ticket()
+    if ticket is not None:
+        quota = workload.quota_bytes(memory_budget().limit)
+        if quota is not None and ticket.device_bytes > quota:
+            cat.synchronous_spill(None, events_out=hops, owner=ticket)
+            for ev in hops:
+                ev.wait()
+            return
     cat.synchronous_spill(None, events_out=hops)
     for ev in hops:
         ev.wait()

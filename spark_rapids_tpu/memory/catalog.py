@@ -23,6 +23,7 @@ disk files are fsync'd before the hop counts as complete.
 from __future__ import annotations
 
 import io
+import itertools
 import os
 import queue
 import struct
@@ -62,9 +63,9 @@ def _leaf_nbytes(tree) -> int:
 class _Entry:
     __slots__ = ("handle_id", "tier", "device_tree", "host_leaves", "treedef",
                  "disk_path", "nbytes", "priority", "in_use", "closed",
-                 "writeback", "pending_device")
+                 "writeback", "pending_device", "owner", "seq")
 
-    def __init__(self, handle_id, tree, priority):
+    def __init__(self, handle_id, tree, priority, owner=None, seq=0):
         self.handle_id = handle_id
         self.tier = StorageTier.DEVICE
         self.device_tree = tree
@@ -79,6 +80,19 @@ class _Entry:
         self.writeback: Optional[threading.Event] = None
         #: device leaves handed to the writer (to_host hop in flight)
         self.pending_device = None
+        #: workload-governor ticket of the admitting query (ISSUE 7):
+        #: quota accounting mirrors this entry's budget reserve/release
+        #: calls against it, and an over-quota reserve spills only its
+        #: owner's entries
+        self.owner = owner
+        #: deterministic per-catalog registration ordinal — the
+        #: fault-injection work-item key (ISSUE 7 satellite): handle_id
+        #: is a uuid that differs across runs, this does not
+        self.seq = seq
+
+    @property
+    def fault_key(self) -> str:
+        return f"spill:{self.seq}"
 
 
 #: spill file container (ISSUE 4 integrity): magic | u32 crc32 |
@@ -93,9 +107,13 @@ class SpillFileCorruption(faults.IntegrityError):
     """Spill file failed its CRC32 / structure check at read."""
 
 
-def _write_npz(path: str, host_leaves) -> None:
+def _write_npz(path: str, host_leaves, key: Optional[str] = None) -> None:
     """Spill file write: CRC32-stamped container, durable (fsync'd)
-    before the hop counts as complete."""
+    before the hop counts as complete. `key` is the owning entry's
+    deterministic fault key (ISSUE 7 satellite: the spill writer runs
+    on its own thread, so without a work-item key the injection
+    PLACEMENT — which entry's write draws the fault — depended on
+    thread scheduling; keyed, placement replays exactly)."""
     buf = io.BytesIO()
     np.savez(buf, **{str(i): a for i, a in enumerate(host_leaves)})
     payload = buf.getvalue()
@@ -103,7 +121,7 @@ def _write_npz(path: str, host_leaves) -> None:
     # kind=corrupt flips a byte of the STORED payload after the true CRC
     # is taken, so the damage is exactly what the read-side check catches
     crc = zlib.crc32(payload)
-    payload = faults.apply("spill.disk_write", payload)
+    payload = faults.apply("spill.disk_write", payload, key=key)
     with open(path, "wb") as f:
         f.write(_SPILL_HEADER.pack(_SPILL_MAGIC, crc, len(payload)))
         f.write(payload)
@@ -111,10 +129,10 @@ def _write_npz(path: str, host_leaves) -> None:
         os.fsync(f.fileno())
 
 
-def _read_npz(path: str) -> List[np.ndarray]:
+def _read_npz(path: str, key: Optional[str] = None) -> List[np.ndarray]:
     """Verified spill file read: any structural or checksum failure
     raises SpillFileCorruption (the caller quarantines + recomputes)."""
-    faults.check("spill.disk_read")
+    faults.check("spill.disk_read", key=key)
     with open(path, "rb") as f:
         header = f.read(_SPILL_HEADER.size)
         if len(header) < _SPILL_HEADER.size:
@@ -138,15 +156,23 @@ class BufferCatalog:
         self._spill_dir: Optional[str] = None
         self._write_q: Optional["queue.Queue"] = None
         self._writer: Optional[threading.Thread] = None
+        #: deterministic registration ordinal (fault-injection keys)
+        self._add_seq = itertools.count(1)
 
     # -- registration ------------------------------------------------------
     def add(self, tree, priority: int = ACTIVE_BATCHING_PRIORITY) -> str:
         """Register a device pytree; returns a handle id. Accounts its
-        footprint against the HBM budget."""
+        footprint against the HBM budget, attributed to the admitting
+        query's workload ticket (ISSUE 7 quota accounting)."""
         from .budget import memory_budget
+        from ..exec import workload
         handle = uuid.uuid4().hex
-        entry = _Entry(handle, tree, priority)
+        owner = workload.current_ticket()
+        with self._lock:
+            seq = next(self._add_seq)
+        entry = _Entry(handle, tree, priority, owner=owner, seq=seq)
         memory_budget().reserve(entry.nbytes)
+        workload.charge(owner, entry.nbytes)
         with self._lock:
             self._entries[handle] = entry
         return handle
@@ -195,6 +221,8 @@ class BufferCatalog:
             # discards its result (incl. unlinking a just-written file)
         if entry.tier == StorageTier.DEVICE:
             memory_budget().release(entry.nbytes)
+            from ..exec import workload
+            workload.discharge(entry.owner, entry.nbytes)
         if entry.disk_path and os.path.exists(entry.disk_path):
             os.unlink(entry.disk_path)
 
@@ -208,8 +236,8 @@ class BufferCatalog:
 
     # -- spilling ----------------------------------------------------------
     def synchronous_spill(self, target_bytes: Optional[int],
-                          events_out: Optional[List[threading.Event]] = None
-                          ) -> int:
+                          events_out: Optional[List[threading.Event]] = None,
+                          owner=None) -> int:
         """Move spillable DEVICE entries to HOST (lowest priority first)
         until target_bytes are freed (None = spill everything spillable).
         Overflows HOST to DISK past the host limit. Returns bytes freed from
@@ -218,15 +246,21 @@ class BufferCatalog:
         returns as soon as the hand-offs are queued; `events_out` then
         collects each queued device->host hop's completion event, so a
         caller under budget pressure can wait for exactly the copies ITS
-        spill started instead of draining the whole writer queue."""
+        spill started instead of draining the whole writer queue.
+
+        `owner` (ISSUE 7): restrict victims to entries owned by that
+        workload ticket — the over-quota reserve path spills the
+        offending query's own working set, never a neighbor's."""
         from .budget import memory_budget
+        from ..exec import workload
         async_write = bool(active_conf().get(SPILL_ASYNC_WRITE))
         freed = 0
         while target_bytes is None or freed < target_bytes:
             with self._lock:
                 candidates = [e for e in self._entries.values()
                               if e.tier == StorageTier.DEVICE and
-                              e.in_use == 0 and not e.closed]
+                              e.in_use == 0 and not e.closed and
+                              (owner is None or e.owner is owner)]
                 if not candidates:
                     break
                 victim = min(candidates, key=lambda e: e.priority)
@@ -240,7 +274,8 @@ class BufferCatalog:
                 # lands — the writer releases the budget then, so the
                 # accounting never under-reports live HBM
                 memory_budget().release(victim.nbytes)
-        self._enforce_host_limit(async_write)
+                workload.discharge(victim.owner, victim.nbytes)
+        self._enforce_host_limit(async_write, owner=owner)
         return freed
 
     def _spill_to_host_locked(self, entry: _Entry, async_write: bool = False):
@@ -256,7 +291,7 @@ class BufferCatalog:
                                     entry.writeback)
         else:
             try:
-                faults.check("spill.d2h_copy")
+                faults.check("spill.d2h_copy", key=entry.fault_key)
                 entry.host_leaves = [np.asarray(jax.device_get(x))
                                      for x in leaves]
             except Exception as e:  # noqa: BLE001 — transient device
@@ -277,11 +312,15 @@ class BufferCatalog:
         obs_events.emit("spill", tier="device->host", bytes=entry.nbytes,
                         priority=entry.priority, background=async_write)
 
-    def _enforce_host_limit(self, async_write: bool = False):
+    def _enforce_host_limit(self, async_write: bool = False, owner=None):
+        """`owner` (ISSUE 7): an owner-scoped quota spill must not
+        demote NEIGHBORS' host entries to disk either — the host limit
+        is soft, and the next unscoped pass re-enforces it globally."""
         limit = active_conf().get(HOST_SPILL_LIMIT)
         with self._lock:
             host_entries = [e for e in self._entries.values()
-                            if e.tier == StorageTier.HOST and not e.closed]
+                            if e.tier == StorageTier.HOST and not e.closed
+                            and (owner is None or e.owner is owner)]
             host_total = sum(e.nbytes for e in host_entries)
             for e in sorted(host_entries, key=lambda x: x.priority):
                 if host_total <= limit:
@@ -314,7 +353,7 @@ class BufferCatalog:
                                     entry.writeback)
         else:
             try:
-                _write_npz(path, entry.host_leaves)
+                _write_npz(path, entry.host_leaves, key=entry.fault_key)
             except Exception as e:  # noqa: BLE001 — disk full/
                 # unwritable: the host copy is intact, so staying on the
                 # HOST tier (over its soft limit) beats failing the
@@ -341,7 +380,8 @@ class BufferCatalog:
         import jax.numpy as jnp
         if entry.tier == StorageTier.DISK:
             try:
-                entry.host_leaves = _read_npz(entry.disk_path)
+                entry.host_leaves = _read_npz(entry.disk_path,
+                                              key=entry.fault_key)
             except SpillFileCorruption as e:
                 # integrity failure: quarantine the evidence (never feed
                 # corrupt bytes downstream) and recover by recompute —
@@ -378,6 +418,8 @@ class BufferCatalog:
             # needs this lock to finalize) — see MemoryBudget.reserve
             memory_budget().reserve(entry.nbytes,
                                     wait_for_writeback=False)
+            from ..exec import workload
+            workload.charge(entry.owner, entry.nbytes)
             leaves = [jnp.asarray(a) for a in entry.host_leaves]
             entry.device_tree = jax.tree_util.tree_unflatten(
                 entry.treedef, leaves)
@@ -478,6 +520,7 @@ class BufferCatalog:
         finalize takes it."""
         if kind == "to_host":
             from .budget import memory_budget
+            from ..exec import workload
             with self._lock:
                 pending = entry.pending_device
                 if entry.closed:
@@ -489,12 +532,13 @@ class BufferCatalog:
                     entry.pending_device = None
                     if pending is not None:
                         memory_budget().release(entry.nbytes)
+                        workload.discharge(entry.owner, entry.nbytes)
                         self.spilled_device_bytes -= entry.nbytes
                     return
             if pending is None:
                 return
             try:
-                faults.check("spill.d2h_copy")
+                faults.check("spill.d2h_copy", key=entry.fault_key)
                 host = [np.asarray(jax.device_get(x)) for x in pending]
             except Exception as e:  # noqa: BLE001 — transient device
                 # error: the data never left the device; put the entry
@@ -513,6 +557,7 @@ class BufferCatalog:
                         self.spilled_device_bytes -= entry.nbytes
                         return
                 memory_budget().release(entry.nbytes)
+                workload.discharge(entry.owner, entry.nbytes)
                 return
             with self._lock:
                 entry.pending_device = None
@@ -521,6 +566,7 @@ class BufferCatalog:
             # the device buffers are dropped HERE (copy landed or entry
             # closed): only now is the HBM actually free
             memory_budget().release(entry.nbytes)
+            workload.discharge(entry.owner, entry.nbytes)
             return
         # to_disk: by single-writer FIFO the to_host hop (if any) has
         # already landed, so host_leaves is populated
@@ -536,7 +582,7 @@ class BufferCatalog:
         if closed or host is None:
             return
         try:
-            _write_npz(path, host)
+            _write_npz(path, host, key=entry.fault_key)
         except Exception as e:  # noqa: BLE001 — disk full/unwritable:
             # the host copy is still intact, so the entry simply stays
             # on the HOST tier; drop any partial file

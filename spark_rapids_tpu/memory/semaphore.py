@@ -14,10 +14,20 @@ has the same property: one semaphore acquisition per Spark task however
 many threads serve it). A producer blocked waiting for a permit polls an
 optional `cancel` predicate so an abandoned pipelined query can always
 tear down.
+
+Fair wakeup (ISSUE 7): permit grants are priority-then-FIFO across
+tasks of different queries — a waiter's priority class comes from its
+query's workload ticket (exec/workload.py PRIORITIES; interactive when
+ungoverned), ties break in registration order, and every
+workload.AGING_EVERY-th grant goes to the OLDEST waiter regardless of
+class, so a batch query can never starve behind a steady interactive
+stream. Before this the permit pool was a bare threading.Semaphore:
+grant order under contention was whatever the OS scheduler woke first.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Callable, Dict, Optional
@@ -25,6 +35,85 @@ from typing import Callable, Dict, Optional
 from ..config import CONCURRENT_TPU_TASKS, active_conf
 
 _POLL_S = 0.05
+
+
+class _Waiter:
+    __slots__ = ("priority", "seq", "granted")
+
+    def __init__(self, priority: int, seq: int):
+        self.priority = priority
+        self.seq = seq
+        self.granted = False
+
+
+class _FairPermits:
+    """Permit pool with deterministic priority-then-FIFO-with-aging
+    grant order. Waiters register once per blocked acquire (stable FIFO
+    seq across poll timeouts) and poll `try_acquire`; a permit goes to
+    the waiter `_next_waiter` picks, never to whoever the scheduler
+    happens to wake."""
+
+    def __init__(self, permits: int):
+        self._cond = threading.Condition()
+        self._avail = permits
+        self._waiters: list = []
+        self._seq = itertools.count(1)
+        self._grants = 0
+
+    def register(self, priority: int) -> _Waiter:
+        with self._cond:
+            w = _Waiter(priority, next(self._seq))
+            self._waiters.append(w)
+            return w
+
+    def _next_waiter(self) -> Optional[_Waiter]:
+        # the ONE fair-selection rule, shared with the admission queue
+        from ..exec.workload import pick_fair
+        return pick_fair(self._waiters, self._grants,
+                         rank=lambda w: w.priority, seq=lambda w: w.seq)
+
+    def try_acquire(self, w: _Waiter, timeout: float) -> bool:
+        """True when `w` was granted a permit; False on timeout (the
+        caller runs its cancellation checks and re-polls — `w` keeps
+        its place in line)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._avail > 0 and self._next_waiter() is w:
+                    self._avail -= 1
+                    self._grants += 1
+                    self._waiters.remove(w)
+                    w.granted = True
+                    # the chosen-next identity changed: other waiters
+                    # must re-evaluate
+                    self._cond.notify_all()
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+
+    def deregister(self, w: _Waiter) -> None:
+        """A waiter that gives up (cancelled / abandoned task) leaves
+        the line; whoever is next must re-evaluate."""
+        with self._cond:
+            if not w.granted and w in self._waiters:
+                self._waiters.remove(w)
+                self._cond.notify_all()
+
+    def release(self) -> None:
+        with self._cond:
+            self._avail += 1
+            self._cond.notify_all()
+
+    @property
+    def available(self) -> int:
+        return self._avail
+
+
+def _waiter_priority() -> int:
+    from ..exec.workload import current_priority_rank
+    return current_priority_rank()
 
 
 class _TaskHold:
@@ -38,8 +127,8 @@ class _TaskHold:
 
 class TpuSemaphore:
     def __init__(self, permits: Optional[int] = None):
-        self._permits = permits or active_conf().get(CONCURRENT_TPU_TASKS)
-        self._sem = threading.Semaphore(self._permits)
+        self._permits_n = permits or active_conf().get(CONCURRENT_TPU_TASKS)
+        self._pool = _FairPermits(self._permits_n)
         self._holders: Dict[int, _TaskHold] = {}
         self._lock = threading.Lock()
         self.total_wait_ns = 0
@@ -99,31 +188,37 @@ class TpuSemaphore:
             obs_events.emit("semaphore_acquire", task_id=task_id,
                             wait_ns=waited)
             return True
-        while not self._sem.acquire(timeout=_POLL_S):
-            if hold.abandoned:
-                # release_if_necessary (task end) ran while this first
-                # acquire was still blocked: the outcome is already
-                # False — stop competing for a permit that would only
-                # be handed straight back (the holder entry is gone)
-                hold.ready.set()
-                return False
-            if cancel is not None and cancel():
-                with self._lock:
-                    if self._holders.get(task_id) is hold:
-                        del self._holders[task_id]
-                hold.ready.set()  # waiters re-race a fresh first acquire
-                return False
-            from ..exec import lifecycle
-            if lifecycle.current_cancelled():
-                # governed-query cancellation while blocked for a
-                # permit: same cleanup as the cancel predicate (this
-                # thread owns the pending hold entry but no permit),
-                # then raise with sem-wait phase attribution
-                with self._lock:
-                    if self._holders.get(task_id) is hold:
-                        del self._holders[task_id]
-                hold.ready.set()
-                lifecycle.check_current("sem-wait")
+        w = self._pool.register(_waiter_priority())
+        try:
+            while not self._pool.try_acquire(w, timeout=_POLL_S):
+                if hold.abandoned:
+                    # release_if_necessary (task end) ran while this
+                    # first acquire was still blocked: the outcome is
+                    # already False — stop competing for a permit that
+                    # would only be handed straight back (the holder
+                    # entry is gone)
+                    hold.ready.set()
+                    return False
+                if cancel is not None and cancel():
+                    with self._lock:
+                        if self._holders.get(task_id) is hold:
+                            del self._holders[task_id]
+                    hold.ready.set()  # waiters re-race a fresh acquire
+                    return False
+                from ..exec import lifecycle
+                if lifecycle.current_cancelled():
+                    # governed-query cancellation while blocked for a
+                    # permit: same cleanup as the cancel predicate (this
+                    # thread owns the pending hold entry but no permit),
+                    # then raise with sem-wait phase attribution
+                    with self._lock:
+                        if self._holders.get(task_id) is hold:
+                            del self._holders[task_id]
+                    hold.ready.set()
+                    lifecycle.check_current("sem-wait")
+        finally:
+            if not w.granted:
+                self._pool.deregister(w)
         waited = time.monotonic_ns() - t0
         with self._lock:
             abandoned = hold.abandoned
@@ -139,7 +234,7 @@ class TpuSemaphore:
             # release_if_necessary ran while we were blocked: keeping
             # this permit would leak it forever (the task never
             # releases again), so hand it straight back
-            self._sem.release()
+            self._pool.release()
             hold.ready.set()
             return False
         hold.ready.set()
@@ -167,7 +262,7 @@ class TpuSemaphore:
                     hold = None
         if hold is not None:
             hold.ready.set()
-            self._sem.release()
+            self._pool.release()
 
     def held_by(self, task_id: int) -> bool:
         with self._lock:
@@ -177,7 +272,7 @@ class TpuSemaphore:
     @property
     def available(self) -> int:
         # not exact under contention; test/debug surface only
-        return self._sem._value  # noqa: SLF001
+        return self._pool.available
 
 
 _semaphore: Optional[TpuSemaphore] = None

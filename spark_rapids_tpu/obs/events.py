@@ -8,14 +8,15 @@ each line a self-describing record:
 
 Event kinds and their levels (spark.rapids.tpu.eventLog.level):
 
-  ESSENTIAL  query_start, query_end, query_cancelled
+  ESSENTIAL  query_start, query_end, query_cancelled, query_shed
   MODERATE   op_close, semaphore_acquire, spill, oom_retry,
              pallas_tier, plan_fallback, plan_not_on_tpu, exchange,
              pipeline_wait, pipeline_full, op_error, fault_inject,
              io_retry, task_retry, integrity_fail, pipeline_stuck,
              spill_error, spill_writer_dead, task_retry_settle_error,
              partition_recompute, breaker_open, breaker_half_open,
-             breaker_close, peer_dead
+             breaker_close, peer_dead, query_queued, query_admitted,
+             quota_spill
   DEBUG      op_open, op_batch, span
 
 Cost discipline: `active_bus()` returns None when logging is disabled —
@@ -77,6 +78,13 @@ EVENT_LEVELS: Dict[str, int] = {
     "breaker_half_open": MODERATE,
     "breaker_close": MODERATE,
     "peer_dead": MODERATE,
+    # workload-governor events (ISSUE 7): a shed query is headline (the
+    # caller got an error, like a cancellation); queue/admission
+    # transitions and quota-triggered self-spills are MODERATE
+    "query_queued": MODERATE,
+    "query_admitted": MODERATE,
+    "query_shed": ESSENTIAL,
+    "quota_spill": MODERATE,
     "op_open": DEBUG,
     "op_batch": DEBUG,
     "span": DEBUG,

@@ -15,6 +15,7 @@ model — SURVEY §5 'no elastic re-sharding').
 
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -33,16 +34,60 @@ class PeerInfo:
 
 
 class HeartbeatManager:
-    """Driver-side registry (reference RapidsShuffleHeartbeatManager)."""
+    """Driver-side registry (reference RapidsShuffleHeartbeatManager).
 
-    def __init__(self, timeout_s: float = 10.0):
+    Bounded (ISSUE 7 satellite): a peer silent past `purge_timeout_s`
+    (default 6x the dead timeout) is PURGED — its registry entry dropped
+    and its slot recycled for the next registration — so a long-lived
+    driver under executor churn cannot grow the registry without bound.
+    A purged executor's next beat registers cleanly (first-beat ==
+    registration, per the `_register_locked` contract)."""
+
+    def __init__(self, timeout_s: float = 10.0,
+                 purge_timeout_s: Optional[float] = None):
         self.timeout_s = timeout_s
+        self.purge_timeout_s = (purge_timeout_s if purge_timeout_s
+                                is not None else 6.0 * timeout_s)
         self._lock = threading.Lock()
         self._peers: Dict[str, PeerInfo] = {}
         self._next_slot = 0
+        #: slots of purged peers, recycled lowest-first
+        self._free_slots: List[int] = []
         #: peers already reported dead (one peer_dead event per
         #: live->dead transition; a returning beat re-arms it)
         self._reported_dead: set = set()
+
+    def _purge_locked(self, now: float,
+                      keep: Optional[str] = None) -> List[tuple]:
+        """Drop peers silent past purge_timeout_s — caller holds
+        self._lock. Their slots go back on the free list. Returns
+        (executor_id, silent_s) for purged peers whose death was never
+        reported: the caller emits their one peer_dead event OUTSIDE
+        the lock, so a peer that crosses the purge threshold between
+        dead_peers() polls does not vanish without its transition.
+        `keep` is the executor currently beating/registering — it just
+        proved alive and is about to be refreshed; purging it here
+        would emit a peer_dead for a live peer."""
+        unreported = []
+        doomed = [e for e, p in self._peers.items()
+                  if now - p.last_beat > self.purge_timeout_s
+                  and e != keep]
+        for executor_id in doomed:
+            peer = self._peers.pop(executor_id)
+            heapq.heappush(self._free_slots, peer.slot)
+            if executor_id not in self._reported_dead:
+                unreported.append((executor_id, now - peer.last_beat))
+            self._reported_dead.discard(executor_id)
+        return unreported
+
+    def _emit_dead(self, fresh) -> None:
+        """One peer_dead event per live->dead transition — emitted
+        outside the lock."""
+        for executor_id, silent_s in fresh:
+            from ..obs import events as obs_events
+            obs_events.emit("peer_dead", executor_id=executor_id,
+                            silent_ms=int(silent_s * 1000),
+                            timeout_ms=int(self.timeout_s * 1000))
 
     def _register_locked(self, executor_id: str,
                          host: str = "local") -> List[PeerInfo]:
@@ -53,9 +98,13 @@ class HeartbeatManager:
         executor's first beat deadlocked forever)."""
         now = time.monotonic()
         if executor_id not in self._peers:
+            if self._free_slots:
+                slot = heapq.heappop(self._free_slots)
+            else:
+                slot = self._next_slot
+                self._next_slot += 1
             self._peers[executor_id] = PeerInfo(
-                executor_id, host, self._next_slot, now)
-            self._next_slot += 1
+                executor_id, host, slot, now)
         else:
             self._peers[executor_id].last_beat = now
         self._reported_dead.discard(executor_id)
@@ -67,7 +116,11 @@ class HeartbeatManager:
         reference's RegisterExecutor reply carries peer identities so
         clients can connect eagerly)."""
         with self._lock:
-            return self._register_locked(executor_id, host)
+            purged = self._purge_locked(time.monotonic(),
+                                        keep=executor_id)
+            peers = self._register_locked(executor_id, host)
+        self._emit_dead(purged)
+        return peers
 
     def heartbeat(self, executor_id: str) -> List[PeerInfo]:
         """Periodic beat: refreshes liveness, returns peers registered
@@ -75,25 +128,36 @@ class HeartbeatManager:
         reference's ExecutorHeartbeat reply)."""
         now = time.monotonic()
         with self._lock:
+            purged = self._purge_locked(now, keep=executor_id)
             me = self._peers.get(executor_id)
             if me is None:
-                return self._register_locked(executor_id)
-            prev = me.last_beat
-            me.last_beat = now
-            self._reported_dead.discard(executor_id)
-            return [p for p in self._peers.values()
-                    if p.executor_id != executor_id
-                    and p.registered_at > prev]
+                peers = self._register_locked(executor_id)
+            else:
+                prev = me.last_beat
+                me.last_beat = now
+                self._reported_dead.discard(executor_id)
+                peers = [p for p in self._peers.values()
+                         if p.executor_id != executor_id
+                         and p.registered_at > prev]
+        self._emit_dead(purged)
+        return peers
 
     def live_peers(self) -> List[str]:
         now = time.monotonic()
         with self._lock:
-            return [p.executor_id for p in self._peers.values()
+            purged = self._purge_locked(now)
+            live = [p.executor_id for p in self._peers.values()
                     if now - p.last_beat <= self.timeout_s]
+        self._emit_dead(purged)
+        return live
 
     def dead_peers(self) -> List[str]:
+        """Peers past the dead timeout but not yet purged (a purged
+        peer is forgotten entirely — neither live nor dead; its
+        transition event, if still unreported, fires on the purge)."""
         now = time.monotonic()
         with self._lock:
+            purged = self._purge_locked(now)
             dead = [p.executor_id for p in self._peers.values()
                     if now - p.last_beat > self.timeout_s]
             fresh = [(e, now - self._peers[e].last_beat) for e in dead
@@ -101,11 +165,7 @@ class HeartbeatManager:
             self._reported_dead.update(e for e, _ in fresh)
         # liveness is observable (ISSUE 6 satellite): one peer_dead
         # event per live->dead transition — emitted outside the lock
-        for executor_id, silent_s in fresh:
-            from ..obs import events as obs_events
-            obs_events.emit("peer_dead", executor_id=executor_id,
-                            silent_ms=int(silent_s * 1000),
-                            timeout_ms=int(self.timeout_s * 1000))
+        self._emit_dead(purged + fresh)
         return dead
 
 

@@ -44,6 +44,24 @@ def _no_leaked_fault_plan():
 
 
 @pytest.fixture(scope="module", autouse=True)
+def _no_leaked_workload_state():
+    """Workload-governor hygiene (ISSUE 7, mirroring the lifecycle
+    tripwire): a query left queued or admitted at a module boundary
+    means some admitted() scope never released its ticket — later
+    suites would inherit a phantom tenant whose quota share shrinks
+    everyone else's. Reset at module boundaries and fail the offender
+    loudly."""
+    from spark_rapids_tpu.exec import workload
+    workload.reset_workload()
+    yield
+    snap = workload.snapshot()
+    workload.reset_workload()
+    assert snap["queue_depth"] == 0 and snap["admitted"] == 0, (
+        f"module leaked workload state: {snap['queue_depth']} queued, "
+        f"{snap['admitted']} admitted")
+
+
+@pytest.fixture(scope="module", autouse=True)
 def _no_leaked_lifecycle_state():
     """Lifecycle-governor hygiene (ISSUE 6, same pattern as the leaked
     fault plan): a breaker left open would silently demote a kernel

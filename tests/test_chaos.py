@@ -418,6 +418,43 @@ def test_task_retry_fatal_and_exhaustion(fast_conf):
 # fault points: spill
 # ---------------------------------------------------------------------------
 
+def test_spill_injection_placement_replays_across_runs(spill_env, spy):
+    """ISSUE 7 satellite: the spill sites now pass the catalog entry's
+    deterministic registration ordinal as the fault work-item key, so
+    injection PLACEMENT — which entry's write draws the fault, not just
+    how many — replays under any processing order. Two runs spill the
+    same 12 entries in OPPOSITE priority order (the single-core proxy
+    for a thread-scheduling permutation), and a third hands the writes
+    to the async writer THREAD: all three fire on the same entries."""
+    import jax.numpy as jnp
+
+    def run(async_write, ascending):
+        cat = spill_env(async_write, host_limit="1")
+        spy.clear()
+        handles = []
+        for i in range(12):
+            prio = i if ascending else -i
+            handles.append(cat.add(jnp.arange(64, dtype=jnp.int64),
+                                   priority=prio))
+        faults.install("spill.disk_write:prob=0.5,seed=0,kind=io")
+        cat.synchronous_spill(None)  # device -> host -> (1B limit) disk
+        cat.drain_writeback()
+        faults.install(None)
+        placed = {(r["point"], r["key"]) for r in spy
+                  if r["kind"] == "fault_inject"}
+        for h in handles:
+            cat.remove(h)
+        return placed
+
+    a = run("false", ascending=True)
+    b = run("false", ascending=False)  # reversed spill order
+    c = run("true", ascending=True)    # writes on the writer thread
+    assert a == b == c, "injection placement moved with scheduling"
+    # teeth: a proper subset fired, and every draw carried an entry key
+    assert 0 < len(a) < 12
+    assert all(k and k.startswith("spill:") for _p, k in a)
+
+
 def test_point_spill_d2h_sync_restores_entry_and_budget(spill_env, spy):
     cat = spill_env(False)
     sb = _spillable()

@@ -120,6 +120,26 @@ def test_breaker_tables_match_registry():
         f"stale={sorted(rows - expected)}")
 
 
+def test_workload_tables_match_registry():
+    """docs/robustness.md's workload-governor admission-state and
+    priority tables list exactly workload.ADMISSION_STATES /
+    PRIORITIES (ISSUE 7: the same drift lint the breaker tables get),
+    scoped to the governor section."""
+    from spark_rapids_tpu.exec import workload
+    docs = (ROOT / "docs" / "robustness.md").read_text()
+    m = re.search(r"## Concurrent workload governor\n(.*?)(?:\n## |\Z)",
+                  docs, re.DOTALL)
+    assert m, "docs/robustness.md lost its workload-governor section"
+    section = m.group(1)
+    rows = set(re.findall(r"^\|\s*`([a-z_]+)`\s*\|", section,
+                          re.MULTILINE))
+    expected = set(workload.ADMISSION_STATES) | set(workload.PRIORITIES)
+    assert rows == expected, (
+        f"docs/robustness.md workload tables drifted: "
+        f"missing={sorted(expected - rows)} "
+        f"stale={sorted(rows - expected)}")
+
+
 def test_robustness_event_kinds_are_registered():
     """Every event kind the robustness layer emits is in
     obs.events.EVENT_LEVELS (an unregistered kind silently defaults to
@@ -130,7 +150,8 @@ def test_robustness_event_kinds_are_registered():
                  "spill_writer_dead", "query_cancelled",
                  "task_retry_settle_error", "partition_recompute",
                  "breaker_open", "breaker_half_open", "breaker_close",
-                 "peer_dead"):
+                 "peer_dead", "query_queued", "query_admitted",
+                 "query_shed", "quota_spill"):
         assert kind in events.EVENT_LEVELS, kind
     docs = (ROOT / "docs" / "observability.md").read_text()
     for kind in events.EVENT_LEVELS:

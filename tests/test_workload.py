@@ -1,0 +1,906 @@
+"""Concurrent workload governor (ISSUE 7): fair admission with aging,
+per-query memory quotas, overload shedding, semaphore grant fairness,
+the heartbeat purge satellite, and the tooling surfaces.
+
+Deterministic on single-core CPU, house style: ordering assertions are
+driven by registration sequence (threads are started one at a time and
+their queue residency is confirmed before the next starts), never by
+sleep races; the concurrency acceptance drive compares every lane
+against a numpy-derived single-threaded oracle."""
+
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import QueryAdmissionError, QueryCancelledError
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.exec import lifecycle, workload
+from spark_rapids_tpu.memory.budget import (memory_budget,
+                                            reset_memory_budget)
+from spark_rapids_tpu.memory.catalog import (buffer_catalog,
+                                             reset_buffer_catalog)
+from spark_rapids_tpu.memory.retry import TpuRetryOOM
+from spark_rapids_tpu.memory.semaphore import reset_tpu_semaphore
+from spark_rapids_tpu.obs import events
+from spark_rapids_tpu.types import LONG, Schema
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+FAST = {
+    "spark.rapids.tpu.io.retryBackoffMs": "1",
+    "spark.rapids.tpu.task.retryBackoffMs": "1",
+    "spark.rapids.tpu.retry.backoffMs": "1",
+}
+
+WL = dict(FAST, **{"spark.rapids.tpu.workload.enabled": "true"})
+
+
+def _threads():
+    return {t for t in threading.enumerate()
+            if t.name.startswith(("pipeline-", "spill-writer"))}
+
+
+@pytest.fixture(autouse=True)
+def _workload_isolation():
+    """Every test starts with a fresh governor and semaphore, a clean
+    lifecycle, injection off, the conf restored, and leaks checked."""
+    pre = _threads()
+    prev_conf = C.active_conf()
+    workload.reset_workload()
+    lifecycle.reset_lifecycle()
+    faults.install(None)
+    yield
+    faults.install(None)
+    snap = workload.snapshot()
+    workload.reset_workload()
+    lifecycle.reset_lifecycle()
+    reset_tpu_semaphore()
+    C.set_active_conf(prev_conf)
+    assert snap["queue_depth"] == 0 and snap["admitted"] == 0, snap
+    assert _threads() <= pre, "leaked threads"
+
+
+@pytest.fixture
+def spy(monkeypatch):
+    rows = []
+    real = events.emit
+
+    def spy_emit(kind, **fields):
+        rows.append({"kind": kind, **fields})
+        real(kind, **fields)
+
+    monkeypatch.setattr(events, "emit", spy_emit)
+    return rows
+
+
+def _kinds(rows, kind):
+    return [r for r in rows if r["kind"] == kind]
+
+
+def _conf(**extra):
+    settings = dict(WL)
+    settings.update({k: str(v) for k, v in extra.items()})
+    return C.RapidsConf(settings)
+
+
+# ---------------------------------------------------------------------------
+# fair admission ordering (unit, no threads)
+# ---------------------------------------------------------------------------
+
+def test_pick_next_is_priority_then_fifo_with_aging():
+    """Weighted-fair ordering: interactive before batch, FIFO inside a
+    class, and every AGING_EVERY-th grant the OLDEST waiter outright —
+    so batch is granted long before the interactive stream drains."""
+    m = workload.WorkloadManager()
+    order_in = ["batch", "interactive", "batch", "interactive",
+                "interactive", "interactive"]
+    tickets = [workload.Ticket(p, seq=next(m._seq)) for p in order_in]
+    m._queued.extend(tickets)
+    order = []
+    while m._queued:
+        t = m._pick_next()
+        m._queued.remove(t)
+        m._grants += 1
+        order.append(tickets.index(t))
+    # hand-derived: seqs 1..6, ranks [1,0,1,0,0,0] —
+    #   g0 (grants=0): min (rank, seq) -> seq2; g1 -> seq4; g2 -> seq5;
+    #   g3 (aging, grants=3): oldest -> seq1 (the first BATCH arrival,
+    #   granted ahead of two younger interactives); g4 -> seq6;
+    #   g5 -> seq3 (batch)
+    assert order == [1, 3, 4, 0, 5, 2]
+
+
+def test_all_interactive_keeps_fifo():
+    m = workload.WorkloadManager()
+    tickets = [workload.Ticket("interactive", seq=next(m._seq))
+               for _ in range(5)]
+    m._queued.extend(tickets)
+    order = []
+    while m._queued:
+        t = m._pick_next()
+        m._queued.remove(t)
+        m._grants += 1
+        order.append(tickets.index(t))
+    assert order == [0, 1, 2, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# admission / shedding (manager-level)
+# ---------------------------------------------------------------------------
+
+def test_direct_admission_and_release(spy):
+    m = workload.manager()
+    conf = _conf(**{"spark.rapids.tpu.workload.maxConcurrentQueries": 2})
+    a = m.admit(conf, None)
+    b = m.admit(conf, None)
+    assert a.state == "admitted" and b.state == "admitted"
+    assert m.admitted_count() == 2 and m.queued_count() == 0
+    evs = _kinds(spy, "query_admitted")
+    assert len(evs) == 2 and evs[0]["wait_ms"] == 0
+    C.set_active_conf(conf)
+    m.release(a)
+    m.release(b)
+    assert m.admitted_count() == 0
+    assert a.state == "released" and b.state == "released"
+    assert workload.counters()["admitted"] == 2
+
+
+def test_queue_full_sheds_fast(spy):
+    m = workload.manager()
+    conf = _conf(**{"spark.rapids.tpu.workload.maxConcurrentQueries": 1,
+                    "spark.rapids.tpu.workload.queueDepth": 0})
+    a = m.admit(conf, None)
+    t0 = time.monotonic()
+    with pytest.raises(QueryAdmissionError) as ei:
+        m.admit(conf, None)
+    assert time.monotonic() - t0 < 2.0, "shed was not fast"
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_ms > 0
+    assert faults.classify(ei.value) == "fatal", \
+        "a shed query must not burn task-retry attempts"
+    evs = _kinds(spy, "query_shed")
+    assert len(evs) == 1 and evs[0]["reason"] == "queue_full"
+    C.set_active_conf(conf)
+    m.release(a)
+    assert workload.counters()["shed"] == 1
+
+
+def test_admission_timeout_sheds(spy):
+    m = workload.manager()
+    conf = _conf(**{
+        "spark.rapids.tpu.workload.maxConcurrentQueries": 1,
+        "spark.rapids.tpu.workload.admissionTimeoutMs": 80})
+    a = m.admit(conf, None)
+    with pytest.raises(QueryAdmissionError) as ei:
+        m.admit(conf, None)
+    assert ei.value.reason == "timeout"
+    assert _kinds(spy, "query_shed")[0]["reason"] == "timeout"
+    assert m.queued_count() == 0, "timed-out ticket left in the queue"
+    C.set_active_conf(conf)
+    m.release(a)
+
+
+def test_deadline_infeasible_sheds(spy):
+    m = workload.manager()
+    conf = _conf(**{"spark.rapids.tpu.workload.maxConcurrentQueries": 1})
+    a = m.admit(conf, None)
+    ctx = lifecycle.QueryContext(timeout_ms=1)
+    time.sleep(0.01)  # the whole wall-clock budget is gone
+    with pytest.raises(QueryAdmissionError) as ei:
+        m.admit(conf, ctx)
+    assert ei.value.reason == "deadline_infeasible"
+    C.set_active_conf(conf)
+    m.release(a)
+
+
+def test_open_device_breaker_sheds_at_admission(spy):
+    """An OPEN device_dispatch breaker means dispatches are currently
+    dying: admission sheds instead of feeding the degraded device —
+    without consuming the breaker's half-open probe slot."""
+    conf = C.RapidsConf(dict(WL, **{
+        "spark.rapids.tpu.breaker.enabled": "true",
+        "spark.rapids.tpu.breaker.threshold": "1",
+        "spark.rapids.tpu.breaker.cooldownMs": "60000"}))
+    C.set_active_conf(conf)
+    lifecycle.record_domain_failure("device_dispatch")
+    assert "device_dispatch" in lifecycle.open_breakers()
+    m = workload.manager()
+    # the consult must run on the ADMITTING conf: admission happens
+    # before collect installs the session conf thread-locally, so a
+    # fresh client thread's active_conf knows nothing of the breaker
+    C.set_active_conf(C.RapidsConf(dict(FAST)))
+    with pytest.raises(QueryAdmissionError) as ei:
+        m.admit(conf, None)
+    assert ei.value.reason == "breaker_open"
+    assert 0 < ei.value.retry_after_ms <= 60000
+    assert workload.counters()["shed"] == 1
+    C.set_active_conf(conf)
+    # the read-only consult must not have half-opened the breaker
+    assert lifecycle.health()["breakers"]["device_dispatch"]["state"] \
+        == "open"
+    # kill-switch parity with breaker_allows: disabling the breaker
+    # conf restores admission immediately
+    off = C.RapidsConf(dict(WL, **{
+        "spark.rapids.tpu.breaker.enabled": "false"}))
+    C.set_active_conf(off)
+    t = m.admit(off, None)
+    m.release(t)
+
+
+def test_cancel_query_dequeues_queued(spy):
+    """cancel_query() on a QUEUED query raises QueryCancelledError with
+    admission-wait phase attribution and leaves the queue clean."""
+    assert "admission-wait" in lifecycle.CANCEL_PHASES
+    m = workload.manager()
+    conf = _conf(**{"spark.rapids.tpu.workload.maxConcurrentQueries": 1})
+    a = m.admit(conf, None)
+    owner = object()
+    result = {}
+
+    def queued_query():
+        C.set_active_conf(conf)
+        with lifecycle.governed(conf, owner=owner) as ctx:
+            try:
+                with workload.admitted(conf, ctx):
+                    result["outcome"] = "admitted"
+            except QueryCancelledError as e:
+                result["outcome"] = e.phase
+
+    t = threading.Thread(target=queued_query, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while m.queued_count() < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert m.queued_count() == 1, "query never queued"
+    assert lifecycle.cancel_owner(owner) == 1
+    t.join(timeout=10)
+    assert not t.is_alive(), "cancelled queued query never unwound"
+    assert result["outcome"] == "admission-wait"
+    evs = _kinds(spy, "query_cancelled")
+    assert len(evs) == 1 and evs[0]["phase"] == "admission-wait"
+    assert m.queued_count() == 0
+    C.set_active_conf(conf)
+    m.release(a)
+
+
+# ---------------------------------------------------------------------------
+# per-query memory quotas
+# ---------------------------------------------------------------------------
+
+def test_quota_rebalances_as_queries_finish():
+    m = workload.manager()
+    conf = _conf(**{"spark.rapids.tpu.workload.maxConcurrentQueries": 4})
+    C.set_active_conf(conf)
+    a = m.admit(conf, None)
+    assert m.quota_bytes(1000, 0.5) is None, \
+        "a lone query gets the whole budget"
+    b = m.admit(conf, None)
+    assert m.quota_bytes(1000, 0.5) == 500
+    c = m.admit(conf, None)
+    # fraction floor beats the even split (soft oversubscription)
+    assert m.quota_bytes(1000, 0.5) == 500
+    assert m.quota_bytes(1000, 0.2) == 333
+    m.release(c)
+    assert m.quota_bytes(1000, 0.2) == 500
+    m.release(b)
+    assert m.quota_bytes(1000, 0.2) is None
+    m.release(a)
+
+
+def _governed_with_ticket(conf, ticket):
+    """Install a governed context carrying `ticket` on this thread."""
+    ctx = lifecycle.QueryContext()
+    ctx.workload_ticket = ticket
+    lifecycle.adopt_context(ctx)
+    return ctx
+
+
+def test_over_quota_reserve_spills_own_entries_first(spy):
+    """The quota contract: under budget pressure an over-share query
+    spills ITS OWN catalog entries (quota_spill event) — the
+    under-share neighbor's residency is untouched on EVERY tier (the
+    host-limit enforcement pass riding the owner-scoped spill must not
+    demote a neighbor's host entry to disk either)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.memory.catalog import (
+        OUTPUT_FOR_SHUFFLE_PRIORITY, StorageTier)
+    conf = C.RapidsConf(dict(WL, **{
+        "spark.rapids.tpu.spill.asyncWrite": "false",
+        "spark.rapids.tpu.workload.maxConcurrentQueries": "2"}))
+    # same settings, 1-byte host soft limit: installed only for B's
+    # pressure phase, so A can park an entry on the HOST tier first
+    tiny_host = C.RapidsConf(dict(
+        conf._settings,
+        **{"spark.rapids.memory.host.spillStorageSize": "1"}))
+    C.set_active_conf(conf)
+    m = workload.manager()
+    a = m.admit(conf, None)
+    b = m.admit(conf, None)
+    try:
+        reset_buffer_catalog()
+        reset_memory_budget(1 << 20)  # 1 MiB; shares = 512 KiB each
+        cat = buffer_catalog()
+        _governed_with_ticket(conf, a)
+        h_a = cat.add(jnp.zeros(300 * 1024, jnp.uint8))  # A: 300 KiB
+        # a second A entry parked on the HOST tier (spilled while the
+        # host limit is roomy): bait for an unscoped host-limit pass
+        h_a2 = cat.add(jnp.zeros(64 * 1024, jnp.uint8),
+                       priority=OUTPUT_FOR_SHUFFLE_PRIORITY)
+        cat.synchronous_spill(64 * 1024, owner=a)
+        assert cat.tier_of(h_a2) == StorageTier.HOST
+        assert a.device_bytes == 300 * 1024
+        # B's phase runs with the 1-byte host limit: its own quota
+        # spill would demote ANY host entry the enforcement pass sees
+        C.set_active_conf(tiny_host)
+        _governed_with_ticket(tiny_host, b)
+        h_b = cat.add(jnp.zeros(600 * 1024, jnp.uint8))  # B: over share
+        assert b.device_bytes == 600 * 1024
+        # B reserves 200 KiB more: global pressure + B over quota ->
+        # B's own entry spills, A's stays device-resident
+        memory_budget().reserve(200 * 1024)
+        memory_budget().release(200 * 1024)
+        assert cat.tier_of(h_b) != StorageTier.DEVICE, \
+            "the offender's entry did not spill"
+        assert cat.tier_of(h_a) == StorageTier.DEVICE, \
+            "a neighbor's entry was pushed down a tier"
+        # the host-limit enforcement riding B's owner-scoped spill must
+        # be owner-scoped too: A's parked HOST entry stays HOST even
+        # though the limit is 1 byte (B's own spilled entry paid the
+        # demotion instead)
+        assert cat.tier_of(h_a2) == StorageTier.HOST, \
+            "B's quota spill demoted a neighbor's HOST entry to disk"
+        assert cat.tier_of(h_b) == StorageTier.DISK
+        assert b.device_bytes == 0 and a.device_bytes == 300 * 1024
+        evs = _kinds(spy, "quota_spill")
+        assert len(evs) == 1
+        assert evs[0]["quota"] == 512 * 1024
+        assert evs[0]["freed"] == 600 * 1024
+        assert workload.counters()["quota_spills"] == 1
+        cat.remove(h_a)
+        cat.remove(h_b)
+    finally:
+        lifecycle.adopt_context(None)
+        m.release(b)
+        m.release(a)
+        reset_buffer_catalog()
+        reset_memory_budget()
+
+
+def test_over_quota_with_pinned_entries_raises_own_oom(spy):
+    """When the over-share query's entries are all in use (nothing of
+    its own to spill), pressure surfaces as ITS TpuRetryOOM — the
+    neighbor is still untouched."""
+    import jax.numpy as jnp
+    conf = C.RapidsConf(dict(WL, **{
+        "spark.rapids.tpu.spill.asyncWrite": "false",
+        "spark.rapids.tpu.workload.maxConcurrentQueries": "2"}))
+    C.set_active_conf(conf)
+    m = workload.manager()
+    a = m.admit(conf, None)
+    b = m.admit(conf, None)
+    try:
+        reset_buffer_catalog()
+        reset_memory_budget(1 << 20)
+        cat = buffer_catalog()
+        _governed_with_ticket(conf, a)
+        h_a = cat.add(jnp.zeros(300 * 1024, jnp.uint8))
+        _governed_with_ticket(conf, b)
+        h_b = cat.add(jnp.zeros(600 * 1024, jnp.uint8))
+        cat.acquire(h_b)  # pinned: unspillable
+        with pytest.raises(TpuRetryOOM) as ei:
+            memory_budget().reserve(200 * 1024)
+        assert "quota" in str(ei.value)
+        from spark_rapids_tpu.memory.catalog import StorageTier
+        assert cat.tier_of(h_a) == StorageTier.DEVICE, \
+            "a neighbor's entry was pushed down a tier"
+        cat.release(h_b)
+        cat.remove(h_a)
+        cat.remove(h_b)
+    finally:
+        lifecycle.adopt_context(None)
+        m.release(b)
+        m.release(a)
+        reset_buffer_catalog()
+        reset_memory_budget()
+
+
+def test_spill_for_retry_honors_quota_while_over_share():
+    """The quota TpuRetryOOM lands in the OOM-retry lane, whose
+    between-attempt spill runs spill_for_retry: while the query is
+    still over its share, that pass too spills only ITS entries — an
+    unfiltered pass would hand the offender the bytes its neighbors
+    freed, undoing the reserve-path isolation one frame up."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.memory.budget import spill_for_retry
+    from spark_rapids_tpu.memory.catalog import StorageTier
+    conf = C.RapidsConf(dict(WL, **{
+        "spark.rapids.tpu.spill.asyncWrite": "false",
+        "spark.rapids.tpu.workload.maxConcurrentQueries": "2"}))
+    C.set_active_conf(conf)
+    m = workload.manager()
+    a = m.admit(conf, None)
+    b = m.admit(conf, None)
+    try:
+        reset_buffer_catalog()
+        reset_memory_budget(1 << 20)  # shares = 512 KiB
+        cat = buffer_catalog()
+        _governed_with_ticket(conf, a)
+        h_a = cat.add(jnp.zeros(300 * 1024, jnp.uint8))
+        _governed_with_ticket(conf, b)
+        h_b = cat.add(jnp.zeros(600 * 1024, jnp.uint8))  # over share
+        spill_for_retry()  # B's thread, B over quota
+        assert cat.tier_of(h_b) != StorageTier.DEVICE
+        assert cat.tier_of(h_a) == StorageTier.DEVICE, \
+            "the retry-lane spill stole a neighbor's working set"
+        # B is now under share (device_bytes 0): the next pass is the
+        # normal global one — A's entry is fair game again
+        spill_for_retry()
+        assert cat.tier_of(h_a) != StorageTier.DEVICE
+        cat.remove(h_a)
+        cat.remove(h_b)
+    finally:
+        lifecycle.adopt_context(None)
+        m.release(b)
+        m.release(a)
+        reset_buffer_catalog()
+        reset_memory_budget()
+
+
+# ---------------------------------------------------------------------------
+# semaphore fairness (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+def test_semaphore_grants_priority_then_fifo_with_aging():
+    """N waiter threads across two simulated queries + one releaser:
+    grants follow (priority, FIFO seq) with the AGING_EVERY-th grant
+    going to the oldest waiter — deterministic ordering, never timing.
+    Batch waiters are granted (no starvation) even though interactive
+    waiters keep arriving behind them."""
+    sem = reset_tpu_semaphore(1)
+    assert sem.acquire_if_necessary(100)  # grant #1: pool is now empty
+    priorities = ["batch", "interactive", "batch", "interactive",
+                  "interactive", "interactive"]
+    order = []
+    threads = []
+
+    def waiter(task_id, prio):
+        ctx = lifecycle.QueryContext()
+        ctx.workload_ticket = workload.Ticket(prio)
+        lifecycle.adopt_context(ctx)
+        try:
+            assert sem.acquire_if_necessary(task_id)
+            order.append(task_id)
+            sem.release_if_necessary(task_id)
+        finally:
+            lifecycle.adopt_context(None)
+
+    for i, prio in enumerate(priorities):
+        t = threading.Thread(target=waiter, args=(i + 1, prio),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+        # registration order IS the FIFO seq: confirm this waiter is in
+        # line before starting the next (state wait, not a sleep race)
+        deadline = time.monotonic() + 10
+        while len(sem._pool._waiters) < i + 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(sem._pool._waiters) == i + 1
+
+    sem.release_if_necessary(100)
+    for t in threads:
+        t.join(timeout=15)
+        assert not t.is_alive(), "a waiter starved"
+    # seqs 2..7 (the releaser's uncontended acquire took seq 1 and
+    # grant #1). grants 2,3: (rank, seq) -> tasks 2, 4; grant #4
+    # (aging) -> oldest = task 1 (batch); grants 5,6 -> tasks 5, 6;
+    # grant 7 -> task 3 (batch)
+    assert order == [2, 4, 1, 5, 6, 3]
+    assert sem.available == 1
+
+
+def test_semaphore_waiter_gives_up_cleanly():
+    """A cancelled waiter leaves the fair queue; the permit goes to the
+    next in line, not to a ghost."""
+    sem = reset_tpu_semaphore(1)
+    assert sem.acquire_if_necessary(1)
+    stop = threading.Event()
+    got = []
+
+    def cancelled_waiter():
+        assert sem.acquire_if_necessary(2, cancel=stop.is_set) is False
+
+    def patient_waiter():
+        assert sem.acquire_if_necessary(3)
+        got.append(3)
+        sem.release_if_necessary(3)
+
+    t1 = threading.Thread(target=cancelled_waiter, daemon=True)
+    t1.start()
+    deadline = time.monotonic() + 10
+    while len(sem._pool._waiters) < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    t2 = threading.Thread(target=patient_waiter, daemon=True)
+    t2.start()
+    while len(sem._pool._waiters) < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    stop.set()
+    t1.join(timeout=10)
+    assert not t1.is_alive()
+    sem.release_if_necessary(1)
+    t2.join(timeout=10)
+    assert not t2.is_alive() and got == [3]
+    assert sem.available == 1
+
+
+# ---------------------------------------------------------------------------
+# heartbeat purge (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_purges_long_dead_peers_and_recycles_slots(spy):
+    from spark_rapids_tpu.parallel.heartbeat import HeartbeatManager
+    m = HeartbeatManager(timeout_s=0.03, purge_timeout_s=0.1)
+    m.register("e1")
+    m.register("e2")
+    slot_e1 = m._peers["e1"].slot
+    time.sleep(0.05)
+    m.heartbeat("e2")  # e2 stays alive (silent 0.05 < purge 0.1)
+    assert m.dead_peers() == ["e1"]  # dead but not yet purged
+    time.sleep(0.06)  # e1 now silent ~0.11 > purge_timeout_s
+    m.heartbeat("e2")
+    # e1 silent past purge_timeout_s: forgotten entirely, its slot free
+    assert m.dead_peers() == []
+    assert "e1" not in m._peers and m._free_slots == [slot_e1]
+    # re-registration after purge is clean (the _register_locked
+    # contract): first beat == registration, recycled slot
+    peers = m.heartbeat("e1")
+    assert [p.executor_id for p in peers] == ["e2"]
+    assert m._peers["e1"].slot == slot_e1 and m._free_slots == []
+    assert set(m.live_peers()) == {"e1", "e2"}
+    # registry stays bounded under churn: slots never exceed the peak
+    # concurrent population
+    assert m._next_slot == 2
+    # a peer whose death was never polled still gets its ONE peer_dead
+    # on the purge — and a peer that beats after crossing the purge
+    # threshold is NOT purged by its own beat (no inverted transition
+    # event for a peer that just proved alive)
+    time.sleep(0.11)  # both now silent past purge_timeout_s
+    spy.clear()
+    m.heartbeat("e1")
+    assert {e["executor_id"]
+            for e in _kinds(spy, "peer_dead")} == {"e2"}
+    assert "e1" in m._peers and "e2" not in m._peers
+
+
+# ---------------------------------------------------------------------------
+# concurrency acceptance drive (tier-1, deterministic)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def storm_files(tmp_path_factory):
+    """Per-lane parquet inputs + numpy oracles for the storm drive —
+    the PR 3/4 proven forced-spill shape (parquet scan -> filter ->
+    join -> agg -> sort holds join/coalesce staging spillable across
+    device calls, unlike a from_pydict scan)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    d = tmp_path_factory.mktemp("storm_q")
+    lanes = []
+    for seed in range(8):
+        rng = np.random.default_rng(seed)
+        n_l, n_o = 2000, 500
+        l_key = rng.integers(0, n_o, n_l)
+        l_val = rng.random(n_l) * 100.0
+        l_flag = rng.integers(0, 4, n_l)
+        o_flag = rng.integers(0, 10, n_o)
+        lp = str(d / f"lines-{seed}.parquet")
+        op = str(d / f"orders-{seed}.parquet")
+        pq.write_table(pa.table({
+            "l_key": pa.array(l_key, pa.int64()),
+            "l_val": pa.array(l_val, pa.float64()),
+            "l_flag": pa.array(l_flag, pa.int64())}), lp,
+            row_group_size=512)
+        pq.write_table(pa.table({
+            "o_key": pa.array(np.arange(n_o), pa.int64()),
+            "o_flag": pa.array(o_flag, pa.int64())}), op,
+            row_group_size=128)
+        keep = (l_flag != 0) & (o_flag[l_key] < 5)
+        oracle = {}
+        for k, v in zip(l_key[keep], l_val[keep]):
+            s, c = oracle.get(int(k), (0.0, 0))
+            oracle[int(k)] = (s + float(v), c + 1)
+        lanes.append((lp, op, oracle))
+    return lanes
+
+
+def _run_storm_query(settings, lane):
+    """scan -> filter -> join -> agg -> sort through the session."""
+    from spark_rapids_tpu.api.functions import col, lit
+    lp, op, _ = lane
+    sess = TpuSession(settings)
+    lines = sess.read_parquet(lp).filter(col("l_flag") != lit(0))
+    orders = sess.read_parquet(op).filter(col("o_flag") < lit(5))
+    j = lines.join(orders, left_on=["l_key"], right_on=["o_key"])
+    agg = j.group_by("l_key").agg((F.sum("l_val"), "rev"),
+                                  (F.count(), "cnt"))
+    return agg.sort(("rev", False)).collect()
+
+
+def _assert_matches_oracle(rows, oracle, label):
+    """Keys/counts bit-exact, float sums 1e-9-relative: under a
+    forced-spill budget OOM-retry SPLIT points depend on thread
+    interleaving, so float reduction order may differ — the engine's
+    documented improvedFloatOps divergence class."""
+    got = {int(k): (rev, int(cnt)) for k, rev, cnt in rows}
+    assert set(got) == set(oracle), label
+    for k, (rev, cnt) in got.items():
+        o_rev, o_cnt = oracle[k]
+        assert cnt == o_cnt, (label, k)
+        assert abs(rev - o_rev) <= 1e-9 * max(abs(o_rev), 1.0), \
+            (label, k)
+
+
+STORM = dict(WL, **{
+    "spark.rapids.tpu.workload.maxConcurrentQueries": "2",
+    "spark.rapids.tpu.workload.queueDepth": "8",
+    "spark.rapids.sql.batchSizeBytes": str(16 * 1024),
+    "spark.rapids.sql.broadcastSizeThreshold": "-1",
+    # two admitted lanes share the forced-spill budget: peaks depend
+    # on interleaving, so give the OOM lane more attempts (with a real
+    # backoff) to wait a neighbor's release out instead of exhausting
+    "spark.rapids.sql.retry.maxAttempts": "50",
+    "spark.rapids.tpu.retry.backoffMs": "5",
+})
+
+
+def test_eight_concurrent_queries_match_single_threaded_oracle(
+        spy, storm_files):
+    """Acceptance criterion: 8 queries from 8 threads under a
+    forced-spill device budget with the governor on all complete and
+    match the single-threaded oracle; zero leaked threads; budget and
+    catalog counters restored after the storm."""
+    pre = _threads()
+    try:
+        reset_buffer_catalog()
+        # one lane peaks ~60 KiB of staged spillables; 112 KiB forces
+        # the two admitted lanes to spill against each other (probed
+        # stable: every lane converges, spill bites every run)
+        reset_memory_budget(112 * 1024)
+        used_before = memory_budget().used
+        entries_before = buffer_catalog().num_entries()
+        results = [None] * 8
+
+        def lane(i):
+            try:
+                results[i] = _run_storm_query(STORM, storm_files[i])
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                results[i] = e
+
+        threads = [threading.Thread(target=lane, args=(i,), daemon=True)
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+            assert not t.is_alive(), "a lane wedged"
+        for i in range(8):
+            assert not isinstance(results[i], BaseException), results[i]
+            _assert_matches_oracle(results[i], storm_files[i][2],
+                                   f"lane {i}")
+        # the storm actually contended: every lane was admitted, some
+        # had to queue behind the 2 slots, none was shed
+        cnt = workload.counters()
+        assert cnt["admitted"] == 8 and cnt["shed"] == 0
+        assert cnt["queued"] >= 1, "no queue residency: no contention"
+        assert memory_budget().spill_requests > 0, \
+            "budget never hit pressure — the forced-spill drive lost " \
+            "its teeth"
+        buffer_catalog().drain_writeback()
+        assert memory_budget().used == used_before, "leaked budget"
+        assert buffer_catalog().num_entries() == entries_before, \
+            "leaked catalog entries"
+        assert workload.snapshot()["admitted"] == 0
+        # the catalog's singleton writer daemon is long-lived by
+        # design; stop it so the leak check sees only true leaks
+        buffer_catalog().shutdown_writer()
+        assert _threads() <= pre, "storm leaked threads"
+    finally:
+        reset_buffer_catalog()
+        reset_memory_budget()
+
+
+def test_queue_depth_exceeded_sheds_while_survivors_stay_correct(spy):
+    """Acceptance criterion: with queueDepth exceeded, shed queries
+    raise QueryAdmissionError fast while the admitted survivors finish
+    correct. Deterministic: the slot-holder blocks on an event, each
+    arrival's queue state is confirmed before the next."""
+    release = threading.Event()
+    settings = dict(WL, **{
+        "spark.rapids.tpu.workload.maxConcurrentQueries": "1",
+        "spark.rapids.tpu.workload.queueDepth": "1",
+        "spark.rapids.sql.batchSizeBytes": "4k"})
+    sess1 = TpuSession(settings)
+    m = workload.manager()
+
+    def blocking_fn(it):
+        for pdf in it:
+            assert release.wait(60), "test driver never released"
+            yield pdf
+
+    df1 = sess1.from_pydict({"a": list(range(512))}, Schema.of(a=LONG),
+                            batch_rows=128)
+    out = {}
+
+    def q1():
+        out["q1"] = df1.map_in_pandas(
+            blocking_fn, Schema.of(a=LONG)).collect()
+
+    def q2():
+        out["q2"] = sorted(
+            TpuSession(settings).from_pydict(
+                {"z": [1, 2, 3]}, Schema.of(z=LONG))
+            .agg((F.sum("z"), "s")).collect())
+
+    t1 = threading.Thread(target=q1, daemon=True)
+    t1.start()
+    deadline = time.monotonic() + 30
+    while m.admitted_count() < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert m.admitted_count() == 1, "q1 never took the slot"
+    t2 = threading.Thread(target=q2, daemon=True)
+    t2.start()
+    while m.queued_count() < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert m.queued_count() == 1, "q2 never queued"
+    # the queue is full: the next arrival is shed FAST on this thread
+    t0 = time.monotonic()
+    with pytest.raises(QueryAdmissionError) as ei:
+        TpuSession(settings).from_pydict(
+            {"w": [9]}, Schema.of(w=LONG)).agg((F.sum("w"), "s")).collect()
+    assert time.monotonic() - t0 < 5.0, "shed was not fast"
+    assert ei.value.reason == "queue_full" and ei.value.retry_after_ms > 0
+    assert _kinds(spy, "query_shed")[0]["reason"] == "queue_full"
+    # survivors complete correct
+    release.set()
+    t1.join(timeout=60)
+    t2.join(timeout=60)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert sorted(out["q1"]) == [(i,) for i in range(512)]
+    assert out["q2"] == [(6,)]
+    assert workload.snapshot()["admitted"] == 0
+    assert workload.counters()["shed"] == 1
+
+
+def test_governed_session_health_and_admission_events(spy):
+    sess = TpuSession(dict(WL))
+    df = sess.from_pydict({"a": [1, 2, 3, 4]}, Schema.of(a=LONG))
+    assert df.agg((F.sum("a"), "s")).collect() == [(10,)]
+    h = sess.health()
+    assert h["workload"]["queue_depth"] == 0
+    assert h["workload"]["admitted"] == 0
+    assert h["workload"]["counters"]["admitted"] == 1
+    evs = _kinds(spy, "query_admitted")
+    assert len(evs) == 1 and evs[0]["priority"] == "interactive"
+    # priority class is a session/query property
+    sess_b = TpuSession(dict(WL, **{
+        "spark.rapids.tpu.workload.priority": "batch"}))
+    dfb = sess_b.from_pydict({"a": [5]}, Schema.of(a=LONG))
+    assert dfb.agg((F.sum("a"), "s")).collect() == [(5,)]
+    assert _kinds(spy, "query_admitted")[-1]["priority"] == "batch"
+
+
+# ---------------------------------------------------------------------------
+# tooling: bench flags + profile_report roll-up
+# ---------------------------------------------------------------------------
+
+def test_bench_concurrency_flag(monkeypatch):
+    import bench
+    monkeypatch.setattr(bench, "_CONCURRENCY", 1)
+    monkeypatch.setattr(bench, "_workload_prev", None)
+    assert bench.maybe_concurrency(["bench.py"]) is None
+    # bad argv: the usage-error JSON convention, never a traceback
+    with pytest.raises(SystemExit):
+        bench.maybe_concurrency(["bench.py", "--concurrency"])
+    with pytest.raises(SystemExit):
+        bench.maybe_concurrency(["bench.py", "--concurrency", "three"])
+    with pytest.raises(SystemExit):
+        bench.maybe_concurrency(["bench.py", "--concurrency", "0"])
+    assert bench.maybe_concurrency(
+        ["bench.py", "--concurrency", "3"]) == 3
+    rec = bench.workload_attribution()
+    assert rec["concurrency"] == 3
+    assert set(rec) >= {"queued", "admitted", "shed", "quota_spills"}
+    # deltas, not cumulative totals
+    assert bench.workload_attribution()["admitted"] == 0
+    # guarded_run admits every iteration through the governor
+    seen = {}
+
+    def probe():
+        seen["ticket"] = workload.current_ticket() is not None
+        return 7
+
+    assert bench.guarded_run(probe) == 7
+    assert seen["ticket"] is True
+    assert bench.workload_attribution()["admitted"] == 1
+    # run_concurrent fans a worker across the lane threads and
+    # re-raises the first failure
+    assert sorted(bench.run_concurrent(lambda i: i)) == [0, 1, 2]
+
+    def boom(i):
+        raise ValueError("lane died")
+
+    with pytest.raises(ValueError):
+        bench.run_concurrent(boom)
+
+
+def test_profile_report_workload_rollup():
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    import profile_report
+    evs = [
+        {"kind": "query_queued", "priority": "batch"},
+        {"kind": "query_admitted", "wait_ms": 12},
+        {"kind": "query_admitted", "wait_ms": 0},
+        {"kind": "query_shed", "reason": "queue_full"},
+        {"kind": "query_shed", "reason": "breaker_open"},
+        {"kind": "quota_spill", "need": 1, "quota": 2, "freed": 3},
+    ]
+    report = profile_report.build_report(evs)
+    assert "workload admissions: 2 (1 queued, max wait 12ms)" in report
+    assert "queries shed: 2 (breaker_open:1, queue_full:1)" in report
+    assert "quota spills: 1" in report
+
+
+# ---------------------------------------------------------------------------
+# slow tier: concurrent chaos soak
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_concurrent_chaos_converges(storm_files):
+    """4 threads x seeded 5% faults x workload on: the governor
+    composes with every recovery lane — per-lane results equal the
+    fault-free oracle, zero leaked threads, budget/catalog restored."""
+    pre = _threads()
+    settings = dict(STORM, **{
+        "spark.rapids.tpu.workload.maxConcurrentQueries": "2",
+        "spark.rapids.tpu.task.maxAttempts": "20"})
+    faults.install(";".join(
+        part + ",max=2" for part in
+        faults.uniform_spec(0.05, seed=3).split(";")))
+    try:
+        reset_buffer_catalog()
+        reset_memory_budget(112 * 1024)
+        used_before = memory_budget().used
+        results = [None] * 4
+
+        def lane(i):
+            try:
+                results[i] = _run_storm_query(settings, storm_files[i])
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                results[i] = e
+
+        threads = [threading.Thread(target=lane, args=(i,), daemon=True)
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "a chaos lane wedged"
+        for i in range(4):
+            assert not isinstance(results[i], BaseException), results[i]
+            _assert_matches_oracle(results[i], storm_files[i][2],
+                                   f"chaos lane {i}")
+        buffer_catalog().drain_writeback()
+        assert memory_budget().used == used_before
+        buffer_catalog().shutdown_writer()
+        assert _threads() <= pre, "chaos storm leaked threads"
+    finally:
+        faults.install(None)
+        reset_buffer_catalog()
+        reset_memory_budget()

@@ -184,6 +184,29 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
         extras.append(f"recovery lanes: {n_part} partition-granular "
                       f"recompute(s), {n_task} whole-plan "
                       "re-execution(s)")
+    # workload-governor roll-up (ISSUE 7): admission flow, sheds by
+    # reason, and quota-triggered self-spills
+    n_adm = sum(1 for e in events if e.get("kind") == "query_admitted")
+    n_que = sum(1 for e in events if e.get("kind") == "query_queued")
+    sheds = [e for e in events if e.get("kind") == "query_shed"]
+    if n_adm or n_que or sheds:
+        waits = [e.get("wait_ms") or 0 for e in events
+                 if e.get("kind") == "query_admitted"]
+        extras.append(
+            f"workload admissions: {n_adm} ({n_que} queued, max wait "
+            f"{max(waits) if waits else 0}ms)")
+    if sheds:
+        by_reason: Dict[str, int] = {}
+        for e in sheds:
+            by_reason[e.get("reason", "?")] = \
+                by_reason.get(e.get("reason", "?"), 0) + 1
+        detail = ", ".join(f"{r}:{n}"
+                           for r, n in sorted(by_reason.items()))
+        extras.append(f"queries shed: {len(sheds)} ({detail})")
+    n_quota = sum(1 for e in events if e.get("kind") == "quota_spill")
+    if n_quota:
+        extras.append(f"quota spills: {n_quota} "
+                      f"(over-share queries spilled their own entries)")
     n_integ = sum(1 for e in events if e.get("kind") == "integrity_fail")
     if n_integ:
         extras.append(f"integrity quarantines: {n_integ}")
