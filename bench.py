@@ -187,6 +187,28 @@ def lifecycle_attribution():
     return out
 
 
+#: gather-engine counter snapshot at the previous gather_attribution()
+#: call (process-cumulative, reported as per-record deltas like chaos)
+_gather_prev = None
+
+
+def gather_attribution():
+    """{"gather": ...} block for each BENCH record: materializing row
+    gathers this lane dispatched, how many rode a packed (multi-column)
+    row gather, and the estimated bytes moved (ops/gather.py counters,
+    as deltas since the previous record). A TPU round reads this next
+    to the q3 throughput to attribute a delta to the gather engine."""
+    global _gather_prev
+    from spark_rapids_tpu.ops import gather as gather_engine
+    cur = gather_engine.counters()
+    prev = _gather_prev if _gather_prev is not None else {}
+    _gather_prev = cur
+    # pallas_count distinguishes DMA-kernel-served gathers from the XLA
+    # fallback — without it a throughput delta can't be attributed
+    return {k: cur[k] - prev.get(k, 0)
+            for k in ("count", "packed_count", "pallas_count", "bytes")}
+
+
 #: counter snapshot at the previous chaos_attribution() call — the
 #: underlying counters are process-cumulative, each BENCH record must
 #: report only ITS OWN lane's deltas
@@ -558,6 +580,7 @@ def main():
         "pipeline": pipeline_attribution(),
         "lifecycle": lifecycle_attribution(),
         "workload": workload_attribution(),
+        "gather": gather_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:
@@ -724,6 +747,7 @@ def q3_bench():
         "pipeline": pipeline_attribution(),
         "lifecycle": lifecycle_attribution(),
         "workload": workload_attribution(),
+        "gather": gather_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:

@@ -112,6 +112,8 @@ BROADCAST_TIME = "broadcastTime"
 PIPELINE_WAIT = "pipelineWaitNs"
 PIPELINE_FULL_WAIT = "pipelineFullWaitNs"
 PIPELINE_WALL = "pipelineWallNs"
+NUM_GATHERS = "numGathers"
+GATHER_TIME = "gatherTimeNs"
 
 #: the closed set of metric names execs may register — one name, one
 #: meaning, exactly like the reference's GpuMetric companion object.
@@ -123,6 +125,7 @@ CANONICAL_METRICS = frozenset({
     PEAK_DEVICE_MEMORY, NUM_TASKS_FALL_BACKED, SPILL_TIME, PARTITION_SIZE,
     SHUFFLE_WRITE_TIME, SHUFFLE_READ_TIME, BROADCAST_TIME,
     PIPELINE_WAIT, PIPELINE_FULL_WAIT, PIPELINE_WALL,
+    NUM_GATHERS, GATHER_TIME,
 })
 
 #: per-operator instance ids for event/span attribution (two
@@ -139,6 +142,12 @@ MetricSpec = Union[str, Tuple[str, int]]
 PIPELINE_STAGE_METRICS = ((PIPELINE_WAIT, MODERATE),
                           (PIPELINE_FULL_WAIT, MODERATE),
                           (PIPELINE_WALL, MODERATE))
+
+#: the metric pair every gather-engine-wired exec registers (include in
+#: additional_metrics(); bind with ops.gather.GatherTracker): the
+#: structural count of materializing row gathers per execution and the
+#: wall-ns of the gather-bearing kernel dispatches
+GATHER_METRICS = ((NUM_GATHERS, MODERATE), (GATHER_TIME, MODERATE))
 
 
 class TpuExec:

@@ -18,7 +18,8 @@ from ..memory.retry import split_in_half_by_rows, with_retry
 from ..memory.spillable import SpillableBatch
 from ..ops.basic import active_mask, compact_columns, sanitize, slice_rows
 from ..types import LongType, Schema, StructField
-from .base import (NUM_INPUT_BATCHES, NUM_INPUT_ROWS, OP_TIME,
+from .base import (GATHER_METRICS, GATHER_TIME, NUM_GATHERS,
+                   NUM_INPUT_BATCHES, NUM_INPUT_ROWS, OP_TIME,
                    PIPELINE_STAGE_METRICS, TpuExec)
 
 
@@ -234,10 +235,16 @@ class FilterExec(TpuExec):
         self.condition = condition
         self._bound = resolve(condition, child.output_schema)
         self._jit = jax.jit(self._kernel)
+        from ..ops.gather import GatherTracker
+        self._gather_track = GatherTracker(self.metrics[NUM_GATHERS],
+                                           self.metrics[GATHER_TIME])
 
     @property
     def output_schema(self) -> Schema:
         return self.child.output_schema
+
+    def additional_metrics(self):
+        return GATHER_METRICS
 
     def _kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
         pred = self._bound.columnar_eval(batch)
@@ -248,21 +255,26 @@ class FilterExec(TpuExec):
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         op_time = self.metrics[OP_TIME]
-        for batch in self.child.execute():
-            spillable = SpillableBatch.from_batch(batch)
-            try:
-                with op_time.ns_timer():
-                    yield from with_retry(
-                        spillable,
-                        lambda s: self._filter_spillable(s),
-                        split_policy=split_in_half_by_rows)
-            finally:
-                spillable.close()
+        try:
+            for batch in self.child.execute():
+                spillable = SpillableBatch.from_batch(batch)
+                try:
+                    with op_time.ns_timer():
+                        yield from with_retry(
+                            spillable,
+                            lambda s: self._filter_spillable(s),
+                            split_policy=split_in_half_by_rows)
+                finally:
+                    spillable.close()
+        finally:
+            self._gather_track.emit_event(type(self).__name__,
+                                          self._op_id)
 
     def _filter_spillable(self, s: SpillableBatch) -> ColumnarBatch:
         batch = s.get_batch()
         try:
-            return self._jit(batch)
+            with self._gather_track.observe((batch.capacity,)):
+                return self._jit(batch)
         finally:
             s.release()
 

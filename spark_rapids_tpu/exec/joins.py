@@ -36,7 +36,8 @@ from ..ops.join import (
     probe_counts, unmatched_indices, verify_pairs,
 )
 from ..types import BooleanType, Schema, StructField
-from .base import BUILD_TIME, DEBUG, JOIN_TIME, NUM_INPUT_BATCHES, TpuExec
+from .base import (BUILD_TIME, DEBUG, GATHER_METRICS, GATHER_TIME,
+                   JOIN_TIME, NUM_GATHERS, NUM_INPUT_BATCHES, TpuExec)
 from .basic import bind_projection, eval_projection, projection_schema
 from .coalesce import concat_batches
 
@@ -53,27 +54,14 @@ def _gather_batch(columns: Sequence[Column], idx, n,
     their output byte bucket from the measured join byte need — the input
     bucket silently truncates payloads once output bytes exceed it.
 
-    Fixed-width columns ride ONE packed row gather (ops/rowpack; XLA's
-    per-gather loop cost dwarfs its per-byte cost on v5e), varlen columns
-    keep the per-column path."""
-    from ..ops.rowpack import (gather_rows, pack_rows, split_packable,
-                               unpack_rows)
-    cap = idx.shape[0]
-    act = active_mask(n, cap)
-    midx = jnp.where(act, idx, -1)
-    caps = byte_caps or (None,) * len(columns)
-    out: List[Optional[Column]] = [None] * len(columns)
-    p_idx, o_idx = split_packable(columns)
-    if len(p_idx) > 1:
-        plan, imat, fmat = pack_rows([columns[i] for i in p_idx])
-        gi, gf = gather_rows(plan, imat, fmat, midx)
-        for j, c in zip(p_idx, unpack_rows(plan, gi, gf)):
-            out[j] = c
-    else:
-        o_idx = sorted(p_idx + o_idx)
-    for j in o_idx:
-        out[j] = gather_column(columns[j], midx, out_byte_capacity=caps[j])
-    return list(out)  # every slot filled by one of the two branches
+    Fixed-width columns ride ONE packed row gather (XLA's per-gather
+    loop cost dwarfs its per-byte cost on v5e), varlen columns keep the
+    per-column path — both routed through the gather engine
+    (ops/gather.gather_batch_columns) so the measured Pallas tier and
+    the structural numGathers accounting cover every join emit."""
+    from ..ops.gather import gather_batch_columns
+    return gather_batch_columns(columns, idx, num_rows=n,
+                                byte_caps=byte_caps)
 
 
 def _is_varsize(c: Column) -> bool:
@@ -154,6 +142,12 @@ class HashJoinExec(TpuExec):
         # (stream_cap, build_cap) -> (cand_cap, s_caps, b_caps): lets a
         # speculation scope skip the per-batch sizing sync (round 4)
         self._size_cache = {}
+        # structural gather accounting (round 8): counts the probe's
+        # materializing row gathers per iteration into numGathers /
+        # gatherTimeNs (trace-time counts memoized per program key)
+        from ..ops.gather import GatherTracker
+        self._gather_track = GatherTracker(self.metrics[NUM_GATHERS],
+                                           self.metrics[GATHER_TIME])
         # per-shape speculative-use counters driving cap decay (round 5)
         self._spec_uses = {}
         # round 5: absorb child Filters into the probe/build kernels as
@@ -210,7 +204,8 @@ class HashJoinExec(TpuExec):
         return Schema(tuple(lf + rf))
 
     def additional_metrics(self):
-        return (BUILD_TIME, JOIN_TIME, (NUM_INPUT_BATCHES, DEBUG))
+        return (BUILD_TIME, JOIN_TIME, (NUM_INPUT_BATCHES, DEBUG)) \
+            + GATHER_METRICS
 
     @property
     def output_grouped_by(self):
@@ -318,17 +313,24 @@ class HashJoinExec(TpuExec):
         build_matched = jnp.zeros((build.capacity,), jnp.bool_)
 
         join_time = self.metrics[JOIN_TIME]
-        for stream_batch in stream_child.execute():
-            with join_time.ns_timer():
-                out, build_matched = self._probe_one(
-                    build, build_batch, stream_batch, build_matched)
-            if out is not None:
-                yield out
+        try:
+            for stream_batch in stream_child.execute():
+                with join_time.ns_timer():
+                    out, build_matched = self._probe_one(
+                        build, build_batch, stream_batch, build_matched)
+                if out is not None:
+                    yield out
 
-        if self._need_build_flags:
-            with join_time.ns_timer():
-                yield self._emit_build_unmatched(build, build_batch,
-                                                 build_matched)
+            if self._need_build_flags:
+                with join_time.ns_timer():
+                    yield self._emit_build_unmatched(build, build_batch,
+                                                     build_matched)
+        finally:
+            # one gather_stats event per execution (the pipeline-event
+            # convention): reconciles with the numGathers metric and
+            # the op_close batch count
+            self._gather_track.emit_event(type(self).__name__,
+                                          self._op_id)
 
     def _counts_kernel(self, build: BuildTable, stream_batch: ColumnarBatch):
         stream_child = self.children[0] if self.build_side == "right" \
@@ -363,17 +365,24 @@ class HashJoinExec(TpuExec):
         expand+verify stage runs as ONE Pallas kernel streaming candidate
         tiles through VMEM (ops/pallas_join.fused_probe_verify) instead
         of separate XLA programs with candidate-level full-width
-        intermediates; the payload gather then happens once, at OUTPUT
-        level, after compaction."""
-        from ..ops.rowpack import (gather_rows, pack_rows, split_packable,
-                                   unpack_rows)
+        intermediates.
+
+        Gather elimination (round 8): BOTH tiers now defer the payload
+        to ONE output-level packed gather per side after compaction —
+        the candidate level touches only key lanes (XLA tier) or
+        nothing (fused tier). Per iteration the emit is one index
+        materialization + one packed payload gather per side, counted
+        structurally by the gather engine (ops/gather) into the
+        numGathers metric."""
+        from ..ops import gather as G
+        from ..ops.rowpack import pack_rows, unpack_rows
         lo, counts, skey_cols = lo_counts
         s_caps = s_caps or (None,) * len(stream_batch.columns)
         b_caps = b_caps or (None,) * len(build.payload)
         scap = stream_batch.capacity
 
-        plan_b, imat_b, fmat_b, kpi, ppi, poi = build.pack
-        n_bkeys = len(kpi)
+        (plan_k, kmat_b, kfmat_b, plan_p, pmat_b, pfmat_b,
+         kpi, ppi, poi) = build.pack
 
         # structural eligibility is static per trace: integer keys on
         # both sides with matching lane widths, i32 candidate space
@@ -396,19 +405,16 @@ class HashJoinExec(TpuExec):
             pair_valid = s_idx >= 0
             b_pos_m = jnp.where(pair_valid, b_pos, -1)
             need_b_row = True  # the kernel emits it in the same pass
-            ok = verified
-            bi_c = bf_c = None
+            ki_c = kf_c = None
         else:
             s_idx, b_pos, total_dev = expand_candidates(lo, counts,
                                                         cand_cap)
             pair_valid = s_idx >= 0
             b_pos_m = jnp.where(pair_valid, b_pos, -1)
 
-            # one candidate-level row gather fetches build keys AND payload
-            bi_c, bf_c = gather_rows(plan_b, imat_b, fmat_b, b_pos_m)
-
-            # --- verify: keys packable on BOTH sides compare via the
-            # packs, the rest via the original per-column gather path ---
+            # --- verify: keys packable on BOTH sides compare via
+            # KEY-ONLY candidate-level row gathers (the payload no
+            # longer rides them), the rest via the per-column path ---
             from ..ops.rowpack import is_packable
             kpi_pos = {ki: pos for pos, ki in enumerate(kpi)}
             pk = [ki for ki in kpi if is_packable(skey_cols[ki])]
@@ -419,14 +425,16 @@ class HashJoinExec(TpuExec):
                 len(pk) < len(skey_cols)
             b_row = gather_column_indices(build.perm, b_pos_m) \
                 if need_b_row else None
-            bk_cand = unpack_rows(plan_b, bi_c, bf_c,
-                                  only=[kpi_pos[ki] for ki in pk]) \
-                if pk else []
             ok = pair_valid
+            ki_c = kf_c = None
             if pk:
+                ki_c, kf_c = G.gather_rows(plan_k, kmat_b, kfmat_b,
+                                           b_pos_m)
+                bk_cand = unpack_rows(plan_k, ki_c, kf_c,
+                                      only=[kpi_pos[ki] for ki in pk])
                 plan_sk, imat_sk, fmat_sk = pack_rows(
                     [skey_cols[ki] for ki in pk])
-                ski_c, skf_c = gather_rows(
+                ski_c, skf_c = G.gather_rows(
                     plan_sk, imat_sk, fmat_sk,
                     jnp.where(pair_valid, s_idx, -1))
                 sk_cand = unpack_rows(plan_sk, ski_c, skf_c)
@@ -482,9 +490,11 @@ class HashJoinExec(TpuExec):
             cols = _gather_batch(stream_batch.columns, perm, n)
             return ColumnarBatch(cols, n, self.output_schema), build_matched
 
-        # --- compact verified pairs (and append the stream/build row maps
-        # as extra lanes so they ride the same row gather) ---
-        grouped_emit = jt == INNER and len(kpi) == len(skey_cols)
+        # --- compact verified pairs ---
+        # (pk == kpi whenever every key is fixed-width, the same
+        # condition output_grouped_by promises grouping under)
+        grouped_emit = jt == INNER and len(kpi) == len(skey_cols) \
+            and (fused or len(pk) == len(kpi))
         if grouped_emit:
             # key-grouped emission (round 5): carry the packed build-key
             # lanes as extra sort keys so equal join keys land contiguous
@@ -505,17 +515,19 @@ class HashJoinExec(TpuExec):
                 klanes = [jnp.where(kflag, ln[safe_c], jnp.uint32(0))
                           for ln in build.key_lanes[0]]
             else:
-                nvl = plan_b.n_valid_lanes
+                # key lanes from the candidate-level KEY pack (already
+                # gathered for the verify above)
+                nvl = plan_k.n_valid_lanes
                 klanes = []
-                for ci in kpi:
-                    kind, lane = plan_b.kinds[ci]
+                for pos in range(len(kpi)):
+                    kind, lane = plan_k.kinds[pos]
                     if kind == "f64":
-                        klanes.append(bf_c[:, lane])
+                        klanes.append(kf_c[:, lane])
                     elif kind == "w2":
-                        klanes.append(bi_c[:, nvl + lane])
-                        klanes.append(bi_c[:, nvl + lane + 1])
+                        klanes.append(ki_c[:, nvl + lane])
+                        klanes.append(ki_c[:, nvl + lane + 1])
                     else:
-                        klanes.append(bi_c[:, nvl + lane])
+                        klanes.append(ki_c[:, nvl + lane])
             iota_c = jnp.arange(cand_cap, dtype=jnp.int32)
             res = jax.lax.sort(
                 ((~kflag).astype(jnp.uint32), *klanes, iota_c),
@@ -524,17 +536,10 @@ class HashJoinExec(TpuExec):
             n_pairs = jnp.sum(kflag, dtype=jnp.int32)
         else:
             perm_c, n_pairs = compaction_order(verified, total_dev)
-        if fused:
-            # compact only the 3 index lanes; the full-width payload
-            # gather happens ONCE, at output level, below
-            lane_mat = jnp.stack([s_idx, b_row, b_pos_m], axis=1)
-            cand_mat = None
-        else:
-            extra = [jax.lax.bitcast_convert_type(s_idx, jnp.uint32)[:, None]]
-            if need_b_row:
-                extra.append(
-                    jax.lax.bitcast_convert_type(b_row, jnp.uint32)[:, None])
-            cand_mat = jnp.concatenate([bi_c] + extra, axis=1)
+        # compact ONLY the 2-3 index lanes (round 8, BOTH tiers); the
+        # full-width payload gather happens ONCE, at output level, below
+        lanes = [s_idx, b_pos_m] + ([b_row] if need_b_row else [])
+        lane_mat = jnp.stack(lanes, axis=1)
 
         if stream_preserved:
             smatched = matched_flags(verified, s_idx, scap)
@@ -565,41 +570,27 @@ class HashJoinExec(TpuExec):
             tail = None
             un_part = None
 
-        if fused:
-            safe_sel = jnp.clip(bsel, 0, cand_cap - 1)
-            g3 = lane_mat[safe_sel]              # one 3-lane row gather
-            s_map = jnp.where(from_pairs, g3[:, 0], -1)
-            if tail is not None:
-                s_map = jnp.where(tail, un_part, s_map)
-            b_map = jnp.where(from_pairs, g3[:, 1], -1)
-            b_pos_out = jnp.where(from_pairs, g3[:, 2], -1)
-            # output-level packed gather: only SURVIVING pairs move the
-            # full payload width (the XLA tier pays this at candidate
-            # level and again at output level)
-            bmat_out, bfmat_out = gather_rows(plan_b, imat_b, fmat_b,
-                                              b_pos_out)
-        else:
-            bmat_out, bfmat_out = gather_rows(plan_b, cand_mat, bf_c, bsel)
-            s_lane = jax.lax.bitcast_convert_type(
-                bmat_out[:, plan_b.n_ilanes], jnp.int32)
-            s_map = jnp.where(from_pairs, s_lane, -1)
-            if tail is not None:
-                s_map = jnp.where(tail, un_part, s_map)
-            if need_b_row:
-                b_lane = jax.lax.bitcast_convert_type(
-                    bmat_out[:, plan_b.n_ilanes + 1], jnp.int32)
-                b_map = jnp.where(from_pairs, b_lane, -1)
-            else:
-                b_map = None
+        # ONE index materialization: the compacted selection reads only
+        # the index lanes; out-of-range bsel rows read row 0 and are
+        # masked by from_pairs
+        g = G.gather_lane_matrix(lane_mat, bsel)
+        s_map = jnp.where(from_pairs, g[:, 0], -1)
+        if tail is not None:
+            s_map = jnp.where(tail, un_part, s_map)
+        b_pos_out = jnp.where(from_pairs, g[:, 1], -1)
+        b_map = jnp.where(from_pairs, g[:, 2], -1) if need_b_row else None
 
-        # build-side output columns: packable from the compacted matrix,
-        # varlen via b_map
+        # build-side output columns: ONE output-level packed payload
+        # gather — only SURVIVING pairs move the full payload width
+        # (before round 8 the XLA tier paid it at candidate level and
+        # again at output level); varlen columns ride b_map
         bcols: List[Optional[Column]] = [None] * len(build.payload)
-        pay_cols = unpack_rows(
-            plan_b, bmat_out, bfmat_out,
-            only=range(n_bkeys, n_bkeys + len(ppi)))
-        for j, c in zip(ppi, pay_cols):
-            bcols[j] = c
+        if ppi:
+            pmat_out, pfmat_out = G.gather_rows(plan_p, pmat_b, pfmat_b,
+                                                b_pos_out)
+            for j, c in zip(ppi, unpack_rows(plan_p, pmat_out,
+                                             pfmat_out)):
+                bcols[j] = c
         for j in poi:
             bcols[j] = gather_column(build.payload[j], b_map,
                                      out_byte_capacity=b_caps[j])
@@ -674,12 +665,22 @@ class HashJoinExec(TpuExec):
         from ..ops.pallas_tier import fused_tier_enabled
         use_fused = build.key_lanes is not None and fused_tier_enabled(
             "join_probe", (stream_batch.capacity, build.capacity))
-        return self._jit_probe(build, build_batch, stream_batch,
-                               (lo, counts, skey_cols), build_matched,
-                               cand_cap, s_caps, b_caps, use_fused)
+        with self._gather_track.observe(
+                (stream_batch.capacity, build.capacity, cand_cap,
+                 s_caps, b_caps, use_fused)):
+            return self._jit_probe(build, build_batch, stream_batch,
+                                   (lo, counts, skey_cols), build_matched,
+                                   cand_cap, s_caps, b_caps, use_fused)
 
     def _emit_build_unmatched(self, build: BuildTable,
                               build_batch: ColumnarBatch, build_matched):
+        with self._gather_track.observe(("unmatched", build.capacity)):
+            return self._emit_build_unmatched_inner(build, build_batch,
+                                                    build_matched)
+
+    def _emit_build_unmatched_inner(self, build: BuildTable,
+                                    build_batch: ColumnarBatch,
+                                    build_matched):
         # probe flags live in SORTED build space; translate to original
         # rows once per join (perm is a permutation, so the scatter is
         # exact)

@@ -281,15 +281,22 @@ BREAKER_DOMAINS: Dict[str, str] = {
                     "(ops/pallas_fused.py) -> XLA formulation",
     "pallas_join": "fused join-probe Pallas tier (ops/pallas_join.py) "
                    "-> XLA formulation",
+    "pallas_gather": "DMA row-gather Pallas tier (ops/pallas_gather.py) "
+                     "-> XLA packed row gather (ops/rowpack.py)",
+    "pallas_hash": "murmur3 Pallas kernels (ops/pallas_kernels.py) "
+                   "-> XLA elementwise murmur3 (ops/hashing.py)",
     "device_dispatch": "guarded device dispatch (memory/retry.py "
                        "oom_guard) -> advisory: already the guarded "
                        "path; open state surfaces in health()/events",
 }
 
-#: Pallas kernel family (ops/pallas_tier.py) -> breaker domain
+#: Pallas kernel family (ops/pallas_tier.PALLAS_FAMILIES) -> breaker
+#: domain; test_docs_lint asserts every family has an entry
 FAMILY_DOMAINS: Dict[str, str] = {
     "scan_agg": "pallas_fused",
     "join_probe": "pallas_join",
+    "gather": "pallas_gather",
+    "murmur3": "pallas_hash",
 }
 
 BREAKER_STATES = ("closed", "open", "half_open")

@@ -28,7 +28,8 @@ from ..ops.sort import (
     SortOrder, order_key_lanes, sort_batch_columns, string_words_for,
 )
 from ..types import Schema
-from .base import DEBUG, NUM_INPUT_BATCHES, SORT_TIME, TpuExec
+from .base import (DEBUG, GATHER_METRICS, GATHER_TIME, NUM_GATHERS,
+                   NUM_INPUT_BATCHES, SORT_TIME, TpuExec)
 from .coalesce import concat_batches
 
 
@@ -74,13 +75,20 @@ class SortExec(TpuExec):
         self.limit = limit
         # one compiled sort program per (capacity bucket, string words)
         self._jit_sort = jax.jit(self._sort_kernel, static_argnums=(1,))
+        # round 8: fixed-width columns ride INSIDE lax.sort as packed
+        # lanes, so numGathers here counts only the varlen columns'
+        # permutation gathers — the structural proof the sort path needs
+        # no row gathers for fixed-width batches
+        from ..ops.gather import GatherTracker
+        self._gather_track = GatherTracker(self.metrics[NUM_GATHERS],
+                                           self.metrics[GATHER_TIME])
 
     @property
     def output_schema(self) -> Schema:
         return self.child.output_schema
 
     def additional_metrics(self):
-        return (SORT_TIME, (NUM_INPUT_BATCHES, DEBUG))
+        return (SORT_TIME, (NUM_INPUT_BATCHES, DEBUG)) + GATHER_METRICS
 
     def _string_words(self, batch: ColumnarBatch) -> int:
         return string_words_for(batch.columns,
@@ -93,7 +101,8 @@ class SortExec(TpuExec):
 
     def _sort_one(self, batch: ColumnarBatch) -> ColumnarBatch:
         words = self._string_words(batch)
-        out = self._jit_sort(batch, words)
+        with self._gather_track.observe((batch.capacity, words)):
+            out = self._jit_sort(batch, words)
         out = ColumnarBatch(out.columns, batch.num_rows, batch.schema,
                             batch._host_rows)
         if self.limit is not None:
@@ -111,6 +120,13 @@ class SortExec(TpuExec):
         return out
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
+        try:
+            yield from self._execute_sort()
+        finally:
+            self._gather_track.emit_event(type(self).__name__,
+                                          self._op_id)
+
+    def _execute_sort(self) -> Iterator[ColumnarBatch]:
         sort_time = self.metrics[SORT_TIME]
         in_batches = self.metrics[NUM_INPUT_BATCHES]
         runs: List[SpillableBatch] = []

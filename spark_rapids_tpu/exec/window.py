@@ -37,7 +37,8 @@ from ..ops.window import (
     windowed_sum_count,
 )
 from ..types import DoubleType, IntegerType, LongType, Schema, StructField
-from .base import OP_TIME, TpuExec
+from .base import (GATHER_METRICS, GATHER_TIME, NUM_GATHERS, OP_TIME,
+                   TpuExec)
 from .basic import bind_projection, eval_projection, projection_schema
 from .coalesce import concat_batches
 from .sort import resolve_sort_orders
@@ -100,6 +101,9 @@ class WindowExec(TpuExec):
         self._pre_bound = bind_projection(self._pre_exprs, in_schema)
         self._pre_schema = projection_schema(self._pre_exprs, in_schema)
         self._jit_window = jax.jit(self._window_kernel, static_argnums=(1,))
+        from ..ops.gather import GatherTracker
+        self._gather_track = GatherTracker(self.metrics[NUM_GATHERS],
+                                           self.metrics[GATHER_TIME])
         self._jit_lps = None
         self._jit_fpl = None
         self._jit_carry_update = None
@@ -115,6 +119,15 @@ class WindowExec(TpuExec):
             fields.append(StructField(name, we.fn.result_type(in_types)))
         return Schema(tuple(fields))
 
+    def additional_metrics(self):
+        return GATHER_METRICS
+
+    def _dispatch_window(self, batch: ColumnarBatch, words: int
+                         ) -> ColumnarBatch:
+        """The one gather-tracked window-kernel dispatch point."""
+        with self._gather_track.observe((batch.capacity, words)):
+            return self._jit_window(batch, words)
+
     # -- kernel ------------------------------------------------------------
     def _window_kernel(self, batch: ColumnarBatch, words: int
                        ) -> ColumnarBatch:
@@ -127,7 +140,11 @@ class WindowExec(TpuExec):
             SortOrder(s, asc, nf) for s, (asc, nf)
             in zip(self._order_slots, self._order_dirs)]
         perm = sort_permutation(batch.columns, orders, n, cap, words)
-        sorted_cols = [gather_column(c, perm) for c in batch.columns]
+        # round 8: the partition-sort permutation moves the whole batch
+        # through the gather engine — ONE packed row gather for the
+        # fixed-width columns instead of one gather per column
+        from ..ops.gather import gather_batch_columns
+        sorted_cols = gather_batch_columns(batch.columns, perm)
         sorted_parts = [sorted_cols[s] for s in self._part_slots]
         sorted_orders = [sorted_cols[s] for s in self._order_slots]
 
@@ -510,6 +527,13 @@ class WindowExec(TpuExec):
         return int(self._jit_lps(batch, words))
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
+        try:
+            yield from self._execute_window()
+        finally:
+            self._gather_track.emit_event(type(self).__name__,
+                                          self._op_id)
+
+    def _execute_window(self) -> Iterator[ColumnarBatch]:
         """Partition-aware batched drive (replaces the r2 concat-all):
         the pre-projected input streams through the out-of-core sort on
         (partition, order) keys; each sorted chunk is windowed
@@ -534,7 +558,7 @@ class WindowExec(TpuExec):
                 merged = concat_batches(batches, self._pre_schema)
                 words = string_words_for(
                     merged.columns, self._part_slots + self._order_slots)
-                yield self._jit_window(merged, words)
+                yield self._dispatch_window(merged, words)
                 return
 
             orders = [SortOrder(s) for s in self._part_slots] + [
@@ -613,7 +637,7 @@ class WindowExec(TpuExec):
                     tail_n, self._pre_schema)
                 # cur_words stays exact for the prefix slice: reuse it
                 # instead of paying a second measuring sync per chunk
-                yield self._jit_window(ready, cur_words)
+                yield self._dispatch_window(ready, cur_words)
             if not saw:
                 return
             if carry is not None:
@@ -621,4 +645,4 @@ class WindowExec(TpuExec):
             elif held is not None and held.num_rows_host > 0:
                 words = string_words_for(
                     held.columns, self._part_slots + self._order_slots)
-                yield self._jit_window(held, words)
+                yield self._dispatch_window(held, words)

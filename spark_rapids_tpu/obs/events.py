@@ -85,6 +85,10 @@ EVENT_LEVELS: Dict[str, int] = {
     "query_admitted": MODERATE,
     "query_shed": ESSENTIAL,
     "quota_spill": MODERATE,
+    # gather engine (ISSUE 8): one record per wired-exec execution with
+    # its materializing-gather totals (count/packed/pallas/bytes) —
+    # reconciles with the numGathers metric and op_close batch counts
+    "gather_stats": MODERATE,
     "op_open": DEBUG,
     "op_batch": DEBUG,
     "span": DEBUG,

@@ -54,7 +54,13 @@ def gather_column(col: Column, indices, out_valid=None,
     `out_valid` masks output rows (False -> null+inactive slot).
     Out-of-range indices produce invalid rows.
     """
+    from .gather import record as _record_gather
     cap = col.capacity
+    # structural accounting (ISSUE 8): one materializing per-column
+    # gather; no-op unless a wired exec's GatherTracker is observing
+    _record_gather(1, nbytes=int(indices.shape[0])
+                   * (col.data.dtype.itemsize
+                      if type(col) is Column else 4))
     in_range = (indices >= 0) & (indices < cap)
     safe = jnp.where(in_range, indices, 0)
     valid = col.validity[safe] & in_range
@@ -131,23 +137,15 @@ def compact_columns(columns: Sequence[Column], keep, num_rows
 
     Fixed-width columns compact through ONE packed row gather (XLA's
     gather cost on v5e is per-row loop overhead, not bytes — see
-    ops/rowpack); varlen/nested columns keep the per-column path."""
-    from .rowpack import gather_rows, pack_rows, split_packable, unpack_rows
+    ops/rowpack), routed through the gather engine (ops/gather) so the
+    measured Pallas tier and the structural numGathers accounting cover
+    every compaction in the engine; varlen/nested columns keep the
+    per-column path."""
+    from .gather import gather_batch_columns
     perm, new_rows = compaction_order(keep, num_rows)
     cap = keep.shape[0]
     out_valid = active_mask(new_rows, cap)
-    out: list = [None] * len(columns)
-    p_idx, o_idx = split_packable(columns)
-    if len(p_idx) > 1:
-        plan, imat, fmat = pack_rows([columns[i] for i in p_idx])
-        gi, gf = gather_rows(plan, imat, fmat,
-                             jnp.where(out_valid, perm, -1))
-        for j, c in zip(p_idx, unpack_rows(plan, gi, gf)):
-            out[j] = c
-    else:
-        o_idx = sorted(p_idx + o_idx)
-    for j in o_idx:
-        out[j] = gather_column(columns[j], perm, out_valid)
+    out = gather_batch_columns(columns, perm, out_valid=out_valid)
     return tuple(out), new_rows
 
 

@@ -56,11 +56,22 @@ def _fmix(h1, length):
 
 def _use_pallas() -> bool:
     """Static (trace-time) tier choice: the Pallas kernel on real TPU
-    when spark.rapids.tpu.pallas.enabled, else the fused-XLA path."""
+    when spark.rapids.tpu.pallas.enabled, else the fused-XLA path. An
+    open `pallas_hash` circuit breaker (exec/lifecycle.FAMILY_DOMAINS
+    entry for the `murmur3` family, ISSUE 8) demotes NEW traces to the
+    XLA formulation like the fused-tier families."""
     from ..config import PALLAS_ENABLED, active_conf
     from .pallas_kernels import on_tpu
     try:
-        return on_tpu() and active_conf().get(PALLAS_ENABLED)
+        if not (on_tpu() and active_conf().get(PALLAS_ENABLED)):
+            return False
+        # one implementation of breaker-consult + engagement noting
+        # (shared with the fused-tier families)
+        from .pallas_tier import _breaker_allows, _note_engaged
+        if not _breaker_allows("murmur3"):
+            return False
+        _note_engaged("murmur3")
+        return True
     except Exception:  # noqa: BLE001 — conf unavailable during early init
         return False
 

@@ -32,7 +32,10 @@ from ..columnar.column import (
 )
 from .basic import active_mask, compaction_order, gather_column
 from .hashing import murmur3_batch
-from .rowpack import gather_rows, pack_rows, split_packable, unpack_rows
+# row gathers in this module route through ops.gather (tier selection,
+# breaker demotion, numGathers accounting) — do NOT import the raw
+# rowpack.gather_rows here
+from .rowpack import pack_rows, split_packable
 from .strings import string_equal
 
 JOIN_HASH_SEED = 0x5370_6172  # arbitrary fixed seed, 'Spar'
@@ -151,10 +154,14 @@ class BuildTable:
         # (2^B, 2) int32 [lo, hi) per bucket: ONE row gather per probe
         # instead of two offset-table gathers (round 4)
         self.pair_table = pair_table
-        # (plan, imat_sorted, fmat_sorted, key_pack_idx, payload_pack_idx,
-        #  payload_other_idx): every fixed-width key/payload column packed
-        #  into one u32 (+ one f64) matrix in SORTED hash order, so the
-        #  probe's verify+emit is a couple of row gathers (ops/rowpack)
+        # (plan_k, kmat_sorted, kfmat_sorted, plan_p, pmat_sorted,
+        #  pfmat_sorted, key_pack_idx, payload_pack_idx,
+        #  payload_other_idx): fixed-width KEYS and PAYLOAD packed into
+        #  SEPARATE u32 (+ f64) matrices in SORTED hash order (round 8:
+        #  the probe's verify gathers only the key pack at candidate
+        #  level; the payload pack is gathered ONCE, at output level,
+        #  after compaction — the gather-elimination contract asserted
+        #  by the structural numGathers tests)
         self.pack = pack
         # (u32 lane arrays..., i32 combined-validity lane) in SORTED hash
         # order, or None for non-integer keys: the fused Pallas probe
@@ -205,15 +212,25 @@ class BuildTable:
                 sorted_lens = jnp.where(iota < valid_count, lens[perm], 0)
                 prefixes.append(jnp.concatenate(
                     [jnp.zeros((1,), jnp.int64), jnp.cumsum(sorted_lens)]))
-        # pack fixed-width keys + payload into sorted-order matrices
+        # pack fixed-width keys and payload into SEPARATE sorted-order
+        # matrices (round 8): the key pack serves the candidate-level
+        # verify, the payload pack is gathered once at output level.
+        # The permutes route through the gather engine so the measured
+        # Pallas tier can serve the build reorder too.
+        from .gather import gather_rows as routed_gather_rows
         key_pack_idx, _ = split_packable(key_cols)
         payload_pack_idx, payload_other_idx = split_packable(payload)
-        pcols = [key_cols[i] for i in key_pack_idx] + \
-            [payload[i] for i in payload_pack_idx]
-        plan, imat, fmat = pack_rows(pcols)
-        imat_s, fmat_s = gather_rows(plan, imat, fmat, perm)
-        pack = (plan, imat_s, fmat_s, tuple(key_pack_idx),
-                tuple(payload_pack_idx), tuple(payload_other_idx))
+        plan_k, kmat, kfmat = pack_rows([key_cols[i]
+                                         for i in key_pack_idx])
+        plan_p, pmat, pfmat = pack_rows([payload[i]
+                                         for i in payload_pack_idx])
+        kmat_s, kfmat_s = routed_gather_rows(plan_k, kmat, kfmat, perm) \
+            if key_pack_idx else (kmat, kfmat)
+        pmat_s, pfmat_s = routed_gather_rows(plan_p, pmat, pfmat, perm) \
+            if payload_pack_idx else (pmat, pfmat)
+        pack = (plan_k, kmat_s, kfmat_s, plan_p, pmat_s, pfmat_s,
+                tuple(key_pack_idx), tuple(payload_pack_idx),
+                tuple(payload_other_idx))
         key_lanes = None
         kl = int_key_lanes(key_cols) if with_key_lanes else None
         if kl is not None:
@@ -225,21 +242,25 @@ class BuildTable:
 
 
 def _bt_flatten(bt: BuildTable):
-    plan, imat_s, fmat_s, kpi, ppi, poi = bt.pack
+    (plan_k, kmat_s, kfmat_s, plan_p, pmat_s, pfmat_s,
+     kpi, ppi, poi) = bt.pack
     return ((bt.bucket_table, bt.perm, bt.valid_count, bt.num_rows,
              tuple(bt.key_cols), tuple(bt.payload), bt.payload_prefix,
-             bt.pair_table, imat_s, fmat_s, bt.key_lanes),
-            (bt.capacity, plan, kpi, ppi, poi))
+             bt.pair_table, kmat_s, kfmat_s, pmat_s, pfmat_s,
+             bt.key_lanes),
+            (bt.capacity, plan_k, plan_p, kpi, ppi, poi))
 
 
 def _bt_unflatten(aux, children):
-    capacity, plan, kpi, ppi, poi = aux
+    capacity, plan_k, plan_p, kpi, ppi, poi = aux
     (bucket_table, perm, valid_count, num_rows, key_cols, payload,
-     payload_prefix, pair_table, imat_s, fmat_s, key_lanes) = children
+     payload_prefix, pair_table, kmat_s, kfmat_s, pmat_s, pfmat_s,
+     key_lanes) = children
     return BuildTable(bucket_table, perm, valid_count, num_rows,
                       list(key_cols), list(payload), capacity,
                       payload_prefix, pair_table,
-                      (plan, imat_s, fmat_s, kpi, ppi, poi), key_lanes)
+                      (plan_k, kmat_s, kfmat_s, plan_p, pmat_s, pfmat_s,
+                       kpi, ppi, poi), key_lanes)
 
 
 jax.tree_util.register_pytree_node(BuildTable, _bt_flatten, _bt_unflatten)

@@ -21,8 +21,25 @@ from __future__ import annotations
 import json
 import os
 import threading
+import warnings
 from pathlib import Path
 from typing import Dict, Optional, Tuple
+
+#: the closed registry of Pallas kernel families. Every family must
+#: have (a) an exec/lifecycle.FAMILY_DOMAINS entry so the degradation
+#: circuit breakers can demote it, (b) a tools/kern_bench.py bench so
+#: `auto` selection is a measurement, and (c) a row in the docs/perf.md
+#: tier table — tests/test_docs_lint.py lints all three (the registries
+#: drifted silently before measurement-gating existed).
+PALLAS_FAMILIES = ("murmur3", "join_probe", "scan_agg", "gather")
+
+#: kern_bench.json layout version. The records file is rewritten by
+#: tools/kern_bench.py with this stamp; a file from an older layout
+#: (missing or mismatched stamp) is IGNORED LOUDLY instead of silently
+#: mis-selecting tiers against measurements of code that no longer
+#: exists. Bump when a family's bench formulation or the record shape
+#: changes incompatibly.
+KERN_BENCH_SCHEMA = 2
 
 _lock = threading.Lock()
 #: path -> (mtime, {(family, platform, bucket): record}) cache
@@ -66,10 +83,20 @@ def _load_records(path: str) -> Dict:
         with open(path) as f:
             doc = json.load(f)
         index = {}
-        for r in doc.get("records", ()):
-            key = (r["family"], r["platform"],
-                   tuple(r["shape_bucket"]))
-            index[key] = r
+        if doc.get("schema") != KERN_BENCH_SCHEMA:
+            # stale layout: refuse the whole file, loudly — a record
+            # measured against an older kernel/bench formulation must
+            # not flip tiers (ISSUE 8 satellite)
+            warnings.warn(
+                f"ignoring kern_bench records at {path}: schema "
+                f"{doc.get('schema')!r} != {KERN_BENCH_SCHEMA} — "
+                "re-run tools/kern_bench.py to refresh the file",
+                stacklevel=2)
+        else:
+            for r in doc.get("records", ()):
+                key = (r["family"], r["platform"],
+                       tuple(r["shape_bucket"]))
+                index[key] = r
     except (OSError, ValueError, KeyError, TypeError):
         index = {}
     with _lock:

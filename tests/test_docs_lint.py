@@ -159,6 +159,50 @@ def test_robustness_event_kinds_are_registered():
             f"event kind {kind} missing from docs/observability.md")
 
 
+def test_pallas_family_registries_agree():
+    """Every Pallas kernel family (ops/pallas_tier.PALLAS_FAMILIES)
+    appears in (1) lifecycle.FAMILY_DOMAINS so the circuit breakers can
+    demote it, (2) tools/kern_bench.py's BENCHES so `auto` selection is
+    a measurement, and (3) the docs/perf.md tier table — and none of
+    the three registries carries a stale family (ISSUE 8: the three
+    drifted silently before measurement-gating existed)."""
+    import sys
+    from spark_rapids_tpu.exec import lifecycle
+    from spark_rapids_tpu.ops import pallas_tier
+
+    fams = set(pallas_tier.PALLAS_FAMILIES)
+    assert fams == set(lifecycle.FAMILY_DOMAINS), (
+        f"FAMILY_DOMAINS drifted: "
+        f"missing={sorted(fams - set(lifecycle.FAMILY_DOMAINS))} "
+        f"stale={sorted(set(lifecycle.FAMILY_DOMAINS) - fams)}")
+    # every family's breaker domain is a registered breaker
+    for fam, dom in lifecycle.FAMILY_DOMAINS.items():
+        assert dom in lifecycle.BREAKER_DOMAINS, (fam, dom)
+
+    sys.path.insert(0, str(ROOT / "tools"))
+    try:
+        import kern_bench
+    finally:
+        sys.path.pop(0)
+    assert fams == set(kern_bench.BENCHES), (
+        f"kern_bench families drifted: "
+        f"missing={sorted(fams - set(kern_bench.BENCHES))} "
+        f"stale={sorted(set(kern_bench.BENCHES) - fams)}")
+    for fam in fams:
+        assert fam in kern_bench.DEFAULT_SHAPES, fam
+        assert fam in kern_bench.QUICK_SHAPES, fam
+
+    docs = (ROOT / "docs" / "perf.md").read_text()
+    m = re.search(r"## Pallas kernel family tier table\n(.*?)(?:\n## |\Z)",
+                  docs, re.DOTALL)
+    assert m, "docs/perf.md lost its Pallas family tier table"
+    rows = set(re.findall(r"^\|\s*`([a-z_0-9]+)`\s*\|", m.group(1),
+                          re.MULTILINE))
+    assert rows == fams, (
+        f"docs/perf.md tier table drifted: "
+        f"missing={sorted(fams - rows)} stale={sorted(rows - fams)}")
+
+
 def test_additional_metrics_are_canonical_and_unique():
     classes = _all_exec_classes()
     assert len(classes) >= 20  # the walk actually found the exec tree

@@ -345,14 +345,14 @@ def test_fused_tier_auto_requires_a_measurement(tmp_path):
 
     from spark_rapids_tpu.config import RapidsConf, set_active_conf
     from spark_rapids_tpu.ops.pallas_tier import (
-        fused_tier_enabled, shape_bucket)
+        KERN_BENCH_SCHEMA, fused_tier_enabled, shape_bucket)
     set_active_conf(RapidsConf({
         "spark.rapids.tpu.pallas.fusedTier": "auto",
         "spark.rapids.tpu.pallas.fusedTier.benchFile":
             str(tmp_path / "none.json")}))
     assert not fused_tier_enabled("join_probe", (1024, 512))
 
-    rec = {"records": [{
+    rec = {"schema": KERN_BENCH_SCHEMA, "records": [{
         "family": "join_probe", "platform": jax.default_backend(),
         "shape_bucket": list(shape_bucket((1024, 512))),
         "xla_ms": 10.0, "pallas_ms": 2.0}]}

@@ -1,0 +1,380 @@
+"""Interpret-mode property tests for the DMA gather kernel family and
+the gather engine (ISSUE 8): the packed row gather must match the XLA
+formulation bit-for-bit on randomized inputs — null masks, mixed column
+widths, capacity-bucket padding, out-of-range and empty index sets —
+and the engine must produce byte-identical results with the gather tier
+on or off. The gather-count drop is asserted STRUCTURALLY (counts, not
+timing) via the numGathers metric and the gather_stats event log.
+"""
+
+import glob
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.ops import gather as G
+from spark_rapids_tpu.ops.pallas_gather import pallas_gather_rows
+from spark_rapids_tpu.ops.rowpack import gather_rows, pack_rows
+from spark_rapids_tpu.types import (
+    BOOLEAN, BYTE, DOUBLE, FLOAT, INT, LONG, SHORT, Schema, StructField,
+)
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+import kern_bench  # noqa: E402
+
+
+def _col(np_arr, dtype, null_every=0, capacity=None):
+    c = Column.from_numpy(np_arr, dtype,
+                          capacity=capacity or bucket_capacity(len(np_arr)))
+    if null_every:
+        v = np.asarray(c.validity).copy()
+        v[::null_every] = False
+        c = Column(c.data, jnp.asarray(v), dtype)
+    return c
+
+
+def _mixed_cols(rng, n, null_every=5):
+    """One column of every packable width class (bool, i8, i16, i32,
+    i64, f32, f64), nulls sprinkled at different cadences."""
+    return [
+        _col(rng.integers(0, 2, n).astype(bool), BOOLEAN, null_every),
+        _col(rng.integers(-100, 100, n).astype(np.int8), BYTE, 0),
+        _col(rng.integers(-1000, 1000, n).astype(np.int16), SHORT,
+             max(0, null_every - 2)),
+        _col(rng.integers(-(2**28), 2**28, n).astype(np.int32), INT, 3),
+        _col(rng.integers(-(2**60), 2**60, n).astype(np.int64), LONG,
+             null_every),
+        _col(rng.random(n).astype(np.float32), FLOAT, 0),
+        _col(rng.random(n) * 1e6, DOUBLE, 7),
+    ]
+
+
+def _assert_pair_equal(xla, pal):
+    gi_x, gf_x = xla
+    gi_p, gf_p = pal
+    assert np.array_equal(np.asarray(gi_x), np.asarray(gi_p))
+    assert (gf_x is None) == (gf_p is None)
+    if gf_x is not None:
+        # bit-level: the kernel moves f64 as u32 lane pairs
+        assert np.array_equal(
+            np.asarray(gf_x).view(np.uint64),
+            np.asarray(gf_p).view(np.uint64))
+
+
+@pytest.mark.parametrize("seed,n,n_out,oob", [
+    (0, 700, 1500, True),    # duplicates + out-of-range + -1 padding
+    (1, 64, 64, False),      # oob-free permutation-ish set
+    (2, 1, 300, True),       # single-row source
+])
+def test_dma_gather_matches_xla_mixed_widths(seed, n, n_out, oob):
+    rng = np.random.default_rng(seed)
+    cols = _mixed_cols(rng, n)
+    plan, imat, fmat = pack_rows(cols)
+    cap = cols[0].capacity
+    lo = -5 if oob else 0
+    hi = cap + 7 if oob else n
+    idx_np = rng.integers(lo, hi, n_out).astype(np.int32)
+    if oob:
+        idx_np[:: max(1, n_out // 9)] = -1  # capacity-padding slots
+    idx = jnp.asarray(idx_np)
+    _assert_pair_equal(gather_rows(plan, imat, fmat, idx),
+                       pallas_gather_rows(plan, imat, fmat, idx,
+                                          interpret=True))
+
+
+def test_dma_gather_int_only_no_f64_matrix():
+    """No f64 columns -> fmat is None end to end."""
+    rng = np.random.default_rng(3)
+    cols = [_col(rng.integers(0, 99, 500).astype(np.int64), LONG, 4),
+            _col(rng.integers(0, 9, 500).astype(np.int32), INT, 0)]
+    plan, imat, fmat = pack_rows(cols)
+    assert fmat is None
+    idx = jnp.asarray(rng.integers(-3, 600, 800).astype(np.int32))
+    _assert_pair_equal(gather_rows(plan, imat, fmat, idx),
+                       pallas_gather_rows(plan, imat, fmat, idx,
+                                          interpret=True))
+
+
+def test_dma_gather_all_invalid_index_set():
+    """Every index out of range -> all-invalid rows, like the XLA path."""
+    rng = np.random.default_rng(4)
+    cols = _mixed_cols(rng, 128, null_every=0)
+    plan, imat, fmat = pack_rows(cols)
+    idx = jnp.full((256,), -1, jnp.int32)
+    gi_p, gf_p = pallas_gather_rows(plan, imat, fmat, idx, interpret=True)
+    _assert_pair_equal(gather_rows(plan, imat, fmat, idx), (gi_p, gf_p))
+    nv = plan.n_valid_lanes
+    assert not np.asarray(gi_p[:, :nv]).any()  # validity lanes zeroed
+
+
+def test_gather_batch_columns_matches_per_column():
+    """The engine helper's packed path == per-column gather_column for
+    every width class, including the masked tail."""
+    from spark_rapids_tpu.ops.basic import active_mask, gather_column
+    rng = np.random.default_rng(5)
+    n = 400
+    cols = _mixed_cols(rng, n)
+    idx = jnp.asarray(rng.integers(0, n, 512).astype(np.int32))
+    n_rows = jnp.int32(300)
+    out = G.gather_batch_columns(cols, idx, num_rows=n_rows)
+    midx = jnp.where(active_mask(n_rows, 512), idx, -1)
+    for got, c in zip(out, cols):
+        ref = gather_column(c, midx)
+        assert np.array_equal(np.asarray(got.validity),
+                              np.asarray(ref.validity))
+        assert np.array_equal(
+            np.asarray(got.data).view(np.uint8).tobytes(),
+            np.asarray(ref.data).view(np.uint8).tobytes())
+
+
+# --- measured-tier selection -------------------------------------------
+
+
+def _tier_conf(path, mode="auto"):
+    from spark_rapids_tpu.config import RapidsConf, set_active_conf
+    set_active_conf(RapidsConf({
+        "spark.rapids.tpu.pallas.fusedTier": mode,
+        "spark.rapids.tpu.pallas.fusedTier.benchFile": str(path)}))
+
+
+def _gather_record(shape, win=True):
+    from spark_rapids_tpu.ops.pallas_tier import (
+        KERN_BENCH_SCHEMA, shape_bucket)
+    return {"schema": KERN_BENCH_SCHEMA, "family": "gather",
+            "platform": jax.default_backend(),
+            "shape_bucket": list(shape_bucket(shape)),
+            "xla_ms": 10.0 if win else 1.0,
+            "pallas_ms": 2.0 if win else 5.0}
+
+
+def test_gather_tier_requires_a_measurement(tmp_path):
+    from spark_rapids_tpu.config import RapidsConf, set_active_conf
+    from spark_rapids_tpu.ops.pallas_tier import (
+        KERN_BENCH_SCHEMA, fused_tier_enabled)
+    try:
+        _tier_conf(tmp_path / "none.json")
+        assert not fused_tier_enabled("gather", (1024, 512))
+        p = tmp_path / "kb.json"
+        p.write_text(json.dumps({
+            "schema": KERN_BENCH_SCHEMA,
+            "records": [_gather_record((1024, 512))]}))
+        _tier_conf(p)
+        assert fused_tier_enabled("gather", (1024, 512))
+        assert not fused_tier_enabled("gather", (4096, 512))  # other bucket
+        assert not fused_tier_enabled("join_probe", (1024, 512))
+    finally:
+        set_active_conf(RapidsConf())
+
+
+def test_stale_schema_bench_file_is_ignored_loudly(tmp_path):
+    """A kern_bench.json from an older layout (missing/mismatched
+    schema stamp) must not flip tiers — and must say so, not silently
+    degrade (ISSUE 8 satellite)."""
+    from spark_rapids_tpu.config import RapidsConf, set_active_conf
+    from spark_rapids_tpu.ops.pallas_tier import fused_tier_enabled
+    try:
+        p = tmp_path / "stale.json"
+        rec = _gather_record((1024, 512))
+        del rec["schema"]
+        p.write_text(json.dumps({"records": [rec]}))  # no doc stamp
+        _tier_conf(p)
+        with pytest.warns(UserWarning, match="ignoring kern_bench"):
+            assert not fused_tier_enabled("gather", (1024, 512))
+    finally:
+        set_active_conf(RapidsConf())
+
+
+def test_kern_bench_quick_record_consulted_by_tier(tmp_path):
+    """Acceptance: `kern_bench --quick` produces a well-formed
+    versioned record that pallas_tier reads (and auto still keeps the
+    XLA floor on CPU, where the interpreter loses by construction)."""
+    from spark_rapids_tpu.config import RapidsConf, set_active_conf
+    from spark_rapids_tpu.ops.pallas_tier import (
+        KERN_BENCH_SCHEMA, bench_record)
+    out = tmp_path / "kb.json"
+    kern_bench.main(["--quick", "--families", "gather",
+                     "--out", str(out)])
+    doc = json.loads(out.read_text())
+    assert doc["schema"] == KERN_BENCH_SCHEMA
+    (rec,) = doc["records"]
+    assert rec["family"] == "gather" and rec["schema"] == KERN_BENCH_SCHEMA
+    assert rec["winner"] in ("xla", "pallas")
+    try:
+        _tier_conf(out)
+        got = bench_record("gather", tuple(rec["shape"]))
+        assert got is not None and got["xla_ms"] == rec["xla_ms"]
+    finally:
+        set_active_conf(RapidsConf())
+
+
+# --- engine-level equality + structural gather counts ------------------
+
+
+def _q3_join_session(extra_conf=None):
+    """q3-shaped join + aggregate: orders (build) x lineitem (stream),
+    fixed-width payload on both sides."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.expr.aggexprs import Sum
+    from spark_rapids_tpu.expr.core import col, lit
+    conf = {"spark.rapids.sql.batchSizeBytes": 16 << 10}
+    conf.update(extra_conf or {})
+    sess = TpuSession(conf)
+    rng = np.random.default_rng(17)
+    no, nl = 300, 1200
+    o_schema = Schema((StructField("o_key", LONG),
+                       StructField("o_flag", INT)))
+    l_schema = Schema((StructField("l_key", LONG),
+                       StructField("l_price", DOUBLE),
+                       StructField("l_qty", LONG)))
+    df_o = sess.from_pydict(
+        {"o_key": np.arange(no, dtype=np.int64).tolist(),
+         "o_flag": rng.integers(0, 10, no).tolist()}, o_schema)
+    df_l = sess.from_pydict(
+        {"l_key": rng.integers(0, no, nl).tolist(),
+         "l_price": (rng.random(nl) * 1000).round(6).tolist(),
+         "l_qty": rng.integers(1, 50, nl).tolist()}, l_schema)
+    q = (df_l.join(df_o, left_on="l_key", right_on="o_key", how="inner")
+             .filter(col("o_flag") < lit(8))
+             .group_by("o_flag")
+             .agg((Sum(col("l_price")), "rev"), (Sum(col("l_qty")), "q")))
+    return sess, q
+
+
+def _collect_sorted(q):
+    return sorted(map(tuple, q.collect()))
+
+
+def test_gather_tier_engine_equality_q3_join(tmp_path):
+    """auto + a recorded gather win (EVERY bucket, so all shapes route
+    through the DMA kernel) must be byte-identical to the tier off —
+    and the kernel must actually have run."""
+    from spark_rapids_tpu.config import RapidsConf, set_active_conf
+    from spark_rapids_tpu.ops.pallas_gather import kernel_trace_count
+    from spark_rapids_tpu.ops.pallas_tier import KERN_BENCH_SCHEMA
+    recs = [_gather_record((1 << i, 1 << j))
+            for i in range(4, 22) for j in range(4, 22)]
+    p = tmp_path / "kb.json"
+    p.write_text(json.dumps({"schema": KERN_BENCH_SCHEMA,
+                             "records": recs}))
+    try:
+        _sess, q_off = _q3_join_session(
+            {"spark.rapids.tpu.pallas.fusedTier": "off"})
+        off = _collect_sorted(q_off)
+        before = kernel_trace_count()
+        _sess2, q_on = _q3_join_session({
+            "spark.rapids.tpu.pallas.fusedTier": "auto",
+            "spark.rapids.tpu.pallas.fusedTier.benchFile": str(p)})
+        on = _collect_sorted(q_on)
+        assert kernel_trace_count() > before  # the DMA kernel engaged
+        assert off == on
+    finally:
+        set_active_conf(RapidsConf())
+
+
+def test_gather_tier_engine_equality_filter_heavy(tmp_path):
+    """Filter-heavy plan (compaction path, ops/basic.compact_columns):
+    byte-identical with the gather tier on vs off."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.config import RapidsConf, set_active_conf
+    from spark_rapids_tpu.expr.core import col, lit
+    from spark_rapids_tpu.ops.pallas_tier import KERN_BENCH_SCHEMA
+
+    def drive(conf):
+        sess = TpuSession(conf)
+        rng = np.random.default_rng(23)
+        n = 3000
+        schema = Schema((StructField("a", LONG), StructField("b", INT),
+                         StructField("c", DOUBLE),
+                         StructField("d", BOOLEAN)))
+        df = sess.from_pydict(
+            {"a": rng.integers(0, 1000, n).tolist(),
+             "b": rng.integers(-50, 50, n).tolist(),
+             "c": (rng.random(n) * 100).tolist(),
+             "d": rng.integers(0, 2, n).astype(bool).tolist()}, schema)
+        q = (df.filter(col("a") % lit(3) == lit(0))
+               .filter(col("b") > lit(-25))
+               .filter(col("d") == lit(True)))
+        return sorted(map(tuple, q.collect()))
+
+    recs = [_gather_record((1 << i, 1 << j))
+            for i in range(4, 22) for j in range(4, 22)]
+    p = tmp_path / "kb.json"
+    p.write_text(json.dumps({"schema": KERN_BENCH_SCHEMA,
+                             "records": recs}))
+    try:
+        off = drive({"spark.rapids.tpu.pallas.fusedTier": "off"})
+        on = drive({
+            "spark.rapids.tpu.pallas.fusedTier": "auto",
+            "spark.rapids.tpu.pallas.fusedTier.benchFile": str(p)})
+        assert off == on and len(off) > 0
+    finally:
+        set_active_conf(RapidsConf())
+
+
+def test_structural_gather_count_per_join_iteration(tmp_path):
+    """The gather-elimination acceptance: with the tier on, the join
+    probe materializes <= 3 row gathers PER STREAM ITERATION (one index
+    materialization + one packed payload gather per side — down from
+    the ~10 per-column payload gathers docs/perf.md r5 measured), and
+    the numGathers totals reconcile with the gather_stats event and the
+    op_close span batches. Counts only — CPU-runnable."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.config import RapidsConf, set_active_conf
+    from spark_rapids_tpu.obs import events
+    try:
+        sess, q = _q3_join_session({
+            "spark.rapids.tpu.pallas.fusedTier": "on",
+            "spark.rapids.tpu.eventLog.enabled": True,
+            "spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+        rows = q.collect()
+        assert rows
+        logged = []
+        for f in glob.glob(str(tmp_path / "events-*.jsonl")):
+            with open(f) as fh:
+                logged += [json.loads(ln) for ln in fh if ln.strip()]
+        gs = [e for e in logged if e.get("kind") == "gather_stats"
+              and "HashJoin" in (e.get("op") or "")]
+        assert gs, "join emitted no gather_stats event"
+        closes = {e.get("op_id"): e for e in logged
+                  if e.get("kind") == "op_close"}
+        for e in gs:
+            oc = closes.get(e.get("op_id"))
+            assert oc is not None and oc["batches"] >= 1
+            per_iter = e["count"] / oc["batches"]
+            assert per_iter <= 3, (e, oc)
+            assert e["packed"] >= 2 * oc["batches"]  # both sides packed
+    finally:
+        events.reset_event_bus()
+        set_active_conf(RapidsConf())
+        TpuSessionReset()
+
+
+def TpuSessionReset():
+    from spark_rapids_tpu.api.session import TpuSession
+    TpuSession()
+
+
+def test_filter_numgathers_metric_counts_one_packed_gather():
+    """FilterExec's compaction = ONE packed row gather per batch for an
+    all-fixed-width schema (the engine-wide helper at work)."""
+    from spark_rapids_tpu.exec.basic import FilterExec, InMemoryScanExec
+    from spark_rapids_tpu.expr.core import col, lit
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    rng = np.random.default_rng(31)
+    n = 500
+    schema = Schema((StructField("a", LONG), StructField("b", DOUBLE)))
+    cols = [_col(rng.integers(0, 50, n).astype(np.int64), LONG),
+            _col(rng.random(n) * 10, DOUBLE)]
+    batches = [ColumnarBatch(cols, n, schema)] * 3
+    f = FilterExec((col("a") > lit(10)), InMemoryScanExec(batches, schema))
+    out = list(f.execute())
+    assert len(out) == 3
+    assert f.metrics["numGathers"].value == 3  # one packed gather each
+    assert f.metrics["gatherTimeNs"].value > 0

@@ -43,6 +43,18 @@ DEFAULT_SHAPES = {
     "join_probe": [(1 << 12, 1 << 10), (1 << 14, 1 << 12)],
     "scan_agg": [(1 << 14,), (1 << 16,)],
     "murmur3": [(1 << 16,), (1 << 20,)],
+    # (gathered rows, source capacity) — the packed-row gather shapes
+    # the join emit and filter compaction actually dispatch
+    "gather": [(1 << 14, 1 << 12), (1 << 16, 1 << 14)],
+}
+
+#: smallest per-family shape for --quick CI smoke (compile + one
+#: timed rep; proves the harness and the record layout, not the chip)
+QUICK_SHAPES = {
+    "join_probe": [(1 << 10, 1 << 8)],
+    "scan_agg": [(1 << 12,)],
+    "murmur3": [(1 << 14,)],
+    "gather": [(1 << 11, 1 << 10)],
 }
 
 
@@ -198,10 +210,62 @@ def bench_murmur3(shape, iters, reps, interpret):
             _timed(pallas_step, iters, reps))
 
 
+def bench_gather(shape, iters, reps, interpret):
+    """Packed row gather (ISSUE 8): XLA's one-row-gather-over-the-pack
+    formulation (ops/rowpack.gather_rows — the engine's floor) vs the
+    DMA kernel (ops/pallas_gather.py), over a representative payload
+    mix (1 LONG + 4 INT + 1 DOUBLE + 1 BOOLEAN = 9 u32 lanes incl the
+    validity lane)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+    from spark_rapids_tpu.ops.pallas_gather import pallas_gather_rows
+    from spark_rapids_tpu.ops.rowpack import gather_rows, pack_rows
+    from spark_rapids_tpu.types import BOOLEAN, DOUBLE, INT, LONG
+
+    nout, cap = shape
+    rng = np.random.default_rng(3)
+    ccap = bucket_capacity(cap)
+    cols = [Column.from_numpy(
+        rng.integers(-(2**40), 2**40, cap).astype(np.int64), LONG,
+        capacity=ccap)]
+    for i in range(4):
+        cols.append(Column.from_numpy(
+            rng.integers(-1000, 1000, cap).astype(np.int32), INT,
+            capacity=ccap))
+    cols.append(Column.from_numpy(rng.random(cap), DOUBLE, capacity=ccap))
+    cols.append(Column.from_numpy(rng.integers(0, 2, cap).astype(bool),
+                                  BOOLEAN, capacity=ccap))
+    plan, imat, fmat = pack_rows(cols)
+    idx = jnp.asarray(rng.integers(0, cap, nout), jnp.int32)
+
+    def fold(chk, gi, gf):
+        chk = chk + jnp.sum(gi, dtype=jnp.float64)
+        if gf is not None:
+            chk = chk + jnp.sum(gf).astype(jnp.float64)
+        return chk
+
+    @jax.jit
+    def xla_step(chk):
+        gi, gf = gather_rows(plan, imat, fmat, idx)
+        return fold(chk, gi, gf)
+
+    @jax.jit
+    def pallas_step(chk):
+        gi, gf = pallas_gather_rows(plan, imat, fmat, idx,
+                                    interpret=interpret)
+        return fold(chk, gi, gf)
+
+    return (_timed(xla_step, iters, reps),
+            _timed(pallas_step, iters, reps))
+
+
 BENCHES = {
     "join_probe": bench_join_probe,
     "scan_agg": bench_scan_agg,
     "murmur3": bench_murmur3,
+    "gather": bench_gather,
 }
 
 
@@ -214,29 +278,57 @@ def main(argv=None):
                          "65536 (1-D families)")
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--reps", type=int, default=3)
-    ap.add_argument("--out", default=os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "kern_bench.json"))
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke: one tiny shape per family, 2 iters "
+                         "x 1 rep — proves the harness + record layout "
+                         "end to end, not the chip")
+    ap.add_argument("--out", default=None,
+                    help="records file (default tools/kern_bench.json)")
     ap.add_argument("--dry-run", action="store_true",
                     help="measure and print, do not write the record "
                          "file")
     args = ap.parse_args(argv)
+    if args.quick:
+        args.iters = min(args.iters, 2)
+        args.reps = 1
+        if args.out is None and not args.dry_run:
+            # a 1-rep tiny-shape smoke record is NOISE, not a
+            # measurement — never let it land in the production file
+            # the auto tier trusts
+            ap.error("--quick writes throwaway records; pass an "
+                     "explicit --out (not the production "
+                     "kern_bench.json) or --dry-run")
+    if args.out is None:
+        args.out = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "kern_bench.json")
 
     import jax
 
     from spark_rapids_tpu.ops.pallas_kernels import on_tpu
-    from spark_rapids_tpu.ops.pallas_tier import shape_bucket
+    from spark_rapids_tpu.ops.pallas_tier import (
+        KERN_BENCH_SCHEMA, shape_bucket)
 
     platform = jax.default_backend()
     interpret = not on_tpu()
 
-    # merge with existing records so shape coverage accumulates
-    doc = {"records": []}
+    # merge with existing records so shape coverage accumulates — but
+    # only records of the CURRENT layout; a stale-schema file is
+    # discarded loudly (the tier selector already refuses to read it)
+    doc = {"schema": KERN_BENCH_SCHEMA, "records": []}
     if os.path.exists(args.out) and not args.dry_run:
         try:
             with open(args.out) as f:
-                doc = json.load(f)
+                old = json.load(f)
+            if old.get("schema") == KERN_BENCH_SCHEMA:
+                doc = old
+            else:
+                print(json.dumps({
+                    "discarded_stale_records": args.out,
+                    "old_schema": old.get("schema"),
+                    "schema": KERN_BENCH_SCHEMA}))
         except (OSError, ValueError):
-            doc = {"records": []}
+            pass
     index = {(r["family"], r["platform"], tuple(r["shape_bucket"])): r
              for r in doc.get("records", ())}
 
@@ -246,7 +338,7 @@ def main(argv=None):
                  "shape arity)")
 
     for family in args.families:
-        shapes = DEFAULT_SHAPES[family]
+        shapes = (QUICK_SHAPES if args.quick else DEFAULT_SHAPES)[family]
         if args.shapes:
             shapes = [tuple(int(x) for x in s.split("x"))
                       for s in args.shapes]
@@ -259,6 +351,7 @@ def main(argv=None):
             xla_ms, pallas_ms = BENCHES[family](
                 shape, args.iters, args.reps, interpret)
             rec = {
+                "schema": KERN_BENCH_SCHEMA,
                 "family": family,
                 "platform": platform,
                 "shape": list(shape),
@@ -276,10 +369,11 @@ def main(argv=None):
                 "winner")}))
 
     if not args.dry_run:
+        doc["schema"] = KERN_BENCH_SCHEMA
         doc["records"] = list(index.values())
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=1)
-        print(json.dumps({"written": args.out,
+        print(json.dumps({"written": args.out, "schema": KERN_BENCH_SCHEMA,
                           "records": len(doc["records"])}))
 
 

@@ -220,6 +220,18 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
         on = sum(1 for e in tiers if e.get("engaged"))
         extras.append(f"pallas tier decisions: {len(tiers)} "
                       f"({on} engaged)")
+    # gather-engine roll-up (ISSUE 8): materializing row gathers per
+    # wired operator — the count drop IS the optimization, so a bench
+    # round reads it next to the pipeline/workload lines
+    gstats = [e for e in events if e.get("kind") == "gather_stats"]
+    if gstats:
+        n_g = sum(e.get("count") or 0 for e in gstats)
+        n_packed = sum(e.get("packed") or 0 for e in gstats)
+        n_pallas = sum(e.get("pallas") or 0 for e in gstats)
+        g_bytes = sum(e.get("bytes") or 0 for e in gstats)
+        extras.append(
+            f"gathers: {n_g} ({n_packed} packed rows, {n_pallas} via "
+            f"the Pallas DMA kernel, ~{_fmt_bytes(g_bytes)} moved)")
     if extras:
         lines.append("")
         lines.extend(extras)
