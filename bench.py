@@ -209,6 +209,27 @@ def gather_attribution():
             for k in ("count", "packed_count", "pallas_count", "bytes")}
 
 
+#: shuffle-counter snapshot at the previous shuffle_attribution() call
+#: (process-cumulative, reported as per-record deltas like chaos)
+_shuffle_prev = None
+
+
+def shuffle_attribution():
+    """{"shuffle": ...} block for each BENCH record (ISSUE 9): batches
+    split per lane (device vs host), frames/bytes written, host-side
+    row gathers (0 on the device-partition lanes), and the write-time
+    split pack/serialize/IO (shuffle/manager.py counters, as deltas
+    since the previous record). Lanes that never shuffle report zeros —
+    the block is present in every record so a round can assert the
+    device lane actually engaged."""
+    global _shuffle_prev
+    from spark_rapids_tpu.shuffle import manager as shuffle_mgr
+    cur = shuffle_mgr.counters()
+    prev = _shuffle_prev if _shuffle_prev is not None else {}
+    _shuffle_prev = cur
+    return {k: v - prev.get(k, 0) for k, v in cur.items()}
+
+
 #: counter snapshot at the previous chaos_attribution() call — the
 #: underlying counters are process-cumulative, each BENCH record must
 #: report only ITS OWN lane's deltas
@@ -581,6 +602,7 @@ def main():
         "lifecycle": lifecycle_attribution(),
         "workload": workload_attribution(),
         "gather": gather_attribution(),
+        "shuffle": shuffle_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:
@@ -748,6 +770,7 @@ def q3_bench():
         "lifecycle": lifecycle_attribution(),
         "workload": workload_attribution(),
         "gather": gather_attribution(),
+        "shuffle": shuffle_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:

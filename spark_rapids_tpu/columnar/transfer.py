@@ -148,6 +148,37 @@ def _unpack_column(col: Column, buf: np.ndarray, pos: int
     return Column(data, v.astype(np.bool_), col.dtype), pos
 
 
+def _pack_split_impl(counts, columns) -> jnp.ndarray:
+    pieces: List[jnp.ndarray] = [_bytes_of(counts.astype(jnp.int32))]
+    for col in columns:
+        _pack_column(col, pieces)
+    return jnp.concatenate(pieces)
+
+
+_pack_split_jit = jax.jit(_pack_split_impl)
+
+
+def fetch_split_host(counts, columns) -> Tuple[np.ndarray, List[Column]]:
+    """Packed D2H lane for the device shuffle partition split (ISSUE 9):
+    land the per-partition count table AND the partition-ordered columns
+    in ONE host copy. The count table is the only host-synced control
+    value of the split; the column payload rides the same buffer instead
+    of per-column pulls.
+
+    Returns (counts int64 numpy, numpy-backed columns).
+    """
+    n_parts = int(counts.shape[0])
+    buf = np.asarray(_pack_split_jit(counts, list(columns)))  # ONE d2h
+    host_counts = buf[: 4 * n_parts].view(np.int32).astype(np.int64)
+    pos = 4 * n_parts
+    out: List[Column] = []
+    for col in columns:
+        host_col, pos = _unpack_column(col, buf, pos)
+        out.append(host_col)
+    assert pos == buf.shape[0], (pos, buf.shape)
+    return host_counts, out
+
+
 def fetch_batch_host(batch) -> Tuple[List[Column], int]:
     """Materialize a device batch with ONE d2h transfer.
 

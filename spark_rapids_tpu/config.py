@@ -240,6 +240,24 @@ SHUFFLE_PARTITIONS = conf_int(
     "per partition — the out-of-core repartition path.",
     commonly_used=True)
 
+SHUFFLE_DEVICE_PARTITION = conf_bool(
+    "spark.rapids.tpu.shuffle.devicePartition.enabled", True,
+    "Device-side shuffle partition split for the MULTITHREADED host "
+    "shuffle writer (exec/exchange.py + ops/partition_split.py): the "
+    "hash/roundrobin/single lanes compute per-partition counts and a "
+    "pid-stable permutation on device, reorder the batch into "
+    "partition-major order through the gather engine (ops/gather.py — "
+    "tier-aware: the Pallas DMA gather when the `gather` family has a "
+    "recorded win, the XLA packed row gather otherwise), land it on the "
+    "host as ONE packed D2H copy (columnar/transfer.py) and serialize "
+    "each partition directly from a row-range slice "
+    "(shuffle/serializer.serialize_slice) — zero host-side row gathers "
+    "per written batch (the reference's GpuHashPartitioning + "
+    "contiguous_split + JCudfSerialization shape). Range partitioning "
+    "keeps the host lane (its sampled split bounds are host objects). "
+    "Off restores the host argsort-and-slice partitioner.",
+    commonly_used=True)
+
 SHUFFLE_WRITER_THREADS = conf_int(
     "spark.rapids.shuffle.multiThreaded.writer.threads", 8,
     "Writer-side serialization threads (reference "

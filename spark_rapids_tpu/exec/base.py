@@ -108,6 +108,7 @@ SPILL_TIME = "spillTime"
 PARTITION_SIZE = "dataSize"
 SHUFFLE_WRITE_TIME = "shuffleWriteTime"
 SHUFFLE_READ_TIME = "shuffleReadTime"
+SHUFFLE_PACK_TIME = "shufflePackTimeNs"
 BROADCAST_TIME = "broadcastTime"
 PIPELINE_WAIT = "pipelineWaitNs"
 PIPELINE_FULL_WAIT = "pipelineFullWaitNs"
@@ -123,7 +124,8 @@ CANONICAL_METRICS = frozenset({
     NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES, NUM_INPUT_ROWS, NUM_INPUT_BATCHES,
     OP_TIME, SORT_TIME, AGG_TIME, CONCAT_TIME, JOIN_TIME, BUILD_TIME,
     PEAK_DEVICE_MEMORY, NUM_TASKS_FALL_BACKED, SPILL_TIME, PARTITION_SIZE,
-    SHUFFLE_WRITE_TIME, SHUFFLE_READ_TIME, BROADCAST_TIME,
+    SHUFFLE_WRITE_TIME, SHUFFLE_READ_TIME, SHUFFLE_PACK_TIME,
+    BROADCAST_TIME,
     PIPELINE_WAIT, PIPELINE_FULL_WAIT, PIPELINE_WALL,
     NUM_GATHERS, GATHER_TIME,
 })

@@ -33,10 +33,12 @@ from ..parallel.exchange import exchange_columns, partition_ids
 from ..parallel.mesh import DATA_AXIS, active_mesh, mesh_axis_size
 from ..types import Schema
 from ..obs import events as obs_events
-from .base import (BROADCAST_TIME, DEBUG, ESSENTIAL,
-                   NUM_INPUT_BATCHES, NUM_INPUT_ROWS, NUM_OUTPUT_BATCHES,
+from .base import (BROADCAST_TIME, DEBUG, ESSENTIAL, GATHER_METRICS,
+                   GATHER_TIME, MODERATE,
+                   NUM_GATHERS, NUM_INPUT_BATCHES, NUM_INPUT_ROWS,
+                   NUM_OUTPUT_BATCHES,
                    NUM_OUTPUT_ROWS, OP_TIME, PARTITION_SIZE,
-                   PIPELINE_STAGE_METRICS,
+                   PIPELINE_STAGE_METRICS, SHUFFLE_PACK_TIME,
                    SHUFFLE_READ_TIME, SHUFFLE_WRITE_TIME, TpuExec)
 from .basic import InMemoryScanExec, bind_projection
 from .coalesce import concat_batches
@@ -48,6 +50,42 @@ def _squeeze0(tree):
 
 def _expand0(tree):
     return jax.tree_util.tree_map(lambda x: x[None], tree)
+
+
+def _host_key_array(col, n: int, idx=None):
+    """Vectorized host materialization of a range-partition sort key
+    (ISSUE 9 satellite): fixed-width columns become an object array via
+    one astype (floats widened to f64 first, so NaN checks keep seeing
+    python floats), strings decode from one contiguous bytes snapshot.
+    Returns None for nested types (the caller falls back to to_pylist).
+    `idx` restricts to sampled rows."""
+    import numpy as np
+
+    from ..columnar.column import Column, StringColumn
+    from ..types import BinaryType
+    if type(col) is Column:
+        data = np.asarray(col.data)[:n]
+        valid = np.asarray(col.validity)[:n]
+        if idx is not None:
+            data, valid = data[idx], valid[idx]
+        if data.dtype.kind == "f":
+            data = data.astype(np.float64)
+        out = data.astype(object)  # python scalars, like .item()
+        out[~valid] = None
+        return out
+    if isinstance(col, StringColumn):
+        offsets = np.asarray(col.offsets)
+        valid = np.asarray(col.validity)
+        buf = np.asarray(col.data).tobytes()
+        binary = isinstance(col.dtype, BinaryType)
+        rows = range(n) if idx is None else idx
+        out = np.empty(n if idx is None else len(idx), dtype=object)
+        for j, i in enumerate(rows):
+            if valid[i]:
+                raw = buf[offsets[i]: offsets[i + 1]]
+                out[j] = raw if binary else raw.decode("utf-8")
+        return out
+    return None
 
 
 class ShuffleExchangeExec(TpuExec):
@@ -330,7 +368,7 @@ class HostShuffleExchangeExec(TpuExec):
         schema and samples the data for split bounds like
         GpuRangePartitioner's reservoir sampling."""
         super().__init__(child)
-        from ..config import active_conf
+        from ..config import SHUFFLE_DEVICE_PARTITION, active_conf
         self.partition_exprs = list(partition_exprs or [])
         self.n_partitions = int(n_partitions)
         self.partitioning = partitioning
@@ -342,6 +380,18 @@ class HostShuffleExchangeExec(TpuExec):
                                           child.output_schema)
             self._jit_pid = jax.jit(self._pid_kernel)
         self._rr_offset = 0
+        # device partition split (ISSUE 9): hash/roundrobin/single pids
+        # are device-computable, so the split runs as ONE compiled
+        # program (pid -> counts + stable permutation -> packed reorder
+        # through the gather engine) + ONE packed D2H; range keeps the
+        # host lane — its sampled split bounds are host objects
+        self._device_partition = (
+            partitioning in ("hash", "roundrobin", "single")
+            and bool(self._conf.get(SHUFFLE_DEVICE_PARTITION)))
+        self._jit_split = jax.jit(self._split_kernel)
+        from ..ops.gather import GatherTracker
+        self._gather_track = GatherTracker(self.metrics[NUM_GATHERS],
+                                           self.metrics[GATHER_TIME])
 
     @property
     def output_schema(self) -> Schema:
@@ -350,7 +400,8 @@ class HostShuffleExchangeExec(TpuExec):
     def additional_metrics(self):
         return ((NUM_INPUT_BATCHES, DEBUG), (NUM_INPUT_ROWS, DEBUG),
                 (PARTITION_SIZE, ESSENTIAL), SHUFFLE_WRITE_TIME,
-                SHUFFLE_READ_TIME) + PIPELINE_STAGE_METRICS
+                SHUFFLE_READ_TIME, (SHUFFLE_PACK_TIME, MODERATE)) \
+            + GATHER_METRICS + PIPELINE_STAGE_METRICS
 
     @property
     def runs_own_pipeline_stage(self) -> bool:
@@ -363,17 +414,108 @@ class HostShuffleExchangeExec(TpuExec):
         return partition_ids(keys, batch.num_rows, batch.capacity,
                              self.n_partitions)
 
+    # -- device partition split (ISSUE 9) ----------------------------------
+    def _split_kernel(self, batch: ColumnarBatch, rr_offset):
+        """One traced program: pid -> per-partition counts + pid-stable
+        permutation -> partition-major reorder through the gather engine
+        (ops/partition_split.py). rr_offset is only read on the
+        roundrobin lane (hash pids come from the key expressions)."""
+        from ..ops.partition_split import partition_table, reorder_columns
+        n = self.n_partitions
+        if self.partitioning == "hash":
+            pid = self._pid_kernel(batch)
+        else:  # roundrobin
+            iota = jnp.arange(batch.capacity, dtype=jnp.int32)
+            pid = (iota + rr_offset) % jnp.int32(n)
+            pid = jnp.where(active_mask(batch.num_rows, batch.capacity),
+                            pid, jnp.int32(n))
+        counts, order = partition_table(pid, batch.num_rows,
+                                        batch.capacity, n)
+        return counts, reorder_columns(batch.columns, order,
+                                       batch.num_rows)
+
+    def _device_split(self, b: ColumnarBatch, n: int):
+        """Split one batch on device: returns (host columns in
+        partition-major order, exclusive bounds (n_partitions+1,)).
+        ONE packed D2H lands the count table and the reordered payload
+        together (columnar/transfer.fetch_split_host) — the offset
+        table is the split's only host-synced control value."""
+        import numpy as np
+        from ..columnar import transfer
+        if self.partitioning == "single":
+            # no permutation needed: the batch IS partition 0's slice
+            cols, _n = transfer.fetch_batch_host(b)
+            counts = np.zeros(self.n_partitions, np.int64)
+            counts[0] = n
+        else:
+            off = self._rr_offset
+            if self.partitioning == "roundrobin":
+                self._rr_offset = int((self._rr_offset + n)
+                                      % self.n_partitions)
+            # observe keyed by the compiled program shape so the
+            # trace-time gather counts replay exactly on jit cache hits
+            key = (self.partitioning, b.capacity, tuple(
+                (tuple(leaf.shape), str(leaf.dtype))
+                for leaf in jax.tree_util.tree_leaves(list(b.columns))))
+            with self._gather_track.observe(key):
+                dev_counts, dev_cols = self._jit_split(b, jnp.int32(off))
+            counts, cols = transfer.fetch_split_host(dev_counts, dev_cols)
+        bounds = np.zeros(self.n_partitions + 1, np.int64)
+        np.cumsum(counts, out=bounds[1:])
+        return cols, bounds
+
+    def _write_map(self, b: ColumnarBatch, n: int, range_bounds, handle,
+                   mgr, map_id: int, register: bool = True):
+        """Partition + serialize + write one map task's output, on the
+        lane the conf selects. Returns (writer, lane, pack_ns). Both the
+        steady-state write loop and the partition-recovery recompute
+        route through here, so recovered map outputs replay the exact
+        lane (and round-robin offsets) of the original write."""
+        import time as _time
+        from ..shuffle.manager import (HostShuffleWriter,
+                                       partition_batch_host)
+        writer = HostShuffleWriter(handle, map_id, mgr, self._conf)
+        if self._device_partition and not n:
+            # empty batch: zero frames, no partitioning work at all
+            writer.write([[] for _ in range(self.n_partitions)],
+                         register=register, lane="device")
+            return writer, "device", 0
+        if self._device_partition:
+            t0 = _time.perf_counter_ns()
+            cols, bounds = self._device_split(b, n)
+            pack_ns = _time.perf_counter_ns() - t0
+            self.metrics[SHUFFLE_PACK_TIME].add(pack_ns)
+            from ..shuffle.manager import note_shuffle_write
+            note_shuffle_write(pack_ns=pack_ns)
+            packed = ColumnarBatch(cols, n, self.output_schema)
+            writer.write_slices(packed, bounds, register=register)
+            return writer, "device", pack_ns
+        pid = self._pid_for(b, n, range_bounds)
+        parts = partition_batch_host(b, pid, self.n_partitions)
+        writer.write([[p] if p.num_rows_host else [] for p in parts],
+                     register=register)
+        return writer, "host", 0
+
     # -- partition id per mode --------------------------------------------
     def _host_keys(self, batch: ColumnarBatch, n: int, stride: int = 1):
-        """First-sort-key values as host objects. With a stride, only the
-        sampled rows are gathered/materialized (the bounds pass needs
-        ~512 values, not a full-column to_pylist)."""
+        """First-sort-key values as host objects (None for nulls). The
+        numeric and string common cases vectorize off the column's host
+        buffers (one astype(object) / one bytes slice pass) instead of
+        the old element-by-element object-array build; nested types keep
+        the to_pylist fallback. With a stride, only the sampled rows
+        materialize (the bounds pass needs ~512 values, not a
+        full-column to_pylist)."""
         import numpy as np
         ordinal, _asc, _nf = self.range_order
         col = batch.columns[ordinal]
-        if stride > 1:
+        idx = np.arange(0, n, stride, dtype=np.int64) if stride > 1 \
+            else None
+        fast = _host_key_array(col, n, idx)
+        if fast is not None:
+            return fast
+        # nested fallback (array/map/struct/decimal128 sort keys)
+        if idx is not None:
             from ..shuffle.serializer import host_gather_column
-            idx = np.arange(0, n, stride, dtype=np.int64)
             col = host_gather_column(col, idx)
             n = len(idx)
         vals = col.to_pylist(n)
@@ -448,8 +590,7 @@ class HostShuffleExchangeExec(TpuExec):
         get the same pieces via internal_execute; partition-aware ones
         (ShuffledHashJoinExec, PartitionWiseSortExec) take the
         boundaries from here."""
-        from ..shuffle.manager import (HostShuffleReader, HostShuffleWriter,
-                                       partition_batch_host, shuffle_manager)
+        from ..shuffle.manager import HostShuffleReader, shuffle_manager
         mgr = shuffle_manager()
         handle = mgr.register(self.n_partitions, self.output_schema)
         in_batches = self.metrics[NUM_INPUT_BATCHES]
@@ -503,12 +644,8 @@ class HostShuffleExchangeExec(TpuExec):
                 # time only the shuffle work (partition/serialize/write),
                 # not the upstream compute driving child.execute()
                 with self.metrics[SHUFFLE_WRITE_TIME].ns_timer():
-                    pid = self._pid_for(b, n, bounds)
-                    parts = partition_batch_host(b, pid, self.n_partitions)
-                    writer = HostShuffleWriter(handle, map_id, mgr,
-                                               self._conf)
-                    writer.write([[p] if p.num_rows_host else []
-                                  for p in parts])
+                    writer, lane, pack_ns = self._write_map(
+                        b, n, bounds, handle, mgr, map_id)
                 if capture_lineage:
                     handle.lineage[mgr.map_data_path(
                         handle.shuffle_id, map_id)] = \
@@ -520,7 +657,20 @@ class HostShuffleExchangeExec(TpuExec):
                                 partitions=self.n_partitions,
                                 bytes=writer.bytes_written,
                                 partitioning=self.partitioning)
+                obs_events.emit("shuffle_write",
+                                exec="HostShuffleExchangeExec",
+                                op_id=self._op_id, map_id=map_id,
+                                lane=lane, bytes=writer.bytes_written,
+                                frames=writer.frames_written,
+                                pack_ns=pack_ns,
+                                serialize_ns=writer.serialize_ns,
+                                io_ns=writer.io_ns)
                 map_id += 1
+            # one gather_stats record per execution, the wired-exec
+            # convention (the write phase is where this exec's gathers
+            # happen — emit once it is complete, not at stream close)
+            self._gather_track.emit_event(type(self).__name__,
+                                          self._op_id)
             reader = HostShuffleReader(handle, mgr, self._conf)
             n = self.n_partitions
 
@@ -592,8 +742,6 @@ class HostShuffleExchangeExec(TpuExec):
         has adopted conf/query-id/attempt/lifecycle context); the
         round-robin offset is replayed from zero so the recomputed pid
         assignment is bit-identical to the original write."""
-        from ..shuffle.manager import (HostShuffleWriter,
-                                       partition_batch_host)
 
         def recompute() -> None:
             # serialization: the reader invokes lineage closures under
@@ -617,13 +765,12 @@ class HostShuffleExchangeExec(TpuExec):
                                     (self._rr_offset + n)
                                     % self.n_partitions)
                             continue
-                        pid = self._pid_for(b, n, None)
-                        parts = partition_batch_host(
-                            b, pid, self.n_partitions)
-                        writer = HostShuffleWriter(handle, map_id,
-                                                   mgr, self._conf)
-                        writer.write([[p] if p.num_rows_host else []
-                                      for p in parts], register=False)
+                        # same lane as the original write (_write_map):
+                        # the rewritten map output keeps the original
+                        # frame layout, so the reader's frame index and
+                        # the seeded chaos keys stay valid
+                        self._write_map(b, n, None, handle, mgr,
+                                        map_id, register=False)
                         return
                     raise RuntimeError(
                         f"partition recovery: child produced no "

@@ -296,6 +296,10 @@ FAMILY_DOMAINS: Dict[str, str] = {
     "scan_agg": "pallas_fused",
     "join_probe": "pallas_join",
     "gather": "pallas_gather",
+    # the device shuffle partition split's tiered step IS the packed
+    # row gather (ops/partition_split.py routes through ops/gather), so
+    # it degrades with the same breaker domain
+    "partition_split": "pallas_gather",
     "murmur3": "pallas_hash",
 }
 

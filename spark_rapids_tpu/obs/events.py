@@ -55,6 +55,10 @@ EVENT_LEVELS: Dict[str, int] = {
     "plan_fallback": MODERATE,
     "plan_not_on_tpu": MODERATE,
     "exchange": MODERATE,
+    # shuffle-write breakdown (ISSUE 9): one record per map task with
+    # the lane (device|host), frame/byte totals and the write-time
+    # split (pack = device partition + packed D2H, serialize, file IO)
+    "shuffle_write": MODERATE,
     "pipeline_wait": MODERATE,
     "pipeline_full": MODERATE,
     # robustness events (ISSUE 4): injected faults, retries at every

@@ -31,7 +31,8 @@ from typing import Dict, Optional, Tuple
 #: `auto` selection is a measurement, and (c) a row in the docs/perf.md
 #: tier table — tests/test_docs_lint.py lints all three (the registries
 #: drifted silently before measurement-gating existed).
-PALLAS_FAMILIES = ("murmur3", "join_probe", "scan_agg", "gather")
+PALLAS_FAMILIES = ("murmur3", "join_probe", "scan_agg", "gather",
+                   "partition_split")
 
 #: kern_bench.json layout version. The records file is rewritten by
 #: tools/kern_bench.py with this stamp; a file from an older layout

@@ -28,7 +28,33 @@ from ..types import Schema
 from .. import faults
 from ..io.retrying import with_io_retry
 from .serializer import (CorruptFrameError, deserialize_batch,
-                         host_gather_batch, serialize_batch)
+                         host_gather_batch, host_gather_calls,
+                         host_slice_batch, serialize_batch,
+                         serialize_slice)
+
+
+#: process-cumulative shuffle-write counters (bench.py embeds per-record
+#: deltas, the chaos-delta pattern): batches split per lane, frames and
+#: bytes written, and the write-time split pack / serialize / file-IO
+_COUNTER_LOCK = threading.Lock()
+_COUNTERS = {"batches": 0, "device_batches": 0, "host_batches": 0,
+             "frames": 0, "bytes": 0, "pack_ns": 0, "serialize_ns": 0,
+             "io_ns": 0}
+
+
+def note_shuffle_write(**deltas) -> None:
+    with _COUNTER_LOCK:
+        for k, v in deltas.items():
+            _COUNTERS[k] += v
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of the shuffle-write counters, plus the serializer's
+    host-gather call count (0 growth on the device-partition lanes)."""
+    with _COUNTER_LOCK:
+        out = dict(_COUNTERS)
+    out["host_gathers"] = host_gather_calls()
+    return out
 
 
 class HostShuffleHandle:
@@ -68,13 +94,56 @@ class HostShuffleWriter:
         conf = conf or active_conf()
         self._pool = manager.writer_pool(conf)
         self.bytes_written = 0
+        self.frames_written = 0
+        self.serialize_ns = 0
+        self.io_ns = 0
 
     def write(self, partitioned: Sequence[List[ColumnarBatch]],
-              register: bool = True) -> None:
+              register: bool = True, lane: str = "host") -> None:
         """partitioned[p] = list of batches for partition p. Serialization
         (the expensive part: host gather + LZ4) fans out on the writer
         pool; the file write is sequential in partition order so the index
-        stays a flat range table.
+        stays a flat range table. `lane` only labels the write counters
+        (the device lane routes its empty-batch maps through here)."""
+        n = self.handle.n_partitions
+        assert len(partitioned) == n
+        import time as _time
+        t0 = _time.perf_counter_ns()
+        jobs = [(p, self._pool.submit(serialize_batch, b))
+                for p in range(n) for b in partitioned[p]]
+        frames_by_part: List[List[bytes]] = [[] for _ in range(n)]
+        for p, fut in jobs:
+            frames_by_part[p].append(fut.result())
+        self.serialize_ns = _time.perf_counter_ns() - t0
+        self._commit(frames_by_part, register, lane=lane)
+
+    def write_slices(self, packed: ColumnarBatch, bounds,
+                     register: bool = True) -> None:
+        """Write one map task from a partition-ordered host batch
+        (ISSUE 9 device lane): `bounds[p]..bounds[p+1]` is partition
+        p's row range, and each non-empty partition serializes straight
+        from that slice on the writer pool (serialize_slice — offsets
+        rebased in place, no gathers). Frame count and order match
+        write()'s one-frame-per-non-empty-partition exactly, so the
+        seeded chaos keys (`shuffle.decode` global ordinals) and the
+        reader's frame indexing are unchanged by the lane."""
+        n = self.handle.n_partitions
+        assert len(bounds) == n + 1
+        import time as _time
+        t0 = _time.perf_counter_ns()
+        jobs = [(p, self._pool.submit(serialize_slice, packed,
+                                      int(bounds[p]), int(bounds[p + 1])))
+                for p in range(n) if bounds[p + 1] > bounds[p]]
+        frames_by_part: List[List[bytes]] = [[] for _ in range(n)]
+        for p, fut in jobs:
+            frames_by_part[p].append(fut.result())
+        self.serialize_ns = _time.perf_counter_ns() - t0
+        self._commit(frames_by_part, register, lane="device")
+
+    def _commit(self, frames_by_part: Sequence[List[bytes]],
+                register: bool, lane: str) -> None:
+        """Write the serialized frames in partition order and publish
+        the map output.
 
         Commit protocol (ISSUE 4): both files are written under
         ATTEMPT-TAGGED temp names and renamed into place atomically,
@@ -84,25 +153,20 @@ class HostShuffleWriter:
         — a reader can never observe a partial shard, and two attempts
         of one map task never collide on a temp name (the reference's
         shuffle write-then-commit discipline, single-process edition)."""
+        import time as _time
         n = self.handle.n_partitions
-        assert len(partitioned) == n
-        jobs = [(p, i, self._pool.submit(serialize_batch, b))
-                for p in range(n) for i, b in enumerate(partitioned[p])]
-        frames: Dict[tuple, bytes] = {}
-        for p, i, fut in jobs:
-            frames[(p, i)] = fut.result()
         data_path = self.manager.map_data_path(self.handle.shuffle_id,
                                                self.map_id)
         from ..exec.task_retry import task_attempt
         tag = f".attempt-{task_attempt()}.tmp"
         tmp_data, tmp_index = data_path + tag, data_path + ".index" + tag
         offsets = [0] * (n + 1)
+        t0 = _time.perf_counter_ns()
         try:
             with open(tmp_data, "wb") as f:
                 pos = 0
                 for p in range(n):
-                    for i in range(len(partitioned[p])):
-                        frame = frames[(p, i)]
+                    for frame in frames_by_part[p]:
                         f.write(struct.pack("<Q", len(frame)))
                         f.write(frame)
                         pos += 8 + len(frame)
@@ -118,7 +182,15 @@ class HostShuffleWriter:
                 except OSError:
                     pass
             raise
+        self.io_ns = _time.perf_counter_ns() - t0
         self.bytes_written = offsets[n]
+        self.frames_written = sum(len(fs) for fs in frames_by_part)
+        note_shuffle_write(
+            batches=1, frames=self.frames_written,
+            bytes=self.bytes_written, serialize_ns=self.serialize_ns,
+            io_ns=self.io_ns,
+            **({"device_batches": 1} if lane == "device"
+               else {"host_batches": 1}))
         if register:
             self.handle.map_outputs.append(data_path)
         # register=False is the partition-recovery rewrite path: the map
@@ -376,9 +448,17 @@ def partition_batch_host(batch: ColumnarBatch, pid: np.ndarray,
                          n_partitions: int) -> List[ColumnarBatch]:
     """Split a batch into per-partition compact host batches given the
     device-computed partition id per row (Spark-exact murmur3 pmod from
-    parallel/exchange.partition_ids). Stable within a partition."""
+    parallel/exchange.partition_ids). Stable within a partition.
+
+    ONE stable argsort-by-pid + ONE whole-batch gather, then each
+    partition emits as a gather-free row-range slice (ISSUE 9
+    satellite) — O(n log n + cols) per batch instead of the old
+    O(partitions x cols) per-partition gathers. Output batches are
+    byte-identical to the per-partition-gather formulation (the slice
+    helper reproduces host_gather_column's buckets and padding)."""
     order = np.argsort(pid, kind="stable")
     sorted_pid = pid[order]
     bounds = np.searchsorted(sorted_pid, np.arange(n_partitions + 1))
-    return [host_gather_batch(batch, order[bounds[p]: bounds[p + 1]])
+    packed = host_gather_batch(batch, order[: bounds[n_partitions]])
+    return [host_slice_batch(packed, int(bounds[p]), int(bounds[p + 1]))
             for p in range(n_partitions)]

@@ -27,6 +27,7 @@ order, trimmed to the logical row count (padding never hits the wire):
 from __future__ import annotations
 
 import struct
+import threading
 from typing import List, Tuple
 
 import numpy as np
@@ -190,12 +191,20 @@ def _decode_column(dtype, n: int, bufs: List[bytes], pos: int,
 def serialize_batch(batch: ColumnarBatch, codec: int = None) -> bytes:
     """Batch → one self-checking frame. Device padding is trimmed; string
     and array payloads keep only referenced bytes/elements."""
-    if codec is None:
-        codec = CODEC_LZ4 if lz4_available() else CODEC_COPY
     n = batch.num_rows_host
     bufs: List[np.ndarray] = []
     for col in batch.columns:
         _encode_column(col, n, bufs)
+    return _frame_from_bufs(bufs, n, batch.schema, codec)
+
+
+def _frame_from_bufs(bufs: List[np.ndarray], n: int, schema: Schema,
+                     codec: int = None) -> bytes:
+    """Shared frame assembly: trimmed buffers -> one self-checking
+    frame (the byte layout both serialize_batch and serialize_slice
+    produce — the slice path is byte-identical by construction)."""
+    if codec is None:
+        codec = CODEC_LZ4 if lz4_available() else CODEC_COPY
     raw_parts = [np.ascontiguousarray(b).tobytes() for b in bufs]
     raw = b"".join(raw_parts)
     if codec == CODEC_LZ4:
@@ -211,13 +220,29 @@ def serialize_batch(batch: ColumnarBatch, codec: int = None) -> bytes:
     # size table is sliced by n/nbuf): a flipped bit in any of them must
     # be a detected corruption, not garbage buffers or a misclassified
     # schema mismatch
-    shash = schema_fingerprint(batch.schema)
+    shash = schema_fingerprint(schema)
     hdr0 = _HEADER.pack(MAGIC, VERSION, codec, 0, n, shash,
                         len(raw), len(payload), 0, len(raw_parts))
     chk = xxh64(hdr0 + sizes + payload)
     header = _HEADER.pack(MAGIC, VERSION, codec, 0, n, shash,
                           len(raw), len(payload), chk, len(raw_parts))
     return header + sizes + payload
+
+
+def serialize_slice(batch: ColumnarBatch, lo: int, hi: int,
+                    codec: int = None) -> bytes:
+    """Encode rows [lo, hi) of a host-resident batch as one frame —
+    byte-identical to `serialize_batch(host_gather_batch(batch,
+    arange(lo, hi)))` but with ZERO gathers: offsets rebase in place,
+    validity lanes and payload bytes slice (ISSUE 9). The device
+    shuffle partitioner lands the batch partition-ordered, so every
+    partition is exactly such a row range."""
+    n = hi - lo
+    assert 0 <= lo <= hi, (lo, hi)
+    bufs: List[np.ndarray] = []
+    for col in batch.columns:
+        _encode_column(col, n, bufs, start=lo)
+    return _frame_from_bufs(bufs, n, batch.schema, codec)
 
 
 def deserialize_batch(frame: bytes, schema: Schema) -> ColumnarBatch:
@@ -263,12 +288,31 @@ def deserialize_batch(frame: bytes, schema: Schema) -> ColumnarBatch:
 # host row gather (writer-side partition split)
 # ---------------------------------------------------------------------------
 
-def host_gather_column(col: Column, idx: np.ndarray) -> Column:
+#: process-cumulative count of host-side row gathers (top-level
+#: host_gather_column calls; child recursions don't double-count).
+#: The device partition lane (ISSUE 9) pins this at ZERO per written
+#: batch on the hash/roundrobin/single lanes — the structural test and
+#: bench.py's {"shuffle": ...} block both read it.
+_host_gathers = 0
+_host_gathers_lock = threading.Lock()
+
+
+def host_gather_calls() -> int:
+    with _host_gathers_lock:
+        return _host_gathers
+
+
+def host_gather_column(col: Column, idx: np.ndarray,
+                       _toplevel: bool = True) -> Column:
     """Row-gather a device column into a compact host-backed column (used
     by the shuffle writer to split a batch into partition blocks). The
     result's arrays are numpy; serialize_batch consumes them directly."""
     from ..types import ArrayType  # noqa: F401
 
+    if _toplevel:
+        global _host_gathers
+        with _host_gathers_lock:
+            _host_gathers += 1
     validity = _np(col.validity)[idx] if len(idx) else np.zeros(0, np.bool_)
     cap = bucket_capacity(max(len(idx), 1))
     vpad = np.zeros(cap, np.bool_)
@@ -305,7 +349,7 @@ def host_gather_column(col: Column, idx: np.ndarray) -> Column:
                         + np.arange(total) - np.repeat(cum, lens))
         else:
             elem_idx = np.zeros(0, np.int64)
-        child = host_gather_column(col.child, elem_idx)
+        child = host_gather_column(col.child, elem_idx, _toplevel=False)
         return ArrayColumn(child, new_off, vpad,
                            col.dtype)
 
@@ -323,12 +367,13 @@ def host_gather_column(col: Column, idx: np.ndarray) -> Column:
                          + np.arange(total) - np.repeat(cum, lens))
         else:
             entry_idx = np.zeros(0, np.int64)
-        keys = host_gather_column(col.keys, entry_idx)
-        vals = host_gather_column(col.values, entry_idx)
+        keys = host_gather_column(col.keys, entry_idx, _toplevel=False)
+        vals = host_gather_column(col.values, entry_idx, _toplevel=False)
         return MapColumn(keys, vals, new_off, vpad, col.dtype)
 
     if isinstance(col, StructColumn):
-        kids = tuple(host_gather_column(c, idx) for c in col.children)
+        kids = tuple(host_gather_column(c, idx, _toplevel=False)
+                     for c in col.children)
         return type(col)(kids, vpad, col.dtype)  # incl. Decimal128
 
     data = _np(col.data)[idx] if len(idx) else \
@@ -342,3 +387,60 @@ def host_gather_batch(batch: ColumnarBatch, idx: np.ndarray
                       ) -> ColumnarBatch:
     cols = [host_gather_column(c, idx) for c in batch.columns]
     return ColumnarBatch(cols, len(idx), batch.schema)
+
+
+# ---------------------------------------------------------------------------
+# host row-range slice (partition emission without gathers)
+# ---------------------------------------------------------------------------
+
+def host_slice_column(col: Column, lo: int, hi: int) -> Column:
+    """Rows [lo, hi) of a host-backed column as a compact column — the
+    gather-free partition emission (ISSUE 9 satellite): offsets rebase
+    by subtraction, validity/data/bytes copy as contiguous slices.
+    Output arrays match host_gather_column(col, arange(lo, hi)) exactly
+    (same capacity buckets, same padding), so serialized frames are
+    byte-identical between the two paths."""
+    n = hi - lo
+    cap = bucket_capacity(max(n, 1))
+    vpad = np.zeros(cap, np.bool_)
+    vpad[:n] = _np(col.validity)[lo:hi]
+
+    def _sliced_offsets(off: np.ndarray):
+        base = int(off[lo])
+        end = int(off[hi]) if n else base
+        new_off = np.zeros(cap + 1, np.int32)
+        new_off[: n + 1] = off[lo: hi + 1] - base
+        new_off[n + 1:] = new_off[n]
+        return new_off, base, end
+
+    if isinstance(col, StringColumn):
+        new_off, base, end = _sliced_offsets(_np(col.offsets))
+        out = np.zeros(bucket_capacity(max(end - base, 1)), np.uint8)
+        out[: end - base] = _np(col.data)[base:end]
+        return StringColumn(out, new_off, vpad, col.dtype)
+
+    if isinstance(col, ArrayColumn):
+        new_off, base, end = _sliced_offsets(_np(col.offsets))
+        child = host_slice_column(col.child, base, end)
+        return ArrayColumn(child, new_off, vpad, col.dtype)
+
+    if isinstance(col, MapColumn):
+        new_off, base, end = _sliced_offsets(_np(col.offsets))
+        keys = host_slice_column(col.keys, base, end)
+        vals = host_slice_column(col.values, base, end)
+        return MapColumn(keys, vals, new_off, vpad, col.dtype)
+
+    if isinstance(col, StructColumn):
+        kids = tuple(host_slice_column(c, lo, hi) for c in col.children)
+        return type(col)(kids, vpad, col.dtype)  # incl. Decimal128
+
+    data = _np(col.data)
+    dpad = np.zeros(cap, data.dtype)
+    dpad[:n] = data[lo:hi]
+    return Column(dpad, vpad, col.dtype)
+
+
+def host_slice_batch(batch: ColumnarBatch, lo: int, hi: int
+                     ) -> ColumnarBatch:
+    cols = [host_slice_column(c, lo, hi) for c in batch.columns]
+    return ColumnarBatch(cols, hi - lo, batch.schema)

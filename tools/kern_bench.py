@@ -46,6 +46,9 @@ DEFAULT_SHAPES = {
     # (gathered rows, source capacity) — the packed-row gather shapes
     # the join emit and filter compaction actually dispatch
     "gather": [(1 << 14, 1 << 12), (1 << 16, 1 << 14)],
+    # (rows, n_partitions) — the device shuffle split pipeline: counts
+    # + stable permutation + one partition-ordered packed row gather
+    "partition_split": [(1 << 14, 8), (1 << 16, 32)],
 }
 
 #: smallest per-family shape for --quick CI smoke (compile + one
@@ -55,6 +58,7 @@ QUICK_SHAPES = {
     "scan_agg": [(1 << 12,)],
     "murmur3": [(1 << 14,)],
     "gather": [(1 << 11, 1 << 10)],
+    "partition_split": [(1 << 11, 4)],
 }
 
 
@@ -261,11 +265,69 @@ def bench_gather(shape, iters, reps, interpret):
             _timed(pallas_step, iters, reps))
 
 
+def bench_partition_split(shape, iters, reps, interpret):
+    """Device shuffle partition split (ISSUE 9): segment-sum counts +
+    stable sort-by-pid permutation + ONE partition-ordered packed row
+    gather over the 9-lane payload mix — the exact pipeline
+    `HostShuffleExchangeExec`'s device lane dispatches per written
+    batch. XLA lane serves the gather from ops/rowpack (the floor),
+    Pallas lane from the DMA kernel; the counts/permutation prefix is
+    shared, so the delta isolates the tiered step."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+    from spark_rapids_tpu.ops.pallas_gather import pallas_gather_rows
+    from spark_rapids_tpu.ops.partition_split import partition_table
+    from spark_rapids_tpu.ops.rowpack import gather_rows, pack_rows
+    from spark_rapids_tpu.types import BOOLEAN, DOUBLE, INT, LONG
+
+    rows, n_parts = shape
+    rng = np.random.default_rng(4)
+    cap = bucket_capacity(rows)
+    cols = [Column.from_numpy(
+        rng.integers(-(2**40), 2**40, rows).astype(np.int64), LONG,
+        capacity=cap)]
+    for _ in range(4):
+        cols.append(Column.from_numpy(
+            rng.integers(-1000, 1000, rows).astype(np.int32), INT,
+            capacity=cap))
+    cols.append(Column.from_numpy(rng.random(rows), DOUBLE, capacity=cap))
+    cols.append(Column.from_numpy(rng.integers(0, 2, rows).astype(bool),
+                                  BOOLEAN, capacity=cap))
+    plan, imat, fmat = pack_rows(cols)
+    pid = jnp.asarray(rng.integers(0, n_parts, cap), jnp.int32)
+    num_rows = jnp.int32(rows)
+
+    def split(gather_fn):
+        counts, order = partition_table(pid, num_rows, cap, n_parts)
+        gi, gf = gather_fn(plan, imat, fmat, order)
+        chk = jnp.sum(counts).astype(jnp.float64) \
+            + jnp.sum(gi, dtype=jnp.float64)
+        if gf is not None:
+            chk = chk + jnp.sum(gf).astype(jnp.float64)
+        return chk
+
+    @jax.jit
+    def xla_step(chk):
+        return chk + split(gather_rows)
+
+    @jax.jit
+    def pallas_step(chk):
+        return chk + split(
+            lambda p, i, f, idx: pallas_gather_rows(
+                p, i, f, idx, interpret=interpret))
+
+    return (_timed(xla_step, iters, reps),
+            _timed(pallas_step, iters, reps))
+
+
 BENCHES = {
     "join_probe": bench_join_probe,
     "scan_agg": bench_scan_agg,
     "murmur3": bench_murmur3,
     "gather": bench_gather,
+    "partition_split": bench_partition_split,
 }
 
 

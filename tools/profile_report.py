@@ -137,6 +137,20 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
     exch = total("exchange", "bytes")
     if exch:
         extras.append(f"exchange bytes: {_fmt_bytes(exch)}")
+    # shuffle-write roll-up (ISSUE 9): write time split pack (device
+    # partition + packed D2H) / serialize / file IO, byte and frame
+    # totals, and how many maps rode the device-partition lane
+    writes = [e for e in events if e.get("kind") == "shuffle_write"]
+    if writes:
+        n_dev = sum(1 for e in writes if e.get("lane") == "device")
+        extras.append(
+            f"shuffle writes: {len(writes)} maps "
+            f"({_fmt_bytes(total('shuffle_write', 'bytes'))} in "
+            f"{total('shuffle_write', 'frames')} frames; "
+            f"{n_dev} device-partitioned; pack "
+            f"{_fmt_ns(total('shuffle_write', 'pack_ns'))}, serialize "
+            f"{_fmt_ns(total('shuffle_write', 'serialize_ns'))}, io "
+            f"{_fmt_ns(total('shuffle_write', 'io_ns'))})")
     n_fb = sum(1 for e in events
                if e.get("kind") in ("plan_fallback", "plan_not_on_tpu"))
     if n_fb:
