@@ -147,28 +147,27 @@ def run_concurrent(worker):
     return results
 
 
-#: workload-counter snapshot at the previous workload_attribution()
-#: call (process-cumulative, reported as per-record deltas like chaos)
-_workload_prev = None
+#: per-family counter snapshots for the attribution blocks below —
+#: the underlying counters are process-cumulative, each BENCH record
+#: must report only ITS OWN lane's deltas (the chaos-delta pattern,
+#: ONE implementation shared by every flat counter family)
+_attr_prev = {}
+
+
+def _delta_since(family, cur):
+    prev = _attr_prev.get(family, {})
+    _attr_prev[family] = cur
+    return {k: v - prev.get(k, 0) for k, v in cur.items()}
 
 
 def workload_attribution():
     """{"workload": ...} block for each BENCH record: admissions,
     queue residency, sheds and quota spills this lane generated
     (exec/workload.py counters, as deltas since the previous record)."""
-    global _workload_prev
     from spark_rapids_tpu.exec import workload
-    cur = workload.counters()
-    prev = _workload_prev if _workload_prev is not None else {}
-    _workload_prev = cur
-    out = {k: v - prev.get(k, 0) for k, v in cur.items()}
+    out = _delta_since("workload", workload.counters())
     out["concurrency"] = _CONCURRENCY
     return out
-
-
-#: lifecycle-counter snapshot at the previous lifecycle_attribution()
-#: call (process-cumulative, reported as per-record deltas like chaos)
-_lifecycle_prev = None
 
 
 def lifecycle_attribution():
@@ -176,42 +175,22 @@ def lifecycle_attribution():
     breaker transitions and partition-vs-whole-plan recovery counts
     this lane absorbed (exec/lifecycle.py counters, as deltas since the
     previous record)."""
-    global _lifecycle_prev
     from spark_rapids_tpu.exec import lifecycle
-    cur = lifecycle.counters()
-    prev = _lifecycle_prev if _lifecycle_prev is not None else {}
-    _lifecycle_prev = cur
-    out = {k: v - prev.get(k, 0) for k, v in cur.items()}
+    out = _delta_since("lifecycle", lifecycle.counters())
     if _QUERY_TIMEOUT_MS is not None:
         out["query_timeout_ms"] = _QUERY_TIMEOUT_MS
     return out
-
-
-#: gather-engine counter snapshot at the previous gather_attribution()
-#: call (process-cumulative, reported as per-record deltas like chaos)
-_gather_prev = None
 
 
 def gather_attribution():
     """{"gather": ...} block for each BENCH record: materializing row
     gathers this lane dispatched, how many rode a packed (multi-column)
     row gather, and the estimated bytes moved (ops/gather.py counters,
-    as deltas since the previous record). A TPU round reads this next
-    to the q3 throughput to attribute a delta to the gather engine."""
-    global _gather_prev
+    as deltas since the previous record). pallas_count distinguishes
+    DMA-kernel-served gathers from the XLA fallback — without it a
+    throughput delta can't be attributed."""
     from spark_rapids_tpu.ops import gather as gather_engine
-    cur = gather_engine.counters()
-    prev = _gather_prev if _gather_prev is not None else {}
-    _gather_prev = cur
-    # pallas_count distinguishes DMA-kernel-served gathers from the XLA
-    # fallback — without it a throughput delta can't be attributed
-    return {k: cur[k] - prev.get(k, 0)
-            for k in ("count", "packed_count", "pallas_count", "bytes")}
-
-
-#: shuffle-counter snapshot at the previous shuffle_attribution() call
-#: (process-cumulative, reported as per-record deltas like chaos)
-_shuffle_prev = None
+    return _delta_since("gather", gather_engine.counters())
 
 
 def shuffle_attribution():
@@ -222,12 +201,8 @@ def shuffle_attribution():
     since the previous record). Lanes that never shuffle report zeros —
     the block is present in every record so a round can assert the
     device lane actually engaged."""
-    global _shuffle_prev
     from spark_rapids_tpu.shuffle import manager as shuffle_mgr
-    cur = shuffle_mgr.counters()
-    prev = _shuffle_prev if _shuffle_prev is not None else {}
-    _shuffle_prev = cur
-    return {k: v - prev.get(k, 0) for k, v in cur.items()}
+    return _delta_since("shuffle", shuffle_mgr.counters())
 
 
 #: counter snapshot at the previous chaos_attribution() call — the
@@ -354,6 +329,18 @@ def query_attribution(plan, before):
         return bench_profile_summary(plan, before)
     except Exception as e:  # noqa: BLE001 — attribution must never
         return {"error": f"{type(e).__name__}: {e}"[:200]}  # kill a lane
+
+def upload_attribution():
+    """{"upload": ...} block for each BENCH record (ISSUE 10): batch
+    uploads per lane (packed = one transfer | per-buffer), actual
+    host->device transfers dispatched, bytes moved, pack+transfer time
+    and staging-pool hit/miss counts (columnar/upload.py counters, as
+    deltas since the previous record). Lanes that never ingest report
+    zeros — the block is present in every record so a TPU round can
+    assert the packed lane actually engaged."""
+    from spark_rapids_tpu.columnar import upload as upload_engine
+    return _delta_since("upload", upload_engine.counters())
+
 
 def pipeline_attribution():
     """{"pipeline": ...} block for each BENCH record (ISSUE 3
@@ -603,6 +590,7 @@ def main():
         "workload": workload_attribution(),
         "gather": gather_attribution(),
         "shuffle": shuffle_attribution(),
+        "upload": upload_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:
@@ -771,6 +759,7 @@ def q3_bench():
         "workload": workload_attribution(),
         "gather": gather_attribution(),
         "shuffle": shuffle_attribution(),
+        "upload": upload_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:

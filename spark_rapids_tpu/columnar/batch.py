@@ -78,20 +78,30 @@ class ColumnarBatch:
         return ColumnarBatch(cols, n, schema)
 
     @staticmethod
-    def from_arrow(table) -> "ColumnarBatch":
-        """pyarrow Table/RecordBatch -> device batch (one capacity bucket)."""
+    def from_arrow(table, fault_key=None) -> "ColumnarBatch":
+        """pyarrow Table/RecordBatch -> device batch (one capacity
+        bucket). The scan ingest seam (ISSUE 10): columns are built
+        host-resident and the whole batch crosses the host->device
+        boundary through the packed upload engine — ONE transfer per
+        batch when `spark.rapids.tpu.transfer.packedUpload.enabled`
+        (default), one per buffer otherwise. `fault_key` is the batch's
+        chaos work-item key (the scan chunk offset)."""
         from ..types import from_arrow as type_from_arrow
+        from .column import host_build
+        from .upload import to_device_batch
         n = table.num_rows
         cap = bucket_capacity(n)
         fields, cols = [], []
-        for name in table.column_names:
-            arr = table.column(name)
-            col = column_from_arrow(arr)
-            if col.capacity < cap:
-                col = col.with_capacity(cap)
-            cols.append(col)
-            fields.append(StructField(name, col.dtype))
-        return ColumnarBatch(cols, n, Schema(tuple(fields)))
+        with host_build():
+            for name in table.column_names:
+                arr = table.column(name)
+                col = column_from_arrow(arr)
+                if col.capacity < cap:
+                    col = col.with_capacity(cap)
+                cols.append(col)
+                fields.append(StructField(name, col.dtype))
+        return to_device_batch(cols, n, Schema(tuple(fields)),
+                               fault_key=fault_key, seam="scan")
 
     # -- host materialization ---------------------------------------------
     # All three fetch the whole batch as ONE packed d2h transfer

@@ -21,6 +21,8 @@ Design (TPU-first, NOT a cuDF translation):
 
 from __future__ import annotations
 
+import threading
+from contextlib import contextmanager
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -34,6 +36,50 @@ from ..types import (
 
 #: minimum capacity bucket — one TPU lane row
 MIN_BUCKET = 128
+
+#: host-build mode (ISSUE 10): inside `host_build()` every constructor
+#: lane keeps its buffers as numpy instead of uploading them one
+#: jnp.asarray at a time, so the packed upload engine
+#: (columnar/upload.py) can ship the whole batch as ONE transfer
+_BUILD_TLS = threading.local()
+
+
+def _dev(x):
+    """Constructor-lane leaf placement: device by default, numpy under
+    host_build() (the packed-upload staging mode)."""
+    if getattr(_BUILD_TLS, "host", False):
+        return x if isinstance(x, np.ndarray) else np.asarray(x)
+    return jnp.asarray(x)
+
+
+@contextmanager
+def host_build():
+    """Build columns with numpy-resident buffers (no per-buffer device
+    uploads); promote the finished batch through columnar/upload.py."""
+    prev = getattr(_BUILD_TLS, "host", False)
+    _BUILD_TLS.host = True
+    try:
+        yield
+    finally:
+        _BUILD_TLS.host = prev
+
+
+def _pad_tail(arr, extra: int):
+    """Zero-pad the leading axis by `extra` slots, staying numpy for
+    numpy inputs (host-built columns must not silently hop to device)."""
+    pad = [(0, extra)] + [(0, 0)] * (arr.ndim - 1)
+    if isinstance(arr, np.ndarray):
+        return np.pad(arr, pad)
+    return jnp.pad(arr, pad)
+
+
+def _extend_offsets(off, extra: int):
+    """Repeat the final offset `extra` times (zero-length padding rows),
+    numpy-in numpy-out."""
+    if isinstance(off, np.ndarray):
+        return np.concatenate([off, np.full(extra, off[-1], off.dtype)])
+    return jnp.concatenate(
+        [off, jnp.broadcast_to(off[-1], (extra,))])
 
 
 def _logical_to_physical(dtype: DataType):
@@ -112,7 +158,7 @@ class Column:
             validity = np.ones(n, dtype=np.bool_)
         data = _pad_np(np.ascontiguousarray(values, dtype=dtype.jnp_dtype), cap)
         valid = _pad_np(validity.astype(np.bool_), cap, fill=False)
-        return Column(jnp.asarray(data), jnp.asarray(valid), dtype)
+        return Column(_dev(data), _dev(valid), dtype)
 
     @staticmethod
     def from_pylist(values: Sequence, dtype: DataType,
@@ -136,8 +182,9 @@ class Column:
         if capacity == cap:
             return self
         assert capacity > cap, (capacity, cap)
-        pad = [(0, capacity - cap)]
-        return Column(jnp.pad(self.data, pad), jnp.pad(self.validity, pad), self.dtype)
+        extra = capacity - cap
+        return Column(_pad_tail(self.data, extra),
+                      _pad_tail(self.validity, extra), self.dtype)
 
     # -- host materialization (test/debug surface) -------------------------
     def to_pylist(self, num_rows: int) -> List:
@@ -181,8 +228,7 @@ class StringColumn(Column):
             data[:total] = np.frombuffer(b"".join(raw), dtype=np.uint8)
         validity = _pad_np(np.array([v is not None for v in values], dtype=np.bool_),
                            cap, fill=False)
-        return StringColumn(jnp.asarray(data), jnp.asarray(offsets),
-                            jnp.asarray(validity), dtype)
+        return StringColumn(_dev(data), _dev(offsets), _dev(validity), dtype)
 
     @property
     def capacity(self) -> int:
@@ -198,9 +244,8 @@ class StringColumn(Column):
             return self
         assert capacity > cap
         extra = capacity - cap
-        offsets = jnp.concatenate(
-            [self.offsets, jnp.broadcast_to(self.offsets[-1], (extra,))])
-        validity = jnp.pad(self.validity, [(0, extra)])
+        offsets = _extend_offsets(self.offsets, extra)
+        validity = _pad_tail(self.validity, extra)
         return StringColumn(self.data, offsets, validity, self.dtype)
 
     def with_byte_capacity(self, byte_capacity: int) -> "StringColumn":
@@ -208,7 +253,7 @@ class StringColumn(Column):
         if byte_capacity == self.byte_capacity:
             return self
         assert byte_capacity > self.byte_capacity
-        data = jnp.pad(self.data, [(0, byte_capacity - self.byte_capacity)])
+        data = _pad_tail(self.data, byte_capacity - self.byte_capacity)
         return StringColumn(data, self.offsets, self.validity, self.dtype)
 
     def to_pylist(self, num_rows: int) -> List[Optional[str]]:
@@ -255,7 +300,7 @@ class StructColumn(Column):
                   (v.get(f.name) if isinstance(v, dict)
                    else getattr(v, f.name)) for v in values]
             kids.append(build_column(fv, f.data_type, cap))
-        return StructColumn(tuple(kids), jnp.asarray(validity), dtype)
+        return StructColumn(tuple(kids), _dev(validity), dtype)
 
     def to_pylist(self, num_rows: int) -> List:
         valid = np.asarray(self.validity[:num_rows])
@@ -312,11 +357,11 @@ class Decimal128Column(StructColumn):
             hi = u >> 64
             los[i] = lo - (1 << 64) if lo >= (1 << 63) else lo
             his[i] = hi - (1 << 64) if hi >= (1 << 63) else hi
-        vpad = jnp.asarray(_pad_np(validity, cap, False))
+        vpad = _dev(_pad_np(validity, cap, False))
         from ..types import LONG
         return Decimal128Column(
-            (Column(jnp.asarray(_pad_np(his, cap)), vpad, LONG),
-             Column(jnp.asarray(_pad_np(los, cap)), vpad, LONG)),
+            (Column(_dev(_pad_np(his, cap)), vpad, LONG),
+             Column(_dev(_pad_np(los, cap)), vpad, LONG)),
             vpad, dtype)
 
     def to_pylist(self, num_rows: int) -> List:
@@ -367,8 +412,7 @@ class ArrayColumn(Column):
         off[n + 1:] = off[n] if n else 0
         flat = [x for v in values if v is not None for x in v]
         child = build_column(flat, dtype.element_type)
-        return ArrayColumn(child, jnp.asarray(off),
-                           jnp.asarray(validity), dtype)
+        return ArrayColumn(child, _dev(off), _dev(validity), dtype)
 
     def to_pylist(self, num_rows: int) -> List:
         offsets = np.asarray(self.offsets)
@@ -423,8 +467,7 @@ class MapColumn(Column):
         vals = build_column([x for _, x in items], dtype.value_type)
         # keys and values index in lockstep by construction
         assert keys.capacity == vals.capacity
-        return MapColumn(keys, vals, jnp.asarray(off),
-                         jnp.asarray(validity), dtype)
+        return MapColumn(keys, vals, _dev(off), _dev(validity), dtype)
 
     def with_capacity(self, capacity: int) -> "MapColumn":
         cap = self.capacity
@@ -432,9 +475,8 @@ class MapColumn(Column):
             return self
         assert capacity > cap, (capacity, cap)
         extra = capacity - cap
-        offsets = jnp.concatenate(
-            [self.offsets, jnp.broadcast_to(self.offsets[-1], (extra,))])
-        validity = jnp.pad(self.validity, [(0, extra)])
+        offsets = _extend_offsets(self.offsets, extra)
+        validity = _pad_tail(self.validity, extra)
         return MapColumn(self.keys, self.values, offsets, validity,
                          self.dtype)
 
@@ -540,7 +582,12 @@ jax.tree_util.register_pytree_node(Decimal128Column, _struct_flatten,
 def _string_from_arrow_buffers(arr, dt: DataType, n: int) -> StringColumn:
     """Arrow string/binary array -> device column straight from the Arrow
     (validity bitmap, offsets, bytes) buffers — no per-value Python loop
-    (review finding r1: `to_pylist` dominated string-heavy scans)."""
+    (review finding r1: `to_pylist` dominated string-heavy scans).
+
+    ISSUE 10 satellite: offsets and data each copy out of the Arrow
+    snapshot exactly ONCE, straight into their padded buffers (the old
+    lane materialized offsets twice — astype then rebase — before the
+    padded copy, a host-side double-copy on every string scan batch)."""
     import pyarrow as pa
 
     if pa.types.is_large_string(arr.type):
@@ -548,19 +595,23 @@ def _string_from_arrow_buffers(arr, dt: DataType, n: int) -> StringColumn:
     elif pa.types.is_large_binary(arr.type):
         arr = arr.cast(pa.binary())
     bufs = arr.buffers()
+    # ONE zero-copy snapshot of the Arrow offsets; the single copy below
+    # lands them in the padded buffer, where the rebase runs in place
     off_all = np.frombuffer(bufs[1], dtype=np.int32)
-    offsets = off_all[arr.offset: arr.offset + n + 1].astype(np.int32)
-    base = offsets[0] if n else 0
-    offsets = offsets - base
-    total = int(offsets[-1]) if n else 0
     cap = bucket_capacity(n)
-    off_padded = np.full(cap + 1, total, dtype=np.int32)
-    off_padded[: n + 1] = offsets
+    off_padded = np.empty(cap + 1, dtype=np.int32)
+    off_padded[: n + 1] = off_all[arr.offset: arr.offset + n + 1]
+    base = int(off_padded[0]) if n else 0
+    if base:
+        off_padded[: n + 1] -= base
+    total = int(off_padded[n]) if n else 0
+    off_padded[n + 1:] = total
     byte_cap = bucket_capacity(max(total, 1))
     data = np.zeros(byte_cap, dtype=np.uint8)
     if total:
+        # ONE copy out of the shared bytes snapshot (frombuffer is a view)
         data[:total] = np.frombuffer(bufs[2], dtype=np.uint8,
-                                     count=total, offset=int(base))
+                                     count=total, offset=base)
     if bufs[0] is None:
         validity = np.ones(n, dtype=np.bool_)
     else:
@@ -571,11 +622,11 @@ def _string_from_arrow_buffers(arr, dt: DataType, n: int) -> StringColumn:
     # kernels promise 0 for nulls — rebuild through the slow path in that
     # (rare in practice) case
     if n and not validity.all():
-        lens_np = np.diff(offsets)
+        lens_np = np.diff(off_padded[: n + 1])
         if (lens_np[~validity] != 0).any():
             return StringColumn.from_pylist(arr.to_pylist(), dtype=dt)
-    return StringColumn(jnp.asarray(data), jnp.asarray(off_padded),
-                        jnp.asarray(_pad_np(validity, cap, False)), dt)
+    return StringColumn(_dev(data), _dev(off_padded),
+                        _dev(_pad_np(validity, cap, False)), dt)
 
 
 def column_from_arrow(arr, dtype: Optional[DataType] = None) -> Column:
@@ -593,7 +644,7 @@ def column_from_arrow(arr, dtype: Optional[DataType] = None) -> Column:
         kids = tuple(column_from_arrow(arr.field(i), f.data_type)
                      for i, f in enumerate(dt.fields))
         cap = bucket_capacity(n)
-        return StructColumn(kids, jnp.asarray(_pad_np(validity, cap, False)), dt)
+        return StructColumn(kids, _dev(_pad_np(validity, cap, False)), dt)
     if isinstance(dt, ArrayType):
         validity = np.asarray(arr.is_valid())
         offsets = np.asarray(arr.offsets, dtype=np.int32)
@@ -602,8 +653,8 @@ def column_from_arrow(arr, dtype: Optional[DataType] = None) -> Column:
         off[: n + 1] = offsets
         off[n + 1 :] = offsets[n] if n else 0
         child = column_from_arrow(arr.values, dt.element_type)
-        return ArrayColumn(child, jnp.asarray(off),
-                           jnp.asarray(_pad_np(validity, cap, False)), dt)
+        return ArrayColumn(child, _dev(off),
+                           _dev(_pad_np(validity, cap, False)), dt)
     from ..types import MapType as _MapType
     if isinstance(dt, _MapType):
         validity = np.asarray(arr.is_valid())
@@ -615,11 +666,12 @@ def column_from_arrow(arr, dtype: Optional[DataType] = None) -> Column:
         keys = column_from_arrow(arr.keys, dt.key_type)
         vals = column_from_arrow(arr.items, dt.value_type)
         assert keys.capacity == vals.capacity  # same entry count
-        return MapColumn(keys, vals, jnp.asarray(off),
-                         jnp.asarray(_pad_np(validity, cap, False)), dt)
+        return MapColumn(keys, vals, _dev(off),
+                         _dev(_pad_np(validity, cap, False)), dt)
     if isinstance(dt, NullType):
         cap = bucket_capacity(max(n, 1))
-        return Column(jnp.zeros(cap, jnp.int8), jnp.zeros(cap, jnp.bool_), dt)
+        return Column(_dev(np.zeros(cap, np.int8)),
+                      _dev(np.zeros(cap, np.bool_)), dt)
     if isinstance(dt, DecimalType):
         pylist = arr.to_pylist()
         if dt.precision > 18:

@@ -158,6 +158,31 @@ def _pack_split_impl(counts, columns) -> jnp.ndarray:
 _pack_split_jit = jax.jit(_pack_split_impl)
 
 
+def pack_split(counts, columns) -> jnp.ndarray:
+    """Traceable split packer: (count table, partition-ordered columns)
+    -> one uint8 buffer. Exposed so the exchange can fuse it INTO the
+    partition-split traced program (ISSUE 10 satellite — shuffle write
+    is ONE dispatch, split + reorder + pack)."""
+    return _pack_split_impl(counts, list(columns))
+
+
+def unpack_split_host(buf: np.ndarray, template_columns,
+                      n_parts: int) -> Tuple[np.ndarray, List[Column]]:
+    """Host-side unpack of a pack_split buffer. `template_columns` only
+    provides the layout (class / capacity / dtype per column) — column
+    objects or `jax.eval_shape` results both work, so the fused
+    split+pack program never has to materialize per-column device
+    arrays. Returns (counts int64 numpy, numpy-backed columns)."""
+    host_counts = buf[: 4 * n_parts].view(np.int32).astype(np.int64)
+    pos = 4 * n_parts
+    out: List[Column] = []
+    for col in template_columns:
+        host_col, pos = _unpack_column(col, buf, pos)
+        out.append(host_col)
+    assert pos == buf.shape[0], (pos, buf.shape)
+    return host_counts, out
+
+
 def fetch_split_host(counts, columns) -> Tuple[np.ndarray, List[Column]]:
     """Packed D2H lane for the device shuffle partition split (ISSUE 9):
     land the per-partition count table AND the partition-ordered columns
@@ -169,14 +194,7 @@ def fetch_split_host(counts, columns) -> Tuple[np.ndarray, List[Column]]:
     """
     n_parts = int(counts.shape[0])
     buf = np.asarray(_pack_split_jit(counts, list(columns)))  # ONE d2h
-    host_counts = buf[: 4 * n_parts].view(np.int32).astype(np.int64)
-    pos = 4 * n_parts
-    out: List[Column] = []
-    for col in columns:
-        host_col, pos = _unpack_column(col, buf, pos)
-        out.append(host_col)
-    assert pos == buf.shape[0], (pos, buf.shape)
-    return host_counts, out
+    return unpack_split_host(buf, columns, n_parts)
 
 
 def fetch_batch_host(batch) -> Tuple[List[Column], int]:

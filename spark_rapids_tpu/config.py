@@ -258,6 +258,30 @@ SHUFFLE_DEVICE_PARTITION = conf_bool(
     "Off restores the host argsort-and-slice partitioner.",
     commonly_used=True)
 
+UPLOAD_PACKED = conf_bool(
+    "spark.rapids.tpu.transfer.packedUpload.enabled", True,
+    "Packed host->device batch upload (columnar/upload.py — the ingest "
+    "mirror of the packed D2H fetch): a decoded batch's row count and "
+    "every column buffer are laid into ONE contiguous uint8 staging "
+    "buffer drawn from a reusable capacity-bucketed pool, cross the "
+    "host->device boundary as ONE transfer, and a jitted device program "
+    "slices/bitcasts them back into column arrays — byte-identical to "
+    "the per-buffer jnp.asarray lane. Wired at every ingest seam: scan "
+    "batch upload, shuffle-read decode promotion, and spill unspill "
+    "(the reference's JCudfSerialization / HostConcatResult one-copy "
+    "table shape). Off, or for column trees the packer does not "
+    "recognize, each buffer uploads individually (2-3 transfers per "
+    "column).",
+    commonly_used=True)
+
+UPLOAD_POOL_BYTES = conf_bytes(
+    "spark.rapids.tpu.transfer.packedUpload.poolBytes", 256 * 1024 * 1024,
+    "Total bytes of IDLE staging buffers the packed-upload pool may "
+    "retain (the pinned-host-memory analog). Buffers are "
+    "capacity-bucketed powers of two, reused LIFO (cache-warm) and "
+    "trimmed least-recently-used past this cap; in-flight buffers are "
+    "never capped. 0 disables pooling (every upload allocates).")
+
 SHUFFLE_WRITER_THREADS = conf_int(
     "spark.rapids.shuffle.multiThreaded.writer.threads", 8,
     "Writer-side serialization threads (reference "

@@ -115,6 +115,8 @@ PIPELINE_FULL_WAIT = "pipelineFullWaitNs"
 PIPELINE_WALL = "pipelineWallNs"
 NUM_GATHERS = "numGathers"
 GATHER_TIME = "gatherTimeNs"
+NUM_UPLOADS = "numUploads"
+UPLOAD_PACK_TIME = "uploadPackTimeNs"
 
 #: the closed set of metric names execs may register — one name, one
 #: meaning, exactly like the reference's GpuMetric companion object.
@@ -128,6 +130,7 @@ CANONICAL_METRICS = frozenset({
     BROADCAST_TIME,
     PIPELINE_WAIT, PIPELINE_FULL_WAIT, PIPELINE_WALL,
     NUM_GATHERS, GATHER_TIME,
+    NUM_UPLOADS, UPLOAD_PACK_TIME,
 })
 
 #: per-operator instance ids for event/span attribution (two
@@ -150,6 +153,12 @@ PIPELINE_STAGE_METRICS = ((PIPELINE_WAIT, MODERATE),
 #: structural count of materializing row gathers per execution and the
 #: wall-ns of the gather-bearing kernel dispatches
 GATHER_METRICS = ((NUM_GATHERS, MODERATE), (GATHER_TIME, MODERATE))
+
+#: the metric pair every upload-engine-wired exec registers (include in
+#: additional_metrics(); attributed via columnar.upload.metric_sink /
+#: promote_stream): batch uploads this execution dispatched and the
+#: wall-ns spent packing + transferring them
+UPLOAD_METRICS = ((NUM_UPLOADS, MODERATE), (UPLOAD_PACK_TIME, MODERATE))
 
 
 class TpuExec:

@@ -19,8 +19,9 @@ from ..memory.spillable import SpillableBatch
 from ..ops.basic import active_mask, compact_columns, sanitize, slice_rows
 from ..types import LongType, Schema, StructField
 from .base import (GATHER_METRICS, GATHER_TIME, NUM_GATHERS,
-                   NUM_INPUT_BATCHES, NUM_INPUT_ROWS, OP_TIME,
-                   PIPELINE_STAGE_METRICS, TpuExec)
+                   NUM_INPUT_BATCHES, NUM_INPUT_ROWS, NUM_UPLOADS,
+                   OP_TIME, PIPELINE_STAGE_METRICS, UPLOAD_METRICS,
+                   UPLOAD_PACK_TIME, TpuExec)
 
 
 class InMemoryScanExec(TpuExec):
@@ -64,7 +65,7 @@ class SourceScanExec(TpuExec):
         return self._schema
 
     def additional_metrics(self):
-        return PIPELINE_STAGE_METRICS
+        return PIPELINE_STAGE_METRICS + UPLOAD_METRICS
 
     @property
     def runs_own_pipeline_stage(self) -> bool:
@@ -85,6 +86,7 @@ class SourceScanExec(TpuExec):
         concurrent queries' scans aren't starved for the stream's
         lifetime (the reference holds per active device work, not per
         stream)."""
+        from ..columnar.upload import metric_sink
         from ..memory.semaphore import tpu_semaphore
         from .pipeline import cancelled
         sem = tpu_semaphore()
@@ -103,7 +105,13 @@ class SourceScanExec(TpuExec):
                                                 cancel=cancelled):
                     return  # consumer closed the stage while we waited
                 try:
-                    batch = next(it)
+                    # the decode + packed device upload of this batch
+                    # happen inside next(it) on THIS (producer) thread:
+                    # the sink attributes them to this scan's
+                    # numUploads/uploadPackTimeNs (ISSUE 10)
+                    with metric_sink(self.metrics[NUM_UPLOADS],
+                                     self.metrics[UPLOAD_PACK_TIME]):
+                        batch = next(it)
                 except StopIteration:
                     return
                 finally:

@@ -62,8 +62,13 @@ class CachedRelation:
             if self._frames is None:
                 self._materialize()
             frames = self._frames  # snapshot: concurrent unpersist-safe
-        for fr in frames:
-            yield deserialize_batch(fr, self.schema)
+        for i, fr in enumerate(frames):
+            # the frame ordinal keys the decode's packed-upload chaos
+            # draws: concurrent producer threads replaying a cached
+            # relation must not let OS scheduling permute which batch
+            # draws a seeded fault (the shuffle.decode key discipline)
+            yield deserialize_batch(fr, self.schema,
+                                    fault_key=f"cache:{i}")
 
     def estimated_size_bytes(self) -> int:
         if self._frames is not None:

@@ -37,9 +37,10 @@ from .base import (BROADCAST_TIME, DEBUG, ESSENTIAL, GATHER_METRICS,
                    GATHER_TIME, MODERATE,
                    NUM_GATHERS, NUM_INPUT_BATCHES, NUM_INPUT_ROWS,
                    NUM_OUTPUT_BATCHES,
-                   NUM_OUTPUT_ROWS, OP_TIME, PARTITION_SIZE,
+                   NUM_OUTPUT_ROWS, NUM_UPLOADS, OP_TIME, PARTITION_SIZE,
                    PIPELINE_STAGE_METRICS, SHUFFLE_PACK_TIME,
-                   SHUFFLE_READ_TIME, SHUFFLE_WRITE_TIME, TpuExec)
+                   SHUFFLE_READ_TIME, SHUFFLE_WRITE_TIME,
+                   UPLOAD_METRICS, UPLOAD_PACK_TIME, TpuExec)
 from .basic import InMemoryScanExec, bind_projection
 from .coalesce import concat_batches
 
@@ -388,7 +389,19 @@ class HostShuffleExchangeExec(TpuExec):
         self._device_partition = (
             partitioning in ("hash", "roundrobin", "single")
             and bool(self._conf.get(SHUFFLE_DEVICE_PARTITION)))
-        self._jit_split = jax.jit(self._split_kernel)
+        # fused split+pack (ISSUE 10 satellite, the round-9 TODO): the
+        # D2H packer is traced INTO the partition-split program, so a
+        # written batch costs ONE dispatch (pid -> counts + permutation
+        # -> packed reorder -> packed uint8 buffer) + ONE D2H copy,
+        # instead of a split dispatch followed by a pack dispatch
+        from ..columnar import transfer as _transfer
+        self._jit_split = jax.jit(
+            lambda b, off: _transfer.pack_split(
+                *self._split_kernel(b, off)))
+        #: host unpack templates per compiled shape key (abstract shapes
+        #: via eval_shape — no device work, no gather-recorder side
+        #: effects: eval_shape runs OUTSIDE the tracker's observe)
+        self._split_templates = {}
         from ..ops.gather import GatherTracker
         self._gather_track = GatherTracker(self.metrics[NUM_GATHERS],
                                            self.metrics[GATHER_TIME])
@@ -401,7 +414,7 @@ class HostShuffleExchangeExec(TpuExec):
         return ((NUM_INPUT_BATCHES, DEBUG), (NUM_INPUT_ROWS, DEBUG),
                 (PARTITION_SIZE, ESSENTIAL), SHUFFLE_WRITE_TIME,
                 SHUFFLE_READ_TIME, (SHUFFLE_PACK_TIME, MODERATE)) \
-            + GATHER_METRICS + PIPELINE_STAGE_METRICS
+            + GATHER_METRICS + UPLOAD_METRICS + PIPELINE_STAGE_METRICS
 
     @property
     def runs_own_pipeline_stage(self) -> bool:
@@ -437,9 +450,11 @@ class HostShuffleExchangeExec(TpuExec):
     def _device_split(self, b: ColumnarBatch, n: int):
         """Split one batch on device: returns (host columns in
         partition-major order, exclusive bounds (n_partitions+1,)).
-        ONE packed D2H lands the count table and the reordered payload
-        together (columnar/transfer.fetch_split_host) — the offset
-        table is the split's only host-synced control value."""
+        The split, the reorder AND the D2H packer run as ONE fused
+        traced program (ISSUE 10 satellite) whose packed uint8 buffer
+        lands the count table and the reordered payload in ONE D2H copy
+        — the offset table is the split's only host-synced control
+        value, and a written batch costs exactly one dispatch."""
         import numpy as np
         from ..columnar import transfer
         if self.partitioning == "single":
@@ -457,9 +472,20 @@ class HostShuffleExchangeExec(TpuExec):
             key = (self.partitioning, b.capacity, tuple(
                 (tuple(leaf.shape), str(leaf.dtype))
                 for leaf in jax.tree_util.tree_leaves(list(b.columns))))
+            tmpl = self._split_templates.get(key)
+            if tmpl is None:
+                # abstract column shapes for the host-side unpack of the
+                # fused program's packed buffer (computed BEFORE observe:
+                # eval_shape re-traces the split and must not double the
+                # tracker's structural gather counts)
+                _c, tmpl = jax.eval_shape(self._split_kernel, b,
+                                          jnp.int32(off))
+                self._split_templates[key] = tmpl
             with self._gather_track.observe(key):
-                dev_counts, dev_cols = self._jit_split(b, jnp.int32(off))
-            counts, cols = transfer.fetch_split_host(dev_counts, dev_cols)
+                buf_dev = self._jit_split(b, jnp.int32(off))
+            buf = np.asarray(buf_dev)  # the ONE d2h copy
+            counts, cols = transfer.unpack_split_host(
+                buf, tmpl, self.n_partitions)
         bounds = np.zeros(self.n_partitions + 1, np.int64)
         np.cumsum(counts, out=bounds[1:])
         return cols, bounds
@@ -789,10 +815,18 @@ class HostShuffleExchangeExec(TpuExec):
         the segment fetch + LZ4 decode of block k+1 run on the producer
         thread (over the reader pool) while the consumer computes on
         block k; shuffleReadTime counts only the time this operator
-        BLOCKED waiting for a block, in both modes."""
+        BLOCKED waiting for a block, in both modes. Decoded blocks are
+        HOST-backed (ISSUE 10): this seam promotes each to device as
+        ONE packed upload, keyed per (partition, batch ordinal) for
+        seeded chaos and attributed to numUploads/uploadPackTimeNs."""
+        from ..columnar.upload import promote_stream
         read_time = self.metrics[SHUFFLE_READ_TIME]
-        stage = self.pipeline_stage(reader.read_partition(p),
-                                    "shuffle-read")
+        stage = self.pipeline_stage(
+            promote_stream(reader.read_partition(p),
+                           key_prefix=f"upload:p{p}", seam="shuffle",
+                           num_metric=self.metrics[NUM_UPLOADS],
+                           time_metric=self.metrics[UPLOAD_PACK_TIME]),
+            "shuffle-read")
         saw = False
         try:
             while True:

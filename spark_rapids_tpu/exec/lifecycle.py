@@ -301,6 +301,10 @@ FAMILY_DOMAINS: Dict[str, str] = {
     # it degrades with the same breaker domain
     "partition_split": "pallas_gather",
     "murmur3": "pallas_hash",
+    # the packed upload's single device copy is a guarded device
+    # dispatch (it rides the device.dispatch fault point); repeated
+    # upload failures implicate the device itself
+    "h2d_upload": "device_dispatch",
 }
 
 BREAKER_STATES = ("closed", "open", "half_open")

@@ -131,10 +131,14 @@ def threaded_chunks(tasks: Sequence[Callable[[], "object"]],
 
 
 def arrow_to_batches(table, target_rows: int) -> Iterator[ColumnarBatch]:
-    """Split a host arrow table into device batches of ~target_rows."""
+    """Split a host arrow table into device batches of ~target_rows.
+    The slice offset keys each batch's upload for seeded chaos (the
+    work item is the row range, not the thread that happens to decode
+    it)."""
     n = table.num_rows
     if n == 0:
-        yield ColumnarBatch.from_arrow(table)
+        yield ColumnarBatch.from_arrow(table, fault_key="scan:0")
         return
     for start in range(0, n, target_rows):
-        yield ColumnarBatch.from_arrow(table.slice(start, target_rows))
+        yield ColumnarBatch.from_arrow(table.slice(start, target_rows),
+                                       fault_key=f"scan:{start}")

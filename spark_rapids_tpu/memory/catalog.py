@@ -377,7 +377,6 @@ class BufferCatalog:
 
     def _unspill_locked(self, entry: _Entry):
         from .budget import memory_budget
-        import jax.numpy as jnp
         if entry.tier == StorageTier.DISK:
             try:
                 entry.host_leaves = _read_npz(entry.disk_path,
@@ -420,7 +419,25 @@ class BufferCatalog:
                                     wait_for_writeback=False)
             from ..exec import workload
             workload.charge(entry.owner, entry.nbytes)
-            leaves = [jnp.asarray(a) for a in entry.host_leaves]
+            # unspill ingest seam (ISSUE 10): the whole spilled tree
+            # crosses host->device as ONE packed upload (per-leaf
+            # jnp.asarray when packedUpload is off), keyed by the
+            # entry's deterministic registration ordinal for seeded
+            # chaos. The upload can now FAIL (injected device fault /
+            # real device error) between the charge above and the tier
+            # flip below — unwind both, or the entry stays HOST with
+            # the reservation and quota charge leaked forever (remove()
+            # only releases DEVICE-tier entries, and a retried acquire
+            # would charge again)
+            from ..columnar.upload import upload_leaves
+            try:
+                leaves = upload_leaves(entry.host_leaves,
+                                       fault_key=f"unspill:{entry.seq}",
+                                       seam="unspill")
+            except BaseException:
+                memory_budget().release(entry.nbytes)
+                workload.discharge(entry.owner, entry.nbytes)
+                raise
             entry.device_tree = jax.tree_util.tree_unflatten(
                 entry.treedef, leaves)
             entry.host_leaves = None

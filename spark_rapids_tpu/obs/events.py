@@ -89,6 +89,10 @@ EVENT_LEVELS: Dict[str, int] = {
     "query_admitted": MODERATE,
     "query_shed": ESSENTIAL,
     "quota_spill": MODERATE,
+    # packed upload engine (ISSUE 10): one record per host->device batch
+    # upload with the lane (packed = one transfer | per-buffer), the
+    # ingest seam (scan / shuffle / unspill) and the pack+transfer time
+    "upload": MODERATE,
     # gather engine (ISSUE 8): one record per wired-exec execution with
     # its materializing-gather totals (count/packed/pallas/bytes) —
     # reconciles with the numGathers metric and op_close batch counts

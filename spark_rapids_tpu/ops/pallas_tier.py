@@ -31,8 +31,13 @@ from typing import Dict, Optional, Tuple
 #: `auto` selection is a measurement, and (c) a row in the docs/perf.md
 #: tier table — tests/test_docs_lint.py lints all three (the registries
 #: drifted silently before measurement-gating existed).
+#: `h2d_upload` is the odd one out: its two bench lanes are the packed
+#: one-copy upload vs the per-buffer jnp.asarray lane (no Pallas kernel
+#: — the gate is spark.rapids.tpu.transfer.packedUpload.enabled, not a
+#: tier consult), registered here so the kern_bench/docs/breaker-domain
+#: lints cover it like every other measured family.
 PALLAS_FAMILIES = ("murmur3", "join_probe", "scan_agg", "gather",
-                   "partition_split")
+                   "partition_split", "h2d_upload")
 
 #: kern_bench.json layout version. The records file is rewritten by
 #: tools/kern_bench.py with this stamp; a file from an older layout

@@ -263,7 +263,12 @@ class HostShuffleReader:
         failure surfaces as a task-retry so the query recomputes."""
         frame = faults.apply("shuffle.decode", frame, key=key or None)
         try:
-            return deserialize_batch(frame, self.handle.schema)
+            # host-backed decode: device promotion happens at the
+            # exchange's read seam (ONE packed upload per batch, on the
+            # pipeline producer thread — ISSUE 10), not on this pool
+            # thread
+            return deserialize_batch(frame, self.handle.schema,
+                                     device=False)
         except CorruptFrameError as e:
             from ..obs import events as obs_events
             obs_events.emit("integrity_fail", what="shuffle_block",

@@ -108,8 +108,12 @@ def _encode_column(col: Column, n: int, out: List[np.ndarray],
 
 def _decode_column(dtype, n: int, bufs: List[bytes], pos: int,
                    capacity: int) -> Tuple[Column, int]:
-    import jax.numpy as jnp
-
+    """Decode one column's buffers into a column whose leaves follow
+    the active build mode (`columnar.column._dev`): numpy under
+    `host_build()` — the ISSUE 10 decode path, so the whole batch can
+    promote to device as ONE packed upload — device-per-buffer
+    otherwise."""
+    from ..columnar.column import _dev
     from ..types import ArrayType, StringType, StructType
 
     vbits = np.frombuffer(bufs[pos], dtype=np.uint8)
@@ -124,14 +128,14 @@ def _decode_column(dtype, n: int, bufs: List[bytes], pos: int,
         for f in dtype.fields:
             k, pos = _decode_column(f.data_type, n, bufs, pos, capacity)
             kids.append(k)
-        return StructColumn(tuple(kids), jnp.asarray(vpad), dtype), pos
+        return StructColumn(tuple(kids), _dev(vpad), dtype), pos
 
     from ..types import DecimalType, LongType
     if isinstance(dtype, DecimalType) and dtype.precision > 18:
         from ..columnar.column import Decimal128Column
         hi, pos = _decode_column(LongType(), n, bufs, pos, capacity)
         lo, pos = _decode_column(LongType(), n, bufs, pos, capacity)
-        return Decimal128Column((hi, lo), jnp.asarray(vpad), dtype), pos
+        return Decimal128Column((hi, lo), _dev(vpad), dtype), pos
 
     if isinstance(dtype, ArrayType):
         off = np.frombuffer(bufs[pos], dtype=np.int32)
@@ -143,8 +147,7 @@ def _decode_column(dtype, n: int, bufs: List[bytes], pos: int,
         child_cap = bucket_capacity(max(child_n, 1))
         child, pos = _decode_column(dtype.element_type, child_n, bufs, pos,
                                     child_cap)
-        return ArrayColumn(child, jnp.asarray(opad), jnp.asarray(vpad),
-                           dtype), pos
+        return ArrayColumn(child, _dev(opad), _dev(vpad), dtype), pos
 
     from ..types import MapType
     if isinstance(dtype, MapType):
@@ -160,8 +163,7 @@ def _decode_column(dtype, n: int, bufs: List[bytes], pos: int,
                                    ecap)
         vals, pos = _decode_column(dtype.value_type, entry_n, bufs, pos,
                                    ecap)
-        return MapColumn(keys, vals, jnp.asarray(opad),
-                         jnp.asarray(vpad), dtype), pos
+        return MapColumn(keys, vals, _dev(opad), _dev(vpad), dtype), pos
 
     if dtype.jnp_dtype is None or isinstance(dtype, StringType):
         off = np.frombuffer(bufs[pos], dtype=np.int32)
@@ -174,14 +176,14 @@ def _decode_column(dtype, n: int, bufs: List[bytes], pos: int,
         byte_cap = bucket_capacity(max(len(data), 1))
         dpad = np.zeros(byte_cap, np.uint8)
         dpad[: len(data)] = data
-        return StringColumn(jnp.asarray(dpad), jnp.asarray(opad),
-                            jnp.asarray(vpad), dtype), pos
+        return StringColumn(_dev(dpad), _dev(opad), _dev(vpad),
+                            dtype), pos
 
     data = np.frombuffer(bufs[pos], dtype=dtype.jnp_dtype)
     pos += 1
     dpad = np.zeros(capacity, dtype.jnp_dtype)
     dpad[:n] = data
-    return Column(jnp.asarray(dpad), jnp.asarray(vpad), dtype), pos
+    return Column(_dev(dpad), _dev(vpad), dtype), pos
 
 
 # ---------------------------------------------------------------------------
@@ -245,7 +247,19 @@ def serialize_slice(batch: ColumnarBatch, lo: int, hi: int,
     return _frame_from_bufs(bufs, n, batch.schema, codec)
 
 
-def deserialize_batch(frame: bytes, schema: Schema) -> ColumnarBatch:
+def deserialize_batch(frame: bytes, schema: Schema,
+                      device: bool = True,
+                      fault_key: str = None) -> ColumnarBatch:
+    """Frame -> batch. Columns decode host-resident; with `device`
+    (default) the batch promotes through the packed upload engine (ONE
+    transfer when packedUpload is on — the shuffle-read ingest seam,
+    ISSUE 10), drawing its `device.dispatch` chaos verdicts under
+    `fault_key` (callers on pool/producer threads should pass their
+    work-item identity so seeded placement is schedule-independent).
+    `device=False` returns the host-backed batch so the caller can
+    promote at its own seam (the exchange promotes on its pipeline
+    producer thread, with metric attribution and per-batch chaos
+    keys)."""
     if len(frame) < _HEADER.size:
         raise CorruptFrameError("truncated shuffle frame header")
     (magic, version, codec, flags, n, shash, raw_len, comp_len, chk,
@@ -276,12 +290,18 @@ def deserialize_batch(frame: bytes, schema: Schema) -> ColumnarBatch:
         bufs.append(raw[p: p + s])
         p += s
     capacity = bucket_capacity(max(n, 1))
+    from ..columnar.column import host_build
     cols: List[Column] = []
     pos = 0
-    for f in schema.fields:
-        c, pos = _decode_column(f.data_type, n, bufs, pos, capacity)
-        cols.append(c)
-    return ColumnarBatch(cols, n, schema)
+    with host_build():
+        for f in schema.fields:
+            c, pos = _decode_column(f.data_type, n, bufs, pos, capacity)
+            cols.append(c)
+    if not device:
+        return ColumnarBatch(cols, n, schema)
+    from ..columnar.upload import to_device_batch
+    return to_device_batch(cols, n, schema, fault_key=fault_key,
+                           seam="shuffle")
 
 
 # ---------------------------------------------------------------------------

@@ -62,6 +62,26 @@ def _no_leaked_workload_state():
 
 
 @pytest.fixture(scope="module", autouse=True)
+def _no_leaked_staging_buffers():
+    """Packed-upload staging-pool hygiene (ISSUE 10, mirroring the
+    lifecycle/workload tripwires): an upload that fails to release its
+    staging buffer leaks host memory forever (the pool can only reuse
+    what comes back) — assert in-flight bytes return to the zero
+    baseline at module boundaries and fail the offender loudly. Idle
+    (pooled) buffers are the pool working as designed and may persist."""
+    from spark_rapids_tpu.columnar import upload
+    yield
+    pool = upload.staging_pool()
+    pool.settle()  # flush deferred (release-when-ready) buffers
+    leaked = pool.outstanding_bytes()
+    if leaked:
+        upload.reset_staging_pool()
+    assert leaked == 0, (
+        f"module leaked {leaked} bytes of in-flight upload staging "
+        f"buffers (acquire without release/discard)")
+
+
+@pytest.fixture(scope="module", autouse=True)
 def _no_leaked_lifecycle_state():
     """Lifecycle-governor hygiene (ISSUE 6, same pattern as the leaked
     fault plan): a breaker left open would silently demote a kernel

@@ -707,7 +707,7 @@ def test_profile_report_lifecycle_rollup():
 def test_bench_query_timeout_flag(monkeypatch):
     import bench
     monkeypatch.setattr(bench, "_QUERY_TIMEOUT_MS", None)
-    monkeypatch.setattr(bench, "_lifecycle_prev", None)
+    monkeypatch.setattr(bench, "_attr_prev", {})
     assert bench.maybe_query_timeout(["bench.py"]) is None
     with pytest.raises(SystemExit):
         bench.maybe_query_timeout(["bench.py", "--query-timeout-ms"])

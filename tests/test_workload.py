@@ -803,7 +803,7 @@ def test_governed_session_health_and_admission_events(spy):
 def test_bench_concurrency_flag(monkeypatch):
     import bench
     monkeypatch.setattr(bench, "_CONCURRENCY", 1)
-    monkeypatch.setattr(bench, "_workload_prev", None)
+    monkeypatch.setattr(bench, "_attr_prev", {})
     assert bench.maybe_concurrency(["bench.py"]) is None
     # bad argv: the usage-error JSON convention, never a traceback
     with pytest.raises(SystemExit):

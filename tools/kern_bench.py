@@ -49,6 +49,9 @@ DEFAULT_SHAPES = {
     # (rows, n_partitions) — the device shuffle split pipeline: counts
     # + stable permutation + one partition-ordered packed row gather
     "partition_split": [(1 << 14, 8), (1 << 16, 32)],
+    # (rows, n_columns) — packed one-copy host->device batch upload vs
+    # the per-buffer jnp.asarray lane (ISSUE 10; lanes, not kernels)
+    "h2d_upload": [(1 << 14, 8), (1 << 16, 16)],
 }
 
 #: smallest per-family shape for --quick CI smoke (compile + one
@@ -59,6 +62,7 @@ QUICK_SHAPES = {
     "murmur3": [(1 << 14,)],
     "gather": [(1 << 11, 1 << 10)],
     "partition_split": [(1 << 11, 4)],
+    "h2d_upload": [(1 << 11, 4)],
 }
 
 
@@ -322,12 +326,72 @@ def bench_partition_split(shape, iters, reps, interpret):
             _timed(pallas_step, iters, reps))
 
 
+def bench_h2d_upload(shape, iters, reps, interpret):
+    """Packed one-copy host->device upload (columnar/upload.py: pool
+    staging pack + ONE device_put + jitted device unpack) vs the
+    per-buffer lane (one jnp.asarray per data/validity buffer). The
+    record's two slots map lanes, not kernels: xla_ms = per-buffer,
+    pallas_ms = packed. `interpret` is unused — neither lane is a
+    Pallas kernel; the runtime gate is
+    spark.rapids.tpu.transfer.packedUpload.enabled, and a TPU round
+    reads this family to quantify the one-copy win per rows x cols
+    bucket."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.column import (Column, bucket_capacity,
+                                                  host_build)
+    from spark_rapids_tpu.columnar.upload import packed_upload_batch
+    from spark_rapids_tpu.types import (BOOLEAN, DOUBLE, INT, LONG, Schema,
+                                        StructField)
+
+    rows, n_cols = shape
+    rng = np.random.default_rng(7)
+    cap = bucket_capacity(rows)
+    dtypes = [LONG, INT, DOUBLE, BOOLEAN]
+    fields, cols = [], []
+    with host_build():
+        for c in range(n_cols):
+            dt = dtypes[c % len(dtypes)]
+            if dt is LONG:
+                vals = rng.integers(-(2**40), 2**40, rows).astype(np.int64)
+            elif dt is INT:
+                vals = rng.integers(-1000, 1000, rows).astype(np.int32)
+            elif dt is DOUBLE:
+                vals = rng.random(rows)
+            else:
+                vals = rng.integers(0, 2, rows).astype(bool)
+            valid = rng.random(rows) > 0.1
+            cols.append(Column.from_numpy(vals, dt, valid, capacity=cap))
+            fields.append(StructField(f"c{c}", dt))
+    schema = Schema(tuple(fields))
+    host_leaves = jax.tree_util.tree_flatten(cols)[0]
+
+    @jax.jit
+    def _chk(leaves, chk):
+        for x in leaves:
+            chk = chk + jnp.sum(x.astype(jnp.float64))
+        return chk
+
+    def per_buffer_step(chk):
+        dev = [jnp.asarray(a) for a in host_leaves]
+        return _chk(dev, chk)
+
+    def packed_step(chk):
+        batch = packed_upload_batch(cols, rows, schema)
+        return _chk(jax.tree_util.tree_leaves(list(batch.columns)), chk)
+
+    return (_timed(per_buffer_step, iters, reps),
+            _timed(packed_step, iters, reps))
+
+
 BENCHES = {
     "join_probe": bench_join_probe,
     "scan_agg": bench_scan_agg,
     "murmur3": bench_murmur3,
     "gather": bench_gather,
     "partition_split": bench_partition_split,
+    "h2d_upload": bench_h2d_upload,
 }
 
 

@@ -246,6 +246,20 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
         extras.append(
             f"gathers: {n_g} ({n_packed} packed rows, {n_pallas} via "
             f"the Pallas DMA kernel, ~{_fmt_bytes(g_bytes)} moved)")
+    # upload-engine roll-up (ISSUE 10): host->device ingest — the
+    # transfer-count drop (one per batch vs one per buffer) is the
+    # optimization, so a round reads it next to the gather line
+    ups = [e for e in events if e.get("kind") == "upload"]
+    if ups:
+        n_pk = sum(1 for e in ups if e.get("lane") == "packed")
+        n_pb = len(ups) - n_pk
+        u_bytes = sum(e.get("bytes") or 0 for e in ups)
+        u_xfers = sum(e.get("transfers") or 0 for e in ups)
+        u_ns = sum(e.get("pack_ns") or 0 for e in ups)
+        extras.append(
+            f"uploads: {len(ups)} batches ({n_pk} packed, {n_pb} "
+            f"per-buffer; {u_xfers} h2d transfers, "
+            f"{_fmt_bytes(u_bytes)}, pack {_fmt_ns(u_ns)})")
     if extras:
         lines.append("")
         lines.extend(extras)
