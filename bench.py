@@ -312,12 +312,29 @@ def maybe_enable_event_log():
     """Opt-in structured event log for bench runs: set
     SPARK_RAPIDS_TPU_EVENTLOG_DIR to get a JSONL operator-span log
     (obs/events.py) next to the BENCH records; render it with
-    tools/profile_report.py. Default: off, zero per-batch cost."""
+    tools/profile_report.py. SPARK_RAPIDS_TPU_EVENTLOG_MAX_BYTES
+    rotates the sink so a bench storm never grows one unbounded file.
+    Default: off, zero per-batch cost."""
     d = os.environ.get("SPARK_RAPIDS_TPU_EVENTLOG_DIR")
     if d:
         from spark_rapids_tpu.obs import events
         events.enable(d, os.environ.get("SPARK_RAPIDS_TPU_EVENTLOG_LEVEL",
-                                        "MODERATE"))
+                                        "MODERATE"),
+                      max_bytes=int(os.environ.get(
+                          "SPARK_RAPIDS_TPU_EVENTLOG_MAX_BYTES", "0")))
+
+
+def maybe_enable_telemetry():
+    """Opt-in live telemetry for bench runs (ISSUE 11): set
+    SPARK_RAPIDS_TPU_TELEMETRY_MS to a sampling interval to start the
+    registry + sampler thread; samples flush into the event log (when
+    enabled above) as telemetry_sample records — render with
+    tools/telemetry_export.py. Default: off, one pointer check per
+    push site."""
+    ms = os.environ.get("SPARK_RAPIDS_TPU_TELEMETRY_MS")
+    if ms:
+        from spark_rapids_tpu.obs import telemetry
+        telemetry.enable(interval_ms=int(ms))
 
 
 def query_attribution(plan, before):
@@ -340,6 +357,33 @@ def upload_attribution():
     assert the packed lane actually engaged."""
     from spark_rapids_tpu.columnar import upload as upload_engine
     return _delta_since("upload", upload_engine.counters())
+
+
+def telemetry_attribution():
+    """{"telemetry": ...} block for each BENCH record (ISSUE 11):
+    registry activity (samples taken, registry writes, push counters)
+    this lane generated, as deltas since the previous record — all
+    zeros with telemetry off, so a round can assert the plane actually
+    engaged."""
+    from spark_rapids_tpu.obs import telemetry
+    return _delta_since("telemetry", telemetry.counters())
+
+
+def statistics_attribution():
+    """{"statistics": ...} block for each BENCH record (ISSUE 11):
+    exchange map outputs/bytes this lane wrote (deltas, chaos-delta
+    pattern) plus the point-in-time distribution summary — the p95
+    map-output bytes and last observed partition skew ratio — so an
+    accumulated TPU round reads skew/attribution next to throughput.
+    Lanes that never shuffle report zeros; the block is present in
+    every record."""
+    from spark_rapids_tpu.obs import stats as runtime_stats
+    cur = runtime_stats.counters()
+    out = _delta_since("statistics",
+                       {"maps": cur["maps"], "bytes": cur["bytes"]})
+    out["p95_map_output_bytes"] = cur["p95_map_output_bytes"]
+    out["skew_ratio"] = cur["skew_ratio_x1000"] / 1000.0
+    return out
 
 
 def pipeline_attribution():
@@ -591,6 +635,8 @@ def main():
         "gather": gather_attribution(),
         "shuffle": shuffle_attribution(),
         "upload": upload_attribution(),
+        "telemetry": telemetry_attribution(),
+        "statistics": statistics_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:
@@ -760,6 +806,8 @@ def q3_bench():
         "gather": gather_attribution(),
         "shuffle": shuffle_attribution(),
         "upload": upload_attribution(),
+        "telemetry": telemetry_attribution(),
+        "statistics": statistics_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:
@@ -769,6 +817,7 @@ def q3_bench():
 
 if __name__ == "__main__":
     maybe_enable_event_log()
+    maybe_enable_telemetry()
     maybe_enable_faults()
     maybe_query_timeout()
     maybe_concurrency()

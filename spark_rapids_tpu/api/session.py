@@ -41,10 +41,12 @@ class TpuSession:
         single-partition plan (no exchange nodes)."""
         from .. import faults
         from ..obs import events as obs_events
+        from ..obs import telemetry
         from ..parallel.mesh import device_mesh, set_active_mesh
         self.conf = RapidsConf(conf or {})
         set_active_conf(self.conf)
         obs_events.configure(self.conf)
+        telemetry.configure(self.conf)
         faults.configure(self.conf)
         if mesh is None and mesh_devices is not None:
             mesh = device_mesh(mesh_devices)
@@ -75,12 +77,30 @@ class TpuSession:
         """Engine health surface (exec/lifecycle.py): degradation
         circuit-breaker states per fault domain, governed-query count,
         the cumulative lifecycle counters (cancellations, breaker
-        trips, partition-granular vs whole-plan recoveries), and the
+        trips, partition-granular vs whole-plan recoveries), the
         workload governor's admission surface — queue depth, admitted
         count, queued/admitted/shed/quota-spill counters
-        (exec/workload.py)."""
+        (exec/workload.py) — and the telemetry registry's state +
+        newest sample (obs/telemetry.py)."""
         from ..exec import lifecycle
-        return lifecycle.health()
+        from ..obs import telemetry
+        out = lifecycle.health()
+        out["telemetry"] = telemetry.health_section()
+        return out
+
+    def active_queries(self) -> List[Dict]:
+        """Live engine introspection (ISSUE 11): one row per in-flight
+        governed query — phase (queued / admitted / executing /
+        retrying), the operator currently yielding batches, root-output
+        batches/rows produced so far, elapsed and deadline-remaining
+        ms, task attempt number, spill count/bytes the query
+        experienced, and (under the workload governor) its quota
+        used/granted. Assembled lock-light from lifecycle/workload/
+        catalog state; `mine` marks the queries this session drives
+        (the surface is engine-wide, like health()). Empty when nothing
+        is running."""
+        from ..exec import lifecycle
+        return lifecycle.active_queries(owner=self._lifecycle_owner)
 
     def last_query_metrics(self):
         """Task-level metrics of the most recent DataFrame.collect():
@@ -368,10 +388,12 @@ class DataFrame:
     def _exec(self):
         from .. import faults
         from ..obs import events as obs_events
+        from ..obs import telemetry
         from ..parallel.mesh import set_active_mesh
         set_active_conf(self.session.conf)
         set_active_mesh(self.session.mesh)
         obs_events.configure(self.session.conf)
+        telemetry.configure(self.session.conf)
         faults.configure(self.session.conf)
         return TpuOverrides(self.session.conf).apply(self._plan)
 
@@ -409,13 +431,24 @@ class DataFrame:
     def _collect_once(self) -> List[tuple]:
         import time as _time
 
+        from ..exec import lifecycle
         from ..exec.task_metrics import query_snapshot, query_summary
         from ..obs import events as obs_events
         from ..obs.profile import QueryProfile
+        from ..obs.stats import RuntimeStats
         with obs_events.query_scope():
             # conversion inside the scope: plan_fallback / plan_not_on_tpu
             # events must carry this query's id
             plan = self._exec()
+            # runtime statistics + live progress (ISSUE 11): a fresh
+            # RuntimeStats per attempt (a failed attempt's partial
+            # distributions must not pollute the retry's), and the root
+            # op id so note_batch counts only real query output
+            ctx = lifecycle.current_context()
+            stats = RuntimeStats()
+            if ctx is not None:
+                ctx.runtime_stats = stats
+                ctx.root_op_id = plan._op_id
             before = query_snapshot()
             obs_events.emit("query_start", root=type(plan).__name__)
             t0 = _time.perf_counter_ns()
@@ -432,7 +465,7 @@ class DataFrame:
                     summary = query_summary(plan, before)
                     self.session._last_query_metrics = summary
                     self.session._last_query_profile = QueryProfile(
-                        plan, summary)
+                        plan, summary, statistics=stats)
                 except Exception:  # noqa: BLE001 — must never mask
                     pass
                 obs_events.emit(

@@ -19,7 +19,8 @@ Layout (all little-endian, matching XLA bitcasts on every supported host):
 
 from __future__ import annotations
 
-from typing import List, Tuple
+import threading
+from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +28,25 @@ import numpy as np
 
 from .column import (ArrayColumn, Column, MapColumn, StringColumn,
                      StructColumn)
+
+# process-cumulative packed-D2H counters (ISSUE 11: the device->host
+# half of the telemetry plane's link-byte attribution — the H2D mirror
+# lives in columnar/upload.py)
+_COUNTER_LOCK = threading.Lock()
+_COUNTERS = {"d2h_copies": 0, "d2h_bytes": 0}
+
+
+def note_d2h(nbytes: int) -> None:
+    """One packed device->host copy landed (`exec/exchange.py` calls
+    this for the fused split+pack buffer it fetches itself)."""
+    with _COUNTER_LOCK:
+        _COUNTERS["d2h_copies"] += 1
+        _COUNTERS["d2h_bytes"] += int(nbytes)
+
+
+def counters() -> Dict[str, int]:
+    with _COUNTER_LOCK:
+        return dict(_COUNTERS)
 
 
 def _dd_split() -> bool:
@@ -194,6 +214,7 @@ def fetch_split_host(counts, columns) -> Tuple[np.ndarray, List[Column]]:
     """
     n_parts = int(counts.shape[0])
     buf = np.asarray(_pack_split_jit(counts, list(columns)))  # ONE d2h
+    note_d2h(buf.nbytes)
     return unpack_split_host(buf, columns, n_parts)
 
 
@@ -209,6 +230,7 @@ def fetch_batch_host(batch) -> Tuple[List[Column], int]:
         return list(batch.columns), batch._host_rows
     packed = _pack_jit(batch)
     buf = np.asarray(packed)  # the single transfer
+    note_d2h(buf.nbytes)
     n = int(buf[:4].view(np.int32)[0])
     pos = 4
     cols: List[Column] = []

@@ -396,6 +396,40 @@ EVENT_LOG_LEVEL = conf_str(
     "and plan decisions, exchange volumes; DEBUG adds per-batch "
     "operator spans and span-API records.")
 
+EVENT_LOG_MAX_BYTES = conf_bytes(
+    "spark.rapids.tpu.eventLog.maxBytes", 0,
+    "Rotate the JSONL event-log sink once the current file reaches this "
+    "many bytes: the file closes and writing continues in "
+    "events-<pid>-<n>.<rot>.jsonl (rot = 1, 2, ...), so a long soak or "
+    "bench storm never grows one unbounded file. "
+    "tools/profile_report.py reads a rotated set in order when given "
+    "any member. 0 (default) = unbounded, no rotation.")
+
+TELEMETRY_ENABLED = conf_bool(
+    "spark.rapids.tpu.telemetry.enabled", False,
+    "Live telemetry registry + sampler (obs/telemetry.py): a "
+    "`telemetry-sampler` thread snapshots per-owner HBM attribution, "
+    "link bytes (H2D uploads / packed D2H fetches), admission queue "
+    "depth, semaphore wait, breaker states and spill volumes every "
+    "telemetry.intervalMs into bounded ring-buffer series, and flushes "
+    "each snapshot to the event log (when enabled) as a "
+    "`telemetry_sample` record — render with tools/telemetry_export.py "
+    "(Prometheus text format). Off (default) costs one pointer check "
+    "per push-counter site and no sampling thread.",
+    commonly_used=True)
+
+TELEMETRY_INTERVAL_MS = conf_int(
+    "spark.rapids.tpu.telemetry.intervalMs", 1000,
+    "Sampling period of the telemetry registry's exporter thread "
+    "(min 10ms). Each tick reads every gauge source once — lock-light "
+    "snapshots, no device syncs.")
+
+TELEMETRY_HISTORY_SIZE = conf_int(
+    "spark.rapids.tpu.telemetry.historySize", 120,
+    "Samples each telemetry series retains in its in-memory ring "
+    "buffer (TpuSession.health()['telemetry'] reads the newest; older "
+    "samples survive only in the event log).")
+
 SORT_OOC_ENABLED = conf_bool(
     "spark.rapids.sql.sort.outOfCore.enabled", True,
     "Bounded-memory streamed run merge for big sorts: runs stay spilled, "

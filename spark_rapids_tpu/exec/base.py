@@ -298,6 +298,11 @@ class TpuExec:
                     rows.add(batch._host_rows)
                 else:
                     rows.add_device(batch.num_rows)
+                if qctx is not None:
+                    # live-introspection progress (ISSUE 11): current
+                    # operator + root-output batch/row counts; host row
+                    # counts only — never a device sync
+                    qctx.note_batch(name, self._op_id, batch._host_rows)
                 if dump_enabled:
                     self._last_output = batch
                 yield batch
@@ -335,6 +340,8 @@ class TpuExec:
                     rows.add(batch._host_rows)
                 else:
                     rows.add_device(batch.num_rows)
+                if qctx is not None:
+                    qctx.note_batch(name, self._op_id, batch._host_rows)
                 if emit_batches:
                     # device_size_bytes() walks the whole pytree — only
                     # pay it when the DEBUG-level record will be kept

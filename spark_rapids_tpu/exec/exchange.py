@@ -303,6 +303,12 @@ class ShuffleExchangeExec(TpuExec):
         pending_bytes = 0
         self.rounds = 0
         self._part_totals = None
+        # runtime statistics (ISSUE 11): the mesh exchange measures
+        # exact per-partition ROW counts per round (its histogram
+        # program) — bytes stay on device, so its skew basis is rows
+        from ..obs import stats as obs_stats
+        stats_rec = obs_stats.ExchangeRecorder(type(self).__name__,
+                                               self._op_id, n)
 
         def flush():
             nonlocal pending, pending_bytes
@@ -314,6 +320,7 @@ class ShuffleExchangeExec(TpuExec):
             # metric is the max over partitions of the whole-stage totals
             self._part_totals = totals if self._part_totals is None \
                 else self._part_totals + totals
+            stats_rec.record_map(totals.tolist(), None, 0)
             for d, shard in enumerate(shards):
                 staged[d].append(SpillableBatch.from_batch(shard))
             pending = []
@@ -337,6 +344,7 @@ class ShuffleExchangeExec(TpuExec):
             obs_events.emit("exchange", exec="ShuffleExchangeExec",
                             op_id=self._op_id, partitions=self.n_partitions,
                             rounds=self.rounds, max_partition_bytes=max_part)
+            stats_rec.finish_and_emit()
         return staged
 
     def node_description(self):
@@ -484,6 +492,7 @@ class HostShuffleExchangeExec(TpuExec):
             with self._gather_track.observe(key):
                 buf_dev = self._jit_split(b, jnp.int32(off))
             buf = np.asarray(buf_dev)  # the ONE d2h copy
+            transfer.note_d2h(buf.nbytes)
             counts, cols = transfer.unpack_split_host(
                 buf, tmpl, self.n_partitions)
         bounds = np.zeros(self.n_partitions + 1, np.int64)
@@ -493,11 +502,16 @@ class HostShuffleExchangeExec(TpuExec):
     def _write_map(self, b: ColumnarBatch, n: int, range_bounds, handle,
                    mgr, map_id: int, register: bool = True):
         """Partition + serialize + write one map task's output, on the
-        lane the conf selects. Returns (writer, lane, pack_ns). Both the
-        steady-state write loop and the partition-recovery recompute
+        lane the conf selects. Returns (writer, lane, pack_ns,
+        rows_per_partition) — the row counts feed the runtime-statistics
+        plane (ISSUE 11) and come free from the work each lane already
+        did (the split's count table / the host partition batches). Both
+        the steady-state write loop and the partition-recovery recompute
         route through here, so recovered map outputs replay the exact
         lane (and round-robin offsets) of the original write."""
         import time as _time
+
+        import numpy as np
         from ..shuffle.manager import (HostShuffleWriter,
                                        partition_batch_host)
         writer = HostShuffleWriter(handle, map_id, mgr, self._conf)
@@ -505,7 +519,7 @@ class HostShuffleExchangeExec(TpuExec):
             # empty batch: zero frames, no partitioning work at all
             writer.write([[] for _ in range(self.n_partitions)],
                          register=register, lane="device")
-            return writer, "device", 0
+            return writer, "device", 0, [0] * self.n_partitions
         if self._device_partition:
             t0 = _time.perf_counter_ns()
             cols, bounds = self._device_split(b, n)
@@ -515,12 +529,13 @@ class HostShuffleExchangeExec(TpuExec):
             note_shuffle_write(pack_ns=pack_ns)
             packed = ColumnarBatch(cols, n, self.output_schema)
             writer.write_slices(packed, bounds, register=register)
-            return writer, "device", pack_ns
+            rows_pp = np.diff(np.asarray(bounds)).tolist()
+            return writer, "device", pack_ns, rows_pp
         pid = self._pid_for(b, n, range_bounds)
         parts = partition_batch_host(b, pid, self.n_partitions)
         writer.write([[p] if p.num_rows_host else [] for p in parts],
                      register=register)
-        return writer, "host", 0
+        return writer, "host", 0, [p.num_rows_host for p in parts]
 
     # -- partition id per mode --------------------------------------------
     def _host_keys(self, batch: ColumnarBatch, n: int, stride: int = 1):
@@ -662,6 +677,15 @@ class HostShuffleExchangeExec(TpuExec):
             capture_lineage = (
                 self.partitioning != "range"
                 and bool(self._conf.get(PARTITION_RECOVERY_ENABLED)))
+            # runtime statistics (ISSUE 11): per-map-output and
+            # per-partition row/byte distributions, recorded from the
+            # counts the split/serializer already produced — into the
+            # governed query's RuntimeStats (when one is running on
+            # this thread) and the process-wide collector
+            from ..obs import stats as obs_stats
+            from ..obs import telemetry
+            stats_rec = obs_stats.ExchangeRecorder(
+                type(self).__name__, self._op_id, self.n_partitions)
             map_id = 0
             for b in source:
                 in_batches.add(1)
@@ -670,8 +694,12 @@ class HostShuffleExchangeExec(TpuExec):
                 # time only the shuffle work (partition/serialize/write),
                 # not the upstream compute driving child.execute()
                 with self.metrics[SHUFFLE_WRITE_TIME].ns_timer():
-                    writer, lane, pack_ns = self._write_map(
+                    writer, lane, pack_ns, rows_pp = self._write_map(
                         b, n, bounds, handle, mgr, map_id)
+                stats_rec.record_map(rows_pp, writer.partition_bytes,
+                                     writer.bytes_written)
+                telemetry.add("exchange.write_bytes",
+                              writer.bytes_written)
                 if capture_lineage:
                     handle.lineage[mgr.map_data_path(
                         handle.shuffle_id, map_id)] = \
@@ -697,6 +725,10 @@ class HostShuffleExchangeExec(TpuExec):
             # happen — emit once it is complete, not at stream close)
             self._gather_track.emit_event(type(self).__name__,
                                           self._op_id)
+            # one exchange_stats record per execution: the skew/
+            # distribution summary profile_report rolls up and the AQE
+            # loop (ROADMAP 4) will consult
+            stats_rec.finish_and_emit()
             reader = HostShuffleReader(handle, mgr, self._conf)
             n = self.n_partitions
 

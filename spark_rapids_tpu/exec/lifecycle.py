@@ -127,7 +127,10 @@ class QueryContext:
 
     __slots__ = ("ctx_id", "owner", "t0", "deadline", "check_every",
                  "_cancel", "reason", "_ticks", "_emit_lock", "_emitted",
-                 "engaged_domains", "workload_ticket")
+                 "engaged_domains", "workload_ticket",
+                 "phase", "current_op", "root_op_id", "batches_produced",
+                 "rows_produced", "attempt_no", "spill_count",
+                 "spill_bytes", "runtime_stats")
 
     def __init__(self, timeout_ms: int = 0, check_every: int = 8,
                  owner: Any = None):
@@ -149,6 +152,71 @@ class QueryContext:
         #: rides the context so producer threads that adopt_context
         #: resolve the same per-query memory quota
         self.workload_ticket = None
+        # -- live introspection surface (ISSUE 11): read lock-free by
+        # TpuSession.active_queries(); every field is a single attribute
+        # assignment on its write path, and torn reads are harmless
+        # (the snapshot is advisory, never a control decision)
+        #: queued | admitted | executing | retrying (ADMISSION-adjacent
+        #: phases are set by exec/workload.py, the others by task_retry)
+        self.phase = "executing"
+        #: operator that most recently yielded a batch on any thread
+        self.current_op: Optional[str] = None
+        #: the plan root's op id (set by DataFrame._collect_once) —
+        #: batches/rows produced count only ROOT output, i.e. actual
+        #: query results, not inner-operator traffic
+        self.root_op_id = -1
+        self.batches_produced = 0
+        self.rows_produced = 0
+        self.attempt_no = 1
+        self.spill_count = 0
+        self.spill_bytes = 0
+        #: per-attempt RuntimeStats (obs/stats.py) — exchanges record
+        #: map-output/partition distributions into it mid-flight
+        self.runtime_stats = None
+
+    def note_batch(self, op: str, op_id: int,
+                   rows: Optional[int]) -> None:
+        """Batch-boundary progress note (TpuExec._drive): cheap enough
+        to run per batch on every governed query — two attribute writes,
+        three when the batch is root output."""
+        self.current_op = op
+        if op_id == self.root_op_id:
+            self.batches_produced += 1
+            if rows:
+                self.rows_produced += rows
+
+    def info(self) -> Dict[str, Any]:
+        """One query's live introspection row — assembled lock-light
+        from this context + its workload ticket (quota read through the
+        manager only when a ticket exists)."""
+        now = time.monotonic()
+        out = {
+            "query": self.ctx_id,
+            "phase": self.phase,
+            "current_op": self.current_op,
+            "batches": self.batches_produced,
+            "rows": self.rows_produced,
+            "elapsed_ms": int((now - self.t0) * 1000),
+            "deadline_remaining_ms": (
+                int((self.deadline - now) * 1000)
+                if self.deadline is not None else None),
+            "attempt": self.attempt_no,
+            "spill_count": self.spill_count,
+            "spill_bytes": self.spill_bytes,
+            "cancelled": self._cancel.is_set(),
+        }
+        t = self.workload_ticket
+        if t is not None:
+            from ..memory.budget import memory_budget
+            from . import workload
+            limit = memory_budget().limit
+            quota = workload.manager().quota_bytes(limit, t.quota_frac)
+            out["quota"] = {
+                "priority": t.priority,
+                "used_bytes": t.device_bytes,
+                "granted_bytes": quota if quota is not None else limit,
+            }
+        return out
 
     def cancel(self, reason: str = "user") -> None:
         if not self._cancel.is_set():
@@ -267,6 +335,42 @@ def cancel_owner(owner: Any, reason: str = "user") -> int:
 def active_query_ids() -> List[int]:
     with _reg_lock:
         return sorted(_active)
+
+
+def set_phase(phase: str) -> None:
+    """Live-introspection phase note for this thread's governed query
+    (no-op outside one — a single pointer check)."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        ctx.phase = phase
+
+
+def note_spill(freed_bytes: int) -> None:
+    """Per-query spill attribution (ISSUE 11): the catalog calls this
+    once per synchronous_spill pass that freed anything, on the thread
+    whose reservation triggered it — the query that EXPERIENCED the
+    pressure, which is what active_queries() reports."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        ctx.spill_count += 1
+        ctx.spill_bytes += freed_bytes
+
+
+def active_queries(owner: Any = None) -> List[Dict[str, Any]]:
+    """Live introspection rows for every registered (in-flight) query,
+    oldest first — the TpuSession.active_queries() payload. The
+    registry lock is held only to snapshot the context list; each row
+    assembles from lock-free attribute reads. `owner` marks (never
+    filters) rows: introspection is engine-wide, `mine` says which
+    queries belong to the asking session."""
+    with _reg_lock:
+        ctxs = sorted(_active.values(), key=lambda c: c.ctx_id)
+    out = []
+    for c in ctxs:
+        row = c.info()
+        row["mine"] = owner is not None and c.owner is owner
+        out.append(row)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -513,10 +617,22 @@ def adopt_engagement(s: Optional[set]) -> None:
         _tls.engaged = s
 
 
-def begin_attempt() -> None:
+def begin_attempt(attempt: int = 1) -> None:
     """Task-attempt start (with_task_retry): clear the engaged-domain
-    notes so failures attribute to THIS attempt's engagements."""
+    notes so failures attribute to THIS attempt's engagements, and note
+    the attempt number + executing phase on the governed context (the
+    live-introspection surface)."""
     _engaged_set(create=True).clear()
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        ctx.attempt_no = attempt
+        ctx.phase = "executing"
+        # per-attempt progress, like the per-attempt RuntimeStats: a
+        # re-executed plan starts its root output from zero — without
+        # this, active_queries() double-counts across task retries
+        ctx.current_op = None
+        ctx.batches_produced = 0
+        ctx.rows_produced = 0
 
 
 def attempt_failed(exc: BaseException) -> None:

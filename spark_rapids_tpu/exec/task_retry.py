@@ -113,7 +113,7 @@ def with_task_retry(run: Callable[[int], T],
         while True:
             attempt += 1
             _tls.attempt = attempt
-            lifecycle.begin_attempt()
+            lifecycle.begin_attempt(attempt)
             try:
                 result = run(attempt)
                 # a half-open breaker whose domain this attempt engaged
@@ -151,6 +151,9 @@ def with_task_retry(run: Callable[[int], T],
                     max_attempts=max_attempts,
                     backoff_ns=int(backoff * 1e9), lane="whole_plan",
                     error=f"{type(e).__name__}: {e}"[:200], **extra)
+                # active_queries() shows the backoff/settle window as
+                # "retrying"; begin_attempt flips it back to executing
+                lifecycle.set_phase("retrying")
                 _settle_between_attempts()
                 # deadline-aware backoff (review r4): a governed
                 # query's deadline can expire mid-sleep — a blind

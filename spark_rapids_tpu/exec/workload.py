@@ -230,6 +230,8 @@ class WorkloadManager:
                     self._queued.append(t)
                     self._pump_locked(max_concurrent, pending)
                     assert t.state == "admitted"
+                    if ctx is not None:
+                        ctx.phase = "admitted"
                     return t
                 if len(self._queued) >= queue_depth:
                     # "come back after roughly one admission turn" —
@@ -244,6 +246,8 @@ class WorkloadManager:
                                pending)
                 self._queued.append(t)
                 self._counters["queued"] += 1
+                if ctx is not None:
+                    ctx.phase = "queued"
                 pending.append(("query_queued", dict(
                     priority=t.priority, queued=len(self._queued),
                     admitted=len(self._admitted))))
@@ -259,6 +263,8 @@ class WorkloadManager:
                     try:
                         self._pump_locked(max_concurrent, pending)
                         if t.state != "queued":
+                            if ctx is not None:
+                                ctx.phase = "admitted"
                             break
                         if deadline is not None \
                                 and time.monotonic() >= deadline:

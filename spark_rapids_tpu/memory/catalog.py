@@ -276,6 +276,12 @@ class BufferCatalog:
                 memory_budget().release(victim.nbytes)
                 workload.discharge(victim.owner, victim.nbytes)
         self._enforce_host_limit(async_write, owner=owner)
+        if freed:
+            # per-query spill attribution (ISSUE 11): the reserving
+            # thread's governed query experienced this pressure —
+            # active_queries() reports it per in-flight query
+            from ..exec import lifecycle
+            lifecycle.note_spill(freed)
         return freed
 
     def _spill_to_host_locked(self, entry: _Entry, async_write: bool = False):
@@ -666,6 +672,34 @@ class BufferCatalog:
     def num_entries(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    def bytes_by_owner(self):
+        """Per-owner resident-byte attribution for the telemetry plane
+        (ISSUE 11): ({owner: device bytes}, {owner: host bytes},
+        device total, host total), all from ONE lock pass so the
+        per-owner sums equal the totals EXACTLY at this snapshot.
+        Owners are the admitting workload tickets (`q<ticket_id>`);
+        entries from ungoverned queries land under `unowned`. An entry
+        whose async writeback is still in flight counts at its TARGET
+        tier (the tier field the hop already flipped) — the documented
+        one-in-flight-writeback tolerance of the attribution."""
+        dev: Dict[str, int] = {}
+        host: Dict[str, int] = {}
+        dev_total = 0
+        host_total = 0
+        with self._lock:
+            for e in self._entries.values():
+                if e.closed:
+                    continue
+                owner = f"q{e.owner.ticket_id}" if e.owner is not None \
+                    else "unowned"
+                if e.tier == StorageTier.DEVICE:
+                    dev[owner] = dev.get(owner, 0) + e.nbytes
+                    dev_total += e.nbytes
+                elif e.tier == StorageTier.HOST:
+                    host[owner] = host.get(owner, 0) + e.nbytes
+                    host_total += e.nbytes
+        return dev, host, dev_total, host_total
 
 
 _catalog: Optional[BufferCatalog] = None

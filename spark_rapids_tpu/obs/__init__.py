@@ -11,9 +11,21 @@ GpuMetric/GpuTaskMetrics/NVTX stack joined into one subsystem (ISSUE 2):
     an event record.
   * `profile` — QueryProfile: the executed plan tree annotated with
     per-operator metrics, with text (explain-with-metrics) and JSON
-    renderers; surfaced as TpuSession.last_query_profile().
+    renderers plus `.statistics()`; surfaced as
+    TpuSession.last_query_profile().
+  * `stats` — runtime statistics collection (ISSUE 11): per-exchange
+    map-output/partition row+byte distributions as log2 histograms,
+    exact per-partition totals and skew summaries, carried per query
+    on the governing QueryContext (`stats.current()`) — the data plane
+    the AQE loop (ROADMAP 4) replans from.
+  * `telemetry` — live metrics registry + sampler (ISSUE 11): per-owner
+    HBM attribution, link bytes, queue/semaphore/breaker/spill gauges
+    in bounded ring-buffer series, flushed as telemetry_sample events;
+    gated by spark.rapids.tpu.telemetry.{enabled,intervalMs,historySize}.
 
-Render an event-log file with tools/profile_report.py.
+Render an event-log file with tools/profile_report.py (`--format json`
+for the machine-readable summary) and telemetry samples with
+tools/telemetry_export.py (Prometheus text format).
 """
 
 from . import events  # noqa: F401

@@ -97,6 +97,13 @@ EVENT_LEVELS: Dict[str, int] = {
     # its materializing-gather totals (count/packed/pallas/bytes) —
     # reconciles with the numGathers metric and op_close batch counts
     "gather_stats": MODERATE,
+    # runtime statistics plane (ISSUE 11): one record per exchange
+    # execution with its map-output/partition distributions and skew
+    # summary (obs/stats.py), and one per telemetry sampler tick with
+    # the registry snapshot (obs/telemetry.py) — the JSONL half of the
+    # periodic exporter
+    "exchange_stats": MODERATE,
+    "telemetry_sample": MODERATE,
     "op_open": DEBUG,
     "op_batch": DEBUG,
     "span": DEBUG,
@@ -116,17 +123,37 @@ class EventBus:
     _seq = 0
     _seq_lock = threading.Lock()
 
-    def __init__(self, directory: str, level: int = MODERATE):
+    def __init__(self, directory: str, level: int = MODERATE,
+                 max_bytes: int = 0):
         self.directory = directory or DEFAULT_DIR
         self.level = level
+        #: rotation threshold (spark.rapids.tpu.eventLog.maxBytes,
+        #: ISSUE 11 satellite): past it the current file closes and
+        #: writing continues in <base>.<n>.jsonl — a soak/bench storm
+        #: never grows one file without bound. 0 = unbounded.
+        self.max_bytes = max(0, int(max_bytes))
         with EventBus._seq_lock:
             EventBus._seq += 1
             seq = EventBus._seq
-        self.path = os.path.join(
-            self.directory, f"events-{os.getpid()}-{seq}.jsonl")
+        self._base = os.path.join(self.directory,
+                                  f"events-{os.getpid()}-{seq}")
+        self._rot = 0
+        self._written = 0
+        self.path = f"{self._base}.jsonl"
         self._lock = threading.Lock()
         self._file = None
         self._closed = False
+
+    def _rotate_locked(self) -> None:
+        """Caller holds self._lock. Close the full file and point the
+        bus at the next member of the rotated set; the new file is
+        created lazily by the next record, like the first one."""
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        self._rot += 1
+        self._written = 0
+        self.path = f"{self._base}.{self._rot}.jsonl"
 
     def emit(self, kind: str, **fields: Any) -> None:
         if self._closed or EVENT_LEVELS.get(kind, MODERATE) > self.level:
@@ -144,6 +171,9 @@ class EventBus:
                     self._file = open(self.path, "a")
                 self._file.write(line + "\n")
                 self._file.flush()
+                self._written += len(line) + 1
+                if self.max_bytes and self._written >= self.max_bytes:
+                    self._rotate_locked()
         except Exception as e:  # noqa: BLE001 — emit runs inside
             # operator/collect finally blocks: an unwritable event log
             # must never fail a query or mask its real exception. One
@@ -199,7 +229,8 @@ def configure(conf=None) -> Optional[EventBus]:
     enabled conf with unchanged dir+level keeps the current file open
     rather than starting a new one per query."""
     global _bus
-    from ..config import (EVENT_LOG_DIR, EVENT_LOG_ENABLED, EVENT_LOG_LEVEL,
+    from ..config import (EVENT_LOG_DIR, EVENT_LOG_ENABLED,
+                          EVENT_LOG_LEVEL, EVENT_LOG_MAX_BYTES,
                           active_conf)
     conf = conf if conf is not None else active_conf()
     enabled = conf.get(EVENT_LOG_ENABLED)
@@ -212,22 +243,25 @@ def configure(conf=None) -> Optional[EventBus]:
             return _bus
         directory = conf.get(EVENT_LOG_DIR) or DEFAULT_DIR
         level = parse_level(conf.get(EVENT_LOG_LEVEL))
+        max_bytes = max(0, conf.get(EVENT_LOG_MAX_BYTES))
         if _bus is not None and _bus.directory == directory \
-                and _bus.level == level:
+                and _bus.level == level and _bus.max_bytes == max_bytes:
             return _bus
         if _bus is not None:
             _bus.close()
-        _bus = EventBus(directory, level)
+        _bus = EventBus(directory, level, max_bytes=max_bytes)
         return _bus
 
 
-def enable(directory: str, level: str = "MODERATE") -> EventBus:
+def enable(directory: str, level: str = "MODERATE",
+           max_bytes: int = 0) -> EventBus:
     """Conf-free switch-on (bench / tooling entry)."""
     global _bus
     with _bus_lock:
         if _bus is not None:
             _bus.close()
-        _bus = EventBus(directory, parse_level(level))
+        _bus = EventBus(directory, parse_level(level),
+                        max_bytes=max_bytes)
         return _bus
 
 

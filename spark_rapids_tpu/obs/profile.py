@@ -72,13 +72,46 @@ class QueryProfile:
     and `.to_json()` renderers."""
 
     def __init__(self, root, summary: Optional[Dict[str, int]] = None,
-                 level: Optional[int] = None):
+                 level: Optional[int] = None, statistics=None):
         level = metrics_level() if level is None else level
         self.tree = _node(root, level)
         self.summary = dict(summary or {})
+        #: per-query RuntimeStats (obs/stats.py), captured by
+        #: DataFrame._collect_once from the governing QueryContext
+        self._runtime_stats = statistics
 
     def to_dict(self) -> Dict[str, Any]:
         return {"summary": self.summary, "plan": self.tree}
+
+    def statistics(self) -> Dict[str, Any]:
+        """Runtime statistics of this query (ISSUE 11): per-exchange
+        map-output/partition row+byte distributions (log2-bucket
+        histograms with exact count/sum/min/max), exact per-partition
+        totals, and a skew summary (max/median partition ratio) — plus
+        per-operator cardinality/selectivity derived from the metric
+        tree (rows-out over rows-in, the data a broadcast/skew AQE
+        decision consumes). Exchange entries exist only for queries
+        that shuffled; `operators` is always populated."""
+        out: Dict[str, Any] = {"exchanges": {}, "operators": []}
+        if self._runtime_stats is not None:
+            out["exchanges"] = \
+                self._runtime_stats.to_dict()["exchanges"]
+
+        def walk(node):
+            rows_out = node["metrics"].get("numOutputRows", 0)
+            rows_in = sum(c["metrics"].get("numOutputRows", 0)
+                          for c in node["children"]) \
+                if node["children"] else None
+            row = {"op": node["op"], "op_id": node["op_id"],
+                   "rows_out": rows_out, "rows_in": rows_in,
+                   "selectivity": (round(rows_out / rows_in, 6)
+                                   if rows_in else None)}
+            out["operators"].append(row)
+            for c in node["children"]:
+                walk(c)
+
+        walk(self.tree)
+        return out
 
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.to_dict(), indent=indent, default=str)

@@ -97,6 +97,10 @@ class HostShuffleWriter:
         self.frames_written = 0
         self.serialize_ns = 0
         self.io_ns = 0
+        #: exact per-partition written bytes (the index offset diffs,
+        #: ISSUE 11): sum(partition_bytes) == bytes_written to the byte
+        #: — the exchange records these into the runtime statistics
+        self.partition_bytes: List[int] = []
 
     def write(self, partitioned: Sequence[List[ColumnarBatch]],
               register: bool = True, lane: str = "host") -> None:
@@ -184,6 +188,8 @@ class HostShuffleWriter:
             raise
         self.io_ns = _time.perf_counter_ns() - t0
         self.bytes_written = offsets[n]
+        self.partition_bytes = [offsets[p + 1] - offsets[p]
+                                for p in range(n)]
         self.frames_written = sum(len(fs) for fs in frames_by_part)
         note_shuffle_write(
             batches=1, frames=self.frames_written,

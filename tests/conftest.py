@@ -82,6 +82,27 @@ def _no_leaked_staging_buffers():
 
 
 @pytest.fixture(scope="module", autouse=True)
+def _no_leaked_telemetry_state():
+    """Telemetry-plane hygiene (ISSUE 11, mirroring the lifecycle/
+    workload tripwires): a registry left enabled keeps a
+    `telemetry-*` sampler thread alive into every later suite — reset
+    at module boundaries and fail the offender loudly if its exporter
+    thread survives the reset."""
+    import threading
+
+    from spark_rapids_tpu.obs import stats as runtime_stats
+    from spark_rapids_tpu.obs import telemetry
+    telemetry.reset_telemetry()
+    runtime_stats.reset_stats()
+    yield
+    telemetry.reset_telemetry()
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("telemetry-") and t.is_alive()]
+    assert not leaked, (
+        f"module leaked telemetry exporter thread(s): {leaked}")
+
+
+@pytest.fixture(scope="module", autouse=True)
 def _no_leaked_lifecycle_state():
     """Lifecycle-governor hygiene (ISSUE 6, same pattern as the leaked
     fault plan): a breaker left open would silently demote a kernel

@@ -159,6 +159,35 @@ def test_robustness_event_kinds_are_registered():
             f"event kind {kind} missing from docs/observability.md")
 
 
+def test_telemetry_series_table_matches_registry():
+    """docs/observability.md's telemetry series table lists exactly
+    obs.telemetry.SERIES (ISSUE 11: the same drift lint EVENT_LEVELS /
+    CANONICAL_METRICS get), scoped to the telemetry section so other
+    name tables in the doc can't collide."""
+    from spark_rapids_tpu.obs import telemetry
+    docs = (ROOT / "docs" / "observability.md").read_text()
+    m = re.search(r"## Telemetry registry\n(.*?)(?:\n## |\Z)", docs,
+                  re.DOTALL)
+    assert m, "docs/observability.md lost its telemetry section"
+    rows = set(re.findall(r"^\|\s*`([a-z_]+\.[a-z_0-9]+)`\s*\|",
+                          m.group(1), re.MULTILINE))
+    expected = set(telemetry.SERIES)
+    assert rows == expected, (
+        f"docs/observability.md telemetry table drifted: "
+        f"missing={sorted(expected - rows)} "
+        f"stale={sorted(rows - expected)}")
+
+
+def test_statistics_event_kinds_are_registered():
+    """The runtime-statistics plane's event kinds are registered in
+    EVENT_LEVELS (the ISSUE 4/6/7 pattern) — the docs-row half is
+    covered by test_robustness_event_kinds_are_registered's full
+    EVENT_LEVELS sweep."""
+    from spark_rapids_tpu.obs import events
+    for kind in ("exchange_stats", "telemetry_sample"):
+        assert kind in events.EVENT_LEVELS, kind
+
+
 def test_pallas_family_registries_agree():
     """Every Pallas kernel family (ops/pallas_tier.PALLAS_FAMILIES)
     appears in (1) lifecycle.FAMILY_DOMAINS so the circuit breakers can

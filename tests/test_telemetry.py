@@ -1,0 +1,706 @@
+"""Runtime statistics & live telemetry plane (ISSUE 11): log2-histogram
+units vs numpy oracles, exchange statistics exactness + the
+QueryProfile.statistics() golden surface, disabled-mode zero-emission
+(the PR 2 cost discipline), live active_queries() introspection against
+an in-flight governed query (the PR 5 stalled-producer recipe), event
+log rotation, the profile_report JSON format, the Prometheus exporter,
+and the 8-lane workload storm reconciling per-owner HBM attribution
+with the catalog/budget counters (the PR 6 storm recipe)."""
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col, lit
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.exec import lifecycle, workload
+from spark_rapids_tpu.memory.budget import (memory_budget,
+                                            reset_memory_budget)
+from spark_rapids_tpu.memory.catalog import (buffer_catalog,
+                                             reset_buffer_catalog)
+from spark_rapids_tpu.obs import events
+from spark_rapids_tpu.obs import stats as runtime_stats
+from spark_rapids_tpu.obs import telemetry
+from spark_rapids_tpu.types import DOUBLE, LONG, Schema
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    prev_conf = C.active_conf()
+    telemetry.reset_telemetry()
+    runtime_stats.reset_stats()
+    lifecycle.reset_lifecycle()
+    workload.reset_workload()
+    yield
+    telemetry.reset_telemetry()
+    runtime_stats.reset_stats()
+    lifecycle.reset_lifecycle()
+    workload.reset_workload()
+    events.reset_event_bus()
+    C.set_active_conf(prev_conf)
+
+
+@pytest.fixture
+def spy(monkeypatch):
+    rows = []
+    real = events.emit
+
+    def spy_emit(kind, **fields):
+        rows.append({"kind": kind, **fields})
+        real(kind, **fields)
+
+    monkeypatch.setattr(events, "emit", spy_emit)
+    return rows
+
+
+def _kinds(rows, kind):
+    return [e for e in rows if e["kind"] == kind]
+
+
+# ---------------------------------------------------------------------------
+# Log2Hist units vs numpy oracles
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 7, 23])
+def test_log2_hist_percentiles_vs_numpy_oracle(seed):
+    """The histogram's exact fields match numpy exactly; its p50/p95
+    are bucket-quantized UPPER bounds within 2x of the true percentile
+    (the documented contract an AQE consumer sizes against)."""
+    rng = np.random.default_rng(seed)
+    data = (rng.lognormal(mean=8.0, sigma=2.0, size=500)
+            .astype(np.int64))
+    h = runtime_stats.Log2Hist()
+    for v in data:
+        h.add(int(v))
+    assert h.count == len(data)
+    assert h.sum == int(data.sum())
+    assert h.min == int(data.min()) and h.max == int(data.max())
+    for q in (50, 95):
+        true = int(np.percentile(data, q, method="inverted_cdf"))
+        est = h.percentile(q)
+        assert true <= est, (q, true, est)
+        assert est <= max(2 * true - 1, true), (q, true, est)
+        assert est <= int(data.max())
+
+
+def test_log2_hist_edges_and_merge():
+    h = runtime_stats.Log2Hist()
+    assert h.summary() == {"count": 0, "sum": 0, "min": 0, "max": 0,
+                           "p50": 0, "p95": 0}
+    assert h.percentile(95) == 0
+    h.add(0)
+    h.add(0)
+    assert h.percentile(50) == 0 and h.summary()["count"] == 2
+    h2 = runtime_stats.Log2Hist()
+    h2.add(1024)
+    h.merge(h2)
+    assert h.count == 3 and h.max == 1024 and h.min == 0
+    assert h.percentile(99) == 1024  # clamped to the observed max
+    single = runtime_stats.Log2Hist()
+    single.add(37)
+    # one sample: every percentile answers within [min, max] == {37}
+    assert single.percentile(1) == 37 and single.percentile(99) == 37
+
+
+def test_exchange_stats_skew_and_exact_sums():
+    st = runtime_stats.ExchangeStats("X", 1, 4)
+    st.record_map([10, 0, 0, 2], [100, 0, 0, 20], 120)
+    st.record_map([10, 0, 0, 0], [100, 0, 0, 0], 100)
+    s = st.summary()
+    assert s["maps"] == 2 and s["rows"] == 22 and s["bytes"] == 220
+    assert s["per_partition_rows"] == [20, 0, 0, 2]
+    assert s["per_partition_bytes"] == [200, 0, 0, 20]
+    assert sum(s["per_partition_bytes"]) == s["bytes"]
+    # median over [0, 0, 20, 200] is 10 -> ratio 20: heavy skew reads
+    # as a large finite ratio
+    sk = s["skew"]
+    assert sk["basis"] == "bytes" and sk["max"] == 200
+    assert sk["ratio"] == pytest.approx(20.0, abs=1e-3)
+    # distributions sampled per (map, partition), empties included
+    assert s["partition_rows"]["count"] == 8
+    assert s["partition_bytes"]["count"] == 8
+    # all-in-one-partition: the all-partitions median is 0, so the
+    # ratio falls back to the non-empty median — finite, never inf
+    lone = runtime_stats.ExchangeStats("X", 2, 4)
+    lone.record_map([7, 0, 0, 0], [700, 0, 0, 0], 700)
+    sk2 = lone.skew()
+    assert sk2["ratio"] == pytest.approx(1.0)
+    empty = runtime_stats.ExchangeStats("X", 3, 2)
+    empty.record_map([0, 0], [0, 0], 0)
+    assert empty.skew()["ratio"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# QueryProfile.statistics() golden (host-shuffled join)
+# ---------------------------------------------------------------------------
+
+def _golden_join_session(extra=None):
+    settings = {"spark.rapids.sql.shuffle.partitions": "3",
+                "spark.rapids.sql.broadcastSizeThreshold": "-1"}
+    settings.update(extra or {})
+    sess = TpuSession(settings)
+    n_l, n_o = 240, 16
+    lines = sess.from_pydict(
+        {"l_key": [i % n_o for i in range(n_l)],
+         "l_val": [float(i) for i in range(n_l)]},
+        Schema.of(l_key=LONG, l_val=DOUBLE), batch_rows=100)
+    orders = sess.from_pydict({"o_key": list(range(n_o))},
+                              Schema.of(o_key=LONG))
+    j = lines.join(orders, left_on=["l_key"], right_on=["o_key"])
+    return sess, j.group_by("l_key").agg((F.sum("l_val"), "s"))
+
+
+def test_statistics_golden_host_shuffled_join(spy):
+    """Acceptance criterion: statistics() exposes per-exchange
+    partition histograms + skew for a host-shuffled join. The murmur3
+    partition assignment is deterministic (Spark-exact), so the
+    per-partition ROW totals are golden; the byte totals are asserted
+    EXACTLY equal to the serializer's written bytes (the shuffle_write
+    events), the acceptance-criterion reconciliation."""
+    sess, q = _golden_join_session()
+    out = q.collect()
+    assert len(out) == 16
+    st = sess.last_query_profile().statistics()
+    assert len(st["exchanges"]) == 3  # lines, orders, agg repartition
+    # the lines-side exchange wrote all 240 rows: golden partition split
+    lines_x = [v for v in st["exchanges"].values() if v["rows"] == 240]
+    assert len(lines_x) == 1
+    v = lines_x[0]
+    assert v["partitions"] == 3 and v["maps"] == 1
+    assert v["per_partition_rows"] == [60, 60, 120]
+    sk = v["skew"]
+    assert sk["basis"] == "bytes"
+    assert sk["max"] == max(v["per_partition_bytes"])
+    med = sorted(v["per_partition_bytes"])[1]
+    assert sk["ratio"] == pytest.approx(sk["max"] / med, abs=1e-3)
+    # histogram fields: one sample per (map, partition); percentile
+    # upper bounds bracket the true values
+    prow = v["partition_rows"]
+    assert prow["count"] == 3 and prow["min"] == 60 \
+        and prow["max"] == 120
+    assert 60 <= prow["p50"] < 120 and prow["p95"] == 120
+    # EXACT byte reconciliation across every exchange in the plan:
+    # sum(per_partition_bytes) == bytes == the serializer's written
+    # bytes (shuffle_write events), per acceptance criterion (c)
+    writes = _kinds(spy, "shuffle_write")
+    assert sum(e["bytes"] for e in writes) \
+        == sum(x["bytes"] for x in st["exchanges"].values())
+    for x in st["exchanges"].values():
+        assert sum(x["per_partition_bytes"]) == x["bytes"]
+    # per-op cardinality derived from the metric tree
+    ops = {(o["op"], o["op_id"]): o for o in st["operators"]}
+    assert any(o["selectivity"] is not None for o in ops.values())
+    # one exchange_stats event per exchange execution, skew included
+    evs = _kinds(spy, "exchange_stats")
+    assert len(evs) == 3
+    for e in evs:
+        assert e["skew_ratio"] >= 1.0 and e["skew_basis"] == "bytes"
+        assert e["maps"] >= 1 and e["partitions"] == 3
+
+
+def test_statistics_reachable_during_execution():
+    """The tentpole contract: RuntimeStats is reachable from the
+    governing QueryContext DURING execution — an operator (here a
+    pandas UDF running mid-plan) sees the upstream exchange's recorded
+    maps before the query finishes."""
+    sess, _ = _golden_join_session()
+    seen = {}
+
+    def probe(it):
+        for pdf in it:
+            rs = runtime_stats.current()
+            if rs is not None:
+                seen["exchanges"] = len(rs.exchanges())
+                seen["maps"] = sum(x.maps for x in rs.exchanges())
+            yield pdf
+
+    n_l, n_o = 240, 16
+    lines = sess.from_pydict(
+        {"l_key": [i % n_o for i in range(n_l)],
+         "l_val": [float(i) for i in range(n_l)]},
+        Schema.of(l_key=LONG, l_val=DOUBLE), batch_rows=100)
+    orders = sess.from_pydict({"o_key": list(range(n_o))},
+                              Schema.of(o_key=LONG))
+    j = lines.join(orders, left_on=["l_key"], right_on=["o_key"])
+    out_schema = Schema.of(l_key=LONG, l_val=DOUBLE, o_key=LONG)
+    j.map_in_pandas(probe, out_schema).collect()
+    assert seen.get("exchanges", 0) >= 1, \
+        "mid-flight probe never saw the RuntimeStats"
+    assert seen.get("maps", 0) >= 1
+
+
+def test_statistics_multiple_maps(spy):
+    """Small batchSizeBytes forces several map tasks per exchange: the
+    map-output histogram sees one sample per map and distributions
+    accumulate across maps."""
+    sess, q = _golden_join_session(
+        {"spark.rapids.sql.batchSizeBytes": "4k"})
+    q.collect()
+    st = sess.last_query_profile().statistics()
+    lines_x = [v for v in st["exchanges"].values() if v["rows"] == 240]
+    assert len(lines_x) == 1 and lines_x[0]["maps"] >= 2
+    assert lines_x[0]["map_output_bytes"]["count"] == lines_x[0]["maps"]
+    assert sum(lines_x[0]["per_partition_rows"]) == 240
+
+
+# ---------------------------------------------------------------------------
+# disabled-mode discipline (PR 2 pattern)
+# ---------------------------------------------------------------------------
+
+def test_disabled_mode_zero_emission_and_single_pointer_check(spy):
+    """Telemetry off (the default): no registry, no sampler thread,
+    push sites cost one pointer check and write nothing, results are
+    byte-identical, and zero telemetry_sample/registry writes happen —
+    acceptance criterion (d)."""
+    assert telemetry.active_registry() is None
+    telemetry.add("anything", 5)  # the entire disabled-mode cost
+    assert telemetry.active_registry() is None
+    sess, q = _golden_join_session()
+    out_off = q.collect()
+    assert not any(t.name.startswith("telemetry-")
+                   for t in threading.enumerate())
+    assert telemetry.counters() == {"samples": 0, "registry_writes": 0}
+    assert not _kinds(spy, "telemetry_sample")
+    # the same query with telemetry ON returns identical results
+    sess2, q2 = _golden_join_session(
+        {"spark.rapids.tpu.telemetry.enabled": "true",
+         "spark.rapids.tpu.telemetry.intervalMs": "50"})
+    assert sorted(q2.collect()) == sorted(out_off)
+    assert telemetry.active_registry() is not None
+    assert telemetry.counters()["registry_writes"] > 0
+
+
+def test_configure_semantics_match_event_bus():
+    """Process-wide conf semantics: unset keeps another session's
+    registry, explicit false tears it down, unchanged params keep the
+    instance (ring-buffer history survives)."""
+    r1 = telemetry.configure(C.RapidsConf(
+        {"spark.rapids.tpu.telemetry.enabled": "true"}))
+    assert r1 is not None
+    # unset: keeps it
+    assert telemetry.configure(C.RapidsConf({})) is r1
+    # unchanged params: same instance
+    assert telemetry.configure(C.RapidsConf(
+        {"spark.rapids.tpu.telemetry.enabled": "true"})) is r1
+    # explicit false: torn down, thread gone
+    assert telemetry.configure(C.RapidsConf(
+        {"spark.rapids.tpu.telemetry.enabled": "false"})) is None
+    time.sleep(0.05)
+    assert not any(t.name.startswith("telemetry-") and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_sample_series_and_owner_attribution_sum():
+    """Every registered series appears in a sample, and the per-owner
+    HBM attribution sums to the tier totals exactly (one lock pass)."""
+    r = telemetry.enable(interval_ms=100000)  # manual sampling only
+    import jax.numpy as jnp
+    cat = buffer_catalog()
+    h = cat.add(jnp.arange(1024, dtype=jnp.int32))
+    try:
+        snap = r.sample()
+        for name in telemetry.SERIES:
+            assert name in snap, name
+        by_owner = snap["hbm_by_owner"]
+        assert sum(by_owner["device"].values()) \
+            == snap["hbm.device_bytes"]
+        assert sum(by_owner["host"].values()) == snap["hbm.host_bytes"]
+        assert snap["hbm.device_bytes"] == cat.device_bytes()
+        assert by_owner["device"].get("unowned", 0) > 0
+        assert r.series("hbm.device_bytes")[-1][1] \
+            == snap["hbm.device_bytes"]
+    finally:
+        cat.remove(h)
+
+
+# ---------------------------------------------------------------------------
+# live introspection: active_queries()
+# ---------------------------------------------------------------------------
+
+class _StallingSource:
+    """batches() parks on an event after the first batch — the PR 5
+    stalled-producer recipe, released by the test driver."""
+
+    def __init__(self, batches, schema, gate):
+        self._batches = batches
+        self.schema = schema
+        self.gate = gate
+
+    def batches(self):
+        for i, b in enumerate(self._batches):
+            if i >= 1:
+                assert self.gate.wait(60), "driver never released"
+            yield b
+
+    def estimated_size_bytes(self):
+        return sum(b.device_size_bytes() for b in self._batches)
+
+    def estimated_num_rows(self):
+        return sum(b.num_rows_host for b in self._batches)
+
+
+def test_active_queries_during_inflight_governed_query():
+    """Acceptance criterion (a): active_queries() observed non-empty
+    mid-run with correct phase/fields, and empty again at quiesce."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.plan import logical as L
+    schema = Schema.of(a=LONG)
+    # each batch alone exceeds batchSizeBytes, so CoalesceBatches
+    # passes the first one through to the root BEFORE the stall —
+    # otherwise no root output exists "mid" the run at all
+    batches = [ColumnarBatch.from_pydict({"a": [i] * 1024}, schema)
+               for i in range(3)]
+    gate = threading.Event()
+    sess = TpuSession({"spark.rapids.sql.batchSizeBytes": "4k"})
+    df = sess._df(L.LogicalScan(_StallingSource(batches, schema, gate)))
+    # pandas tail: the ROOT's output batches are host-built, so the
+    # live rows counter sees them (device-resident root output counts
+    # batches only — progress never pays a device sync)
+    q = df.filter(col("a") >= lit(0)).map_in_pandas(
+        lambda it: it, schema)
+    done = {}
+
+    def drive():
+        done["rows"] = q.collect()
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30
+    rows = []
+    while time.monotonic() < deadline:
+        rows = sess.active_queries()
+        if rows and rows[0]["batches"] >= 1:
+            break
+        time.sleep(0.01)
+    assert rows, "active_queries never saw the in-flight query"
+    r = rows[0]
+    assert r["phase"] == "executing"
+    assert r["mine"] is True
+    assert r["attempt"] == 1
+    assert r["cancelled"] is False
+    assert r["current_op"] is not None
+    assert r["rows"] >= 1024  # root output observed mid-run
+    assert r["elapsed_ms"] >= 0
+    assert r["deadline_remaining_ms"] is None  # no timeoutMs set
+    gate.set()
+    t.join(timeout=30)
+    assert not t.is_alive() and len(done["rows"]) == 3072
+    assert sess.active_queries() == []
+
+
+def test_attempt_restart_resets_live_progress():
+    """A task re-execution starts its root output from zero — the live
+    progress counters must not double-count across attempts (review
+    finding; mirrors the fresh-RuntimeStats-per-attempt rule)."""
+    with lifecycle.governed(C.RapidsConf({})) as ctx:
+        lifecycle.begin_attempt(1)
+        ctx.root_op_id = 42
+        ctx.note_batch("RootExec", 42, 100)
+        ctx.note_batch("RootExec", 42, 100)
+        assert ctx.batches_produced == 2 and ctx.rows_produced == 200
+        lifecycle.begin_attempt(2)
+        assert ctx.attempt_no == 2 and ctx.phase == "executing"
+        assert ctx.batches_produced == 0 and ctx.rows_produced == 0
+        assert ctx.current_op is None
+
+
+# ---------------------------------------------------------------------------
+# event-log rotation + report tooling
+# ---------------------------------------------------------------------------
+
+def test_event_log_rotation_and_rotated_report(tmp_path):
+    """eventLog.maxBytes rotates the sink to events-<n>.<rot>.jsonl;
+    profile_report reads the set in order and still tolerates a
+    truncated final line."""
+    import profile_report
+    bus = events.enable(str(tmp_path), max_bytes=512)
+    for i in range(50):
+        bus.emit("op_close", op="FakeExec", op_id=1, wall_ns=1000,
+                 batches=1, rows=10)
+    bus.emit("query_end", root="FakeExec", ok=True, wall_ns=1)
+    events.reset_event_bus()
+    files = sorted(tmp_path.glob("*.jsonl"))
+    assert len(files) >= 3, "rotation never engaged"
+    members = profile_report.rotated_set(str(files[0]))
+    assert len(members) == len(files)
+    # rotation order: base first, then .1, .2, ... (numeric, not lex)
+    assert members[0].endswith("-1.jsonl") \
+        or ".1.jsonl" not in members[0]
+    evs = profile_report.read_event_files(str(members[0]))
+    assert sum(1 for e in evs if e["kind"] == "op_close") == 50
+    # truncated final line in the newest member: parseable prefix kept
+    with open(members[-1], "a") as f:
+        f.write('{"kind": "op_close", "op": "Trunc')
+    evs2 = profile_report.read_event_files(str(members[0]))
+    assert len(evs2) == len(evs)
+    report = profile_report.build_report(evs2)
+    assert "51 events" in report and "FakeExec" in report
+
+
+def test_rotation_respects_unrotated_default(tmp_path):
+    bus = events.enable(str(tmp_path))
+    for _ in range(200):
+        bus.emit("op_close", op="E", op_id=1, wall_ns=1, batches=1,
+                 rows=1)
+    events.reset_event_bus()
+    assert len(list(tmp_path.glob("*.jsonl"))) == 1
+
+
+def test_profile_report_json_format(tmp_path, capsys, spy):
+    """--format json: the same roll-ups as the text report, as fields
+    (the AQE/CI assertion surface), including the statistics block."""
+    import profile_report
+    d = tmp_path / "ev"
+    sess, q = _golden_join_session(
+        {"spark.rapids.tpu.eventLog.enabled": "true",
+         "spark.rapids.tpu.eventLog.dir": str(d)})
+    q.collect()
+    events.reset_event_bus()
+    log = sorted(d.glob("*.jsonl"))[0]
+    assert profile_report.main([str(log), "--format", "json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["completed"] == 1
+    assert summary["top_ops"] and summary["top_ops"][0]["wall_ns"] > 0
+    st = summary["statistics"]
+    assert st["exchanges"] == 3 and st["max_skew_ratio"] >= 1.0
+    assert st["p95_map_output_bytes"] > 0
+    assert len(st["per_exchange"]) == 3
+    assert summary["shuffle_writes"]["maps"] >= 3
+    # the text renderer prints the same data as a statistics line
+    text = profile_report.build_report(
+        profile_report.read_event_files(str(log)))
+    assert "statistics: 3 exchange(s)" in text
+    assert "max partition skew ratio" in text
+
+
+def test_telemetry_export_prometheus(tmp_path, capsys):
+    """tools/telemetry_export.py renders telemetry_sample records as
+    Prometheus text format, per-owner HBM labels included."""
+    import telemetry_export
+    sample = {
+        "kind": "telemetry_sample", "ts_ms": 1700000000000,
+        "hbm.device_bytes": 4096, "hbm.host_bytes": 0,
+        "hbm_by_owner": {"device": {"q3": 4096, "unowned": 0},
+                         "host": {}},
+        "counters": {"exchange.write_bytes": 99},
+    }
+    text = telemetry_export.to_prometheus(sample)
+    assert "# TYPE spark_rapids_tpu_hbm_device_bytes gauge" in text
+    assert "spark_rapids_tpu_hbm_device_bytes 4096 1700000000000" \
+        in text
+    assert ('spark_rapids_tpu_hbm_owner_bytes{tier="device",'
+            'owner="q3"} 4096') in text
+    assert "spark_rapids_tpu_counter_exchange_write_bytes 99" in text
+    # CLI over a real log (rotated-set reading included)
+    log = tmp_path / "events-1-1.jsonl"
+    sample2 = dict(sample, **{"ts_ms": 1700000001000,
+                              "hbm.device_bytes": 2048})
+    log.write_text(json.dumps(sample) + "\n"
+                   + json.dumps(sample2) + "\n")
+    assert telemetry_export.main([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "spark_rapids_tpu_hbm_device_bytes 2048" in out  # newest
+    # --all: valid exposition — ONE TYPE line per metric, one
+    # timestamped line per sample under it (no duplicate TYPE lines)
+    assert telemetry_export.main([str(log), "--all"]) == 0
+    out_all = capsys.readouterr().out
+    assert out_all.count(
+        "# TYPE spark_rapids_tpu_hbm_device_bytes gauge") == 1
+    assert "spark_rapids_tpu_hbm_device_bytes 4096 1700000000000" \
+        in out_all
+    assert "spark_rapids_tpu_hbm_device_bytes 2048 1700000001000" \
+        in out_all
+    empty = tmp_path / "events-1-2.jsonl"
+    empty.write_text("")
+    assert telemetry_export.main([str(empty)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# bench attribution blocks
+# ---------------------------------------------------------------------------
+
+def test_bench_telemetry_and_statistics_blocks():
+    import bench
+    bench._attr_prev.clear()
+    base = bench.telemetry_attribution()
+    assert base == {"samples": 0, "registry_writes": 0}
+    r = telemetry.enable(interval_ms=100000)
+    r.sample()
+    delta = bench.telemetry_attribution()
+    assert delta["samples"] == 1 and delta["registry_writes"] >= 1
+    runtime_stats.reset_stats()
+    bench._attr_prev.pop("statistics", None)
+    s0 = bench.statistics_attribution()
+    assert s0["maps"] == 0 and s0["skew_ratio"] == 0.0
+    rec = runtime_stats.ExchangeRecorder("X", 1, 2)
+    rec.record_map([5, 1], [500, 100], 600)
+    rec.finish()
+    s1 = bench.statistics_attribution()
+    assert s1["maps"] == 1 and s1["bytes"] == 600
+    assert s1["p95_map_output_bytes"] >= 600 \
+        and s1["p95_map_output_bytes"] < 1200
+    assert s1["skew_ratio"] == pytest.approx(500 / 300, abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# the 8-lane storm: per-owner attribution reconciles (PR 6 recipe)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def storm_files(tmp_path_factory):
+    """The PR 6 proven forced-spill storm shape, verbatim scale."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    d = tmp_path_factory.mktemp("telemetry_storm")
+    lanes = []
+    for seed in range(8):
+        rng = np.random.default_rng(seed)
+        n_l, n_o = 2000, 500
+        l_key = rng.integers(0, n_o, n_l)
+        l_val = rng.random(n_l) * 100.0
+        l_flag = rng.integers(0, 4, n_l)
+        o_flag = rng.integers(0, 10, n_o)
+        lp = str(d / f"lines-{seed}.parquet")
+        op = str(d / f"orders-{seed}.parquet")
+        pq.write_table(pa.table({
+            "l_key": pa.array(l_key, pa.int64()),
+            "l_val": pa.array(l_val, pa.float64()),
+            "l_flag": pa.array(l_flag, pa.int64())}), lp,
+            row_group_size=512)
+        pq.write_table(pa.table({
+            "o_key": pa.array(np.arange(n_o), pa.int64()),
+            "o_flag": pa.array(o_flag, pa.int64())}), op,
+            row_group_size=128)
+        keep = (l_flag != 0) & (o_flag[l_key] < 5)
+        oracle = {}
+        for k, v in zip(l_key[keep], l_val[keep]):
+            s, c = oracle.get(int(k), (0.0, 0))
+            oracle[int(k)] = (s + float(v), c + 1)
+        lanes.append((lp, op, oracle))
+    return lanes
+
+
+STORM = {
+    "spark.rapids.tpu.workload.enabled": "true",
+    "spark.rapids.tpu.workload.maxConcurrentQueries": "2",
+    "spark.rapids.tpu.workload.queueDepth": "8",
+    "spark.rapids.sql.batchSizeBytes": str(16 * 1024),
+    "spark.rapids.sql.broadcastSizeThreshold": "-1",
+    "spark.rapids.sql.retry.maxAttempts": "50",
+    "spark.rapids.tpu.retry.backoffMs": "5",
+    "spark.rapids.tpu.io.retryBackoffMs": "1",
+    "spark.rapids.tpu.task.retryBackoffMs": "1",
+}
+
+
+def _run_storm_query(settings, lane):
+    lp, op, _ = lane
+    sess = TpuSession(settings)
+    lines = sess.read_parquet(lp).filter(col("l_flag") != lit(0))
+    orders = sess.read_parquet(op).filter(col("o_flag") < lit(5))
+    j = lines.join(orders, left_on=["l_key"], right_on=["o_key"])
+    agg = j.group_by("l_key").agg((F.sum("l_val"), "rev"),
+                                  (F.count(), "cnt"))
+    return agg.sort(("rev", False)).collect()
+
+
+def test_storm_hbm_attribution_reconciles(storm_files):
+    """Acceptance criterion: 8 governed lanes under a forced-spill
+    budget with telemetry ON — (a) active_queries() snapshots observed
+    non-empty mid-run with correct phases, (b) per-owner HBM
+    attribution sums to the catalog totals at every sampled tick and
+    owner-keyed attribution actually engaged, (c) results match the
+    per-lane oracles, and everything reconciles at quiesce."""
+    pre = {t for t in threading.enumerate()
+           if t.name.startswith(("pipeline-", "spill-writer",
+                                 "telemetry-"))}
+    try:
+        reset_buffer_catalog()
+        reset_memory_budget(112 * 1024)  # the PR 6 probed-stable point
+        used_before = memory_budget().used
+        reg = telemetry.enable(interval_ms=100000)  # sampled by driver
+        results = [None] * 8
+        settings = dict(STORM, **{
+            "spark.rapids.tpu.telemetry.enabled": "true"})
+
+        def lane(i):
+            try:
+                results[i] = _run_storm_query(settings, storm_files[i])
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                results[i] = e
+
+        threads = [threading.Thread(target=lane, args=(i,), daemon=True)
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        samples = []
+        snapshots = []
+        while any(t.is_alive() for t in threads):
+            samples.append(reg.sample())
+            snapshots.append(lifecycle.active_queries())
+            time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=240)
+            assert not t.is_alive(), "a lane wedged"
+        for i in range(8):
+            assert not isinstance(results[i], BaseException), results[i]
+            got = {int(k): (rev, int(cnt))
+                   for k, rev, cnt in results[i]}
+            oracle = storm_files[i][2]
+            assert set(got) == set(oracle), f"lane {i}"
+            for k, (rev, cnt) in got.items():
+                o_rev, o_cnt = oracle[k]
+                assert cnt == o_cnt, (i, k)
+                assert abs(rev - o_rev) <= 1e-9 * max(abs(o_rev), 1.0)
+        # (b) attribution reconciles at EVERY sampled tick: per-owner
+        # sums equal the same-pass tier totals
+        assert samples, "storm finished before the first sample"
+        for s in samples:
+            assert sum(s["hbm_by_owner"]["device"].values()) \
+                == s["hbm.device_bytes"]
+            assert sum(s["hbm_by_owner"]["host"].values()) \
+                == s["hbm.host_bytes"]
+        # owner-keyed attribution engaged: some tick saw bytes charged
+        # to an admitted ticket (q<id>), not just "unowned"
+        assert any(k.startswith("q") and v > 0
+                   for s in samples
+                   for k, v in s["hbm_by_owner"]["device"].items()), \
+            "no sampled tick attributed device bytes to a ticket owner"
+        assert memory_budget().spill_requests > 0, \
+            "the forced-spill drive lost its teeth"
+        # (a) live snapshots: non-empty mid-run, phases valid, and the
+        # admission queue actually held queries at some tick
+        flat = [r for snap in snapshots for r in snap]
+        assert flat, "active_queries never saw the storm"
+        valid = {"queued", "admitted", "executing", "retrying"}
+        assert all(r["phase"] in valid for r in flat)
+        assert any(r["phase"] == "executing" for r in flat)
+        assert any(s["workload.queue_depth"] > 0 for s in samples) \
+            or any(r["phase"] == "queued" for r in flat), \
+            "no queue residency observed: no contention"
+        # quiesce: budget restored, no lingering queries, totals zero
+        buffer_catalog().drain_writeback()
+        assert memory_budget().used == used_before, "leaked budget"
+        final = reg.sample()
+        assert final["hbm.device_bytes"] == buffer_catalog().device_bytes()
+        assert lifecycle.active_queries() == []
+        assert workload.snapshot()["admitted"] == 0
+        buffer_catalog().shutdown_writer()
+        telemetry.reset_telemetry()
+        post = {t for t in threading.enumerate()
+                if t.name.startswith(("pipeline-", "spill-writer",
+                                      "telemetry-"))}
+        assert post <= pre, "storm leaked threads"
+    finally:
+        reset_buffer_catalog()
+        reset_memory_budget()

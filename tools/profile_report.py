@@ -5,19 +5,31 @@ over Spark event logs).
 
 Usage:
     python tools/profile_report.py EVENTS.jsonl [--top N] [--query QID]
+                                   [--format text|json]
 
 Reads `op_close` spans (cumulative wall-ns / rows / batches per
 operator instance), `op_batch` spans (per-batch bytes), and the
 query/task events (spill, oom_retry, semaphore_acquire, exchange) and
 prints one aggregated report. Wall-ns are INCLUSIVE of child time (the
 pull model), so percentages are of the slowest root span, not a sum.
-Stdlib only — runs anywhere the log file lands.
+
+`--format json` (ISSUE 11 satellite) emits the SAME roll-ups as the
+text report — top ops, pipeline overlap, gathers, shuffle writes,
+uploads, robustness, workload, runtime statistics — as one JSON object
+(`build_summary`), so CI and AQE tests assert on fields instead of
+scraping text. Given any member of a rotated log set
+(`events-<pid>-<n>.jsonl` + `.1.jsonl`, `.2.jsonl`, ... —
+spark.rapids.tpu.eventLog.maxBytes), the whole set is read in rotation
+order. Stdlib only — runs anywhere the log file lands.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
+import os
+import re
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
@@ -41,6 +53,35 @@ def read_events(lines: Iterable[str]) -> List[Dict[str, Any]]:
     return out
 
 
+def rotated_set(path: str) -> List[str]:
+    """All members of `path`'s rotated log set, in write order (base
+    file first, then `.1.jsonl`, `.2.jsonl`, ...). A non-rotated log —
+    or any file that does not match the rotation naming — returns just
+    itself, so every existing caller keeps working."""
+    m = re.fullmatch(r"(.*?)(?:\.(\d+))?\.jsonl", path)
+    if m is None:
+        return [path]
+    base = m.group(1)
+    members = [(0, f"{base}.jsonl")]
+    for p in _glob.glob(_glob.escape(base) + ".*.jsonl"):
+        mm = re.fullmatch(re.escape(base) + r"\.(\d+)\.jsonl", p)
+        if mm:
+            members.append((int(mm.group(1)), p))
+    out = [p for _n, p in sorted(members) if os.path.exists(p)]
+    return out or [path]
+
+
+def read_event_files(path: str) -> List[Dict[str, Any]]:
+    """Read `path`'s whole rotated set in order (ISSUE 11 satellite:
+    a soak's rotated log renders as one report; a truncated final line
+    in any member is tolerated)."""
+    events: List[Dict[str, Any]] = []
+    for p in rotated_set(path):
+        with open(p) as f:
+            events.extend(read_events(f))
+    return events
+
+
 def _fmt_ns(ns: float) -> str:
     if ns < 1_000:
         return f"{ns:.0f}ns"
@@ -61,8 +102,15 @@ def _fmt_bytes(b: float) -> str:
     return f"{b / (1 << 30):.2f}GB"
 
 
-def build_report(events: List[Dict[str, Any]], top: int = 10,
-                 query: Optional[int] = None) -> str:
+def _worst_skew(xstats: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    return max(xstats, key=lambda e: e.get("skew_ratio") or 0, default=None)
+
+
+def build_summary(events: List[Dict[str, Any]], top: int = 10,
+                  query: Optional[int] = None) -> Dict[str, Any]:
+    """THE report data: every roll-up the text renderer prints, as one
+    machine-readable dict (the `--format json` payload). build_report
+    renders from this, so the two formats cannot drift."""
     if query is not None:
         events = [e for e in events if e.get("query") == query]
 
@@ -84,182 +132,276 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
         else:
             agg["bytes"] += e.get("bytes") or 0
 
-    lines: List[str] = []
-    queries = sorted({e.get("query") for e in events
-                      if e.get("query") is not None})
-    n_end = sum(1 for e in events if e.get("kind") == "query_end")
-    lines.append(f"event log: {len(events)} events, "
-                 f"{len(queries)} queries ({n_end} completed)")
-
     rows = sorted(ops.values(), key=lambda r: -r["wall_ns"])
     total_ns = max((r["wall_ns"] for r in rows), default=0)
+    top_ops = []
+    for r in rows[:top]:
+        row = dict(r)
+        row["pct_root"] = round(100.0 * r["wall_ns"] / total_ns, 1) \
+            if total_ns else 0.0
+        top_ops.append(row)
+
+    def count(kind) -> int:
+        return sum(1 for e in events if e.get("kind") == kind)
+
+    def total(kind, field) -> int:
+        return sum(e.get(field) or 0 for e in events
+                   if e.get("kind") == kind)
+
+    def by(kind, field) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in events:
+            if e.get("kind") == kind:
+                k = e.get(field, "?") or "?"
+                out[k] = out.get(k, 0) + 1
+        return out
+
+    writes = [e for e in events if e.get("kind") == "shuffle_write"]
+    tiers = [e for e in events if e.get("kind") == "pallas_tier"]
+    gstats = [e for e in events if e.get("kind") == "gather_stats"]
+    ups = [e for e in events if e.get("kind") == "upload"]
+    xstats = [e for e in events if e.get("kind") == "exchange_stats"]
+    waits = [e.get("wait_ms") or 0 for e in events
+             if e.get("kind") == "query_admitted"]
+
+    summary: Dict[str, Any] = {
+        "events": len(events),
+        "queries": sorted({e.get("query") for e in events
+                           if e.get("query") is not None}),
+        "completed": count("query_end"),
+        "top_ops": top_ops,
+        "operators": len(rows),
+        "spills": {"count": count("spill"),
+                   "bytes": total("spill", "bytes")},
+        "oom_retries": count("oom_retry"),
+        "semaphore_wait_ns": total("semaphore_acquire", "wait_ns"),
+        "pipeline": {"stages": count("pipeline_wait"),
+                     "consumer_wait_ns": total("pipeline_wait",
+                                               "wait_ns"),
+                     "producer_full_ns": total("pipeline_full",
+                                               "full_ns")},
+        "exchange_bytes": total("exchange", "bytes"),
+        "shuffle_writes": {
+            "maps": len(writes),
+            "bytes": total("shuffle_write", "bytes"),
+            "frames": total("shuffle_write", "frames"),
+            "device_partitioned": sum(1 for e in writes
+                                      if e.get("lane") == "device"),
+            "pack_ns": total("shuffle_write", "pack_ns"),
+            "serialize_ns": total("shuffle_write", "serialize_ns"),
+            "io_ns": total("shuffle_write", "io_ns")},
+        "plan_fallbacks": (count("plan_fallback")
+                           + count("plan_not_on_tpu")),
+        "robustness": {
+            "injected_faults": by("fault_inject", "point"),
+            "io_retries": count("io_retry"),
+            "task_retries": count("task_retry"),
+            "integrity_quarantines": count("integrity_fail"),
+            "watchdog_trips": (count("pipeline_stuck")
+                               + count("spill_writer_dead"))},
+        "lifecycle": {
+            "cancellations": by("query_cancelled", "phase"),
+            "breaker": {"open": count("breaker_open"),
+                        "half_open": count("breaker_half_open"),
+                        "close": count("breaker_close")},
+            "partition_recomputes": count("partition_recompute")},
+        "workload": {
+            "admissions": count("query_admitted"),
+            "queued": count("query_queued"),
+            "max_wait_ms": max(waits) if waits else 0,
+            "sheds": by("query_shed", "reason"),
+            "quota_spills": count("quota_spill")},
+        "pallas_tier": {"decisions": len(tiers),
+                        "engaged": sum(1 for e in tiers
+                                       if e.get("engaged"))},
+        "gathers": {"count": sum(e.get("count") or 0 for e in gstats),
+                    "records": len(gstats),
+                    "packed": sum(e.get("packed") or 0 for e in gstats),
+                    "pallas": sum(e.get("pallas") or 0 for e in gstats),
+                    "bytes": sum(e.get("bytes") or 0 for e in gstats)},
+        "uploads": {
+            "batches": len(ups),
+            "packed": sum(1 for e in ups if e.get("lane") == "packed"),
+            "per_buffer": sum(1 for e in ups
+                              if e.get("lane") != "packed"),
+            "transfers": sum(e.get("transfers") or 0 for e in ups),
+            "bytes": sum(e.get("bytes") or 0 for e in ups),
+            "pack_ns": sum(e.get("pack_ns") or 0 for e in ups)},
+        # runtime-statistics roll-up (ISSUE 11): per-exchange skew +
+        # distribution records — worst skew leads, it is the AQE signal.
+        # Exchanges may compute skew on different bases (rows vs bytes),
+        # so the headline carries the winning exchange's basis alongside.
+        "statistics": {
+            "exchanges": len(xstats),
+            "maps": sum(e.get("maps") or 0 for e in xstats),
+            "max_skew_ratio": ((_worst_skew(xstats) or {}).get("skew_ratio")
+                               or 0),
+            "max_skew_basis": (_worst_skew(xstats) or {}).get("skew_basis"),
+            "p95_map_output_bytes": max(
+                (e.get("p95_map_output_bytes") or 0 for e in xstats),
+                default=0),
+            "telemetry_samples": count("telemetry_sample"),
+            "per_exchange": [
+                {"exec": e.get("exec"), "op_id": e.get("op_id"),
+                 "partitions": e.get("partitions"),
+                 "maps": e.get("maps"), "rows": e.get("rows"),
+                 "bytes": e.get("bytes"),
+                 "skew_ratio": e.get("skew_ratio"),
+                 "skew_basis": e.get("skew_basis"),
+                 "p95_partition_bytes": e.get("p95_partition_bytes"),
+                 "p95_map_output_bytes": e.get("p95_map_output_bytes")}
+                for e in xstats]},
+    }
+    return summary
+
+
+def build_report(events: List[Dict[str, Any]], top: int = 10,
+                 query: Optional[int] = None) -> str:
+    """Text renderer over build_summary — same data, human form."""
+    s = build_summary(events, top=top, query=query)
+    lines: List[str] = []
+    lines.append(f"event log: {s['events']} events, "
+                 f"{len(s['queries'])} queries "
+                 f"({s['completed']} completed)")
+
+    rows = s["top_ops"]
     if rows:
         lines.append("")
-        lines.append(f"top {min(top, len(rows))} operators by inclusive "
-                     "wall time:")
+        lines.append(f"top {min(top, s['operators'])} operators by "
+                     "inclusive wall time:")
         hdr = (f"{'#':>3} {'operator':<28} {'id':>4} {'time':>10} "
                f"{'%root':>6} {'rows':>12} {'batches':>8} {'bytes':>10}")
         lines.append(hdr)
         lines.append("-" * len(hdr))
-        for i, r in enumerate(rows[:top], 1):
-            pct = 100.0 * r["wall_ns"] / total_ns if total_ns else 0.0
+        for i, r in enumerate(rows, 1):
             lines.append(
                 f"{i:>3} {r['op']:<28} "
                 f"{r['op_id'] if r['op_id'] is not None else '-':>4} "
-                f"{_fmt_ns(r['wall_ns']):>10} {pct:>5.1f}% "
+                f"{_fmt_ns(r['wall_ns']):>10} {r['pct_root']:>5.1f}% "
                 f"{r['rows']:>12} {r['batches']:>8} "
                 f"{_fmt_bytes(r['bytes']):>10}")
 
-    # task-scoped roll-ups
-    def total(kind, field):
-        return sum(e.get(field) or 0 for e in events
-                   if e.get("kind") == kind)
-
     extras = []
-    n_spill = sum(1 for e in events if e.get("kind") == "spill")
-    if n_spill:
-        extras.append(f"spills: {n_spill} "
-                      f"({_fmt_bytes(total('spill', 'bytes'))})")
-    n_retry = sum(1 for e in events if e.get("kind") == "oom_retry")
-    if n_retry:
-        extras.append(f"oom retries: {n_retry}")
-    sem_ns = total("semaphore_acquire", "wait_ns")
-    if sem_ns:
-        extras.append(f"semaphore wait: {_fmt_ns(sem_ns)}")
-    pipe_wait = total("pipeline_wait", "wait_ns")
-    pipe_full = total("pipeline_full", "full_ns")
-    n_stage = sum(1 for e in events if e.get("kind") == "pipeline_wait")
-    if n_stage:
+    if s["spills"]["count"]:
+        extras.append(f"spills: {s['spills']['count']} "
+                      f"({_fmt_bytes(s['spills']['bytes'])})")
+    if s["oom_retries"]:
+        extras.append(f"oom retries: {s['oom_retries']}")
+    if s["semaphore_wait_ns"]:
+        extras.append(f"semaphore wait: "
+                      f"{_fmt_ns(s['semaphore_wait_ns'])}")
+    pipe = s["pipeline"]
+    if pipe["stages"]:
         extras.append(
-            f"pipeline stages: {n_stage} (consumer stalled "
-            f"{_fmt_ns(pipe_wait)} on empty, producer stalled "
-            f"{_fmt_ns(pipe_full)} on full)")
-    exch = total("exchange", "bytes")
-    if exch:
-        extras.append(f"exchange bytes: {_fmt_bytes(exch)}")
+            f"pipeline stages: {pipe['stages']} (consumer stalled "
+            f"{_fmt_ns(pipe['consumer_wait_ns'])} on empty, producer "
+            f"stalled {_fmt_ns(pipe['producer_full_ns'])} on full)")
+    if s["exchange_bytes"]:
+        extras.append(f"exchange bytes: "
+                      f"{_fmt_bytes(s['exchange_bytes'])}")
     # shuffle-write roll-up (ISSUE 9): write time split pack (device
     # partition + packed D2H) / serialize / file IO, byte and frame
     # totals, and how many maps rode the device-partition lane
-    writes = [e for e in events if e.get("kind") == "shuffle_write"]
-    if writes:
-        n_dev = sum(1 for e in writes if e.get("lane") == "device")
+    sw = s["shuffle_writes"]
+    if sw["maps"]:
         extras.append(
-            f"shuffle writes: {len(writes)} maps "
-            f"({_fmt_bytes(total('shuffle_write', 'bytes'))} in "
-            f"{total('shuffle_write', 'frames')} frames; "
-            f"{n_dev} device-partitioned; pack "
-            f"{_fmt_ns(total('shuffle_write', 'pack_ns'))}, serialize "
-            f"{_fmt_ns(total('shuffle_write', 'serialize_ns'))}, io "
-            f"{_fmt_ns(total('shuffle_write', 'io_ns'))})")
-    n_fb = sum(1 for e in events
-               if e.get("kind") in ("plan_fallback", "plan_not_on_tpu"))
-    if n_fb:
-        extras.append(f"plan fallback/why-not records: {n_fb}")
+            f"shuffle writes: {sw['maps']} maps "
+            f"({_fmt_bytes(sw['bytes'])} in {sw['frames']} frames; "
+            f"{sw['device_partitioned']} device-partitioned; pack "
+            f"{_fmt_ns(sw['pack_ns'])}, serialize "
+            f"{_fmt_ns(sw['serialize_ns'])}, io "
+            f"{_fmt_ns(sw['io_ns'])})")
+    if s["plan_fallbacks"]:
+        extras.append(f"plan fallback/why-not records: "
+                      f"{s['plan_fallbacks']}")
     # robustness roll-up (docs/robustness.md): how much chaos the run
     # absorbed, and at which recovery layer
-    n_inject = sum(1 for e in events if e.get("kind") == "fault_inject")
-    if n_inject:
-        by_point: Dict[str, int] = {}
-        for e in events:
-            if e.get("kind") == "fault_inject":
-                by_point[e.get("point", "?")] = \
-                    by_point.get(e.get("point", "?"), 0) + 1
-        detail = ", ".join(f"{p}:{n}" for p, n in sorted(by_point.items()))
+    rob = s["robustness"]
+    if rob["injected_faults"]:
+        n_inject = sum(rob["injected_faults"].values())
+        detail = ", ".join(f"{p}:{n}" for p, n
+                           in sorted(rob["injected_faults"].items()))
         extras.append(f"injected faults: {n_inject} ({detail})")
-    n_io = sum(1 for e in events if e.get("kind") == "io_retry")
-    if n_io:
-        extras.append(f"io retries: {n_io}")
-    n_task = sum(1 for e in events if e.get("kind") == "task_retry")
-    if n_task:
-        extras.append(f"task re-executions: {n_task}")
+    if rob["io_retries"]:
+        extras.append(f"io retries: {rob['io_retries']}")
+    if rob["task_retries"]:
+        extras.append(f"task re-executions: {rob['task_retries']}")
     # lifecycle-governor roll-up (ISSUE 6): cancellations by phase,
     # breaker transitions, and which recovery lane paid for failures
-    cancels = [e for e in events if e.get("kind") == "query_cancelled"]
-    if cancels:
-        by_phase: Dict[str, int] = {}
-        for e in cancels:
-            by_phase[e.get("phase", "?")] = \
-                by_phase.get(e.get("phase", "?"), 0) + 1
-        detail = ", ".join(f"{p}:{n}" for p, n in sorted(by_phase.items()))
-        extras.append(f"query cancellations: {len(cancels)} ({detail})")
-    n_bopen = sum(1 for e in events if e.get("kind") == "breaker_open")
-    n_bhalf = sum(1 for e in events
-                  if e.get("kind") == "breaker_half_open")
-    n_bclose = sum(1 for e in events if e.get("kind") == "breaker_close")
-    if n_bopen or n_bhalf or n_bclose:
-        extras.append(f"breaker trips: {n_bopen} open, {n_bhalf} "
-                      f"half-open, {n_bclose} close")
+    lc = s["lifecycle"]
+    if lc["cancellations"]:
+        n_cancel = sum(lc["cancellations"].values())
+        detail = ", ".join(f"{p}:{n}" for p, n
+                           in sorted(lc["cancellations"].items()))
+        extras.append(f"query cancellations: {n_cancel} ({detail})")
+    br = lc["breaker"]
+    if br["open"] or br["half_open"] or br["close"]:
+        extras.append(f"breaker trips: {br['open']} open, "
+                      f"{br['half_open']} half-open, "
+                      f"{br['close']} close")
     # only when the partition lane actually engaged — the whole-plan
     # count already prints as "task re-executions" above, and repeating
     # it alone would state the same figure twice
-    n_part = sum(1 for e in events
-                 if e.get("kind") == "partition_recompute")
-    if n_part:
-        extras.append(f"recovery lanes: {n_part} partition-granular "
-                      f"recompute(s), {n_task} whole-plan "
+    if lc["partition_recomputes"]:
+        extras.append(f"recovery lanes: {lc['partition_recomputes']} "
+                      f"partition-granular recompute(s), "
+                      f"{rob['task_retries']} whole-plan "
                       "re-execution(s)")
     # workload-governor roll-up (ISSUE 7): admission flow, sheds by
     # reason, and quota-triggered self-spills
-    n_adm = sum(1 for e in events if e.get("kind") == "query_admitted")
-    n_que = sum(1 for e in events if e.get("kind") == "query_queued")
-    sheds = [e for e in events if e.get("kind") == "query_shed"]
-    if n_adm or n_que or sheds:
-        waits = [e.get("wait_ms") or 0 for e in events
-                 if e.get("kind") == "query_admitted"]
+    wl = s["workload"]
+    if wl["admissions"] or wl["queued"] or wl["sheds"]:
         extras.append(
-            f"workload admissions: {n_adm} ({n_que} queued, max wait "
-            f"{max(waits) if waits else 0}ms)")
-    if sheds:
-        by_reason: Dict[str, int] = {}
-        for e in sheds:
-            by_reason[e.get("reason", "?")] = \
-                by_reason.get(e.get("reason", "?"), 0) + 1
-        detail = ", ".join(f"{r}:{n}"
-                           for r, n in sorted(by_reason.items()))
-        extras.append(f"queries shed: {len(sheds)} ({detail})")
-    n_quota = sum(1 for e in events if e.get("kind") == "quota_spill")
-    if n_quota:
-        extras.append(f"quota spills: {n_quota} "
+            f"workload admissions: {wl['admissions']} "
+            f"({wl['queued']} queued, max wait {wl['max_wait_ms']}ms)")
+    if wl["sheds"]:
+        n_shed = sum(wl["sheds"].values())
+        detail = ", ".join(f"{r}:{n}" for r, n
+                           in sorted(wl["sheds"].items()))
+        extras.append(f"queries shed: {n_shed} ({detail})")
+    if wl["quota_spills"]:
+        extras.append(f"quota spills: {wl['quota_spills']} "
                       f"(over-share queries spilled their own entries)")
-    n_integ = sum(1 for e in events if e.get("kind") == "integrity_fail")
-    if n_integ:
-        extras.append(f"integrity quarantines: {n_integ}")
-    n_watch = sum(1 for e in events
-                  if e.get("kind") in ("pipeline_stuck",
-                                       "spill_writer_dead"))
-    if n_watch:
-        extras.append(f"watchdog trips: {n_watch}")
-    tiers = [e for e in events if e.get("kind") == "pallas_tier"]
-    if tiers:
-        on = sum(1 for e in tiers if e.get("engaged"))
-        extras.append(f"pallas tier decisions: {len(tiers)} "
-                      f"({on} engaged)")
+    if rob["integrity_quarantines"]:
+        extras.append(f"integrity quarantines: "
+                      f"{rob['integrity_quarantines']}")
+    if rob["watchdog_trips"]:
+        extras.append(f"watchdog trips: {rob['watchdog_trips']}")
+    pt = s["pallas_tier"]
+    if pt["decisions"]:
+        extras.append(f"pallas tier decisions: {pt['decisions']} "
+                      f"({pt['engaged']} engaged)")
     # gather-engine roll-up (ISSUE 8): materializing row gathers per
     # wired operator — the count drop IS the optimization, so a bench
     # round reads it next to the pipeline/workload lines
-    gstats = [e for e in events if e.get("kind") == "gather_stats"]
-    if gstats:
-        n_g = sum(e.get("count") or 0 for e in gstats)
-        n_packed = sum(e.get("packed") or 0 for e in gstats)
-        n_pallas = sum(e.get("pallas") or 0 for e in gstats)
-        g_bytes = sum(e.get("bytes") or 0 for e in gstats)
+    g = s["gathers"]
+    if g["records"]:
         extras.append(
-            f"gathers: {n_g} ({n_packed} packed rows, {n_pallas} via "
-            f"the Pallas DMA kernel, ~{_fmt_bytes(g_bytes)} moved)")
+            f"gathers: {g['count']} ({g['packed']} packed rows, "
+            f"{g['pallas']} via the Pallas DMA kernel, "
+            f"~{_fmt_bytes(g['bytes'])} moved)")
     # upload-engine roll-up (ISSUE 10): host->device ingest — the
     # transfer-count drop (one per batch vs one per buffer) is the
     # optimization, so a round reads it next to the gather line
-    ups = [e for e in events if e.get("kind") == "upload"]
-    if ups:
-        n_pk = sum(1 for e in ups if e.get("lane") == "packed")
-        n_pb = len(ups) - n_pk
-        u_bytes = sum(e.get("bytes") or 0 for e in ups)
-        u_xfers = sum(e.get("transfers") or 0 for e in ups)
-        u_ns = sum(e.get("pack_ns") or 0 for e in ups)
+    u = s["uploads"]
+    if u["batches"]:
         extras.append(
-            f"uploads: {len(ups)} batches ({n_pk} packed, {n_pb} "
-            f"per-buffer; {u_xfers} h2d transfers, "
-            f"{_fmt_bytes(u_bytes)}, pack {_fmt_ns(u_ns)})")
+            f"uploads: {u['batches']} batches ({u['packed']} packed, "
+            f"{u['per_buffer']} per-buffer; {u['transfers']} h2d "
+            f"transfers, {_fmt_bytes(u['bytes'])}, pack "
+            f"{_fmt_ns(u['pack_ns'])})")
+    # runtime-statistics roll-up (ISSUE 11): the exchange skew line an
+    # AQE round (ROADMAP 4) reads first
+    st = s["statistics"]
+    if st["exchanges"]:
+        basis = f" (by {st['max_skew_basis']})" if st.get("max_skew_basis") else ""
+        extras.append(
+            f"statistics: {st['exchanges']} exchange(s), "
+            f"{st['maps']} map outputs; max partition skew ratio "
+            f"{st['max_skew_ratio']:.2f}{basis}, p95 map output "
+            f"{_fmt_bytes(st['p95_map_output_bytes'])}")
+    if st["telemetry_samples"]:
+        extras.append(f"telemetry samples: {st['telemetry_samples']}")
     if extras:
         lines.append("")
         lines.extend(extras)
@@ -268,15 +410,23 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("log", help="events-*.jsonl file (obs/events.py)")
+    ap.add_argument("log", help="events-*.jsonl file (obs/events.py); "
+                               "a rotated set is read in order")
     ap.add_argument("--top", type=int, default=10,
                     help="operators to show (default 10)")
     ap.add_argument("--query", type=int, default=None,
                     help="restrict to one query id")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text",
+                    help="text table (default) or the machine-readable "
+                         "summary JSON")
     args = ap.parse_args(argv)
-    with open(args.log) as f:
-        events = read_events(f)
-    print(build_report(events, top=args.top, query=args.query))
+    events = read_event_files(args.log)
+    if args.format == "json":
+        print(json.dumps(build_summary(events, top=args.top,
+                                       query=args.query), indent=2))
+    else:
+        print(build_report(events, top=args.top, query=args.query))
     return 0
 
 

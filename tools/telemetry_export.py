@@ -1,0 +1,118 @@
+"""Render telemetry samples as Prometheus text exposition format
+(ISSUE 11 tentpole part 2: the scrape side of the telemetry plane).
+
+The engine's telemetry registry (obs/telemetry.py) flushes one
+`telemetry_sample` JSONL record per sampler tick into the event log.
+This CLI turns a log (or a whole rotated set — any member works) into
+Prometheus text format a scrape pipeline ingests:
+
+    python tools/telemetry_export.py EVENTS.jsonl            # newest
+    python tools/telemetry_export.py EVENTS.jsonl --all      # every one
+
+Gauges are named `spark_rapids_tpu_<series>` with dots mapped to
+underscores; the per-owner HBM attribution exports as
+`spark_rapids_tpu_hbm_owner_bytes{tier="device|host",owner="..."}`.
+Stdlib only — runs anywhere the log lands; importable as
+`to_prometheus(sample)` for tests and embedding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+from profile_report import read_event_files  # noqa: E402
+
+PREFIX = "spark_rapids_tpu"
+
+
+def _metric(name: str) -> str:
+    return f"{PREFIX}_{name.replace('.', '_').replace('-', '_')}"
+
+
+def _sample_lines(sample: Dict[str, Any]) -> List[tuple]:
+    """One sample -> [(metric, type, labeled-name, value, ts-suffix)]."""
+    out: List[tuple] = []
+    ts_ms = sample.get("ts_ms")
+    suffix = f" {ts_ms}" if ts_ms is not None else ""
+    for key in sorted(sample):
+        val = sample[key]
+        if key in ("ts_ms", "ts_ns", "kind", "query", "counters",
+                   "hbm_by_owner"):
+            continue
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
+        m = _metric(key)
+        out.append((m, "gauge", m, val, suffix))
+    owners = sample.get("hbm_by_owner") or {}
+    if owners:
+        m = _metric("hbm.owner_bytes")
+        for tier in ("device", "host"):
+            for owner, nbytes in sorted((owners.get(tier) or {}).items()):
+                out.append((m, "gauge",
+                            f'{m}{{tier="{tier}",owner="{owner}"}}',
+                            nbytes, suffix))
+    counters = sample.get("counters") or {}
+    for key in sorted(counters):
+        m = _metric(f"counter.{key}")
+        out.append((m, "counter", m, counters[key], suffix))
+    return out
+
+
+def render(samples: List[Dict[str, Any]]) -> str:
+    """Render one OR several samples as valid text exposition: each
+    metric's `# TYPE` line appears exactly once, with one timestamped
+    line per sample under it (the layout `promtool tsdb
+    create-blocks-from openmetrics` style backfill consumes; a single
+    sample is a plain Prometheus scrape page)."""
+    by_metric: Dict[str, List[str]] = {}
+    types: Dict[str, str] = {}
+    order: List[str] = []
+    for s in samples:
+        for metric, typ, labeled, val, suffix in _sample_lines(s):
+            if metric not in types:
+                types[metric] = typ
+                order.append(metric)
+            by_metric.setdefault(metric, []).append(
+                f"{labeled} {val}{suffix}")
+    lines: List[str] = []
+    for metric in order:
+        lines.append(f"# TYPE {metric} {types[metric]}")
+        lines.extend(by_metric[metric])
+    return "\n".join(lines) + "\n"
+
+
+def to_prometheus(sample: Dict[str, Any]) -> str:
+    """One telemetry_sample record -> Prometheus text format."""
+    return render([sample])
+
+
+def samples_from_events(events: List[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+    return [e for e in events if e.get("kind") == "telemetry_sample"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("log", help="events-*.jsonl file (obs/events.py); "
+                               "a rotated set is read in order")
+    ap.add_argument("--all", action="store_true",
+                    help="export every sample, oldest first "
+                         "(default: only the newest)")
+    args = ap.parse_args(argv)
+    samples = samples_from_events(read_event_files(args.log))
+    if not samples:
+        print("no telemetry_sample records found "
+              "(spark.rapids.tpu.telemetry.enabled?)", file=sys.stderr)
+        return 1
+    sys.stdout.write(render(samples if args.all else samples[-1:]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
