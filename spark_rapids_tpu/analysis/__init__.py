@@ -1,0 +1,38 @@
+"""Engine contract analyzer (ISSUE 12 tentpole).
+
+Ten PRs of review rounds fixed the same bug classes by hand — missed
+thread-local adoption at producer-thread spawns, conf reads from the
+calling thread instead of the admitting ticket, event emission and
+blocking calls while holding engine locks, module-level ``jnp``
+constants capturing tracers, and budget counters left asymmetric on
+failure branches. This package turns those review findings into an
+AST-based static-analysis pass that runs in tier-1
+(tests/test_contract_check.py) and as a CLI (tools/contract_check.py).
+
+Structure:
+
+* ``core``        — findings, suppressions, baseline, the run driver
+* ``registry``    — THE rule registry: rule metadata plus the engine
+                    contract data (named locks + partial order, adopt
+                    helpers, cross-query conf entries, accounting pairs)
+* ``callgraph``   — per-module call-graph resolution shared by rules
+* ``scan``        — source-file discovery + conf-key literal scanning
+                    (tests/test_docs_lint.py delegates here)
+* ``rules_*``     — one module per rule family
+
+Findings support ``# contract: ok <rule> — <why>`` suppressions
+(justification required — an empty one is itself a finding) and a
+checked-in baseline (tools/contract_baseline.json) whose every entry
+carries a justification.
+"""
+
+from .core import (AnalysisReport, Finding, analyze_paths, apply_baseline,
+                   load_baseline, write_baseline)
+from .registry import DEFAULT_REGISTRY, RULES, ContractRegistry
+from .scan import conf_key_literals, default_source_files
+
+__all__ = [
+    "AnalysisReport", "Finding", "analyze_paths", "apply_baseline",
+    "load_baseline", "write_baseline", "DEFAULT_REGISTRY", "RULES",
+    "ContractRegistry", "conf_key_literals", "default_source_files",
+]

@@ -1,0 +1,102 @@
+"""Trace-purity rules (ISSUE 12 rule family 3).
+
+``trace-module-jnp``: a module-level ``jnp.*(...)`` binding creates a
+jax array at import time; when the module is first imported INSIDE a
+jit trace (lazy imports are everywhere in this engine), the "constant"
+captures a tracer and every later use leaks it — the exact
+order-dependent failure PR 2 fixed across seven ops modules. Constants
+belong as plain Python ints / numpy scalars; bare attribute references
+(``_mk('Sqrt', jnp.sqrt)``) are fine and not flagged.
+
+``trace-host-sync``: host-sync / materialization calls (``np.asarray``,
+``.item()``, ``.tolist()``, ``jax.device_get``, ``.block_until_ready``)
+on values inside a ``@jit``-decorated function or a Pallas kernel body
+(``*_kernel`` by the repo's naming convention) force a device sync mid-
+trace or fail outright on tracers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import ModuleGraph, attr_root, unparse
+from .core import Finding, ModuleInfo
+from .registry import HOST_SYNC_ATTRS, HOST_SYNC_NP_ATTRS
+
+
+def check_module_jnp(module: ModuleInfo, graph: ModuleGraph, reg):
+    if reg.scope_prefix not in module.path:
+        return []  # tools/bench are scripts: module scope IS their main
+    aliases = set(graph.jnp_aliases)
+    if not aliases:
+        return []
+    out = []
+    for stmt in module.tree.body:
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+            continue
+        value = stmt.value
+        if value is None:
+            continue
+        for call in ast.walk(value):
+            if isinstance(call, ast.Call) and \
+                    attr_root(call.func) in aliases:
+                target = stmt.targets[0] if isinstance(
+                    stmt, ast.Assign) else stmt.target
+                out.append(Finding(
+                    "trace-module-jnp", module.path, stmt.lineno,
+                    "<module>", unparse(target),
+                    f"module-level `{unparse(call)[:60]}` builds a jax "
+                    "array at import time — first import inside a jit "
+                    "trace captures a tracer (PR 2 bug class); use a "
+                    "Python int / numpy scalar"))
+                break  # one finding per binding
+    return out
+
+
+def _numpy_aliases(tree: ast.Module):
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def _is_traced_def(fnode: ast.FunctionDef) -> bool:
+    if fnode.name.endswith("_kernel"):
+        return True
+    for dec in fnode.decorator_list:
+        if "jit" in unparse(dec):
+            return True
+    return False
+
+
+def check_host_sync(module: ModuleInfo, graph: ModuleGraph, reg):
+    if reg.scope_prefix not in module.path:
+        return []
+    np_aliases = _numpy_aliases(module.tree)
+    out = []
+    for qual, cls, fnode in graph.scopes():
+        if not _is_traced_def(fnode):
+            continue
+        for node in ast.walk(fnode):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            attr = node.func.attr
+            recv_root = attr_root(node.func.value)
+            hit = None
+            if attr in HOST_SYNC_ATTRS:
+                hit = f".{attr}()"
+            elif attr in HOST_SYNC_NP_ATTRS and recv_root in np_aliases:
+                hit = f"{recv_root}.{attr}(...)"
+            if hit is not None:
+                out.append(Finding(
+                    "trace-host-sync", module.path, node.lineno, qual,
+                    f"{attr}",
+                    f"host-sync `{hit}` inside traced body `{qual}` — "
+                    "forces a device sync mid-trace (or fails on a "
+                    "tracer); materialize at the batch boundary"))
+    return out

@@ -1,0 +1,45 @@
+"""Source discovery + literal scanning shared by the analyzer and
+tests/test_docs_lint.py (ISSUE 12 satellite: ONE registry walk — the
+docs lint delegates its AST scanning here and keeps only the doc-table
+assertions)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator, List, Tuple, Union
+
+_KEY_RE = re.compile(r"spark\.rapids\.[A-Za-z0-9_.]+$")
+
+
+def repo_root(start: Path = None) -> Path:
+    """The repo root: the directory holding spark_rapids_tpu/."""
+    here = Path(start) if start is not None else Path(__file__)
+    return here.resolve().parents[2]
+
+
+def default_source_files(root: Path = None) -> List[Path]:
+    """The analyzer's (and docs lint's) default scan set: the package,
+    tools/ and bench.py — tests and fixtures stay out."""
+    root = Path(root) if root is not None else repo_root()
+    files = sorted((root / "spark_rapids_tpu").rglob("*.py"))
+    files += sorted((root / "tools").glob("*.py"))
+    bench = root / "bench.py"
+    if bench.exists():
+        files.append(bench)
+    return files
+
+
+def conf_key_literals(source: Union[Path, ast.Module]
+                      ) -> Iterator[Tuple[str, int]]:
+    """String literals that ARE a conf key (the whole literal matches),
+    with their line — f-strings/doc prose don't count. Moved verbatim
+    from tests/test_docs_lint.py (ISSUE 12)."""
+    tree = source if isinstance(source, ast.Module) \
+        else ast.parse(Path(source).read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                _KEY_RE.fullmatch(node.value.strip()):
+            yield node.value.strip(), node.lineno

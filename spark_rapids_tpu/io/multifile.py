@@ -95,13 +95,19 @@ def threaded_chunks(tasks: Sequence[Callable[[], "object"]],
     object-store hiccup, an injected `io.multifile_read` fault — backs
     off and re-reads instead of killing the scan."""
     from .retrying import with_io_retry
+    from ..obs import events as obs_events
     conf = active_conf()  # captured HERE: pool threads see default conf
+    # the query id too (ISSUE 12): the shared pool serves every query,
+    # so io_retry events from a decode task must carry the SUBMITTING
+    # thread's attribution, not the pool thread's empty TLS
+    qid = obs_events.current_query_id()
 
     def retrying(t: Callable[[], "object"], i: int) -> "object":
         # per-chunk jitter salt: concurrent decode tasks on one flaky
         # mount must not back off in lockstep
-        return with_io_retry(t, "multifile_read", conf=conf,
-                             fault_point="io.multifile_read", salt=str(i))
+        return obs_events.with_query_id(
+            qid, with_io_retry, t, "multifile_read", conf=conf,
+            fault_point="io.multifile_read", salt=str(i))
 
     if num_threads <= 1 or len(tasks) <= 1:
         for i, t in enumerate(tasks):

@@ -107,6 +107,19 @@ class SpillFileCorruption(faults.IntegrityError):
     """Spill file failed its CRC32 / structure check at read."""
 
 
+def _flush_events(out_events) -> None:
+    """Emit buffered (kind, fields) records — called OUTSIDE the
+    catalog lock (ISSUE 12 lock-blocking-call fix: the bus takes its
+    own lock and writes a file; spill-path emits are buffered under the
+    lock and flushed here, the PR 6 workload-governor pattern)."""
+    if not out_events:
+        return
+    from ..obs import events as obs_events
+    for kind, fields in out_events:
+        obs_events.emit(kind, **fields)
+    out_events.clear()
+
+
 def _write_npz(path: str, host_leaves, key: Optional[str] = None) -> None:
     """Spill file write: CRC32-stamped container, durable (fsync'd)
     before the hop counts as complete. `key` is the owning entry's
@@ -115,6 +128,10 @@ def _write_npz(path: str, host_leaves, key: Optional[str] = None) -> None:
     PLACEMENT — which entry's write draws the fault — depended on
     thread scheduling; keyed, placement replays exactly)."""
     buf = io.BytesIO()
+    # contract: ok lock-blocking-call — reached under the catalog lock
+    # only on the SYNC spill lane and the dead-writer drain (both by
+    # design: the entry must not be observable mid-hop); steady-state
+    # async writes run on the writer thread lock-free
     np.savez(buf, **{str(i): a for i, a in enumerate(host_leaves)})
     payload = buf.getvalue()
     # fault point: kind=io dies here (the entry stays on HOST);
@@ -122,10 +139,12 @@ def _write_npz(path: str, host_leaves, key: Optional[str] = None) -> None:
     # is taken, so the damage is exactly what the read-side check catches
     crc = zlib.crc32(payload)
     payload = faults.apply("spill.disk_write", payload, key=key)
+    # contract: ok lock-blocking-call — see the savez note above
     with open(path, "wb") as f:
         f.write(_SPILL_HEADER.pack(_SPILL_MAGIC, crc, len(payload)))
         f.write(payload)
         f.flush()
+        # contract: ok lock-blocking-call — see the savez note above
         os.fsync(f.fileno())
 
 
@@ -133,6 +152,9 @@ def _read_npz(path: str, key: Optional[str] = None) -> List[np.ndarray]:
     """Verified spill file read: any structural or checksum failure
     raises SpillFileCorruption (the caller quarantines + recomputes)."""
     faults.check("spill.disk_read", key=key)
+    # contract: ok lock-blocking-call — disk unspill runs under the
+    # catalog RLock by design (atomic promotion, module docstring); the
+    # async writer never calls this
     with open(path, "rb") as f:
         header = f.read(_SPILL_HEADER.size)
         if len(header) < _SPILL_HEADER.size:
@@ -183,16 +205,20 @@ class BufferCatalog:
         whose async writeback is still in flight is waited for OUTSIDE
         the lock (the writer needs the lock to finish the hop)."""
         while True:
-            with self._lock:
-                entry = self._entries[handle]
-                assert not entry.closed, "acquire after close"
-                ev = entry.writeback
-                if ev is None or ev.is_set():
-                    entry.writeback = None
-                    if entry.tier != StorageTier.DEVICE:
-                        self._unspill_locked(entry)
-                    entry.in_use += 1
-                    return entry.device_tree
+            evs: List[tuple] = []
+            try:
+                with self._lock:
+                    entry = self._entries[handle]
+                    assert not entry.closed, "acquire after close"
+                    ev = entry.writeback
+                    if ev is None or ev.is_set():
+                        entry.writeback = None
+                        if entry.tier != StorageTier.DEVICE:
+                            self._unspill_locked(entry, evs)
+                        entry.in_use += 1
+                        return entry.device_tree
+            finally:
+                _flush_events(evs)
             # bounded wait + watchdog: a writer that died with this
             # hop still queued would otherwise park us here forever.
             # The lifecycle governor checks here too (ISSUE 6): a
@@ -256,18 +282,24 @@ class BufferCatalog:
         async_write = bool(active_conf().get(SPILL_ASYNC_WRITE))
         freed = 0
         while target_bytes is None or freed < target_bytes:
-            with self._lock:
-                candidates = [e for e in self._entries.values()
-                              if e.tier == StorageTier.DEVICE and
-                              e.in_use == 0 and not e.closed and
-                              (owner is None or e.owner is owner)]
-                if not candidates:
-                    break
-                victim = min(candidates, key=lambda e: e.priority)
-                self._spill_to_host_locked(victim, async_write)
-                if async_write and events_out is not None:
-                    events_out.append(victim.writeback)
-                freed += victim.nbytes
+            evs: List[tuple] = []
+            try:
+                with self._lock:
+                    candidates = [e for e in self._entries.values()
+                                  if e.tier == StorageTier.DEVICE and
+                                  e.in_use == 0 and not e.closed and
+                                  (owner is None or e.owner is owner)]
+                    if not candidates:
+                        break
+                    victim = min(candidates, key=lambda e: e.priority)
+                    self._spill_to_host_locked(victim, async_write, evs)
+                    if async_write and events_out is not None:
+                        events_out.append(victim.writeback)
+                    freed += victim.nbytes
+            finally:
+                # spill/spill_error events land OUTSIDE the catalog
+                # lock (ISSUE 12), incl. on the raise path
+                _flush_events(evs)
             if not async_write:
                 # async: the device buffer is still physically alive in
                 # entry.pending_device until the writer's device_get
@@ -284,7 +316,8 @@ class BufferCatalog:
             lifecycle.note_spill(freed)
         return freed
 
-    def _spill_to_host_locked(self, entry: _Entry, async_write: bool = False):
+    def _spill_to_host_locked(self, entry: _Entry, async_write: bool,
+                              out_events: List[tuple]):
         leaves = jax.tree_util.tree_leaves(entry.device_tree)
         entry.device_tree = None
         entry.tier = StorageTier.HOST
@@ -294,10 +327,15 @@ class BufferCatalog:
             entry.pending_device = leaves
             entry.writeback = threading.Event()
             self._enqueue_writeback("to_host", entry, None,
-                                    entry.writeback)
+                                    entry.writeback, out_events)
         else:
             try:
                 faults.check("spill.d2h_copy", key=entry.fault_key)
+                # contract: ok lock-blocking-call — the SYNC lane
+                # (asyncWrite=false) deliberately copies under the
+                # catalog lock: the entry must not be observable
+                # mid-hop, and the async lane exists precisely for
+                # callers that cannot afford this hold
                 entry.host_leaves = [np.asarray(jax.device_get(x))
                                      for x in leaves]
             except Exception as e:  # noqa: BLE001 — transient device
@@ -307,38 +345,43 @@ class BufferCatalog:
                 entry.device_tree = jax.tree_util.tree_unflatten(
                     entry.treedef, leaves)
                 entry.tier = StorageTier.DEVICE
-                from ..obs import events as obs_events
-                obs_events.emit("spill_error", stage="d2h_copy",
-                                sync=True, error=str(e)[:200])
+                out_events.append(("spill_error", dict(
+                    stage="d2h_copy", sync=True, error=str(e)[:200])))
                 from ..faults import TpuTaskRetryError
                 raise TpuTaskRetryError(
                     f"device->host spill copy failed: {e}") from e
         self.spilled_device_bytes += entry.nbytes
-        from ..obs import events as obs_events
-        obs_events.emit("spill", tier="device->host", bytes=entry.nbytes,
-                        priority=entry.priority, background=async_write)
+        out_events.append(("spill", dict(
+            tier="device->host", bytes=entry.nbytes,
+            priority=entry.priority, background=async_write)))
 
     def _enforce_host_limit(self, async_write: bool = False, owner=None):
         """`owner` (ISSUE 7): an owner-scoped quota spill must not
         demote NEIGHBORS' host entries to disk either — the host limit
         is soft, and the next unscoped pass re-enforces it globally."""
         limit = active_conf().get(HOST_SPILL_LIMIT)
-        with self._lock:
-            host_entries = [e for e in self._entries.values()
-                            if e.tier == StorageTier.HOST and not e.closed
-                            and (owner is None or e.owner is owner)]
-            host_total = sum(e.nbytes for e in host_entries)
-            for e in sorted(host_entries, key=lambda x: x.priority):
-                if host_total <= limit:
-                    break
-                # a sync disk-write failure leaves the entry on HOST
-                # (returns False): don't count those bytes as moved, or
-                # the pass stops early without trying other candidates
-                if self._spill_to_disk_locked(e, async_write):
-                    host_total -= e.nbytes
+        evs: List[tuple] = []
+        try:
+            with self._lock:
+                host_entries = [e for e in self._entries.values()
+                                if e.tier == StorageTier.HOST
+                                and not e.closed
+                                and (owner is None or e.owner is owner)]
+                host_total = sum(e.nbytes for e in host_entries)
+                for e in sorted(host_entries, key=lambda x: x.priority):
+                    if host_total <= limit:
+                        break
+                    # a sync disk-write failure leaves the entry on
+                    # HOST (returns False): don't count those bytes as
+                    # moved, or the pass stops early without trying
+                    # other candidates
+                    if self._spill_to_disk_locked(e, async_write, evs):
+                        host_total -= e.nbytes
+        finally:
+            _flush_events(evs)  # spill events outside the lock (ISSUE 12)
 
-    def _spill_to_disk_locked(self, entry: _Entry,
-                              async_write: bool = False) -> bool:
+    def _spill_to_disk_locked(self, entry: _Entry, async_write: bool,
+                              out_events: List[tuple]) -> bool:
         """Returns True when the hop landed (or was queued to the
         writer); False when a sync write failed and the entry stayed on
         the HOST tier."""
@@ -356,7 +399,7 @@ class BufferCatalog:
             # for this entry lands before this job runs
             entry.writeback = threading.Event()
             self._enqueue_writeback("to_disk", entry, path,
-                                    entry.writeback)
+                                    entry.writeback, out_events)
         else:
             try:
                 _write_npz(path, entry.host_leaves, key=entry.fault_key)
@@ -369,19 +412,18 @@ class BufferCatalog:
                     os.unlink(path)
                 except OSError:
                     pass
-                from ..obs import events as obs_events
-                obs_events.emit("spill_error", stage="disk_write",
-                                sync=True, error=str(e)[:200])
+                out_events.append(("spill_error", dict(
+                    stage="disk_write", sync=True, error=str(e)[:200])))
                 return False
             entry.host_leaves = None
             entry.disk_path = path
         self.spilled_host_bytes += entry.nbytes
-        from ..obs import events as obs_events
-        obs_events.emit("spill", tier="host->disk", bytes=entry.nbytes,
-                        priority=entry.priority, background=async_write)
+        out_events.append(("spill", dict(
+            tier="host->disk", bytes=entry.nbytes,
+            priority=entry.priority, background=async_write)))
         return True
 
-    def _unspill_locked(self, entry: _Entry):
+    def _unspill_locked(self, entry: _Entry, out_events: List[tuple]):
         from .budget import memory_budget
         if entry.tier == StorageTier.DISK:
             try:
@@ -397,10 +439,9 @@ class BufferCatalog:
                     entry.disk_path = qpath  # remove() still cleans up
                 except OSError:
                     pass
-                from ..obs import events as obs_events
-                obs_events.emit("integrity_fail", what="spill_file",
-                                path=entry.disk_path, bytes=entry.nbytes,
-                                error=str(e)[:200])
+                out_events.append(("integrity_fail", dict(
+                    what="spill_file", path=entry.disk_path,
+                    bytes=entry.nbytes, error=str(e)[:200])))
                 # provenance (ISSUE 6): a spill entry is intermediate
                 # state with no captured lineage — the task-retry layer
                 # sees this as AMBIGUOUS provenance and takes the
@@ -409,9 +450,8 @@ class BufferCatalog:
                                 "handle": entry.handle_id}
                 raise
             except OSError as e:
-                from ..obs import events as obs_events
-                obs_events.emit("spill_error", stage="disk_read",
-                                sync=True, error=str(e)[:200])
+                out_events.append(("spill_error", dict(
+                    stage="disk_read", sync=True, error=str(e)[:200])))
                 from ..faults import TpuTaskRetryError
                 raise TpuTaskRetryError(
                     f"spill file unreadable: {e}") from e
@@ -437,6 +477,11 @@ class BufferCatalog:
             # would charge again)
             from ..columnar.upload import upload_leaves
             try:
+                # contract: ok lock-blocking-call — unspill promotes
+                # under the catalog RLock by design (atomic: the entry
+                # must not be observable mid-promotion; module
+                # docstring); reserve above uses the documented
+                # lock-safe wait_for_writeback=False form
                 leaves = upload_leaves(entry.host_leaves,
                                        fault_key=f"unspill:{entry.seq}",
                                        seam="unspill")
@@ -458,8 +503,8 @@ class BufferCatalog:
 
     # -- background writer -------------------------------------------------
     def _enqueue_writeback(self, kind: str, entry: _Entry,
-                           path: Optional[str], ev: threading.Event
-                           ) -> None:
+                           path: Optional[str], ev: threading.Event,
+                           out_events: List[tuple]) -> None:
         """Queue one tier hop's byte movement (caller holds the lock;
         `ev` is THAT hop's completion event — entry.writeback may point
         at a later hop by the time the job runs). A dead writer thread
@@ -468,24 +513,35 @@ class BufferCatalog:
         fresh writer spawned, so one writer death never wedges spilling
         for the rest of the process."""
         if self._writer is not None and not self._writer.is_alive():
-            self._recover_dead_writer_locked()
+            self._recover_dead_writer_locked(out_events)
         if self._write_q is None:
             self._write_q = queue.Queue()
             self._writer = threading.Thread(
                 target=self._writer_loop, args=(self._write_q,),
                 name="spill-writer", daemon=True)
             self._writer.start()
-        self._write_q.put((kind, entry, path, ev))
+        from ..obs import events as obs_events
+        # the enqueuing query's id rides the job (ISSUE 12 thread-adopt
+        # fix): the singleton writer serves EVERY query — per-job
+        # adoption keeps async spill_error events attributed instead of
+        # landing with query: null
+        # contract: ok lock-blocking-call — unbounded queue: put() never
+        # blocks, it is a list append under the queue's own mutex
+        self._write_q.put((kind, entry, path, ev,
+                           obs_events.current_query_id()))
 
-    def _recover_dead_writer_locked(self) -> None:
+    def _recover_dead_writer_locked(self, out_events: List[tuple]
+                                    ) -> None:
         """Caller holds the catalog lock. Drain the dead writer's queue
         synchronously (running each stranded hop's byte movement on THIS
         thread — the 'queue drained synchronously' watchdog of ISSUE 4)
-        and detach it so the next enqueue starts a fresh writer."""
-        q, self._write_q, self._writer = self._write_q, None, None
+        and detach it so the next enqueue starts a fresh writer. The
+        spill_writer_dead event is buffered into `out_events` (flushed
+        by the caller outside the lock, ISSUE 12)."""
         from ..obs import events as obs_events
-        obs_events.emit("spill_writer_dead",
-                        pending=q.qsize() if q is not None else 0)
+        q, self._write_q, self._writer = self._write_q, None, None
+        out_events.append(("spill_writer_dead", dict(
+            pending=q.qsize() if q is not None else 0)))
         if q is None:
             return
         while True:
@@ -496,11 +552,14 @@ class BufferCatalog:
             if job is None:
                 q.task_done()
                 continue
-            kind, entry, path, ev = job
+            kind, entry, path, ev, qid = job
             try:
                 # NOTE: we already hold self._lock (RLock) — fine, the
-                # writeback takes it re-entrantly for its finalize steps
-                self._run_writeback(kind, entry, path)
+                # writeback takes it re-entrantly for its finalize
+                # steps. Each stranded job still runs under ITS query's
+                # event attribution, not the detecting thread's.
+                obs_events.with_query_id(qid, self._run_writeback,
+                                         kind, entry, path)
             except Exception:  # noqa: BLE001 — same contract as the
                 pass           # writer loop: the event must still set
             finally:
@@ -512,22 +571,32 @@ class BufferCatalog:
         points: if the writer died with jobs still queued, drain them
         synchronously. No return value — callers re-check their own
         wait condition afterwards."""
-        with self._lock:
-            if self._writer is not None and not self._writer.is_alive():
-                self._recover_dead_writer_locked()
+        evs: List[tuple] = []
+        try:
+            with self._lock:
+                if self._writer is not None and \
+                        not self._writer.is_alive():
+                    self._recover_dead_writer_locked(evs)
+        finally:
+            _flush_events(evs)
 
     def _writer_loop(self, q: "queue.Queue") -> None:
         # the queue travels as an argument, not through self._write_q:
         # shutdown_writer detaches the attribute while this thread may
         # still be finishing the drained jobs
+        from ..obs import events as obs_events
         while True:
             job = q.get()
             if job is None:
                 q.task_done()
                 return
-            kind, entry, path, ev = job
+            kind, entry, path, ev, qid = job
             try:
-                self._run_writeback(kind, entry, path)
+                # per-job query attribution (ISSUE 12): the enqueuing
+                # thread's id rides the job so the writer's spill_error
+                # events don't land with query: null
+                obs_events.with_query_id(qid, self._run_writeback,
+                                         kind, entry, path)
             except Exception:  # noqa: BLE001 — a failed writeback must
                 # not kill the writer; the event is still set so waiters
                 # don't hang (they will fail loudly on the missing data)
@@ -562,11 +631,17 @@ class BufferCatalog:
                 return
             try:
                 faults.check("spill.d2h_copy", key=entry.fault_key)
+                # contract: ok lock-blocking-call — lock-free on the
+                # writer thread (steady state); under the catalog RLock
+                # only on the dead-writer synchronous drain (recovery)
                 host = [np.asarray(jax.device_get(x)) for x in pending]
             except Exception as e:  # noqa: BLE001 — transient device
                 # error: the data never left the device; put the entry
                 # back on the DEVICE tier intact (budget never released)
                 from ..obs import events as obs_events
+                # contract: ok lock-blocking-call — lock-free on the
+                # writer thread; under the RLock only on the dead-writer
+                # drain (rare recovery; the bus lock is the leaf)
                 obs_events.emit("spill_error", stage="d2h_copy",
                                 sync=False, error=str(e)[:200])
                 with self._lock:
@@ -610,6 +685,9 @@ class BufferCatalog:
             # the host copy is still intact, so the entry simply stays
             # on the HOST tier; drop any partial file
             from ..obs import events as obs_events
+            # contract: ok lock-blocking-call — lock-free on the writer
+            # thread; under the RLock only on the dead-writer drain
+            # (rare recovery; the bus lock is the leaf)
             obs_events.emit("spill_error", stage="disk_write",
                             sync=False, error=str(e)[:200])
             with self._lock:

@@ -168,6 +168,10 @@ class EventBus:
                     return
                 if self._file is None:
                     os.makedirs(self.directory, exist_ok=True)
+                    # contract: ok lock-blocking-call — the bus lock is
+                    # the declared LEAF lock and exists precisely to
+                    # serialize this lazy open + append; nothing is ever
+                    # acquired under it
                     self._file = open(self.path, "a")
                 self._file.write(line + "\n")
                 self._file.flush()
@@ -290,6 +294,22 @@ def adopt_query_id(qid: Optional[int]) -> None:
     pipeline producer threads (exec/pipeline.py) so events emitted
     behind a stage boundary carry their consumer's query."""
     _qlocal.qid = qid
+
+
+def with_query_id(qid: Optional[int], fn, *args, **kwargs):
+    """Run `fn` with this thread's events attributed to `qid`,
+    restoring the previous attribution after (ISSUE 12): the shared
+    decode/serialize pools and the spill writer serve MANY queries from
+    one long-lived thread, so per-job adoption — the submitter captures
+    current_query_id() and wraps the work item — is the only
+    granularity that keeps io_retry/spill events attributed. Accepted
+    by the thread-adopt contract rule as a spawn target."""
+    prev = current_query_id()
+    adopt_query_id(qid)
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        adopt_query_id(prev)
 
 
 @contextlib.contextmanager

@@ -107,6 +107,9 @@ class TelemetryRegistry:
     def start(self) -> None:
         if self._thread is not None:
             return
+        # contract: ok thread-adopt — process-wide sampler: it reads
+        # global gauges and emits unattributed telemetry_sample records
+        # by design; there is no per-query context to adopt
         self._thread = threading.Thread(
             target=self._loop, name="telemetry-sampler", daemon=True)
         self._thread.start()
@@ -195,24 +198,33 @@ def configure(conf=None) -> Optional[TelemetryRegistry]:
                           TELEMETRY_INTERVAL_MS, active_conf)
     conf = conf if conf is not None else active_conf()
     enabled = conf.get(TELEMETRY_ENABLED)
-    with _registry_lock:
-        if not enabled:
-            if TELEMETRY_ENABLED.key in conf._settings \
-                    and _registry is not None:
-                _registry.shutdown()
-                _registry = None
+    # the replaced registry is detached under the lock but its sampler
+    # is JOINED outside it (ISSUE 12 lock-blocking-call fix: shutdown()
+    # joins up to 5s — holding `telemetry-config` across that stalled
+    # every concurrent configure/enable/reset). The detached sampler
+    # may take one last sample while the successor starts: harmless,
+    # each writes only its own registry object.
+    to_stop = None
+    try:
+        with _registry_lock:
+            if not enabled:
+                if TELEMETRY_ENABLED.key in conf._settings \
+                        and _registry is not None:
+                    to_stop, _registry = _registry, None
+                return _registry
+            interval = conf.get(TELEMETRY_INTERVAL_MS)
+            history = conf.get(TELEMETRY_HISTORY_SIZE)
+            if _registry is not None \
+                    and _registry.interval_ms == max(10, interval) \
+                    and _registry.history == max(1, history):
+                return _registry
+            to_stop = _registry
+            _registry = TelemetryRegistry(interval, history)
+            _registry.start()
             return _registry
-        interval = conf.get(TELEMETRY_INTERVAL_MS)
-        history = conf.get(TELEMETRY_HISTORY_SIZE)
-        if _registry is not None \
-                and _registry.interval_ms == max(10, interval) \
-                and _registry.history == max(1, history):
-            return _registry
-        if _registry is not None:
-            _registry.shutdown()
-        _registry = TelemetryRegistry(interval, history)
-        _registry.start()
-        return _registry
+    finally:
+        if to_stop is not None:
+            to_stop.shutdown()
 
 
 def enable(interval_ms: int = 1000,
@@ -220,11 +232,13 @@ def enable(interval_ms: int = 1000,
     """Conf-free switch-on (bench / tooling entry)."""
     global _registry
     with _registry_lock:
-        if _registry is not None:
-            _registry.shutdown()
+        to_stop = _registry
         _registry = TelemetryRegistry(interval_ms, history)
         _registry.start()
-        return _registry
+        out = _registry
+    if to_stop is not None:
+        to_stop.shutdown()  # join outside the config lock (ISSUE 12)
+    return out
 
 
 def reset_telemetry() -> None:
@@ -232,9 +246,9 @@ def reset_telemetry() -> None:
     conftest tripwire asserts no `telemetry-*` thread survives it)."""
     global _registry
     with _registry_lock:
-        if _registry is not None:
-            _registry.shutdown()
-        _registry = None
+        to_stop, _registry = _registry, None
+    if to_stop is not None:
+        to_stop.shutdown()  # join outside the config lock (ISSUE 12)
 
 
 def counters() -> Dict[str, int]:

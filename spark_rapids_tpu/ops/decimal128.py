@@ -15,8 +15,11 @@ from __future__ import annotations
 from typing import Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
-_U32 = jnp.uint64(0xFFFFFFFF)
+# numpy scalar: a module-level jnp call captures a tracer when first
+# imported inside a jit trace (PR 2 class; contract trace-module-jnp)
+_U32 = np.uint64(0xFFFFFFFF)
 
 
 def _u(x):

@@ -33,8 +33,11 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-_M52 = jnp.uint64((1 << 52) - 1)
+# numpy scalar: a module-level jnp call captures a tracer when first
+# imported inside a jit trace (PR 2 class; contract trace-module-jnp)
+_M52 = np.uint64((1 << 52) - 1)
 
 
 def _decode_f32(b):

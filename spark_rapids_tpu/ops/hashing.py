@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..columnar.column import Column, StringColumn, StructColumn
 from ..types import (
@@ -26,8 +27,12 @@ from ..types import (
 
 # --- Murmur3_x86_32 -------------------------------------------------------
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+# numpy (not jnp) scalars: a module-level jnp call builds a jax array at
+# IMPORT time, and a first import inside a jit trace captures a tracer —
+# the PR 2 order-dependent leak class (contract rule trace-module-jnp).
+# Every use site has a jax operand, so dtype semantics are unchanged.
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
 
 
 def _rotl32(x, r):
@@ -186,11 +191,13 @@ def murmur3_batch(columns, seed: int = 42) -> jnp.ndarray:
 
 # --- XxHash64 -------------------------------------------------------------
 
-_P1 = jnp.uint64(0x9E3779B185EBCA87)
-_P2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
-_P3 = jnp.uint64(0x165667B19E3779F9)
-_P4 = jnp.uint64(0x85EBCA77C2B2AE63)
-_P5 = jnp.uint64(0x27D4EB2F165667C5)
+# numpy scalars for the same reason as _C1/_C2 above (every use site
+# folds into a jax uint64 expression: seeds are always jax lanes)
+_P1 = np.uint64(0x9E3779B185EBCA87)
+_P2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_P3 = np.uint64(0x165667B19E3779F9)
+_P4 = np.uint64(0x85EBCA77C2B2AE63)
+_P5 = np.uint64(0x27D4EB2F165667C5)
 
 
 def _rotl64(x, r):

@@ -187,6 +187,9 @@ class HeartbeatEndpoint:
         for p in self.manager.register(self.executor_id):
             if self.on_new_peer:
                 self.on_new_peer(p)
+        # contract: ok thread-adopt — engine-global liveness daemon: it
+        # beats the peer table and emits peer_dead transitions, none of
+        # which belong to a query; there is no context to adopt
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"heartbeat-{self.executor_id}")
         self._thread.start()

@@ -113,6 +113,9 @@ class HostShuffleWriter:
         assert len(partitioned) == n
         import time as _time
         t0 = _time.perf_counter_ns()
+        # contract: ok thread-adopt — serialize_batch is a pure function
+        # of its batch argument: no conf/event/attempt reads on the pool
+        # thread (fault keys ride the frame ordinals at decode, not here)
         jobs = [(p, self._pool.submit(serialize_batch, b))
                 for p in range(n) for b in partitioned[p]]
         frames_by_part: List[List[bytes]] = [[] for _ in range(n)]
@@ -135,6 +138,8 @@ class HostShuffleWriter:
         assert len(bounds) == n + 1
         import time as _time
         t0 = _time.perf_counter_ns()
+        # contract: ok thread-adopt — serialize_slice is a pure function
+        # of (packed batch, row range): no thread-local reads on the pool
         jobs = [(p, self._pool.submit(serialize_slice, packed,
                                       int(bounds[p]), int(bounds[p + 1])))
                 for p in range(n) if bounds[p + 1] > bounds[p]]
@@ -286,8 +291,14 @@ class HostShuffleReader:
 
     def read_partition(self, partition: int) -> Iterator[ColumnarBatch]:
         paths = list(self.handle.map_outputs)
+        # the reader pool serves every query: io_retry/integrity_fail
+        # events from fetch/decode tasks carry the SUBMITTING thread's
+        # query id via per-job adoption (ISSUE 12 thread-adopt fix)
+        from ..obs import events as obs_events
+        qid = obs_events.current_query_id()
         segs = list(self._pool.map(
-            lambda path: self._fetch_segment(path, partition), paths))
+            lambda path: obs_events.with_query_id(
+                qid, self._fetch_segment, path, partition), paths))
         # per-frame injection key (partition + GLOBAL frame ordinal in
         # map-output order — identical to the pre-ISSUE-6 flattened
         # scheme, so seeded chaos draws replay unchanged): the chaos
@@ -297,6 +308,7 @@ class HostShuffleReader:
         for path, frames in zip(paths, segs):
             for i, fr in enumerate(frames):
                 jobs.append((path, i, self._pool.submit(
+                    obs_events.with_query_id, qid,
                     self._decode, fr, f"p{partition}:{ordinal}")))
                 ordinal += 1
         for path, frame_idx, fut in jobs:

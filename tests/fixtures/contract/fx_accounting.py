@@ -1,0 +1,40 @@
+"""Contract-analyzer fixture: accounting-symmetry FIRES (one-sided and
+exception-edge shapes), stays silent on the guarded and the
+registry-escrowed shapes."""
+
+
+class _Budget:
+    def reserve(self, n):
+        pass
+
+    def release(self, n):
+        pass
+
+
+budget = _Budget()
+
+
+def _work(n):
+    pass
+
+
+def one_sided(n):
+    budget.reserve(n)  # accounting-symmetry: no release anywhere
+
+
+def exception_edge(n):
+    budget.reserve(n)
+    _work(n)  # may raise: the release below is skipped on unwind
+    budget.release(n)
+
+
+def guarded(n):
+    budget.reserve(n)
+    try:
+        _work(n)
+    finally:
+        budget.release(n)  # close on every edge: NOT flagged
+
+
+def escrowed(n):
+    budget.reserve(n)  # registry escrow declares the transfer: silent

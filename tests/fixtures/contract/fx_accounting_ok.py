@@ -1,0 +1,29 @@
+"""Contract-analyzer fixture: the fx_accounting.py shapes, suppressed."""
+
+
+class _Budget:
+    def reserve(self, n):
+        pass
+
+    def release(self, n):
+        pass
+
+
+budget = _Budget()
+
+
+def _work(n):
+    pass
+
+
+def one_sided(n):
+    # contract: ok accounting-symmetry — fixture: ownership transfers to
+    # the caller's handle
+    budget.reserve(n)
+
+
+def exception_edge(n):
+    # contract: ok accounting-symmetry — fixture: _work cannot raise here
+    budget.reserve(n)
+    _work(n)
+    budget.release(n)

@@ -1,0 +1,17 @@
+"""Contract-analyzer fixture: conf-provenance FIRES on the declared
+producer entry (`writer_loop`), including through a module-local call,
+and NOT on functions outside the entry's reach."""
+
+from spark_rapids_tpu.config import active_conf
+
+
+def writer_loop():
+    _helper()
+
+
+def _helper():
+    return active_conf()  # conf-provenance: reachable from writer_loop
+
+
+def consumer_side():
+    return active_conf()  # NOT flagged: not reachable from the entry

@@ -1,0 +1,13 @@
+"""Contract-analyzer fixture: the fx_conf.py read, suppressed."""
+
+from spark_rapids_tpu.config import active_conf
+
+
+def writer_loop():
+    _helper()
+
+
+def _helper():
+    # contract: ok conf-provenance — fixture: value is invariant across
+    # queries in this scenario
+    return active_conf()

@@ -1,0 +1,37 @@
+"""Contract-analyzer fixture (never imported): every lock-discipline
+rule FIRES here. tests/test_contract_check.py registers Engine._lock /
+Engine._outer as fixture locks with declared order [fx-outer, fx-lock]
+and asserts one finding per bad_* method."""
+
+import threading
+import time
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._outer = threading.Lock()
+
+    def bad_blocking(self):
+        with self._lock:
+            time.sleep(0.1)  # lock-blocking-call: sleep under fx-lock
+
+    def bad_blocking_via_call(self):
+        with self._lock:
+            self._do_io()  # the module-local walk follows this
+
+    def _do_io(self):
+        open("/tmp/fx", "rb")  # lock-blocking-call via bad_blocking_via_call
+
+    def bad_reacquire(self):
+        with self._lock:
+            self._helper()
+
+    def _helper(self):
+        with self._lock:  # lock-reacquire (non-reentrant, via bad_reacquire)
+            pass
+
+    def bad_order(self):
+        with self._lock:
+            with self._outer:  # lock-order: fx-outer must be taken first
+                pass

@@ -1,0 +1,41 @@
+"""Contract-analyzer fixture: the fx_locks.py violations with justified
+suppressions — the analyzer must report ZERO findings here and count
+the suppressions."""
+
+import threading
+import time
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._outer = threading.Lock()
+
+    def bad_blocking(self):
+        with self._lock:
+            # contract: ok lock-blocking-call — fixture: bounded 100ms
+            # sleep, lock is test-local
+            time.sleep(0.1)
+
+    def bad_blocking_via_call(self):
+        with self._lock:
+            self._do_io()
+
+    def _do_io(self):
+        # contract: ok lock-blocking-call — fixture: tmpfile probe only
+        open("/tmp/fx", "rb")
+
+    def bad_reacquire(self):
+        with self._lock:
+            self._helper()
+
+    def _helper(self):
+        # contract: ok lock-reacquire — fixture: demonstrates suppression
+        with self._lock:
+            pass
+
+    def bad_order(self):
+        with self._lock:
+            # contract: ok lock-order — fixture: demonstrates suppression
+            with self._outer:
+                pass

@@ -1,0 +1,8 @@
+"""Contract-analyzer fixture: both registry-drift rules FIRE here."""
+
+BAD_KEY = "spark.rapids.tpu.fixture.not.registered"  # conf-key-registered
+
+
+def report(emit):
+    emit("fixture_unregistered_kind", x=1)  # event-kind-registered
+    emit("query_start")  # registered kind: NOT flagged

@@ -1,0 +1,9 @@
+"""Contract-analyzer fixture: the fx_registry.py literals, suppressed."""
+
+# contract: ok conf-key-registered — fixture: deliberately fake key
+BAD_KEY = "spark.rapids.tpu.fixture.not.registered"
+
+
+def report(emit):
+    # contract: ok event-kind-registered — fixture: deliberately fake kind
+    emit("fixture_unregistered_kind", x=1)

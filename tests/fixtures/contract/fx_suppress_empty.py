@@ -1,0 +1,22 @@
+"""Contract-analyzer fixture: a justification-less suppression and a
+typo'd rule id — both must surface as `suppression-empty` findings (the
+empty one still silences its base finding, so CI fails on the meta
+finding, not on noise)."""
+
+import threading
+import time
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad(self):
+        with self._lock:
+            # contract: ok lock-blocking-call —
+            time.sleep(0.1)
+
+    def typo(self):
+        with self._lock:
+            # contract: ok lock-blocking-cal — the rule id is misspelled
+            time.sleep(0.1)

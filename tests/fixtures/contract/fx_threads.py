@@ -1,0 +1,27 @@
+"""Contract-analyzer fixture: thread-adopt FIRES on the bare spawn and
+stays silent on the adopting one."""
+
+import threading
+
+
+def _worker():
+    pass  # no capture/adopt helper anywhere in reach
+
+
+def _adopting_worker():
+    from spark_rapids_tpu.obs.events import adopt_query_id
+    adopt_query_id(None)
+
+
+def spawn_bad():
+    t = threading.Thread(target=_worker)  # thread-adopt fires
+    t.start()
+
+
+def spawn_good():
+    t = threading.Thread(target=_adopting_worker)  # clean
+    t.start()
+
+
+def submit_bad(pool):
+    return pool.submit(_worker)  # thread-adopt fires

@@ -1,0 +1,19 @@
+"""Contract-analyzer fixture: the fx_threads.py spawns, suppressed."""
+
+import threading
+
+
+def _worker():
+    pass
+
+
+def spawn_bad():
+    # contract: ok thread-adopt — fixture: daemon carries no per-query
+    # context by design
+    t = threading.Thread(target=_worker)
+    t.start()
+
+
+def submit_bad(pool):
+    # contract: ok thread-adopt — fixture: pure function of its args
+    return pool.submit(_worker)

@@ -1,0 +1,22 @@
+"""Contract-analyzer fixture: both trace-purity rules FIRE here."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BAD = jnp.uint32(7)  # trace-module-jnp: jax array built at import
+_OK_REF = jnp.sqrt    # bare attribute reference: NOT flagged
+_OK_NP = np.uint32(7)  # numpy scalar: NOT flagged
+
+
+@jax.jit
+def traced(x):
+    return np.asarray(x)  # trace-host-sync: materializes a tracer
+
+
+def add_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...].item()  # trace-host-sync in a Pallas body
+
+
+def untraced(x):
+    return np.asarray(x)  # host helper: NOT flagged

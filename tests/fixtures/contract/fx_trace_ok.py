@@ -1,0 +1,20 @@
+"""Contract-analyzer fixture: the fx_trace.py violations, suppressed."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# contract: ok trace-module-jnp — fixture: module imported only at top
+# level, never inside a trace
+_BAD = jnp.uint32(7)
+
+
+@jax.jit
+def traced(x):
+    # contract: ok trace-host-sync — fixture: x is statically concrete
+    return np.asarray(x)
+
+
+def add_kernel(x_ref, o_ref):
+    # contract: ok trace-host-sync — fixture: demonstrates suppression
+    o_ref[...] = x_ref[...].item()

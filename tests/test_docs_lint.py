@@ -2,53 +2,47 @@
 code uses resolves to the registry and is documented in docs/configs.md
 (unless internal), and every additional_metrics() name is canonical and
 unique — one name, one meaning, across the exec tree (reference
-GpuMetric companion discipline)."""
+GpuMetric companion discipline).
 
-import ast
+ISSUE 12: the AST scanning (source discovery, conf-key literal walk,
+unregistered-key and unregistered-event-kind detection) lives in
+`spark_rapids_tpu.analysis` now — ONE rule registry. This file keeps
+only the doc-TABLE assertions the analyzer doesn't own (a markdown
+table matching a Python registry) and delegates every code walk to
+`analysis.scan` / the `registry-drift` rules."""
+
 import importlib
 import re
 from pathlib import Path
 
 import pytest
 
+from spark_rapids_tpu import analysis
 from spark_rapids_tpu import config as cfg
 from spark_rapids_tpu.exec import base as exec_base
 
 ROOT = Path(__file__).resolve().parents[1]
 
-_KEY_RE = re.compile(r"spark\.rapids\.[A-Za-z0-9_.]+$")
-
-
-def _full_key_literals(path: Path):
-    """String literals that ARE a conf key (the whole literal matches),
-    with the AST position of each — f-strings/doc prose don't count."""
-    tree = ast.parse(path.read_text())
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
-                and _KEY_RE.fullmatch(node.value.strip()):
-            yield node.value.strip(), node.lineno
-
-
-def _source_files():
-    yield from sorted((ROOT / "spark_rapids_tpu").rglob("*.py"))
-    yield from sorted((ROOT / "tools").glob("*.py"))
-    yield ROOT / "bench.py"
-
 
 def test_conf_keys_in_code_are_registered_and_documented():
+    """ONE walk: source discovery and the conf-key literal scan are the
+    analyzer's (`analysis.scan` — the same scanner the
+    `conf-key-registered` rule runs on, which the contract-check tier-1
+    gate enforces with suppression/baseline support package-wide); this
+    test derives both halves — registration and docs presence — from
+    that single pass."""
     docs = (ROOT / "docs" / "configs.md").read_text()
+    dynamic = cfg.RapidsConf._DYNAMIC_PREFIXES
     problems = []
-    for path in _source_files():
-        for key, lineno in _full_key_literals(path):
+    for path in analysis.default_source_files(ROOT):
+        for key, lineno in analysis.conf_key_literals(path):
             where = f"{path.relative_to(ROOT)}:{lineno}"
             entry = cfg._REGISTRY.get(key)
             if entry is None:
-                if key.startswith(cfg.RapidsConf._DYNAMIC_PREFIXES):
-                    continue
-                problems.append(f"{where}: {key} not in the config "
-                                "registry")
-                continue
-            if not entry.internal and f"`{key}`" not in docs:
+                if not key.startswith(dynamic):
+                    problems.append(f"{where}: {key} not in the config "
+                                    "registry")
+            elif not entry.internal and f"`{key}`" not in docs:
                 problems.append(f"{where}: {key} missing from "
                                 "docs/configs.md — run tools/gen_docs.py")
     assert not problems, "\n".join(problems)
