@@ -359,6 +359,24 @@ def upload_attribution():
     return _delta_since("upload", upload_engine.counters())
 
 
+def dispatch_attribution():
+    """{"dispatch": ...} block for each BENCH record (ISSUE 13):
+    compiled programs, program dispatches, fresh traces vs jit cache
+    hits, compile wall-ns and recompile storms this lane generated
+    (obs/dispatch.py ledger counters, as deltas since the previous
+    record). All zeros with dispatch.ledger.enabled=false — a TPU
+    round reads dispatches/compile_ns next to throughput to see what
+    whole-stage compilation (ROADMAP 2) must collapse."""
+    from spark_rapids_tpu.obs import dispatch as dispatch_ledger
+    cur = dispatch_ledger.counters()
+    return _delta_since("dispatch",
+                        {"programs": cur["programs"],
+                         "dispatches": cur["dispatches"],
+                         "compile_ns": cur["compile_ns"],
+                         "cache_hits": cur["cache_hits"],
+                         "storms": cur["storms"]})
+
+
 def telemetry_attribution():
     """{"telemetry": ...} block for each BENCH record (ISSUE 11):
     registry activity (samples taken, registry writes, push counters)
@@ -635,6 +653,7 @@ def main():
         "gather": gather_attribution(),
         "shuffle": shuffle_attribution(),
         "upload": upload_attribution(),
+        "dispatch": dispatch_attribution(),
         "telemetry": telemetry_attribution(),
         "statistics": statistics_attribution(),
     }
@@ -806,6 +825,7 @@ def q3_bench():
         "gather": gather_attribution(),
         "shuffle": shuffle_attribution(),
         "upload": upload_attribution(),
+        "dispatch": dispatch_attribution(),
         "telemetry": telemetry_attribution(),
         "statistics": statistics_attribution(),
     }

@@ -155,8 +155,9 @@ class RuleMeta:
 
 
 def _build_rules() -> Dict[str, RuleMeta]:
-    from . import (rules_accounting, rules_conf, rules_locks,
-                   rules_registry, rules_threads, rules_trace)
+    from . import (rules_accounting, rules_conf, rules_dispatch,
+                   rules_locks, rules_registry, rules_threads,
+                   rules_trace)
     rules = [
         RuleMeta(
             "lock-blocking-call", "lock-discipline",
@@ -241,6 +242,15 @@ def _build_rules() -> Dict[str, RuleMeta]:
             'obs_events.emit("not_a_kind", ...)',
             rules_registry.check_event_kinds),
         RuleMeta(
+            "dispatch-ledger", "dispatch-discipline",
+            "jax.jit / pallas_call site that does not route through "
+            "the dispatch-ledger chokepoint (obs.dispatch.instrument) "
+            "— its dispatches/compiles/storms are invisible to the "
+            "observability plane",
+            "ISSUE 13 (dispatch & compile observability plane)",
+            "self._jit = jax.jit(self._kernel) in an exec",
+            rules_dispatch.check),
+        RuleMeta(
             "suppression-empty", "analyzer-meta",
             "a `# contract: ok` suppression with no justification, or "
             "naming a rule that does not exist",
@@ -299,6 +309,13 @@ DEFAULT_REGISTRY = ContractRegistry(
                  reentrant=False, note="per-exchange distribution state"),
         LockSpec("stats-global", "obs/stats.py", None, "_global_lock",
                  reentrant=False, note="process-wide stats collector"),
+        LockSpec("dispatch-ledger", "obs/dispatch.py", "DispatchLedger",
+                 "self._lock", reentrant=False,
+                 note="program-stats registry (events buffered under "
+                 "it, emitted after it drops)"),
+        LockSpec("dispatch-config", "obs/dispatch.py", None,
+                 "_ledger_lock", reentrant=False,
+                 note="ledger singleton install/teardown"),
         LockSpec("event-bus-config", "obs/events.py", None, "_bus_lock",
                  reentrant=False, note="bus singleton install/teardown"),
         LockSpec("event-bus", "obs/events.py", "EventBus", "self._lock",
@@ -311,8 +328,8 @@ DEFAULT_REGISTRY = ContractRegistry(
     lock_order=[
         "catalog", "workload-cond", "budget-cond", "semaphore-cond",
         "semaphore", "heartbeat", "breaker", "telemetry-config",
-        "telemetry", "stats", "stats-global", "event-bus-config",
-        "event-bus",
+        "telemetry", "stats", "stats-global", "dispatch-config",
+        "dispatch-ledger", "event-bus-config", "event-bus",
     ],
     cross_query_entries=[
         EntrySpec("memory/catalog.py", "BufferCatalog", "_writer_loop",

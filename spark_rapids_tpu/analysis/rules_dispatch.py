@@ -1,0 +1,49 @@
+"""Dispatch-ledger chokepoint rule (ISSUE 13 satellite).
+
+``dispatch-ledger``: every ``jax.jit(...)`` and ``pl.pallas_call(...)``
+site in the package must route through the dispatch-ledger chokepoint
+(``obs.dispatch.instrument``) or carry a justified suppression. A bare
+jit site is a program the observability plane cannot see — its
+dispatches, compiles and recompile storms vanish from
+``QueryProfile.dispatch_summary()``, the bench ``{"dispatch"}`` deltas
+and the storm detector, which is exactly the silent-throughput-loss
+channel the plane exists to close.
+
+Accepted suppressions by construction: Pallas ``pallas_call`` bodies
+traced inline into an instrumented enclosing program (they are part of
+the outer program, not a separate device dispatch). The chokepoint
+module itself (``obs/dispatch.py``) owns the one real ``jax.jit`` call.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import ModuleGraph, attr_root
+from .core import Finding, ModuleInfo
+
+
+def check(module: ModuleInfo, graph: ModuleGraph, reg):
+    if reg.scope_prefix not in module.path:
+        return []  # tools/bench scripts may drive jax directly
+    if module.path.endswith("obs/dispatch.py"):
+        return []  # THE chokepoint: the one sanctioned jax.jit call
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        if node.attr == "jit" and attr_root(node) == "jax":
+            out.append(Finding(
+                "dispatch-ledger", module.path, node.lineno,
+                "<module>", "jax.jit",
+                "bare `jax.jit` — route this program through "
+                "obs.dispatch.instrument(label=...) so its dispatches/"
+                "compiles reach the ledger, or suppress with the why"))
+        elif node.attr == "pallas_call":
+            out.append(Finding(
+                "dispatch-ledger", module.path, node.lineno,
+                "<module>", "pallas_call",
+                "bare `pallas_call` — either instrument the enclosing "
+                "jit entry point and suppress here (traced inline), or "
+                "route the call through the ledger chokepoint"))
+    return out

@@ -40,6 +40,7 @@ class TpuSession:
         SPMD stages over the device mesh (exec/exchange.py). Default: the
         single-partition plan (no exchange nodes)."""
         from .. import faults
+        from ..obs import dispatch as obs_dispatch
         from ..obs import events as obs_events
         from ..obs import telemetry
         from ..parallel.mesh import device_mesh, set_active_mesh
@@ -47,6 +48,7 @@ class TpuSession:
         set_active_conf(self.conf)
         obs_events.configure(self.conf)
         telemetry.configure(self.conf)
+        obs_dispatch.configure(self.conf)
         faults.configure(self.conf)
         if mesh is None and mesh_devices is not None:
             mesh = device_mesh(mesh_devices)
@@ -80,12 +82,15 @@ class TpuSession:
         trips, partition-granular vs whole-plan recoveries), the
         workload governor's admission surface — queue depth, admitted
         count, queued/admitted/shed/quota-spill counters
-        (exec/workload.py) — and the telemetry registry's state +
-        newest sample (obs/telemetry.py)."""
+        (exec/workload.py) — the telemetry registry's state + newest
+        sample (obs/telemetry.py), and the dispatch ledger's program
+        counters with the worst compile-cost programs
+        (obs/dispatch.py)."""
         from ..exec import lifecycle
-        from ..obs import telemetry
+        from ..obs import dispatch, telemetry
         out = lifecycle.health()
         out["telemetry"] = telemetry.health_section()
+        out["dispatch"] = dispatch.health_section()
         return out
 
     def active_queries(self) -> List[Dict]:
@@ -387,6 +392,7 @@ class DataFrame:
     # -- actions -----------------------------------------------------------
     def _exec(self):
         from .. import faults
+        from ..obs import dispatch as obs_dispatch
         from ..obs import events as obs_events
         from ..obs import telemetry
         from ..parallel.mesh import set_active_mesh
@@ -394,6 +400,7 @@ class DataFrame:
         set_active_mesh(self.session.mesh)
         obs_events.configure(self.session.conf)
         telemetry.configure(self.session.conf)
+        obs_dispatch.configure(self.session.conf)
         faults.configure(self.session.conf)
         return TpuOverrides(self.session.conf).apply(self._plan)
 

@@ -115,7 +115,9 @@ def _pack_impl(batch) -> jnp.ndarray:
     return jnp.concatenate(pieces)
 
 
-_pack_jit = jax.jit(_pack_impl)
+from ..obs.dispatch import instrument as _instrument
+
+_pack_jit = _instrument(_pack_impl, label="transfer.pack_batch")
 
 
 def _take(buf: np.ndarray, pos: int, n: int) -> Tuple[np.ndarray, int]:
@@ -175,7 +177,8 @@ def _pack_split_impl(counts, columns) -> jnp.ndarray:
     return jnp.concatenate(pieces)
 
 
-_pack_split_jit = jax.jit(_pack_split_impl)
+_pack_split_jit = _instrument(_pack_split_impl,
+                              label="transfer.pack_split")
 
 
 def pack_split(counts, columns) -> jnp.ndarray:

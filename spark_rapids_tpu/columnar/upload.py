@@ -495,7 +495,11 @@ def _unpack_batch_impl(buf, specs, dd: bool):
     return num_rows, tuple(cols)
 
 
-_unpack_batch_jit = jax.jit(_unpack_batch_impl, static_argnums=(1, 2))
+from ..obs.dispatch import instrument as _instrument
+
+_unpack_batch_jit = _instrument(_unpack_batch_impl,
+                                label="upload.unpack_batch",
+                                static_argnums=(1, 2))
 
 
 def _unpack_leaves_impl(buf, specs, dd: bool):
@@ -512,7 +516,9 @@ def _unpack_leaves_impl(buf, specs, dd: bool):
     return tuple(out)
 
 
-_unpack_leaves_jit = jax.jit(_unpack_leaves_impl, static_argnums=(1, 2))
+_unpack_leaves_jit = _instrument(_unpack_leaves_impl,
+                                 label="upload.unpack_leaves",
+                                 static_argnums=(1, 2))
 
 
 # ---------------------------------------------------------------------------

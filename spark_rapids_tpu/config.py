@@ -405,6 +405,35 @@ EVENT_LOG_MAX_BYTES = conf_bytes(
     "tools/profile_report.py reads a rotated set in order when given "
     "any member. 0 (default) = unbounded, no rotation.")
 
+DISPATCH_LEDGER_ENABLED = conf_bool(
+    "spark.rapids.tpu.dispatch.ledger.enabled", True,
+    "Process-wide jit dispatch ledger (obs/dispatch.py): every engine "
+    "program dispatch is counted per stable program key (owning "
+    "exec/family x arg-shape bucket x platform) with first-trace vs "
+    "cache-hit discrimination, trace/compile wall-ns and donated vs "
+    "retained argument bytes; wired execs accumulate numDispatches / "
+    "compileTimeNs metrics and QueryProfile.dispatch_summary() reads "
+    "them as the whole-stage-compilation baseline. On (default) costs "
+    "host-side bookkeeping per dispatch (noise against jit dispatch "
+    "overhead); explicitly false = one pointer check per dispatch and "
+    "no records. Results are byte-identical either way.")
+
+DISPATCH_STORM_TRACES = conf_int(
+    "spark.rapids.tpu.dispatch.storm.traces", 8,
+    "Recompile-storm threshold: when one program key (see "
+    "dispatch.ledger.enabled) is RE-traced this many times inside "
+    "dispatch.storm.windowMs, the ledger emits one `recompile_storm` "
+    "event (ESSENTIAL) — the shape-bucket-churn failure mode where "
+    "every batch arrives with a new exact shape and every dispatch "
+    "pays a fresh XLA compile. A program site's FIRST trace of a "
+    "bucket is a new program, not churn, and never counts.")
+
+DISPATCH_STORM_WINDOW_MS = conf_int(
+    "spark.rapids.tpu.dispatch.storm.windowMs", 10000,
+    "Sliding window for the recompile-storm detector. After a storm "
+    "fires, the same program key stays quiet for one window (a storm "
+    "is one incident, not one event per churning batch).")
+
 TELEMETRY_ENABLED = conf_bool(
     "spark.rapids.tpu.telemetry.enabled", False,
     "Live telemetry registry + sampler (obs/telemetry.py): a "

@@ -21,7 +21,6 @@ from __future__ import annotations
 from functools import partial
 from typing import Iterator, List, Optional, Sequence, Tuple
 
-import jax
 import jax.numpy as jnp
 
 from ..columnar.batch import ColumnarBatch
@@ -36,13 +35,15 @@ from ..ops.aggregate import groupby_aggregate, groupby_aggregate_hash
 from ..ops.basic import active_mask, sanitize
 from ..ops.sort import string_words_for
 from ..types import DataType, LongType, Schema, StructField
-from .base import (AGG_TIME, CONCAT_TIME, DEBUG, NUM_INPUT_BATCHES,
-                   NUM_INPUT_ROWS, TpuExec)
+from ..obs.dispatch import instrument
+from .base import (AGG_TIME, CONCAT_TIME, DEBUG, DISPATCH_METRICS,
+                   NUM_INPUT_BATCHES, NUM_INPUT_ROWS, TpuExec)
 from .basic import bind_projection, eval_projection
 from .coalesce import concat_batches
 
 
-@partial(jax.jit, static_argnums=(1,))
+@partial(instrument, label="aggregate.shrink_batch",
+         static_argnums=(1,))
 def _shrink_batch(batch: ColumnarBatch, cap: int) -> ColumnarBatch:
     """Move the active prefix into a smaller capacity bucket: aggregated
     partials carry few groups in huge input-sized buckets; merging at input
@@ -105,22 +106,35 @@ class AggregateExec(TpuExec):
         self._source: TpuExec = child
 
         # compiled kernels (cache keyed by capacity bucket + string words)
-        self._jit_update = jax.jit(self._update_batch, static_argnums=(1,))
-        self._jit_merge = jax.jit(self._merge_batch, static_argnums=(1,))
+        self._jit_update = instrument(self._update_batch,
+                                      label="AggregateExec.update",
+                                      owner=self, static_argnums=(1,))
+        self._jit_merge = instrument(self._merge_batch,
+                                     label="AggregateExec.merge",
+                                     owner=self, static_argnums=(1,))
         # hash-path tiers: cheap 2-round first, 6-round escalation for
         # mid-cardinality, exact sort as the last resort
         self._jit_update_hash = {
-            r: jax.jit(partial(self._update_batch, hash_path=True,
-                               hash_rounds=r)) for r in (2, 6)}
+            r: instrument(partial(self._update_batch, hash_path=True,
+                                  hash_rounds=r),
+                          label="AggregateExec.update_hash", owner=self)
+            for r in (2, 6)}
         self._jit_merge_hash = {
-            r: jax.jit(partial(self._merge_batch, hash_path=True,
-                               hash_rounds=r)) for r in (2, 6)}
+            r: instrument(partial(self._merge_batch, hash_path=True,
+                                  hash_rounds=r),
+                          label="AggregateExec.merge_hash", owner=self)
+            for r in (2, 6)}
         # sync-free exact merge: masked buckets + in-program sort fallback
-        self._jit_merge_auto = jax.jit(
-            partial(self._merge_batch, auto_path=True))
-        self._jit_pre = jax.jit(self._pre_project)
-        self._jit_concat_merge = jax.jit(self._concat_merge_pair,
-                                         static_argnums=(2,))
+        self._jit_merge_auto = instrument(
+            partial(self._merge_batch, auto_path=True),
+            label="AggregateExec.merge_auto", owner=self)
+        self._jit_pre = instrument(self._pre_project,
+                                   label="AggregateExec.pre_project",
+                                   owner=self)
+        self._jit_concat_merge = instrument(
+            self._concat_merge_pair,
+            label="AggregateExec.concat_merge", owner=self,
+            static_argnums=(2,))
 
         if mode == "final":
             # input is keys+buffers produced by a partial instance; the
@@ -162,8 +176,12 @@ class AggregateExec(TpuExec):
 
         # streaming speculative kernel: fused steps + masked-bucket update
         # + fold into the O(1) device state — ONE program per source batch
-        self._jit_step_spec = jax.jit(self._streaming_step)
-        self._jit_step_exact = jax.jit(self._fused_update_exact)
+        self._jit_step_spec = instrument(
+            self._streaming_step,
+            label="AggregateExec.streaming_step", owner=self)
+        self._jit_step_exact = instrument(
+            self._fused_update_exact,
+            label="AggregateExec.fused_update_exact", owner=self)
 
         # fused Pallas tier (ISSUE 1): compile the absorbed operator
         # chain for the one-kernel scan-filter-project-partial-aggregate
@@ -190,7 +208,9 @@ class AggregateExec(TpuExec):
         # groups rows by this aggregate's keys — e.g. the inner join's
         # key-grouped emission — the exact tier skips its batch sort
         self._pre_grouped = mode != "final" and self._input_pre_grouped()
-        self._jit_evaluate = jax.jit(self._evaluate)
+        self._jit_evaluate = instrument(self._evaluate,
+                                        label="AggregateExec.evaluate",
+                                        owner=self)
         self._initial_state_cache = None
 
     def _input_pre_grouped(self) -> bool:
@@ -238,7 +258,7 @@ class AggregateExec(TpuExec):
 
     def additional_metrics(self):
         return (AGG_TIME, CONCAT_TIME, (NUM_INPUT_ROWS, DEBUG),
-                (NUM_INPUT_BATCHES, DEBUG))
+                (NUM_INPUT_BATCHES, DEBUG)) + DISPATCH_METRICS
 
     # -- kernels -----------------------------------------------------------
     def _pre_project(self, batch: ColumnarBatch) -> ColumnarBatch:

@@ -117,6 +117,8 @@ NUM_GATHERS = "numGathers"
 GATHER_TIME = "gatherTimeNs"
 NUM_UPLOADS = "numUploads"
 UPLOAD_PACK_TIME = "uploadPackTimeNs"
+NUM_DISPATCHES = "numDispatches"
+COMPILE_TIME = "compileTimeNs"
 
 #: the closed set of metric names execs may register — one name, one
 #: meaning, exactly like the reference's GpuMetric companion object.
@@ -131,6 +133,7 @@ CANONICAL_METRICS = frozenset({
     PIPELINE_WAIT, PIPELINE_FULL_WAIT, PIPELINE_WALL,
     NUM_GATHERS, GATHER_TIME,
     NUM_UPLOADS, UPLOAD_PACK_TIME,
+    NUM_DISPATCHES, COMPILE_TIME,
 })
 
 #: per-operator instance ids for event/span attribution (two
@@ -159,6 +162,16 @@ GATHER_METRICS = ((NUM_GATHERS, MODERATE), (GATHER_TIME, MODERATE))
 #: promote_stream): batch uploads this execution dispatched and the
 #: wall-ns spent packing + transferring them
 UPLOAD_METRICS = ((NUM_UPLOADS, MODERATE), (UPLOAD_PACK_TIME, MODERATE))
+
+#: the metric pair every dispatch-ledger-wired exec registers (include
+#: in additional_metrics(); bound by building the exec's jit sites with
+#: obs.dispatch.instrument(owner=self), or via dispatch.metric_scope
+#: for module-level program sites): program dispatches this exec issued
+#: and the wall-ns its fresh traces spent compiling (ISSUE 13 — the
+#: per-stage dispatches/batch baseline whole-stage compilation answers
+#: to). Dispatches are counted at CALL time, so jit cache hits replay
+#: identical counts on repeated executions.
+DISPATCH_METRICS = ((NUM_DISPATCHES, MODERATE), (COMPILE_TIME, MODERATE))
 
 
 class TpuExec:
@@ -315,6 +328,13 @@ class TpuExec:
             rows_at_open = rows.value
         except Exception:  # noqa: BLE001
             rows_at_open = None
+        # dispatch plane (ISSUE 13): wired execs carry DISPATCH_METRICS
+        # — snapshot them so one dispatch_stats record per execution
+        # reports per-execution deltas (the gather_stats convention)
+        disp = self.metrics.get(NUM_DISPATCHES)
+        comp = self.metrics.get(COMPILE_TIME)
+        disp_at_open = disp.value if disp is not None else None
+        comp_at_open = comp.value if comp is not None else 0
         total_ns = 0
         nbatches = 0
         emit_batches = bus.level >= obs_events.DEBUG
@@ -363,6 +383,13 @@ class TpuExec:
                 out_rows = None
             bus.emit("op_close", op=name, op_id=self._op_id,
                      wall_ns=total_ns, batches=nbatches, rows=out_rows)
+            if disp_at_open is not None \
+                    and disp.value > disp_at_open:
+                bus.emit("dispatch_stats", op=name, op_id=self._op_id,
+                         dispatches=disp.value - disp_at_open,
+                         compile_ns=(comp.value - comp_at_open
+                                     if comp is not None else 0),
+                         batches=nbatches)
 
     #: most recent batch this operator yielded (= a child's view of its
     #: input); consumed by the failure dump below

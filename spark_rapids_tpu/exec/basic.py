@@ -8,7 +8,6 @@ from __future__ import annotations
 
 from typing import Dict, Iterator, List, Optional, Sequence
 
-import jax
 import jax.numpy as jnp
 
 from ..columnar.batch import ColumnarBatch
@@ -18,7 +17,9 @@ from ..memory.retry import split_in_half_by_rows, with_retry
 from ..memory.spillable import SpillableBatch
 from ..ops.basic import active_mask, compact_columns, sanitize, slice_rows
 from ..types import LongType, Schema, StructField
-from .base import (GATHER_METRICS, GATHER_TIME, NUM_GATHERS,
+from ..obs.dispatch import instrument
+from .base import (COMPILE_TIME, DISPATCH_METRICS, GATHER_METRICS,
+                   GATHER_TIME, NUM_DISPATCHES, NUM_GATHERS,
                    NUM_INPUT_BATCHES, NUM_INPUT_ROWS, NUM_UPLOADS,
                    OP_TIME, PIPELINE_STAGE_METRICS, UPLOAD_METRICS,
                    UPLOAD_PACK_TIME, TpuExec)
@@ -65,7 +66,7 @@ class SourceScanExec(TpuExec):
         return self._schema
 
     def additional_metrics(self):
-        return PIPELINE_STAGE_METRICS + UPLOAD_METRICS
+        return PIPELINE_STAGE_METRICS + UPLOAD_METRICS + DISPATCH_METRICS
 
     @property
     def runs_own_pipeline_stage(self) -> bool:
@@ -88,6 +89,7 @@ class SourceScanExec(TpuExec):
         stream)."""
         from ..columnar.upload import metric_sink
         from ..memory.semaphore import tpu_semaphore
+        from ..obs import dispatch as obs_dispatch
         from .pipeline import cancelled
         sem = tpu_semaphore()
         # a source that drives a child exec plan to build its data (e.g.
@@ -109,8 +111,14 @@ class SourceScanExec(TpuExec):
                     # happen inside next(it) on THIS (producer) thread:
                     # the sink attributes them to this scan's
                     # numUploads/uploadPackTimeNs (ISSUE 10)
+                    # the upload's device unpack program is a module-
+                    # level dispatch site — the dispatch metric scope
+                    # attributes it here, like the upload sink
                     with metric_sink(self.metrics[NUM_UPLOADS],
-                                     self.metrics[UPLOAD_PACK_TIME]):
+                                     self.metrics[UPLOAD_PACK_TIME]), \
+                            obs_dispatch.metric_scope(
+                                self.metrics[NUM_DISPATCHES],
+                                self.metrics[COMPILE_TIME]):
                         batch = next(it)
                 except StopIteration:
                     return
@@ -172,12 +180,16 @@ class ProjectExec(TpuExec):
         self.exprs = list(exprs)
         self._schema = projection_schema(self.exprs, child.output_schema)
         self._bound = bind_projection(self.exprs, child.output_schema)
-        self._jit = jax.jit(
-            lambda b: eval_projection(self._bound, b, self._schema))
+        self._jit = instrument(
+            lambda b: eval_projection(self._bound, b, self._schema),
+            label="ProjectExec.project", owner=self)
 
     @property
     def output_schema(self) -> Schema:
         return self._schema
+
+    def additional_metrics(self):
+        return DISPATCH_METRICS
 
     @property
     def output_grouped_by(self):
@@ -242,7 +254,8 @@ class FilterExec(TpuExec):
         super().__init__(child)
         self.condition = condition
         self._bound = resolve(condition, child.output_schema)
-        self._jit = jax.jit(self._kernel)
+        self._jit = instrument(self._kernel, label="FilterExec.filter",
+                               owner=self)
         from ..ops.gather import GatherTracker
         self._gather_track = GatherTracker(self.metrics[NUM_GATHERS],
                                            self.metrics[GATHER_TIME])
@@ -252,7 +265,7 @@ class FilterExec(TpuExec):
         return self.child.output_schema
 
     def additional_metrics(self):
-        return GATHER_METRICS
+        return GATHER_METRICS + DISPATCH_METRICS
 
     def _kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
         pred = self._bound.columnar_eval(batch)
@@ -420,12 +433,17 @@ class ExpandExec(TpuExec):
         self._bound = [bind_projection(p, child.output_schema)
                        for p in self.projections]
         self._jits = [
-            jax.jit(lambda b, bp=bp: eval_projection(bp, b, self._schema))
+            instrument(
+                lambda b, bp=bp: eval_projection(bp, b, self._schema),
+                label="ExpandExec.project", owner=self)
             for bp in self._bound]
 
     @property
     def output_schema(self) -> Schema:
         return self._schema
+
+    def additional_metrics(self):
+        return DISPATCH_METRICS
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         for batch in self.child.execute():
@@ -445,11 +463,15 @@ class SampleExec(TpuExec):
         super().__init__(child)
         self.fraction = float(fraction)
         self.seed = int(seed)
-        self._jit = jax.jit(self._kernel, static_argnums=(2,))
+        self._jit = instrument(self._kernel, label="SampleExec.sample",
+                               owner=self, static_argnums=(2,))
 
     @property
     def output_schema(self) -> Schema:
         return self.child.output_schema
+
+    def additional_metrics(self):
+        return DISPATCH_METRICS
 
     def _kernel(self, batch: ColumnarBatch, batch_idx, fraction: float):
         import jax as _jax

@@ -8,7 +8,6 @@ from __future__ import annotations
 
 from typing import Iterator, List, Optional
 
-import jax
 import jax.numpy as jnp
 
 from ..columnar.batch import ColumnarBatch
@@ -18,14 +17,18 @@ from ..memory.retry import with_retry_no_split
 from ..memory.spillable import SpillableBatch
 from ..ops.basic import concat_columns, sanitize
 from ..types import Schema
-from .base import (CONCAT_TIME, DEBUG, NUM_INPUT_BATCHES,
-                   NUM_INPUT_ROWS, PIPELINE_STAGE_METRICS, TpuExec)
+from ..obs import dispatch as obs_dispatch
+from ..obs.dispatch import instrument
+from .base import (COMPILE_TIME, CONCAT_TIME, DEBUG, DISPATCH_METRICS,
+                   NUM_DISPATCHES, NUM_INPUT_BATCHES, NUM_INPUT_ROWS,
+                   PIPELINE_STAGE_METRICS, TpuExec)
 
 
 from functools import partial
 
 
-@partial(jax.jit, static_argnums=(2,))
+@partial(instrument, label="coalesce.concat_pair",
+         static_argnums=(2,))
 def _concat_pair(a: ColumnarBatch, b: ColumnarBatch, cap: int
                  ) -> ColumnarBatch:
     cols = [concat_columns(ca, cb, a.num_rows, b.num_rows, cap)
@@ -75,7 +78,8 @@ class CoalesceBatchesExec(TpuExec):
 
     def additional_metrics(self):
         return (CONCAT_TIME, (NUM_INPUT_ROWS, DEBUG),
-                (NUM_INPUT_BATCHES, DEBUG)) + PIPELINE_STAGE_METRICS
+                (NUM_INPUT_BATCHES, DEBUG)) + PIPELINE_STAGE_METRICS \
+            + DISPATCH_METRICS
 
     @property
     def runs_own_pipeline_stage(self) -> bool:
@@ -96,7 +100,11 @@ class CoalesceBatchesExec(TpuExec):
             nonlocal pending, pending_bytes
             if not pending:
                 return None
-            with concat_time.ns_timer():
+            # the concat program is a module-level dispatch site: the
+            # metric scope attributes its dispatches to this exec
+            with concat_time.ns_timer(), obs_dispatch.metric_scope(
+                    self.metrics[NUM_DISPATCHES],
+                    self.metrics[COMPILE_TIME]):
                 spillables, pending = pending, []
                 pending_bytes = 0
                 def do(items):

@@ -33,7 +33,9 @@ from ..parallel.exchange import exchange_columns, partition_ids
 from ..parallel.mesh import DATA_AXIS, active_mesh, mesh_axis_size
 from ..types import Schema
 from ..obs import events as obs_events
-from .base import (BROADCAST_TIME, DEBUG, ESSENTIAL, GATHER_METRICS,
+from ..obs.dispatch import instrument
+from .base import (BROADCAST_TIME, DEBUG, DISPATCH_METRICS, ESSENTIAL,
+                   GATHER_METRICS,
                    GATHER_TIME, MODERATE,
                    NUM_GATHERS, NUM_INPUT_BATCHES, NUM_INPUT_ROWS,
                    NUM_OUTPUT_BATCHES,
@@ -106,7 +108,9 @@ class ShuffleExchangeExec(TpuExec):
         self._mesh = mesh if mesh is not None else active_mesh()
         self._bound = bind_projection(self.partition_exprs,
                                       child.output_schema)
-        self._jit_measure = jax.jit(self._measure_kernel)
+        self._jit_measure = instrument(
+            self._measure_kernel,
+            label="ShuffleExchangeExec.measure", owner=self)
         self._steps = {}
 
     @property
@@ -115,7 +119,8 @@ class ShuffleExchangeExec(TpuExec):
 
     def additional_metrics(self):
         return ((NUM_INPUT_BATCHES, DEBUG), (NUM_INPUT_ROWS, DEBUG),
-                (PARTITION_SIZE, ESSENTIAL)) + PIPELINE_STAGE_METRICS
+                (PARTITION_SIZE, ESSENTIAL)) + PIPELINE_STAGE_METRICS \
+            + DISPATCH_METRICS
 
     @property
     def runs_own_pipeline_stage(self) -> bool:
@@ -175,9 +180,10 @@ class ShuffleExchangeExec(TpuExec):
             return _expand0(ColumnarBatch(cols, n_recv, schema))
 
         from ..parallel.mesh import shard_map_compat
-        step = jax.jit(shard_map_compat(
+        step = instrument(shard_map_compat(
             spmd, mesh=self._mesh, in_specs=P(DATA_AXIS),
-            out_specs=P(DATA_AXIS)))
+            out_specs=P(DATA_AXIS)),
+            label="ShuffleExchangeExec.exchange_step", owner=self)
         self._steps[key] = step
         return step
 
@@ -387,7 +393,9 @@ class HostShuffleExchangeExec(TpuExec):
             assert self.partition_exprs, "hash partitioning needs keys"
             self._bound = bind_projection(self.partition_exprs,
                                           child.output_schema)
-            self._jit_pid = jax.jit(self._pid_kernel)
+            self._jit_pid = instrument(
+                self._pid_kernel,
+                label="HostShuffleExchangeExec.pid", owner=self)
         self._rr_offset = 0
         # device partition split (ISSUE 9): hash/roundrobin/single pids
         # are device-computable, so the split runs as ONE compiled
@@ -403,9 +411,10 @@ class HostShuffleExchangeExec(TpuExec):
         # -> packed reorder -> packed uint8 buffer) + ONE D2H copy,
         # instead of a split dispatch followed by a pack dispatch
         from ..columnar import transfer as _transfer
-        self._jit_split = jax.jit(
+        self._jit_split = instrument(
             lambda b, off: _transfer.pack_split(
-                *self._split_kernel(b, off)))
+                *self._split_kernel(b, off)),
+            label="HostShuffleExchangeExec.split_pack", owner=self)
         #: host unpack templates per compiled shape key (abstract shapes
         #: via eval_shape — no device work, no gather-recorder side
         #: effects: eval_shape runs OUTSIDE the tracker's observe)
@@ -422,7 +431,8 @@ class HostShuffleExchangeExec(TpuExec):
         return ((NUM_INPUT_BATCHES, DEBUG), (NUM_INPUT_ROWS, DEBUG),
                 (PARTITION_SIZE, ESSENTIAL), SHUFFLE_WRITE_TIME,
                 SHUFFLE_READ_TIME, (SHUFFLE_PACK_TIME, MODERATE)) \
-            + GATHER_METRICS + UPLOAD_METRICS + PIPELINE_STAGE_METRICS
+            + GATHER_METRICS + UPLOAD_METRICS + PIPELINE_STAGE_METRICS \
+            + DISPATCH_METRICS
 
     @property
     def runs_own_pipeline_stage(self) -> bool:

@@ -20,7 +20,9 @@ from ..columnar.column import (ArrayColumn, Column, MapColumn,
 from ..expr.core import Expression, resolve
 from ..ops.basic import active_mask, compaction_order, gather_column
 from ..types import ArrayType, IntegerType, Schema, StructField
-from .base import DEBUG, NUM_INPUT_BATCHES, OP_TIME, TpuExec
+from ..obs.dispatch import instrument
+from .base import (DEBUG, DISPATCH_METRICS, NUM_INPUT_BATCHES, OP_TIME,
+                   TpuExec)
 
 
 class GenerateExec(TpuExec):
@@ -46,8 +48,12 @@ class GenerateExec(TpuExec):
             assert isinstance(arr_t, ArrayType), \
                 f"explode needs an ARRAY or MAP input, got {arr_t}"
             self._elem_type = arr_t.element_type
-        self._jit = jax.jit(self._kernel, static_argnums=(1,))
-        self._jit_measure = jax.jit(self._measure_kernel)
+        self._jit = instrument(self._kernel,
+                               label="GenerateExec.explode", owner=self,
+                               static_argnums=(1,))
+        self._jit_measure = instrument(self._measure_kernel,
+                                       label="GenerateExec.measure",
+                                       owner=self)
 
     @property
     def output_schema(self) -> Schema:
@@ -64,7 +70,7 @@ class GenerateExec(TpuExec):
         return Schema(tuple(fields))
 
     def additional_metrics(self):
-        return ((NUM_INPUT_BATCHES, DEBUG),)
+        return ((NUM_INPUT_BATCHES, DEBUG),) + DISPATCH_METRICS
 
     def _measure_kernel(self, batch: ColumnarBatch):
         """Exact output payload need per variable-size payload column

@@ -36,7 +36,9 @@ from ..ops.join import (
     probe_counts, unmatched_indices, verify_pairs,
 )
 from ..types import BooleanType, Schema, StructField
-from .base import (BUILD_TIME, DEBUG, GATHER_METRICS, GATHER_TIME,
+from ..obs.dispatch import instrument
+from .base import (BUILD_TIME, DEBUG, DISPATCH_METRICS, GATHER_METRICS,
+                   GATHER_TIME,
                    JOIN_TIME, NUM_GATHERS, NUM_INPUT_BATCHES, TpuExec)
 from .basic import bind_projection, eval_projection, projection_schema
 from .coalesce import concat_batches
@@ -135,10 +137,16 @@ class HashJoinExec(TpuExec):
             assert build_side == "right"
         # compiled phases: counts (sized by stream bucket) and the probe
         # body (sized by stream + candidate buckets, static per shape)
-        self._jit_build = jax.jit(self._build_kernel)
-        self._jit_counts = jax.jit(self._counts_kernel)
-        self._jit_probe = jax.jit(self._probe_kernel,
-                                  static_argnums=(5, 6, 7, 8))
+        self._jit_build = instrument(self._build_kernel,
+                                     label="HashJoinExec.build",
+                                     owner=self)
+        self._jit_counts = instrument(self._counts_kernel,
+                                      label="HashJoinExec.counts",
+                                      owner=self)
+        self._jit_probe = instrument(self._probe_kernel,
+                                     label="HashJoinExec.probe",
+                                     owner=self,
+                                     static_argnums=(5, 6, 7, 8))
         # (stream_cap, build_cap) -> (cand_cap, s_caps, b_caps): lets a
         # speculation scope skip the per-batch sizing sync (round 4)
         self._size_cache = {}
@@ -205,7 +213,7 @@ class HashJoinExec(TpuExec):
 
     def additional_metrics(self):
         return (BUILD_TIME, JOIN_TIME, (NUM_INPUT_BATCHES, DEBUG)) \
-            + GATHER_METRICS
+            + GATHER_METRICS + DISPATCH_METRICS
 
     @property
     def output_grouped_by(self):

@@ -30,7 +30,7 @@ import numpy as np
 from ..columnar.batch import ColumnarBatch
 from ..expr.core import Expression, col
 from ..types import DataType, Schema, StructField, to_arrow as _t2a
-from .base import OP_TIME, TpuExec
+from .base import DISPATCH_METRICS, OP_TIME, TpuExec
 from .basic import bind_projection, eval_projection, projection_schema
 
 _KEY_PREFIX = "__pandas_gkey_"
@@ -77,9 +77,14 @@ class _PandasExecBase(TpuExec):
             for k, n in zip(key_exprs, self._key_valid_names)]
         self._pre_bound = bind_projection(pre, in_schema)
         self._pre_schema = projection_schema(pre, in_schema)
-        import jax
-        self._jit_pre = jax.jit(lambda b: eval_projection(
-            self._pre_bound, b, self._pre_schema))
+        from ..obs.dispatch import instrument
+        self._jit_pre = instrument(
+            lambda b: eval_projection(self._pre_bound, b,
+                                      self._pre_schema),
+            label="PandasExec.pre_project", owner=self)
+
+    def additional_metrics(self):
+        return DISPATCH_METRICS
 
     def _host_frame(self):
         import pandas as pd

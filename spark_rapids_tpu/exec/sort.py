@@ -28,8 +28,9 @@ from ..ops.sort import (
     SortOrder, order_key_lanes, sort_batch_columns, string_words_for,
 )
 from ..types import Schema
-from .base import (DEBUG, GATHER_METRICS, GATHER_TIME, NUM_GATHERS,
-                   NUM_INPUT_BATCHES, SORT_TIME, TpuExec)
+from ..obs.dispatch import instrument
+from .base import (DEBUG, DISPATCH_METRICS, GATHER_METRICS, GATHER_TIME,
+                   NUM_GATHERS, NUM_INPUT_BATCHES, SORT_TIME, TpuExec)
 from .coalesce import concat_batches
 
 
@@ -74,7 +75,9 @@ class SortExec(TpuExec):
         self.orders = resolve_sort_orders(orders, child.output_schema)
         self.limit = limit
         # one compiled sort program per (capacity bucket, string words)
-        self._jit_sort = jax.jit(self._sort_kernel, static_argnums=(1,))
+        self._jit_sort = instrument(self._sort_kernel,
+                                    label="SortExec.sort", owner=self,
+                                    static_argnums=(1,))
         # round 8: fixed-width columns ride INSIDE lax.sort as packed
         # lanes, so numGathers here counts only the varlen columns'
         # permutation gathers — the structural proof the sort path needs
@@ -88,7 +91,8 @@ class SortExec(TpuExec):
         return self.child.output_schema
 
     def additional_metrics(self):
-        return (SORT_TIME, (NUM_INPUT_BATCHES, DEBUG)) + GATHER_METRICS
+        return (SORT_TIME, (NUM_INPUT_BATCHES, DEBUG)) + GATHER_METRICS \
+            + DISPATCH_METRICS
 
     def _string_words(self, batch: ColumnarBatch) -> int:
         return string_words_for(batch.columns,

@@ -37,7 +37,9 @@ from ..ops.window import (
     windowed_sum_count,
 )
 from ..types import DoubleType, IntegerType, LongType, Schema, StructField
-from .base import (GATHER_METRICS, GATHER_TIME, NUM_GATHERS, OP_TIME,
+from ..obs.dispatch import instrument
+from .base import (DISPATCH_METRICS, GATHER_METRICS, GATHER_TIME,
+                   NUM_GATHERS, OP_TIME,
                    TpuExec)
 from .basic import bind_projection, eval_projection, projection_schema
 from .coalesce import concat_batches
@@ -100,15 +102,19 @@ class WindowExec(TpuExec):
             self._input_slots.append(slots)
         self._pre_bound = bind_projection(self._pre_exprs, in_schema)
         self._pre_schema = projection_schema(self._pre_exprs, in_schema)
-        self._jit_window = jax.jit(self._window_kernel, static_argnums=(1,))
+        self._jit_window = instrument(self._window_kernel,
+                                      label="WindowExec.window",
+                                      owner=self, static_argnums=(1,))
         from ..ops.gather import GatherTracker
         self._gather_track = GatherTracker(self.metrics[NUM_GATHERS],
                                            self.metrics[GATHER_TIME])
         self._jit_lps = None
         self._jit_fpl = None
         self._jit_carry_update = None
-        self._jit_pre = jax.jit(lambda b: eval_projection(
-            self._pre_bound, b, self._pre_schema))
+        self._jit_pre = instrument(
+            lambda b: eval_projection(self._pre_bound, b,
+                                      self._pre_schema),
+            label="WindowExec.pre_project", owner=self)
 
     @property
     def output_schema(self) -> Schema:
@@ -120,7 +126,7 @@ class WindowExec(TpuExec):
         return Schema(tuple(fields))
 
     def additional_metrics(self):
-        return GATHER_METRICS
+        return GATHER_METRICS + DISPATCH_METRICS
 
     def _dispatch_window(self, batch: ColumnarBatch, words: int
                          ) -> ColumnarBatch:
@@ -357,7 +363,9 @@ class WindowExec(TpuExec):
             # the compiled update kernel lives on the exec (aggs are fixed
             # per exec), so successive giant partitions share it
             if getattr(exec_, "_jit_carry_update", None) is None:
-                exec_._jit_carry_update = jax.jit(self._update_kernel)
+                exec_._jit_carry_update = instrument(
+                    self._update_kernel,
+                    label="WindowExec.carry_update", owner=exec_)
             self._jit_update = exec_._jit_carry_update
 
         def _update_kernel(self, batch: ColumnarBatch, state):
@@ -499,7 +507,9 @@ class WindowExec(TpuExec):
                 nm = jnp.min(jnp.where(act & ~same, idx, cap))
                 return jnp.minimum(nm, n)
 
-            self._jit_fpl = jax.jit(fpl, static_argnums=(1,))
+            self._jit_fpl = instrument(fpl,
+                                       label="WindowExec.first_part_len",
+                                       owner=self, static_argnums=(1,))
         return int(self._jit_fpl(batch, words, ref_cols))
 
     # -- drive -------------------------------------------------------------
@@ -523,7 +533,9 @@ class WindowExec(TpuExec):
                 nm = jnp.max(jnp.where(act & ~same, idx, -1))
                 return nm + 1
 
-            self._jit_lps = jax.jit(lps, static_argnums=(1,))
+            self._jit_lps = instrument(lps,
+                                       label="WindowExec.last_part_start",
+                                       owner=self, static_argnums=(1,))
         return int(self._jit_lps(batch, words))
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:

@@ -22,6 +22,14 @@ GpuMetric/GpuTaskMetrics/NVTX stack joined into one subsystem (ISSUE 2):
     HBM attribution, link bytes, queue/semaphore/breaker/spill gauges
     in bounded ring-buffer series, flushed as telemetry_sample events;
     gated by spark.rapids.tpu.telemetry.{enabled,intervalMs,historySize}.
+  * `dispatch` — the jit dispatch ledger (ISSUE 13): every engine
+    program dispatch routes through `dispatch.instrument`, recording
+    per stable program key (label x arg-shape bucket x platform) the
+    dispatch count, first-trace vs cache-hit split, trace/compile cost
+    and donated/retained bytes; emits `program_compile` per fresh trace
+    and `recompile_storm` on shape-bucket churn. The whole-stage-
+    compilation baseline (ROADMAP 2) reads
+    QueryProfile.dispatch_summary() on top of it.
 
 Render an event-log file with tools/profile_report.py (`--format json`
 for the machine-readable summary) and telemetry samples with

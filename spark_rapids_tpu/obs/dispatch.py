@@ -1,0 +1,511 @@
+"""Process-wide dispatch ledger (ISSUE 13 tentpole part 1): THE
+chokepoint every engine jit entry point routes through.
+
+The engine dispatches many small jitted programs per batch — exactly the
+per-operator interpretation overhead whole-stage compilation (ROADMAP
+open item 2) must collapse — yet until this plane existed nothing
+recorded how many programs run, what tracing/compiling them costs, or
+why a program re-traces. `instrument()` replaces bare `jax.jit(...)` at
+every entry point (exec operators, exchange split, upload unpack,
+transfer pack, the Pallas kernel families) and records, per compiled
+program:
+
+  * a stable program key — (owning exec/family label, arg-shape
+    bucket, backend platform) — the log2 bucket discipline of
+    ops/pallas_tier.shape_bucket, so one key covers every batch that
+    compiles to the same program shape;
+  * dispatch count, first-trace vs cache-hit discriminated;
+  * trace-ns (the Python tracing of the body, measured inside the
+    traced function — it only runs when jax actually traces) and
+    compile-ns (wall-clock of the compiling dispatch, inclusive of
+    trace + lowering + compilation);
+  * donated vs retained argument bytes (from the tracer avals at trace
+    time, against the site's `donate_argnums`).
+
+Per-exec attribution mirrors the GatherTracker pattern: a site built
+with `owner=<exec>` adds to that exec's `numDispatches` /
+`compileTimeNs` canonical metrics on every call — dispatches are
+counted at CALL time, so jit cache hits never zero the counts and
+repeated collects replay identical per-stage dispatches/batch.
+Module-level program sites (upload unpack, coalesce concat) attribute
+through the thread-local `metric_scope` sink instead.
+
+Each fresh trace emits a `program_compile` event (MODERATE), and the
+recompile-storm detector emits `recompile_storm` (ESSENTIAL) when one
+program key traces more than `spark.rapids.tpu.dispatch.storm.traces`
+times inside `spark.rapids.tpu.dispatch.storm.windowMs` — the
+shape-bucket-churn failure mode that silently destroys TPU throughput
+(every batch a new exact shape, every dispatch a fresh XLA compile).
+
+Cost discipline: `spark.rapids.tpu.dispatch.ledger.enabled` defaults
+ON (the ledger is host-side bookkeeping, ~one dict update per program
+dispatch — noise against jit dispatch overhead); explicitly false =
+`active_ledger()` None and every instrumented site pays exactly one
+pointer check before calling straight into its jitted function.
+Results are byte-identical either way — the wrapper never touches the
+computation.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "DispatchLedger", "InstrumentedJit", "instrument", "active_ledger",
+    "configure", "reset_dispatch_ledger", "counters", "programs",
+    "health_section", "metric_scope",
+]
+
+#: canonical per-exec metric names (exec/base.py re-exports them into
+#: CANONICAL_METRICS; literals here so obs/ never imports exec/)
+NUM_DISPATCHES = "numDispatches"
+COMPILE_TIME = "compileTimeNs"
+
+_tls = threading.local()
+
+#: backend platform, resolved once (it cannot change in-process)
+_platform_cache: Optional[str] = None
+
+
+def _platform() -> str:
+    global _platform_cache
+    if _platform_cache is None:
+        import jax
+        _platform_cache = jax.default_backend()
+    return _platform_cache
+
+
+def _shape_bucket(shape) -> Tuple[int, ...]:
+    from ..ops.pallas_tier import shape_bucket
+    return shape_bucket(shape)
+
+
+def _args_bucket(args, kwargs) -> Tuple:
+    """Stable arg-shape bucket: log2-bucketed dims + dtype per array
+    leaf, hashable statics verbatim. Long static pytrees (the upload
+    unpack's nested column specs) fold into one hash so keys stay
+    small."""
+    from jax.tree_util import tree_leaves
+    parts: List[Any] = []
+    for leaf in tree_leaves((args, kwargs)):
+        shp = getattr(leaf, "shape", None)
+        if shp is not None:
+            dt = getattr(leaf, "dtype", None)
+            parts.append((_shape_bucket(shp),
+                          dt.name if dt is not None else None))
+        elif isinstance(leaf, (int, float, bool, str, bytes,
+                               type(None))):
+            parts.append(leaf)
+        else:
+            try:
+                parts.append(hash(leaf) & 0xFFFFFFFF)
+            except TypeError:
+                parts.append(type(leaf).__name__)
+    if len(parts) > 12:
+        parts = parts[:8] + [hash(tuple(parts[8:])) & 0xFFFFFFFF]
+    return tuple(parts)
+
+
+class _Pending:
+    """Per-call trace capture: the traced function body sets these when
+    jax actually traces (on a cache hit it never runs)."""
+
+    __slots__ = ("traced", "trace_ns", "donated", "retained", "depth")
+
+    def __init__(self):
+        self.traced = False
+        self.trace_ns = 0
+        self.donated = 0
+        self.retained = 0
+        #: nesting depth of instrumented bodies under this call — only
+        #: the outermost frame records time/bytes (an inner instrumented
+        #: program inlined into the outer trace is part of it)
+        self.depth = 0
+
+
+class ProgramStats:
+    """Cumulative ledger record of one compiled program key."""
+
+    # counters accumulate; donated/retained_bytes hold the LATEST
+    # trace's aval sizes (a shape property, not a running total)
+    __slots__ = ("label", "bucket", "platform", "dispatches", "traces",
+                 "cache_hits", "compile_ns", "trace_ns", "donated_bytes",
+                 "retained_bytes", "trace_times", "storms",
+                 "storm_open_until")
+
+    def __init__(self, label: str, bucket, platform: str):
+        self.label = label
+        self.bucket = bucket
+        self.platform = platform
+        self.dispatches = 0
+        self.traces = 0
+        self.cache_hits = 0
+        self.compile_ns = 0
+        self.trace_ns = 0
+        self.donated_bytes = 0
+        self.retained_bytes = 0
+        #: recent trace timestamps (ns) for the storm window
+        self.trace_times: deque = deque()
+        self.storms = 0
+        #: suppress repeat storm events until the window rolls past
+        self.storm_open_until = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"label": self.label, "bucket": list(self.bucket),
+                "platform": self.platform,
+                "dispatches": self.dispatches, "traces": self.traces,
+                "cache_hits": self.cache_hits,
+                "compile_ns": self.compile_ns,
+                "trace_ns": self.trace_ns,
+                "donated_bytes": self.donated_bytes,
+                "retained_bytes": self.retained_bytes,
+                "storms": self.storms}
+
+
+class DispatchLedger:
+    """Process-wide program registry. All mutation happens under one
+    leaf lock; events are buffered and emitted after it drops (the
+    lock-blocking-call contract)."""
+
+    def __init__(self, storm_traces: int = 8,
+                 storm_window_ms: int = 10_000):
+        self.storm_traces = max(1, int(storm_traces))
+        self.storm_window_ms = max(1, int(storm_window_ms))
+        self._lock = threading.Lock()
+        self._programs: Dict[Tuple, ProgramStats] = {}
+        self._dispatches = 0
+        self._traces = 0
+        self._cache_hits = 0
+        self._compile_ns = 0
+        self._trace_ns = 0
+        self._storms = 0
+
+    # -- the per-call accounting (InstrumentedJit.__call__ fast path) --
+    def dispatch(self, site: "InstrumentedJit", args, kwargs):
+        bucket = _args_bucket(args, kwargs)
+        key = (site.label, bucket, _platform())
+        # a bucket THIS site never traced before is a NEW program, not
+        # churn: ledger keys aggregate per label family, so distinct
+        # program sites (ExpandExec's per-projection jits, a fresh exec
+        # instance per collect) legitimately share a key — only a
+        # re-trace within ONE site's own jit cache is the shape-churn
+        # signal the storm detector (and the event's `first` flag)
+        # discriminate on
+        site_first = bucket not in site._seen_buckets
+        pend = _Pending()
+        _tls.pending = pend
+        t0 = time.perf_counter_ns()
+        try:
+            return site._jit(*args, **kwargs)
+        finally:
+            _tls.pending = None
+            if pend.traced and site_first:
+                site._seen_buckets.add(bucket)
+            self._account(site, key, pend, site_first,
+                          time.perf_counter_ns() - t0)
+
+    def _account(self, site, key, pend: _Pending, site_first: bool,
+                 wall_ns: int) -> None:
+        out_events = []
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is None:
+                prog = self._programs[key] = ProgramStats(*key)
+            prog.dispatches += 1
+            self._dispatches += 1
+            if pend.traced:
+                prog.traces += 1
+                prog.compile_ns += wall_ns
+                prog.trace_ns += pend.trace_ns
+                # arg bytes are a per-program-shape PROPERTY, not a
+                # counter: the latest trace's aval sizes (re-traces
+                # inside one bucket differ only marginally)
+                prog.donated_bytes = pend.donated
+                prog.retained_bytes = pend.retained
+                self._traces += 1
+                self._compile_ns += wall_ns
+                self._trace_ns += pend.trace_ns
+                out_events.append((
+                    "program_compile",
+                    dict(label=prog.label, bucket=list(prog.bucket),
+                         platform=prog.platform,
+                         compile_ns=wall_ns, trace_ns=pend.trace_ns,
+                         first=site_first, traces=prog.traces,
+                         donated_bytes=pend.donated,
+                         retained_bytes=pend.retained)))
+                if not site_first:
+                    storm = self._note_trace_locked(prog)
+                    if storm is not None:
+                        out_events.append(storm)
+            else:
+                prog.cache_hits += 1
+                self._cache_hits += 1
+        # metric attribution outside the lock: TpuMetric.add is a plain
+        # int accumulate on the dispatching thread
+        metrics = site._owner.metrics if site._owner is not None else None
+        if metrics is not None:
+            m = metrics.get(NUM_DISPATCHES)
+            if m is not None:
+                m.add(1)
+                if pend.traced:
+                    tm = metrics.get(COMPILE_TIME)
+                    if tm is not None:
+                        tm.add(wall_ns)
+        else:
+            sink = getattr(_tls, "sink", None)
+            if sink is not None:
+                sink[0].add(1)
+                if pend.traced and sink[1] is not None:
+                    sink[1].add(wall_ns)
+        if out_events:
+            from . import events as obs_events
+            if obs_events.active_bus() is not None:
+                for kind, fields in out_events:
+                    obs_events.emit(kind, **fields)
+
+    def _note_trace_locked(self, prog: ProgramStats):
+        """Caller holds self._lock. Slide the storm window; past the
+        conf'd trace count one `recompile_storm` fires and the key goes
+        quiet until the window rolls past (a storm is one incident, not
+        one event per churning batch)."""
+        now = time.monotonic_ns()
+        window_ns = self.storm_window_ms * 1_000_000
+        prog.trace_times.append(now)
+        while prog.trace_times and prog.trace_times[0] < now - window_ns:
+            prog.trace_times.popleft()
+        if len(prog.trace_times) < self.storm_traces \
+                or now < prog.storm_open_until:
+            return None
+        prog.storms += 1
+        self._storms += 1
+        prog.storm_open_until = now + window_ns
+        return ("recompile_storm",
+                dict(label=prog.label, bucket=list(prog.bucket),
+                     platform=prog.platform,
+                     traces_in_window=len(prog.trace_times),
+                     window_ms=self.storm_window_ms,
+                     threshold=self.storm_traces,
+                     compile_ns=prog.compile_ns))
+
+    # -- read surfaces ------------------------------------------------
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {"programs": len(self._programs),
+                    "dispatches": self._dispatches,
+                    "traces": self._traces,
+                    "cache_hits": self._cache_hits,
+                    "compile_ns": self._compile_ns,
+                    "trace_ns": self._trace_ns,
+                    "storms": self._storms}
+
+    def programs(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [p.to_dict() for p in self._programs.values()]
+
+
+_ledger: Optional[DispatchLedger] = DispatchLedger()
+_ledger_lock = threading.Lock()
+
+
+def active_ledger() -> Optional[DispatchLedger]:
+    """The process ledger, or None when disabled — instrumented sites
+    check this pointer once per dispatch (the entire off-mode cost)."""
+    return _ledger
+
+
+def configure(conf=None) -> Optional[DispatchLedger]:
+    """(Re)configure from a RapidsConf (None = the thread's active
+    conf). Like the event bus the ledger is PROCESS-wide; unlike it the
+    conf defaults ON, so a default session (re)creates the ledger and
+    only an explicit dispatch.ledger.enabled=false tears it down.
+    Storm thresholds are re-read here — never per dispatch."""
+    global _ledger
+    from ..config import (DISPATCH_LEDGER_ENABLED, DISPATCH_STORM_TRACES,
+                          DISPATCH_STORM_WINDOW_MS, active_conf)
+    conf = conf if conf is not None else active_conf()
+    enabled = conf.get(DISPATCH_LEDGER_ENABLED)
+    traces = conf.get(DISPATCH_STORM_TRACES)
+    window = conf.get(DISPATCH_STORM_WINDOW_MS)
+    with _ledger_lock:
+        if not enabled:
+            _ledger = None
+            return None
+        if _ledger is None:
+            _ledger = DispatchLedger(traces, window)
+        else:
+            _ledger.storm_traces = max(1, int(traces))
+            _ledger.storm_window_ms = max(1, int(window))
+        return _ledger
+
+
+def reset_dispatch_ledger() -> None:
+    """Fresh default-enabled ledger (test isolation)."""
+    global _ledger
+    with _ledger_lock:
+        _ledger = DispatchLedger()
+
+
+def counters() -> Dict[str, int]:
+    led = _ledger
+    if led is None:
+        return {"programs": 0, "dispatches": 0, "traces": 0,
+                "cache_hits": 0, "compile_ns": 0, "trace_ns": 0,
+                "storms": 0}
+    return led.counters()
+
+
+def programs() -> List[Dict[str, Any]]:
+    led = _ledger
+    return led.programs() if led is not None else []
+
+
+def health_section() -> Dict[str, Any]:
+    """`TpuSession.health()["dispatch"]`: enabled flag + the cumulative
+    counters + the worst compile-cost programs."""
+    led = _ledger
+    out: Dict[str, Any] = {"enabled": led is not None}
+    out.update(counters())
+    if led is not None:
+        progs = led.programs()
+        progs.sort(key=lambda p: -p["compile_ns"])
+        out["top_programs"] = progs[:5]
+    return out
+
+
+@contextmanager
+def metric_scope(num_metric, time_metric=None):
+    """Attribute module-level program dispatches inside the with-block
+    to an exec's (numDispatches, compileTimeNs) metric pair — the
+    upload/coalesce sites have no owning exec instance at definition
+    time (the upload.metric_sink shape). Owner-bound sites ignore the
+    sink."""
+    prev = getattr(_tls, "sink", None)
+    _tls.sink = (num_metric, time_metric)
+    try:
+        yield
+    finally:
+        _tls.sink = prev
+
+
+def _trace_state_clean() -> bool:
+    """Resolved once — the per-dispatch path must not pay import
+    machinery (jax is necessarily imported before any site is built)."""
+    global _trace_state_clean
+    import jax.core
+    _trace_state_clean = jax.core.trace_state_clean
+    return _trace_state_clean()
+
+
+class InstrumentedJit:
+    """`jax.jit` plus ledger accounting — the chokepoint wrapper.
+
+    Call-time behavior: with the ledger off, one pointer check then the
+    bare jitted call. Nested calls — an instrumented program traced
+    inline into another program's trace (the murmur3 kernels inside an
+    exec's update kernel), or an abstract evaluation like
+    `jax.eval_shape` — pass straight through: they are not device
+    dispatches, and counting them would double-book the outer trace."""
+
+    # __weakref__: jax.eval_shape weakly caches the callable it is
+    # given — an un-weakref-able wrapper would reject abstract eval
+    __slots__ = ("label", "_owner", "_jit", "_donate", "_seen_buckets",
+                 "__weakref__")
+
+    def __init__(self, fn, label: str, owner=None, **jit_kwargs):
+        import jax
+        self.label = label
+        #: owning exec instance (per-exec metric attribution + the
+        #: QueryProfile dispatch summary walk); None for module sites
+        self._owner = owner
+        donate = jit_kwargs.get("donate_argnums", ()) or ()
+        self._donate = tuple(donate) if isinstance(
+            donate, (tuple, list)) else (donate,)
+        #: arg-shape buckets THIS site has traced: discriminates a new
+        #: program (first trace of a bucket here) from shape churn (a
+        #: re-trace the site's own jit cache rejected)
+        self._seen_buckets = set()
+
+        @functools.wraps(fn)
+        def _traced(*a, **k):
+            pend = getattr(_tls, "pending", None)
+            if pend is None:
+                return fn(*a, **k)
+            pend.traced = True
+            pend.depth += 1
+            t0 = time.perf_counter_ns()
+            try:
+                out = fn(*a, **k)
+            finally:
+                pend.depth -= 1
+            if pend.depth == 0:
+                pend.trace_ns += time.perf_counter_ns() - t0
+                pend.donated, pend.retained = self._arg_bytes(a, k)
+            return out
+
+        self._jit = jax.jit(_traced, **jit_kwargs)
+        if owner is not None:
+            # per-exec site registry: QueryProfile._node records these
+            # labels so dispatch_summary() joins ledger programs to
+            # plan stages by EXACT label (subclass-safe)
+            owner.__dict__.setdefault("_dispatch_sites", []).append(self)
+
+    def _arg_bytes(self, args, kwargs) -> Tuple[int, int]:
+        """Donated vs retained bytes from the trace-time avals (shapes
+        are concrete there; no device data is touched)."""
+        from jax.tree_util import tree_leaves
+        donated = retained = 0
+        for i, a in enumerate(args):
+            total = 0
+            for leaf in tree_leaves(a):
+                shp = getattr(leaf, "shape", None)
+                dt = getattr(leaf, "dtype", None)
+                if shp is None or dt is None:
+                    continue
+                n = 1
+                for d in shp:
+                    n *= int(d)
+                total += n * dt.itemsize
+            if i in self._donate:
+                donated += total
+            else:
+                retained += total
+        for a in kwargs.values():
+            for leaf in tree_leaves(a):
+                shp = getattr(leaf, "shape", None)
+                dt = getattr(leaf, "dtype", None)
+                if shp is not None and dt is not None:
+                    n = 1
+                    for d in shp:
+                        n *= int(d)
+                    retained += n * dt.itemsize
+        return donated, retained
+
+    def __call__(self, *args, **kwargs):
+        led = _ledger
+        if led is None:
+            return self._jit(*args, **kwargs)
+        if getattr(_tls, "pending", None) is not None:
+            # nested under another instrumented dispatch's trace
+            return self._jit(*args, **kwargs)
+        if not _trace_state_clean():
+            # traced inline into an un-instrumented outer program, or
+            # abstractly evaluated (eval_shape) — not a device dispatch
+            return self._jit(*args, **kwargs)
+        return led.dispatch(self, args, kwargs)
+
+
+def instrument(fn=None, *, label: str, owner=None, **jit_kwargs):
+    """THE jit entry point: `instrument(fn, label=...)` replaces
+    `jax.jit(fn)` everywhere the engine compiles a program (the
+    dispatch-ledger contract rule holds every `jax.jit`/`pallas_call`
+    site in the package to this chokepoint or a justified suppression).
+    Usable as a decorator factory: `@instrument(label=...)`."""
+    if fn is None:
+        return lambda f: InstrumentedJit(f, label, owner=owner,
+                                         **jit_kwargs)
+    return InstrumentedJit(fn, label, owner=owner, **jit_kwargs)

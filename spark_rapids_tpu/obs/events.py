@@ -8,7 +8,8 @@ each line a self-describing record:
 
 Event kinds and their levels (spark.rapids.tpu.eventLog.level):
 
-  ESSENTIAL  query_start, query_end, query_cancelled, query_shed
+  ESSENTIAL  query_start, query_end, query_cancelled, query_shed,
+             recompile_storm
   MODERATE   op_close, semaphore_acquire, spill, oom_retry,
              pallas_tier, plan_fallback, plan_not_on_tpu, exchange,
              pipeline_wait, pipeline_full, op_error, fault_inject,
@@ -104,6 +105,15 @@ EVENT_LEVELS: Dict[str, int] = {
     # periodic exporter
     "exchange_stats": MODERATE,
     "telemetry_sample": MODERATE,
+    # dispatch/compile observability plane (ISSUE 13): one record per
+    # fresh program trace with its trace/compile cost and donated vs
+    # retained argument bytes (obs/dispatch.py); one per wired-exec
+    # execution with its dispatch/compile deltas (exec/base.py, the
+    # gather_stats shape); recompile_storm is headline — shape-bucket
+    # churn silently destroys TPU throughput
+    "program_compile": MODERATE,
+    "dispatch_stats": MODERATE,
+    "recompile_storm": ESSENTIAL,
     "op_open": DEBUG,
     "op_batch": DEBUG,
     "span": DEBUG,
@@ -158,8 +168,14 @@ class EventBus:
     def emit(self, kind: str, **fields: Any) -> None:
         if self._closed or EVENT_LEVELS.get(kind, MODERATE) > self.level:
             return
+        # `thread` (ISSUE 13 satellite): the emitting thread's name, so
+        # tools/trace_export.py assigns timeline tracks (consumer vs
+        # pipeline-* producers vs spill-writer vs decode-pool workers)
+        # without heuristics. Read only once the record is known kept —
+        # a disabled bus or filtered level pays nothing.
         rec = {"ts_ns": time.time_ns(), "kind": kind,
-               "query": current_query_id()}
+               "query": current_query_id(),
+               "thread": threading.current_thread().name}
         rec.update(fields)
         try:
             line = json.dumps(rec, separators=(",", ":"), default=str)

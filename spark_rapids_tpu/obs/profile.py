@@ -56,7 +56,7 @@ def _fmt_metric(name: str, value: int) -> str:
 
 
 def _node(op, level: int) -> Dict[str, Any]:
-    return {
+    node = {
         "op": type(op).__name__,
         "op_id": getattr(op, "_op_id", None),
         "desc": op.node_description(),
@@ -64,6 +64,14 @@ def _node(op, level: int) -> Dict[str, Any]:
                     if m.level <= level},
         "children": [_node(c, level) for c in op.children],
     }
+    # program labels of this exec's owner-bound dispatch sites (ISSUE
+    # 13): dispatch_summary() joins the ledger by EXACT label, so a
+    # subclass inheriting its parent's sites (TopNExec builds
+    # "SortExec.sort") still claims its programs
+    sites = getattr(op, "_dispatch_sites", None)
+    if sites:
+        node["dispatch_labels"] = sorted({s.label for s in sites})
+    return node
 
 
 class QueryProfile:
@@ -143,6 +151,83 @@ class QueryProfile:
 
         walk(self.tree, 0)
         return "\n".join(lines)
+
+    def dispatch_summary(self) -> Dict[str, Any]:
+        """THE whole-stage-compilation baseline table (ISSUE 13 /
+        ROADMAP 2): per plan stage, how many device programs exist, how
+        many dispatches the stage issued, and dispatches per output
+        batch — the per-operator interpretation overhead a stage
+        compiler must collapse to ~1/batch. Rows come from the wired
+        execs' numDispatches/compileTimeNs metrics (counted at call
+        time, so jit cache hits replay identical counts across
+        repeated collects); `programs` joins the process dispatch
+        ledger by the exec's own site labels. The `stages` rows are
+        per-query; `engine` rows (module-level program families the
+        plan tree cannot own: upload unpack, transfer pack, coalesce
+        concat) and `counters` are PROCESS-lifetime ledger totals —
+        the query-scoped share of those dispatches already lands in
+        the stage rows via dispatch.metric_scope, so never sum stages
+        with engine."""
+        from . import dispatch as obs_dispatch
+        by_label: Dict[str, List[Dict[str, Any]]] = {}
+        by_family: Dict[str, List[Dict[str, Any]]] = {}
+        for p in obs_dispatch.programs():
+            by_label.setdefault(p["label"], []).append(p)
+            by_family.setdefault(p["label"].split(".")[0], []).append(p)
+        stages: List[Dict[str, Any]] = []
+        seen = set()
+
+        def walk(node):
+            m = node["metrics"]
+            d = m.get("numDispatches")
+            if d is not None:
+                batches = m.get("numOutputBatches", 0)
+                # join by the exec's own site labels (recorded at
+                # profile build) — exact even when a subclass inherits
+                # its parent's program labels; fall back to the class-
+                # name family for metric-scope-attributed execs
+                labels = node.get("dispatch_labels")
+                if labels:
+                    progs = [p for lb in labels
+                             for p in by_label.get(lb, ())]
+                else:
+                    progs = by_family.get(node["op"], ())
+                for lb in labels or (node["op"],):
+                    seen.add(lb.split(".")[0])
+                stages.append({
+                    "op": node["op"], "op_id": node["op_id"],
+                    "dispatches": d, "batches": batches,
+                    "dispatches_per_batch": (round(d / batches, 4)
+                                             if batches else None),
+                    "compile_ns": m.get("compileTimeNs", 0),
+                    "programs": len(progs),
+                })
+            for c in node["children"]:
+                walk(c)
+
+        walk(self.tree)
+        # `engine`: module-level program families with no owning exec
+        # instance (closed set by construction; exec-owned families are
+        # excluded). These rows are PROCESS-lifetime ledger totals —
+        # reference info, not per-query attribution: the query-scoped
+        # share of these dispatches already lands in the stage rows
+        # above via dispatch.metric_scope (scan claims upload unpack,
+        # coalesce claims concat), so do NOT sum stages + engine.
+        module_families = {"upload", "transfer", "coalesce",
+                           "aggregate", "pallas", "distributed"}
+        engine = []
+        for fam, progs in sorted(by_family.items()):
+            if fam in seen or fam not in module_families:
+                continue
+            engine.append({
+                "scope": "process",
+                "family": fam, "programs": len(progs),
+                "dispatches": sum(p["dispatches"] for p in progs),
+                "compile_ns": sum(p["compile_ns"] for p in progs),
+                "cache_hits": sum(p["cache_hits"] for p in progs),
+            })
+        return {"stages": stages, "engine": engine,
+                "counters": obs_dispatch.counters()}
 
     def top_operators(self, n: int = 5,
                       by: str = "time") -> List[Dict[str, Any]]:

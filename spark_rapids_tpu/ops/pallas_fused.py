@@ -420,6 +420,9 @@ def fused_scan_agg_update(spec: ScanAggSpec, batch, G: int, out_cap: int,
             out_shapes.append(jax.ShapeDtypeStruct((G, 128), jnp.int32))
 
     nrows = jnp.asarray(batch.num_rows).astype(jnp.int32).reshape(1, 1)
+    # contract: ok dispatch-ledger — traced inline into the owning
+    # AggregateExec's instrumented streaming-step program (this function
+    # is only ever called inside an exec's jit trace)
     outs = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shapes),

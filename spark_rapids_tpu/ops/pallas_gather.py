@@ -40,6 +40,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.dispatch import instrument as _instrument
+
 #: rows of packed output per grid step (each row is one DMA)
 GATHER_TILE_ROWS = 256
 #: in-flight row copies per grid step (W distinct DMA semaphores;
@@ -94,7 +96,8 @@ def _gather_kernel_body(window: int, tile_rows: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(_instrument, label="pallas.gather",
+                   static_argnames=("interpret",))
 def dma_row_gather(mat: jnp.ndarray, idx: jnp.ndarray,
                    interpret: bool = False) -> jnp.ndarray:
     """out[i] = mat[idx[i]] by per-row DMA; the caller pre-sanitizes idx
@@ -119,6 +122,8 @@ def dma_row_gather(mat: jnp.ndarray, idx: jnp.ndarray,
     # arithmetic; the interpreter re-canonicalizes under the global mode
     ctx = contextlib.nullcontext() if interpret else enable_x64(False)
     with ctx:
+        # contract: ok dispatch-ledger — traced inline into the
+        # instrumented dma_row_gather program above
         out = pl.pallas_call(
             _gather_kernel_body(DMA_WINDOW, tr),
             out_shape=jax.ShapeDtypeStruct((rows, lanes), jnp.uint32),

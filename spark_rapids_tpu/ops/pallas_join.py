@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.dispatch import instrument as _instrument
+
 from .pallas_kernels import TILE_ROWS, pad_to_tiles, tile_spec, whole_spec
 
 # candidate tiles are smaller than the murmur3 tiles: the kernel keeps
@@ -109,7 +111,7 @@ def _probe_kernel_body(n_lanes: int):
     return kernel
 
 
-@functools.partial(jax.jit,
+@functools.partial(_instrument, label="pallas.join_probe",
                    static_argnames=("out_capacity", "interpret"))
 def fused_probe_verify(lo, counts, bk_lanes, bvalid, sk_lanes, svalid,
                        perm, out_capacity: int, interpret: bool = False):
@@ -155,6 +157,8 @@ def fused_probe_verify(lo, counts, bk_lanes, bvalid, sk_lanes, svalid,
         smem_spec = pl.BlockSpec(
             (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
         whole = whole_spec()
+        # contract: ok dispatch-ledger — this pallas_call is traced
+        # inline into the instrumented fused_probe_verify program above
         ver, s_idx, b_pos, b_row = pl.pallas_call(
             _probe_kernel_body(n_lanes),
             out_shape=(out_struct,) * 4,

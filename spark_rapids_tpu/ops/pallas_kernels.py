@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.dispatch import instrument as _instrument
+
 TILE_ROWS = 256  # (256, 128) int32 tile = 128 KiB VMEM per operand
 
 
@@ -116,7 +118,8 @@ _pad_to_tiles = pad_to_tiles
 _tile_spec = tile_spec
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(_instrument, label="pallas.murmur3_long",
+                   static_argnames=("interpret",))
 def murmur3_long_lanes(data_i64, seeds_u32, interpret: bool = False):
     """Per-row murmur3 update over int64 lanes; seeds/result uint32."""
     from jax.experimental import enable_x64
@@ -131,6 +134,8 @@ def murmur3_long_lanes(data_i64, seeds_u32, interpret: bool = False):
     # mosaic wants i32 grid/index arithmetic; the engine's global x64
     # mode would trace the index maps as i64 and fail legalization
     with enable_x64(False):
+        # contract: ok dispatch-ledger — traced inline into the
+        # instrumented murmur3_long_lanes program above
         out = pl.pallas_call(
             _two_word_kernel,
             out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.uint32),
@@ -142,7 +147,8 @@ def murmur3_long_lanes(data_i64, seeds_u32, interpret: bool = False):
     return out.reshape(-1)[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(_instrument, label="pallas.murmur3_int",
+                   static_argnames=("interpret",))
 def murmur3_int_lanes(data_i32, seeds_u32, interpret: bool = False):
     from jax.experimental import enable_x64
     from jax.experimental import pallas as pl
@@ -152,6 +158,8 @@ def murmur3_int_lanes(data_i32, seeds_u32, interpret: bool = False):
     seeds, _ = _pad_to_tiles(seeds_u32.astype(jnp.uint32))
     rows = w.shape[0]
     with enable_x64(False):
+        # contract: ok dispatch-ledger — traced inline into the
+        # instrumented murmur3_int_lanes program above
         out = pl.pallas_call(
             _one_word_kernel,
             out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.uint32),

@@ -131,7 +131,8 @@ def make_distributed_groupby(mesh: Mesh, key_count: int,
     mapped = shard_map_compat(spmd, mesh=mesh,
                               in_specs=P(axis_name),
                               out_specs=P(axis_name))
-    jitted = jax.jit(mapped)
+    from ..obs.dispatch import instrument
+    jitted = instrument(mapped, label="distributed.agg_exchange_step")
 
     def checked(stacked: ColumnarBatch) -> ColumnarBatch:
         # the fixed-width exchange codec TRUNCATES beyond string_width;

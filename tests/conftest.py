@@ -103,6 +103,20 @@ def _no_leaked_telemetry_state():
 
 
 @pytest.fixture(scope="module", autouse=True)
+def _dispatch_ledger_reset():
+    """Dispatch-plane hygiene (ISSUE 13): a module that disabled the
+    ledger (dispatch.ledger.enabled=false session) must not leave the
+    default-on plane dark for every later suite, and a module's
+    program records must not bleed into another's dispatch_summary
+    assertions — reset to a fresh default-enabled ledger at module
+    boundaries."""
+    from spark_rapids_tpu.obs import dispatch
+    dispatch.reset_dispatch_ledger()
+    yield
+    dispatch.reset_dispatch_ledger()
+
+
+@pytest.fixture(scope="module", autouse=True)
 def _no_leaked_lifecycle_state():
     """Lifecycle-governor hygiene (ISSUE 6, same pattern as the leaked
     fault plan): a breaker left open would silently demote a kernel

@@ -1,0 +1,17 @@
+"""Contract-analyzer fixture: the dispatch-ledger rule FIRES here —
+bare jit/pallas sites the observability plane cannot see (ISSUE 13)."""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def bare_jit(fn):
+    return jax.jit(fn)  # dispatch-ledger
+
+
+def bare_jit_decorator_arg(fn, partial):
+    return partial(jax.jit, static_argnums=(1,))(fn)  # dispatch-ledger
+
+
+def bare_pallas(kernel, out_shape):
+    return pl.pallas_call(kernel, out_shape=out_shape)  # dispatch-ledger

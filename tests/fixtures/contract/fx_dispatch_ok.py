@@ -1,0 +1,24 @@
+"""Contract-analyzer fixture twin: dispatch-ledger stays SILENT —
+chokepoint-routed programs are clean, accepted bare sites carry a
+justified suppression."""
+
+import jax
+from jax.experimental import pallas as pl
+
+from spark_rapids_tpu.obs.dispatch import instrument
+
+
+def routed(fn):
+    # the chokepoint itself: not flagged
+    return instrument(fn, label="fixture.routed")
+
+
+def inline_pallas(kernel, out_shape):
+    # contract: ok dispatch-ledger — fixture: traced inline into an
+    # instrumented enclosing program (not a separate device dispatch)
+    return pl.pallas_call(kernel, out_shape=out_shape)
+
+
+def accepted_bare(fn):
+    # contract: ok dispatch-ledger — fixture: measured elsewhere
+    return jax.jit(fn)

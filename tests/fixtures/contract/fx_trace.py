@@ -9,6 +9,8 @@ _OK_REF = jnp.sqrt    # bare attribute reference: NOT flagged
 _OK_NP = np.uint32(7)  # numpy scalar: NOT flagged
 
 
+# contract: ok dispatch-ledger — fixture: exercising the trace rules,
+# not the ledger chokepoint
 @jax.jit
 def traced(x):
     return np.asarray(x)  # trace-host-sync: materializes a tracer

@@ -9,6 +9,8 @@ import numpy as np
 _BAD = jnp.uint32(7)
 
 
+# contract: ok dispatch-ledger — fixture: exercising the trace rules,
+# not the ledger chokepoint
 @jax.jit
 def traced(x):
     # contract: ok trace-host-sync — fixture: x is statically concrete

@@ -111,6 +111,17 @@ def test_accounting_rule_shapes():
     # guarded (finally) and escrowed (registry-declared) stay clean
 
 
+def test_dispatch_rule_fires():
+    """ISSUE 13 satellite: every jax.jit / pallas_call site must route
+    through the dispatch-ledger chokepoint (obs.dispatch.instrument)
+    or carry a justified suppression — a bare site's dispatches and
+    compiles are invisible to the observability plane."""
+    rep = run_fixture("fx_dispatch.py")
+    assert rules_fired(rep) == ["dispatch-ledger"]
+    keys = sorted(f.key for f in rep.findings)
+    assert keys == ["jax.jit", "jax.jit", "pallas_call"], keys
+
+
 def test_registry_rules_fire():
     rep = run_fixture("fx_registry.py")
     assert rules_fired(rep) == ["conf-key-registered",
@@ -125,10 +136,11 @@ def test_registry_rules_fire():
 @pytest.mark.parametrize("fname,n_suppressed", [
     ("fx_locks_ok.py", 4),
     ("fx_threads_ok.py", 2),
-    ("fx_trace_ok.py", 3),
+    ("fx_trace_ok.py", 4),
     ("fx_conf_ok.py", 1),
     ("fx_accounting_ok.py", 2),
     ("fx_registry_ok.py", 2),
+    ("fx_dispatch_ok.py", 2),
 ])
 def test_suppressions_silence(fname, n_suppressed):
     rep = run_fixture(fname)
@@ -315,7 +327,8 @@ def test_every_rule_family_is_fixture_proven():
     this keeps a NEW rule from landing without a fixture)."""
     fired = set()
     for fname in ("fx_locks.py", "fx_threads.py", "fx_trace.py",
-                  "fx_conf.py", "fx_accounting.py", "fx_registry.py"):
+                  "fx_conf.py", "fx_accounting.py", "fx_registry.py",
+                  "fx_dispatch.py"):
         for f in run_fixture(fname).findings:
             fired.add(f.rule)
     non_meta = {rid for rid, m in reg_mod.RULES.items()

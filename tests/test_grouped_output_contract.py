@@ -98,6 +98,9 @@ def test_single_key_join_hint_and_sort_skip_correct():
     _check(agg, l, r, ["lk"])  # ...and is bit-correct
 
 
+# moved to the slow tier by ISSUE 13 budget relief (6s: hint-drop
+# variant; the join-hint + sort-skip contract single stays tier-1)
+@pytest.mark.slow
 def test_computed_alias_reusing_key_name_drops_hint():
     """project (lk + 1) AS lk: the output column named 'lk' is NOT the
     join key anymore — the hint must vanish and the aggregate must use

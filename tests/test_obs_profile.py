@@ -47,7 +47,7 @@ def test_text_renderer_golden():
     expected = """== TPU Query Profile ==
 task: semWaitTimeNs=1.0us retryCount=1 spilledDeviceBytes=2.0KB
 FilterExec[(col('x') > lit(1))]
-  + gatherTimeNs: 0ns, numGathers: 0, numOutputBatches: 1, numOutputRows: 2, opTime: 2.0ms
+  + compileTimeNs: 0ns, gatherTimeNs: 0ns, numDispatches: 0, numGathers: 0, numOutputBatches: 1, numOutputRows: 2, opTime: 2.0ms
   InMemoryScanExec
     + numOutputBatches: 1, numOutputRows: 3, opTime: 1.5us"""
     assert prof.text() == expected

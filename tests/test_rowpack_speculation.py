@@ -184,6 +184,9 @@ def test_prefix_tier_null_and_all_null_groups():
     assert got[3] == (None, 0)  # all-null group: NULL sum, count 0
 
 
+# moved to the slow tier by ISSUE 13 budget relief (4s: prefix-tier
+# single; the trip/decay/exact-rerun contracts stay tier-1)
+@pytest.mark.slow
 def test_prefix_tier_single_group_and_negatives():
     got = _sums([5] * 7, [-(2 ** 50), 2 ** 50, -1, 2, -3, 4, -5], LONG)
     assert got[5] == (-3, 7)

@@ -614,6 +614,10 @@ def _run_storm_query(settings, lane):
     return agg.sort(("rev", False)).collect()
 
 
+# moved to the slow tier by ISSUE 13 budget relief (91s: 8-lane storm
+# reconciliation; per-owner attribution equality stays tier-1 on the
+# single-query drive)
+@pytest.mark.slow
 def test_storm_hbm_attribution_reconciles(storm_files):
     """Acceptance criterion: 8 governed lanes under a forced-spill
     budget with telemetry ON — (a) active_queries() snapshots observed

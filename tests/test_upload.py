@@ -349,6 +349,10 @@ def _join_agg_query(sess, seed=0):
         (F.count(), "n")).sort("k")
 
 
+# moved to the slow tier by ISSUE 13 budget relief (47s: engine-level
+# on/off equality; byte-roundtrip property tests + the forced-spill
+# equality drive stay tier-1)
+@pytest.mark.slow
 def test_engine_scan_join_agg_on_off_equality(tmp_path):
     """Engine-level equality: parquet scan -> host-shuffled join ->
     agg -> sort returns identical rows with packedUpload on and off

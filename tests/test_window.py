@@ -100,6 +100,9 @@ def test_bounded_rows_frame_sum():
     assert a == [30, 60, 90, 70]
 
 
+# moved to the slow tier by ISSUE 13 budget relief (6s: overlaps the
+# bounded min/max frame tests kept tier-1)
+@pytest.mark.slow
 def test_running_min_max():
     spec = window(partition_by=["p"], order_by=["o"],
                   frame=WindowFrame.rows(None, 0))

@@ -103,7 +103,9 @@ def _run(op, prec, foll, ascending=True, keys=KEYS, vals=VALS,
 
 
 @pytest.mark.parametrize("op", [
-    "sum", "count",
+    "sum",
+    # count rides the same machinery (ISSUE 13 budget relief): nightly
+    pytest.param("count", marks=pytest.mark.slow),
     # min/max/avg ride the same range-frame machinery (~25s): nightly
     pytest.param("min", marks=pytest.mark.slow),
     pytest.param("max", marks=pytest.mark.slow),
@@ -115,7 +117,10 @@ def test_range_bounded_ops(op):
 
 @pytest.mark.parametrize("prec,foll", [
     (0, 0),        # CURRENT ROW..CURRENT ROW with ties
-    (None, 2),     # UNBOUNDED PRECEDING..2 FOLLOWING
+    # ISSUE 13 budget relief: the bounded shapes (0,0)/(1,1) and the
+    # effectively-unbounded (1e12,1e12) stay tier-1; the rest of the
+    # mixed-bound lattice is nightly
+    pytest.param(None, 2, marks=pytest.mark.slow),
     pytest.param(2, None, marks=pytest.mark.slow),  # 2 PREC..UNB FOLL
     pytest.param(5, 0, marks=pytest.mark.slow),
     pytest.param(0, 5, marks=pytest.mark.slow),

@@ -660,6 +660,10 @@ STORM = dict(WL, **{
 })
 
 
+# moved to the slow tier by ISSUE 13 budget relief (92s: the 8-lane
+# storm acceptance; fairness/quota/shed contracts stay tier-1 as units
+# and the queueDepth-exceeded drive)
+@pytest.mark.slow
 def test_eight_concurrent_queries_match_single_threaded_oracle(
         spy, storm_files):
     """Acceptance criterion: 8 queries from 8 threads under a

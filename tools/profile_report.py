@@ -106,6 +106,55 @@ def _worst_skew(xstats: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     return max(xstats, key=lambda e: e.get("skew_ratio") or 0, default=None)
 
 
+def _dispatch_rollup(compiles: List[Dict[str, Any]],
+                     storms: List[Dict[str, Any]],
+                     dstats: List[Dict[str, Any]],
+                     top: int) -> Dict[str, Any]:
+    """The `dispatch` section of build_summary: program_compile /
+    recompile_storm / dispatch_stats events aggregated by program label
+    and by operator."""
+    by_label: Dict[str, Dict[str, Any]] = {}
+    for e in compiles:
+        lab = e.get("label") or "?"
+        agg = by_label.setdefault(lab, {"label": lab, "compiles": 0,
+                                        "programs": 0, "compile_ns": 0,
+                                        "trace_ns": 0})
+        agg["compiles"] += 1
+        agg["programs"] += 1 if e.get("first") else 0
+        agg["compile_ns"] += e.get("compile_ns") or 0
+        agg["trace_ns"] += e.get("trace_ns") or 0
+    top_compile = sorted(by_label.values(),
+                         key=lambda r: -r["compile_ns"])[:top]
+    by_op: Dict[Any, Dict[str, Any]] = {}
+    for e in dstats:
+        key = (e.get("op"), e.get("op_id"))
+        agg = by_op.setdefault(key, {"op": e.get("op"),
+                                     "op_id": e.get("op_id"),
+                                     "dispatches": 0, "batches": 0,
+                                     "compile_ns": 0})
+        agg["dispatches"] += e.get("dispatches") or 0
+        agg["batches"] += e.get("batches") or 0
+        agg["compile_ns"] += e.get("compile_ns") or 0
+    for r in by_op.values():
+        r["dispatches_per_batch"] = (
+            round(r["dispatches"] / r["batches"], 4)
+            if r["batches"] else None)
+    top_rate = sorted(
+        by_op.values(),
+        key=lambda r: -(r["dispatches_per_batch"] or 0))[:top]
+    return {
+        "programs_compiled": len(compiles),
+        "compile_ns": sum(e.get("compile_ns") or 0 for e in compiles),
+        "trace_ns": sum(e.get("trace_ns") or 0 for e in compiles),
+        "top_by_compile_ns": top_compile,
+        "top_by_dispatches_per_batch": top_rate,
+        "storms": [{"label": e.get("label"),
+                    "bucket": e.get("bucket"),
+                    "traces_in_window": e.get("traces_in_window"),
+                    "window_ms": e.get("window_ms")} for e in storms],
+    }
+
+
 def build_summary(events: List[Dict[str, Any]], top: int = 10,
                   query: Optional[int] = None) -> Dict[str, Any]:
     """THE report data: every roll-up the text renderer prints, as one
@@ -156,6 +205,9 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
                 out[k] = out.get(k, 0) + 1
         return out
 
+    compiles = [e for e in events if e.get("kind") == "program_compile"]
+    storms = [e for e in events if e.get("kind") == "recompile_storm"]
+    dstats = [e for e in events if e.get("kind") == "dispatch_stats"]
     writes = [e for e in events if e.get("kind") == "shuffle_write"]
     tiers = [e for e in events if e.get("kind") == "pallas_tier"]
     gstats = [e for e in events if e.get("kind") == "gather_stats"]
@@ -211,6 +263,13 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
             "max_wait_ms": max(waits) if waits else 0,
             "sheds": by("query_shed", "reason"),
             "quota_spills": count("quota_spill")},
+        # dispatch/compile roll-up (ISSUE 13): what the per-operator
+        # program model costs — how many programs compiled, which
+        # labels paid the most compile wall-clock, which stages issue
+        # the most dispatches per batch (the whole-stage-compilation
+        # baseline), and any recompile storms. Logs from builds without
+        # the dispatch plane simply report zeros/empty lists.
+        "dispatch": _dispatch_rollup(compiles, storms, dstats, top),
         "pallas_tier": {"decisions": len(tiers),
                         "engaged": sum(1 for e in tiers
                                        if e.get("engaged"))},
@@ -367,6 +426,34 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
                       f"{rob['integrity_quarantines']}")
     if rob["watchdog_trips"]:
         extras.append(f"watchdog trips: {rob['watchdog_trips']}")
+    # dispatch/compile roll-up (ISSUE 13): compile spend by program
+    # label and the per-stage dispatch rate the whole-stage-compilation
+    # work must collapse; absent entirely for pre-dispatch-plane logs
+    dp = s["dispatch"]
+    if dp["programs_compiled"]:
+        extras.append(
+            f"program compiles: {dp['programs_compiled']} "
+            f"(compile {_fmt_ns(dp['compile_ns'])}, trace "
+            f"{_fmt_ns(dp['trace_ns'])})")
+        worst = dp["top_by_compile_ns"][:3]
+        if worst:
+            detail = ", ".join(
+                f"{r['label']}:{_fmt_ns(r['compile_ns'])}"
+                for r in worst)
+            extras.append(f"  top compile cost: {detail}")
+    rate = [r for r in dp["top_by_dispatches_per_batch"]
+            if r["dispatches_per_batch"]][:3]
+    if rate:
+        detail = ", ".join(
+            f"{r['op']}#{r['op_id']}:{r['dispatches_per_batch']}"
+            for r in rate)
+        extras.append(f"dispatches/batch (top stages): {detail}")
+    if dp["storms"]:
+        detail = ", ".join(
+            f"{r['label']}({r['traces_in_window']} traces/"
+            f"{r['window_ms']}ms)" for r in dp["storms"][:3])
+        extras.append(f"RECOMPILE STORMS: {len(dp['storms'])} "
+                      f"({detail})")
     pt = s["pallas_tier"]
     if pt["decisions"]:
         extras.append(f"pallas tier decisions: {pt['decisions']} "
