@@ -92,6 +92,64 @@ def maybe_query_timeout(argv=None):
     return ms
 
 
+#: `bench.py --stage-fusion on|off` (ISSUE 14): A/B the whole-stage
+#: compiler on the handmade lane plans. Default (None) follows the
+#: conf (stage.fusion.enabled, default on).
+_STAGE_FUSION = None
+
+
+def maybe_stage_fusion(argv=None):
+    """Parse `--stage-fusion on|off`. Bad argv emits the usage-error
+    JSON convention and exits 2 — never a traceback."""
+    global _STAGE_FUSION
+    argv = sys.argv if argv is None else argv
+    if "--stage-fusion" not in argv:
+        return None
+    idx = argv.index("--stage-fusion")
+    try:
+        mode = argv[idx + 1]
+        assert mode in ("on", "off")
+    except (IndexError, AssertionError):
+        print(json.dumps({"error_kind": "usage",
+                          "error": "--stage-fusion requires 'on' or "
+                                   "'off'"}))
+        raise SystemExit(2)
+    _STAGE_FUSION = mode == "on"
+    from spark_rapids_tpu.config import (RapidsConf, active_conf,
+                                         set_active_conf)
+    settings = dict(active_conf()._settings)
+    settings["spark.rapids.tpu.stage.fusion.enabled"] = str(
+        _STAGE_FUSION).lower()
+    set_active_conf(RapidsConf(settings))
+    return _STAGE_FUSION
+
+
+def compile_lane_plan(plan):
+    """Route a handmade lane's exec tree through the stage planner
+    (ISSUE 14) — the same rewrite DataFrame._exec applies to planner-
+    built trees; a no-op with fusion off, so `--stage-fusion off` is
+    the per-operator baseline."""
+    from spark_rapids_tpu.exec.stage_compiler import compile_stages
+    return compile_stages(plan)
+
+
+def stage_attribution():
+    """{"stage": ...} block for each BENCH record (ISSUE 14): stages
+    fused, operators absorbed, fused-stage program dispatches and
+    plan-fingerprint program-cache hits this lane generated
+    (exec/stage_compiler.py + obs/dispatch.py counters, as deltas
+    since the previous record; the _delta_since pattern). All zeros
+    with --stage-fusion off — a round reads dispatches next to the
+    q1/q3 throughput to see the per-operator overhead collapse."""
+    from spark_rapids_tpu.exec import stage_compiler
+    cur = stage_compiler.counters()
+    return _delta_since("stage", {
+        "stages_fused": cur["stages_fused"],
+        "ops_fused": cur["ops_fused"],
+        "dispatches": cur["dispatches"],
+        "cache_hits": cur["cache_hits"]})
+
+
 #: `bench.py --concurrency N` (ISSUE 7): drive each lane from N
 #: threads, every iteration admitted through the workload governor —
 #: the nightly proof that fair admission + per-query quotas compose
@@ -562,11 +620,14 @@ def main():
             col("returnflag"), col("quantity"),
             (col("extendedprice") * (lit(1.0) - col("discount")))
             .alias("disc_price")], filt)
-        return AggregateExec(
+        agg = AggregateExec(
             [col("returnflag")],
             [(Sum(col("quantity")), "sum_qty"),
              (Sum(col("disc_price")), "sum_disc"),
              (Count(), "cnt")], proj)
+        # ISSUE 14: the scan->filter->project->agg chain compiles to
+        # one fused stage (a no-op under --stage-fusion off)
+        return compile_lane_plan(agg)
 
     from spark_rapids_tpu.exec.speculation import speculation_scope
     from spark_rapids_tpu.exec.task_metrics import query_snapshot
@@ -654,6 +715,7 @@ def main():
         "shuffle": shuffle_attribution(),
         "upload": upload_attribution(),
         "dispatch": dispatch_attribution(),
+        "stage": stage_attribution(),
         "telemetry": telemetry_attribution(),
         "statistics": statistics_attribution(),
     }
@@ -749,7 +811,10 @@ def q3_bench():
         # iteration); the scope below exists for the JOIN's speculative
         # candidate sizing
         agg._spec_enabled = False
-        return TopNExec(10, [(col("revenue"), False)], agg)
+        # ISSUE 14: filter->probe->project->partial-agg fuses to one
+        # program per stream batch (no-op under --stage-fusion off)
+        return compile_lane_plan(TopNExec(10, [(col("revenue"), False)],
+                                          agg))
 
     from spark_rapids_tpu.exec.speculation import speculation_scope
     from spark_rapids_tpu.exec.task_metrics import query_snapshot
@@ -826,6 +891,7 @@ def q3_bench():
         "shuffle": shuffle_attribution(),
         "upload": upload_attribution(),
         "dispatch": dispatch_attribution(),
+        "stage": stage_attribution(),
         "telemetry": telemetry_attribution(),
         "statistics": statistics_attribution(),
     }
@@ -841,5 +907,6 @@ if __name__ == "__main__":
     maybe_enable_faults()
     maybe_query_timeout()
     maybe_concurrency()
+    maybe_stage_fusion()
     main()
     q3_bench()

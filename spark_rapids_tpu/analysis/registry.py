@@ -156,8 +156,8 @@ class RuleMeta:
 
 def _build_rules() -> Dict[str, RuleMeta]:
     from . import (rules_accounting, rules_conf, rules_dispatch,
-                   rules_locks, rules_registry, rules_threads,
-                   rules_trace)
+                   rules_locks, rules_registry, rules_stage,
+                   rules_threads, rules_trace)
     rules = [
         RuleMeta(
             "lock-blocking-call", "lock-discipline",
@@ -250,6 +250,18 @@ def _build_rules() -> Dict[str, RuleMeta]:
             "ISSUE 13 (dispatch & compile observability plane)",
             "self._jit = jax.jit(self._kernel) in an exec",
             rules_dispatch.check),
+        RuleMeta(
+            "stage-governance", "dispatch-discipline",
+            "per-batch governance hook (lifecycle tick, chaos fault "
+            "point, metric timer, event emit, gather observe, breaker "
+            "engagement) inside a traced stage body handed to the "
+            "dispatch chokepoint — it runs once per TRACE, not per "
+            "batch, so it is silently dead under jit caching; hooks "
+            "belong in the stage-boundary harness",
+            "ISSUE 14 (whole-stage compilation: governance extracted "
+            "to the stage boundary)",
+            "faults.check(...) inside a fn passed to instrument()",
+            rules_stage.check),
         RuleMeta(
             "suppression-empty", "analyzer-meta",
             "a `# contract: ok` suppression with no justification, or "

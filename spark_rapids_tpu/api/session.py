@@ -40,6 +40,7 @@ class TpuSession:
         SPMD stages over the device mesh (exec/exchange.py). Default: the
         single-partition plan (no exchange nodes)."""
         from .. import faults
+        from ..columnar import upload
         from ..obs import dispatch as obs_dispatch
         from ..obs import events as obs_events
         from ..obs import telemetry
@@ -50,6 +51,10 @@ class TpuSession:
         telemetry.configure(self.conf)
         obs_dispatch.configure(self.conf)
         faults.configure(self.conf)
+        # pre-size the upload staging pool's bucket ladder from
+        # batchSizeBytes (ISSUE 14 satellite): steady-state scans hit
+        # zero grow-on-miss staging allocations
+        upload.configure(self.conf)
         if mesh is None and mesh_devices is not None:
             mesh = device_mesh(mesh_devices)
         self.mesh = mesh
@@ -392,6 +397,7 @@ class DataFrame:
     # -- actions -----------------------------------------------------------
     def _exec(self):
         from .. import faults
+        from ..columnar import upload
         from ..obs import dispatch as obs_dispatch
         from ..obs import events as obs_events
         from ..obs import telemetry
@@ -402,6 +408,7 @@ class DataFrame:
         telemetry.configure(self.session.conf)
         obs_dispatch.configure(self.session.conf)
         faults.configure(self.session.conf)
+        upload.configure(self.session.conf)
         return TpuOverrides(self.session.conf).apply(self._plan)
 
     def collect(self) -> List[tuple]:

@@ -222,9 +222,43 @@ class StagingPool:
                     "hits": self.hits, "misses": self.misses,
                     "trims": self.trims}
 
+    def presize(self, target_bytes: int, pool_cap: int) -> int:
+        """Pre-populate one idle buffer per power-of-two bucket from
+        256B up to the bucket of `target_bytes` (ISSUE 14 satellite —
+        the PR 10 recorded TODO): steady-state scans pack batches at or
+        under batchSizeBytes, so with the ladder pre-sized their
+        acquires are all HITS and the miss counter stays at zero
+        (asserted in tests/test_upload.py). Cumulative pre-sized bytes
+        respect `pool_cap` (the poolBytes conf) — a 1GiB default
+        batch-size target under the 256MiB default pool cap pre-sizes
+        the ladder up to the cap, never past it. np.empty buffers are
+        lazily paged, so an unused rung costs address space, not RSS.
+        Idempotent per bucket: rungs that already have an idle or
+        in-flight buffer are skipped. Returns bytes pre-allocated."""
+        top = _byte_bucket(max(int(target_bytes), 256))
+        added = 0
+        bucket = 256
+        while bucket <= top:
+            with self._lock:
+                have = bool(self._free.get(bucket))
+                room = self._pooled + bucket <= pool_cap
+            if not have and room:
+                buf = np.empty(bucket, np.uint8)
+                with self._lock:
+                    self._tick += 1
+                    self._free.setdefault(bucket, []).append(
+                        (self._tick, buf))
+                    self._pooled += bucket
+                added += bucket
+            bucket <<= 1
+        return added
+
 
 _POOL: Optional[StagingPool] = None
 _POOL_LOCK = threading.Lock()
+#: (target, cap) the process pool was last pre-sized for — configure()
+#: re-presizes only when the sizing inputs actually changed
+_PRESIZED_FOR: Optional[Tuple[int, int]] = None
 
 
 def staging_pool() -> StagingPool:
@@ -237,10 +271,35 @@ def staging_pool() -> StagingPool:
 
 
 def reset_staging_pool() -> StagingPool:
-    global _POOL
+    global _POOL, _PRESIZED_FOR
     with _POOL_LOCK:
         _POOL = StagingPool()
+        _PRESIZED_FOR = None
     return _POOL
+
+
+def configure(conf=None) -> None:
+    """Session-configure hook (ISSUE 14 satellite): pre-size the
+    staging pool's bucket ladder from spark.rapids.sql.batchSizeBytes
+    so steady-state scan uploads hit pre-allocated buffers instead of
+    growing on miss. Cheap and idempotent per (batchSizeBytes,
+    poolBytes) pair; packedUpload.poolBytes=0 (pooling off) skips."""
+    global _PRESIZED_FOR
+    from ..config import (BATCH_SIZE_BYTES, UPLOAD_PACKED,
+                          UPLOAD_POOL_BYTES, active_conf)
+    conf = conf if conf is not None else active_conf()
+    if not conf.get(UPLOAD_PACKED):
+        return
+    cap = max(int(conf.get(UPLOAD_POOL_BYTES)), 0)
+    if cap <= 0:
+        return
+    target = int(conf.get(BATCH_SIZE_BYTES))
+    key = (target, cap)
+    with _POOL_LOCK:
+        if _PRESIZED_FOR == key:
+            return
+        _PRESIZED_FOR = key
+    staging_pool().presize(target, cap)
 
 
 #: cpu-family backends can make device_put a zero-copy ALIAS of the

@@ -656,6 +656,38 @@ FUSION_ENABLED = conf_bool(
     "instead of one per operator; filters become reduction masks instead "
     "of gathers.", commonly_used=True)
 
+STAGE_FUSION_ENABLED = conf_bool(
+    "spark.rapids.tpu.stage.fusion.enabled", True,
+    "Whole-stage compilation (exec/stage_compiler.py): after plan "
+    "conversion a stage planner walks the TpuExec tree and groups "
+    "maximal chains of whitelisted operators (filter -> project -> "
+    "expand -> inner-join probe -> partial/complete masked aggregate) "
+    "into CompiledStageExec nodes whose per-batch body is ONE "
+    "dispatch-ledger-routed jitted program with buffer donation "
+    "(carried aggregate state reuses HBM in place), per-batch "
+    "governance hooks (cancellation, chaos fault points, dispatch "
+    "metrics, breaker engagement) at the stage boundary, and program "
+    "sites drawn from the plan-fingerprint program cache so a reused "
+    "plan's second collect() is all jit cache hits. Non-whitelisted "
+    "operators (exchanges, sorts, UDFs, windows) break the stage and "
+    "keep their per-operator execs. An open device_dispatch / "
+    "pallas_fused circuit breaker demotes a stage back to per-operator "
+    "execution. Off: the converted tree runs unchanged and exec "
+    "program sites stay per-instance — CPU results are identical "
+    "either way (tier-1 asserted).", commonly_used=True)
+
+STAGE_PROGRAM_CACHE_ENTRIES = conf_int(
+    "spark.rapids.tpu.stage.programCache.maxSites", 512,
+    "Upper bound on program sites the process-wide plan-fingerprint "
+    "program cache retains (obs/dispatch.py). Each entry keys one "
+    "(site label x canonical plan-subtree fingerprint) to its compiled "
+    "program wrapper, so rebuilding the exec tree for an identical "
+    "plan — every DataFrame.collect() does — reuses the already-traced "
+    "programs instead of recompiling the whole plan. Past the bound "
+    "the least recently used site is evicted (its programs recompile "
+    "on next use). 0 disables the cache (every exec instance traces "
+    "fresh programs, the pre-stage-fusion behavior).")
+
 AGG_SPECULATIVE = conf_bool(
     "spark.rapids.tpu.agg.speculative.enabled", True,
     "Speculative masked-bucket aggregation: emit small partials plus a "

@@ -105,37 +105,6 @@ class AggregateExec(TpuExec):
         self._fused_steps: list = []
         self._source: TpuExec = child
 
-        # compiled kernels (cache keyed by capacity bucket + string words)
-        self._jit_update = instrument(self._update_batch,
-                                      label="AggregateExec.update",
-                                      owner=self, static_argnums=(1,))
-        self._jit_merge = instrument(self._merge_batch,
-                                     label="AggregateExec.merge",
-                                     owner=self, static_argnums=(1,))
-        # hash-path tiers: cheap 2-round first, 6-round escalation for
-        # mid-cardinality, exact sort as the last resort
-        self._jit_update_hash = {
-            r: instrument(partial(self._update_batch, hash_path=True,
-                                  hash_rounds=r),
-                          label="AggregateExec.update_hash", owner=self)
-            for r in (2, 6)}
-        self._jit_merge_hash = {
-            r: instrument(partial(self._merge_batch, hash_path=True,
-                                  hash_rounds=r),
-                          label="AggregateExec.merge_hash", owner=self)
-            for r in (2, 6)}
-        # sync-free exact merge: masked buckets + in-program sort fallback
-        self._jit_merge_auto = instrument(
-            partial(self._merge_batch, auto_path=True),
-            label="AggregateExec.merge_auto", owner=self)
-        self._jit_pre = instrument(self._pre_project,
-                                   label="AggregateExec.pre_project",
-                                   owner=self)
-        self._jit_concat_merge = instrument(
-            self._concat_merge_pair,
-            label="AggregateExec.concat_merge", owner=self,
-            static_argnums=(2,))
-
         if mode == "final":
             # input is keys+buffers produced by a partial instance; the
             # planner's input_types hint restores original result types
@@ -174,15 +143,6 @@ class AggregateExec(TpuExec):
             self._fused_steps = list(reversed(steps))
             self._source = node
 
-        # streaming speculative kernel: fused steps + masked-bucket update
-        # + fold into the O(1) device state — ONE program per source batch
-        self._jit_step_spec = instrument(
-            self._streaming_step,
-            label="AggregateExec.streaming_step", owner=self)
-        self._jit_step_exact = instrument(
-            self._fused_update_exact,
-            label="AggregateExec.fused_update_exact", owner=self)
-
         # fused Pallas tier (ISSUE 1): compile the absorbed operator
         # chain for the one-kernel scan-filter-project-partial-aggregate
         # when every expression is in the whitelisted elementwise subset;
@@ -208,10 +168,81 @@ class AggregateExec(TpuExec):
         # groups rows by this aggregate's keys — e.g. the inner join's
         # key-grouped emission — the exact tier skips its batch sort
         self._pre_grouped = mode != "final" and self._input_pre_grouped()
-        self._jit_evaluate = instrument(self._evaluate,
-                                        label="AggregateExec.evaluate",
-                                        owner=self)
         self._initial_state_cache = None
+
+        # program sites, built LAST (ISSUE 14): the plan fingerprint
+        # the site cache keys on must see the final semantic fields
+        # (fused steps, pallas spec, pre-grouped contract) — a site
+        # built earlier would fingerprint a half-constructed node.
+        # Compiled-kernel jit caches key on capacity bucket + string
+        # words; the site cache keys whole instances across collects.
+        self._jit_update = self._site(self._update_batch,
+                                      label="AggregateExec.update",
+                                      static_argnums=(1,))
+        self._jit_merge = self._site(self._merge_batch,
+                                     label="AggregateExec.merge",
+                                     static_argnums=(1,))
+        # hash-path tiers: cheap 2-round first, 6-round escalation for
+        # mid-cardinality, exact sort as the last resort
+        self._jit_update_hash = {
+            r: self._site(partial(self._update_batch, hash_path=True,
+                                  hash_rounds=r),
+                          label="AggregateExec.update_hash", key_salt=r)
+            for r in (2, 6)}
+        self._jit_merge_hash = {
+            r: self._site(partial(self._merge_batch, hash_path=True,
+                                  hash_rounds=r),
+                          label="AggregateExec.merge_hash", key_salt=r)
+            for r in (2, 6)}
+        # sync-free exact merge: masked buckets + in-program sort fallback
+        self._jit_merge_auto = self._site(
+            partial(self._merge_batch, auto_path=True),
+            label="AggregateExec.merge_auto")
+        self._jit_pre = self._site(self._pre_project,
+                                   label="AggregateExec.pre_project")
+        self._jit_concat_merge = self._site(
+            self._concat_merge_pair,
+            label="AggregateExec.concat_merge", static_argnums=(2,))
+        # streaming speculative kernel: fused steps + masked-bucket update
+        # + fold into the O(1) device state — ONE program per source batch
+        self._jit_step_spec = self._site(
+            self._streaming_step,
+            label="AggregateExec.streaming_step")
+        self._jit_step_exact = self._site(
+            self._fused_update_exact,
+            label="AggregateExec.fused_update_exact")
+        self._jit_evaluate = self._site(self._evaluate,
+                                        label="AggregateExec.evaluate")
+
+    def _fingerprint_extras(self):
+        # semantic_key throughout, NOT repr: repr is display-only and
+        # omits non-child parameters (a percentile's percentage, a
+        # first()'s ignore_nulls) — a lossy key hands one aggregate
+        # another's compiled programs (caught live)
+        from .stage_compiler import schema_sig
+        exprs = list(self.group_exprs) + [
+            e for fn, _ in self.aggregates for e in fn.inputs]
+        for s in self._fused_steps:
+            exprs.extend(s[1] if s[0] == "project" else [s[1]])
+        if not all(e.deterministic for e in exprs):
+            return None  # see ProjectExec._fingerprint_extras
+
+        def step_key(s):
+            if s[0] == "filter":
+                return ("filter", s[1].semantic_key())
+            return ("project",
+                    tuple(b.semantic_key() for b in s[1]),
+                    schema_sig(s[2]))
+
+        return (self.mode,
+                tuple(e.semantic_key() for e in self.group_exprs),
+                tuple((fn.semantic_key(), name)
+                      for fn, name in self.aggregates),
+                repr(self._final_input_types),
+                self._slots, self._rounds, self._spec_enabled,
+                self._fusion_enabled,
+                tuple(step_key(s) for s in self._fused_steps),
+                self._pallas_agg_spec is not None, self._pre_grouped)
 
     def _input_pre_grouped(self) -> bool:
         from ..expr.core import UnresolvedAttribute
@@ -590,6 +621,46 @@ class AggregateExec(TpuExec):
             yield evaluated if evaluated is not None \
                 else self._jit_evaluate(state)
 
+    def _absorb_partial(self, aggregated: List[SpillableBatch],
+                        out: ColumnarBatch) -> None:
+        """Partial-accumulation discipline shared by the per-op exact
+        drive and the fused stage's exact flavor (ISSUE 14): eager
+        shrink of big partials past SHRINK_THRESHOLD_CAP, then
+        MERGE_FAN_IN windowing so live partials stay BOUNDED — a
+        forced-spill budget survives an arbitrarily long stream."""
+        if (out.capacity >= self.SHRINK_THRESHOLD_CAP
+                and aggregated):
+            # the FIRST partial is held unshrunken: for the
+            # (common) single-batch pipeline the shrink's
+            # d2h sync (~100 ms on the tunnel) buys nothing
+            # — one full-size partial costs what the input
+            # batch already cost, and it is spillable
+            # big-batch partials keep the input capacity
+            # (groups are usually few): pay ONE host sync
+            # to shrink rather than hold MERGE_FAN_IN
+            # full-size partials in HBM
+            from ..columnar.column import bucket_capacity
+            rows = out.num_rows_host
+            small = bucket_capacity(max(rows, 1))
+            if small < out.capacity:
+                shrunk = _shrink_batch(out, small)
+                out = ColumnarBatch(shrunk.columns, rows,
+                                    out.schema)
+        aggregated.append(SpillableBatch.from_batch(out))
+        if len(aggregated) >= self.MERGE_FAN_IN:
+            # bound live partials: merge the window device-side,
+            # then ONE host sync shrinks the result into a tight
+            # bucket (amortized over MERGE_FAN_IN batches).
+            merged = self._merge_all(list(aggregated))
+            from ..columnar.column import bucket_capacity
+            rows = merged.num_rows_host
+            small_cap = bucket_capacity(max(rows, 1))
+            if small_cap < merged.capacity:
+                shrunk = _shrink_batch(merged, small_cap)
+                merged = ColumnarBatch(shrunk.columns, rows,
+                                       merged.schema)
+            aggregated[:] = [SpillableBatch.from_batch(merged)]
+
     def _execute_exact(self) -> Iterator[ColumnarBatch]:
         agg_time = self.metrics[AGG_TIME]
         in_rows = self.metrics[NUM_INPUT_ROWS]
@@ -610,40 +681,9 @@ class AggregateExec(TpuExec):
                     for out in with_retry(spillable,
                                           self._spill_wrap(first_pass),
                                           split_policy=split_in_half_by_rows):
-                        if (out.capacity >= self.SHRINK_THRESHOLD_CAP
-                                and aggregated):
-                            # the FIRST partial is held unshrunken: for the
-                            # (common) single-batch pipeline the shrink's
-                            # d2h sync (~100 ms on the tunnel) buys nothing
-                            # — one full-size partial costs what the input
-                            # batch already cost, and it is spillable
-                            # big-batch partials keep the input capacity
-                            # (groups are usually few): pay ONE host sync
-                            # to shrink rather than hold MERGE_FAN_IN
-                            # full-size partials in HBM
-                            from ..columnar.column import bucket_capacity
-                            rows = out.num_rows_host
-                            small = bucket_capacity(max(rows, 1))
-                            if small < out.capacity:
-                                shrunk = _shrink_batch(out, small)
-                                out = ColumnarBatch(shrunk.columns, rows,
-                                                    out.schema)
-                        aggregated.append(SpillableBatch.from_batch(out))
+                        self._absorb_partial(aggregated, out)
                 finally:
                     spillable.close()
-                if len(aggregated) >= self.MERGE_FAN_IN:
-                    # bound live partials: merge the window device-side,
-                    # then ONE host sync shrinks the result into a tight
-                    # bucket (amortized over MERGE_FAN_IN batches).
-                    merged = self._merge_all(aggregated)
-                    from ..columnar.column import bucket_capacity
-                    rows = merged.num_rows_host
-                    small_cap = bucket_capacity(max(rows, 1))
-                    if small_cap < merged.capacity:
-                        shrunk = _shrink_batch(merged, small_cap)
-                        merged = ColumnarBatch(shrunk.columns, rows,
-                                               merged.schema)
-                    aggregated = [SpillableBatch.from_batch(merged)]
 
             if not aggregated:
                 if not self.group_exprs and self.mode != "partial":

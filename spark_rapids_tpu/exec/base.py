@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import itertools
 import time
+from contextlib import contextmanager, nullcontext
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 from ..columnar.batch import ColumnarBatch
@@ -222,6 +223,99 @@ class TpuExec:
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         raise NotImplementedError(type(self).__name__)
+
+    def _fingerprint_extras(self):
+        """Semantic parameters of THIS node beyond its class, output
+        schema and children — everything a trace of its programs
+        depends on (bound expressions, modes, captured conf knobs).
+        Returning None opts the subtree out of the plan-fingerprint
+        program cache (the safe default: an exec whose trace semantics
+        are not fully captured here must never share compiled programs
+        across instances)."""
+        return None
+
+    def plan_fingerprint(self) -> Optional[str]:
+        """Canonical plan-subtree fingerprint (ISSUE 14): equal
+        fingerprints promise byte-identical traces, so the process-wide
+        program cache (obs/dispatch.py) may hand a later collect()'s
+        rebuilt exec the programs an identical earlier plan already
+        compiled — and the stage compiler keys CompiledStageExec
+        programs (and, later, ROADMAP 5's sub-plan result cache) off
+        the same digest. Combines per-node semantics
+        (_fingerprint_extras), the output schema, every child's
+        fingerprint, the backend platform and the trace-affecting conf
+        digest. None = some node in the subtree opted out (or the
+        stage.fusion gate is off) — callers fall back to per-instance
+        program sites. Memoized per instance: compute it only after
+        the node's semantic fields are final."""
+        memo = self.__dict__.get("_plan_fp", False)
+        if memo is not False:
+            return memo
+        fp = None
+        try:
+            extras = self._fingerprint_extras()
+            if extras is not None:
+                from .stage_compiler import fingerprint_node
+                fp = fingerprint_node(self, extras)
+        except Exception:  # noqa: BLE001 — fingerprinting is an
+            fp = None      # optimization; never fail plan build
+        self.__dict__["_plan_fp"] = fp
+        return fp
+
+    def _site(self, fn, label: str, key_salt=None, **jit_kwargs):
+        """Build one of this exec's program sites through the dispatch
+        chokepoint, keyed by the plan fingerprint when available — a
+        semantically identical exec in a later collect() then reuses
+        the SAME compiled programs (zero fresh traces, the PR 13
+        per-collect-recompile finding closed). `key_salt`
+        disambiguates several sites sharing one label on one exec
+        (ExpandExec's per-projection programs): without it the cache
+        would hand every projection the FIRST one's program."""
+        from ..obs.dispatch import instrument
+        fp = self.plan_fingerprint()
+        key = None if fp is None else \
+            (fp if key_salt is None else (fp, key_salt))
+        return instrument(fn, label=label, owner=self, cache_key=key,
+                          **jit_kwargs)
+
+    def batch_harness(self, gather_shape=None, fault_point=None,
+                      fault_key=None, metric_scope: bool = False):
+        """THE per-batch stage-boundary governance harness (ISSUE 14).
+
+        Compute bodies handed to the dispatch chokepoint must stay PURE
+        traced dataflow (the `stage-governance` analyzer rule): the
+        per-batch governance hooks — gather accounting, chaos fault
+        points, module-site dispatch metric attribution — bind HERE,
+        around the one program call, at the stage boundary. Lifecycle
+        cancellation ticks already live at the TpuExec._drive batch
+        boundary, and breaker engagement is noted at trace time by the
+        tier selector, so the PR 5/6 contracts hold at stage
+        granularity. Returns a context manager; plain per-op paths and
+        CompiledStageExec route through the same helper so every wired
+        boundary changes together."""
+        scopes = []
+        if fault_point is not None:
+            from .. import faults
+            faults.check(fault_point, key=fault_key)
+        if gather_shape is not None:
+            tracker = getattr(self, "_gather_track", None)
+            if tracker is not None:
+                scopes.append(tracker.observe(gather_shape))
+        if metric_scope:
+            from ..obs import dispatch as obs_dispatch
+            scopes.append(obs_dispatch.metric_scope(
+                self.metrics[NUM_DISPATCHES],
+                self.metrics[COMPILE_TIME]))
+        if not scopes:
+            return nullcontext()
+        if len(scopes) == 1:
+            return scopes[0]
+
+        @contextmanager
+        def _stacked():
+            with scopes[0], scopes[1]:
+                yield
+        return _stacked()
 
     def pipeline_stage(self, source, label: str, depth=None):
         """The one way an exec wraps an input in a pipelined() stage:

@@ -17,7 +17,6 @@ from ..memory.retry import split_in_half_by_rows, with_retry
 from ..memory.spillable import SpillableBatch
 from ..ops.basic import active_mask, compact_columns, sanitize, slice_rows
 from ..types import LongType, Schema, StructField
-from ..obs.dispatch import instrument
 from .base import (COMPILE_TIME, DISPATCH_METRICS, GATHER_METRICS,
                    GATHER_TIME, NUM_DISPATCHES, NUM_GATHERS,
                    NUM_INPUT_BATCHES, NUM_INPUT_ROWS, NUM_UPLOADS,
@@ -37,6 +36,11 @@ class InMemoryScanExec(TpuExec):
     @property
     def output_schema(self) -> Schema:
         return self._schema
+
+    def _fingerprint_extras(self):
+        # programs depend on the schema (in the fingerprint already)
+        # and batch SHAPES (jit arg keys) — never on the data values
+        return ()
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         yield from self._batches
@@ -67,6 +71,11 @@ class SourceScanExec(TpuExec):
 
     def additional_metrics(self):
         return PIPELINE_STAGE_METRICS + UPLOAD_METRICS + DISPATCH_METRICS
+
+    def _fingerprint_extras(self):
+        # the source's class scopes the fingerprint; its data never
+        # shapes a program (shapes ride the jit arg keys)
+        return (type(self._source).__name__,)
 
     @property
     def runs_own_pipeline_stage(self) -> bool:
@@ -180,9 +189,9 @@ class ProjectExec(TpuExec):
         self.exprs = list(exprs)
         self._schema = projection_schema(self.exprs, child.output_schema)
         self._bound = bind_projection(self.exprs, child.output_schema)
-        self._jit = instrument(
+        self._jit = self._site(
             lambda b: eval_projection(self._bound, b, self._schema),
-            label="ProjectExec.project", owner=self)
+            label="ProjectExec.project")
 
     @property
     def output_schema(self) -> Schema:
@@ -190,6 +199,17 @@ class ProjectExec(TpuExec):
 
     def additional_metrics(self):
         return DISPATCH_METRICS
+
+    def _fingerprint_extras(self):
+        # semantic_key, NOT repr: repr is display-only and omits
+        # non-child parameters (a trim set, a pad char) — the CSE
+        # identity is the value-complete one (caught live: two trims
+        # differing only in trim set shared one cached program).
+        # Non-deterministic expressions (UDFs key per-INSTANCE by id,
+        # recyclable after GC) opt the subtree out of the cache.
+        if not all(e.deterministic for e in self._bound):
+            return None
+        return tuple(e.semantic_key() for e in self._bound)
 
     @property
     def output_grouped_by(self):
@@ -245,6 +265,12 @@ class ProjectExec(TpuExec):
         whole-stage codegen; XLA is the codegen)."""
         return ("project", self._bound, self._schema)
 
+    #: stage-compiler step protocol (ISSUE 14): same pure step, but a
+    #: SEPARATE name — fused_step is the AggregateExec absorption
+    #: protocol, and growing it (ExpandExec) would silently change
+    #: which operators aggregates swallow
+    stage_step = fused_step
+
     def node_description(self):
         return f"ProjectExec[{', '.join(map(repr, self.exprs))}]"
 
@@ -254,8 +280,7 @@ class FilterExec(TpuExec):
         super().__init__(child)
         self.condition = condition
         self._bound = resolve(condition, child.output_schema)
-        self._jit = instrument(self._kernel, label="FilterExec.filter",
-                               owner=self)
+        self._jit = self._site(self._kernel, label="FilterExec.filter")
         from ..ops.gather import GatherTracker
         self._gather_track = GatherTracker(self.metrics[NUM_GATHERS],
                                            self.metrics[GATHER_TIME])
@@ -266,6 +291,11 @@ class FilterExec(TpuExec):
 
     def additional_metrics(self):
         return GATHER_METRICS + DISPATCH_METRICS
+
+    def _fingerprint_extras(self):
+        if not self._bound.deterministic:
+            return None  # see ProjectExec._fingerprint_extras
+        return (self._bound.semantic_key(),)
 
     def _kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
         pred = self._bound.columnar_eval(batch)
@@ -294,7 +324,10 @@ class FilterExec(TpuExec):
     def _filter_spillable(self, s: SpillableBatch) -> ColumnarBatch:
         batch = s.get_batch()
         try:
-            with self._gather_track.observe((batch.capacity,)):
+            # stage-boundary harness (ISSUE 14): the governance hooks
+            # (gather accounting here) bind AROUND the one program
+            # call — the kernel itself stays pure traced dataflow
+            with self.batch_harness(gather_shape=(batch.capacity,)):
                 return self._jit(batch)
         finally:
             s.release()
@@ -304,6 +337,9 @@ class FilterExec(TpuExec):
         (ANDed into the consumer's reductions) instead of a compaction
         gather — gathers are among the slowest ops on TPU, masks are free."""
         return ("filter", self._bound)
+
+    #: stage-compiler step protocol (see ProjectExec.stage_step)
+    stage_step = fused_step
 
     def node_description(self):
         return f"FilterExec[{self.condition!r}]"
@@ -324,6 +360,9 @@ class RangeExec(TpuExec):
     @property
     def output_schema(self) -> Schema:
         return self._schema
+
+    def _fingerprint_extras(self):
+        return (self.start, self.end, self.step, self.batch_rows)
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         total = max(0, -(-(self.end - self.start) // self.step))
@@ -349,6 +388,9 @@ class UnionExec(TpuExec):
     def output_schema(self) -> Schema:
         return self.children[0].output_schema
 
+    def _fingerprint_extras(self):
+        return ()
+
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         for c in self.children:
             for batch in c.execute():
@@ -367,6 +409,9 @@ class LocalLimitExec(TpuExec):
     @property
     def output_schema(self) -> Schema:
         return self.child.output_schema
+
+    def _fingerprint_extras(self):
+        return (self.limit, getattr(self, "offset", 0))
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         remaining = self.limit
@@ -433,10 +478,10 @@ class ExpandExec(TpuExec):
         self._bound = [bind_projection(p, child.output_schema)
                        for p in self.projections]
         self._jits = [
-            instrument(
+            self._site(
                 lambda b, bp=bp: eval_projection(bp, b, self._schema),
-                label="ExpandExec.project", owner=self)
-            for bp in self._bound]
+                label="ExpandExec.project", key_salt=i)
+            for i, bp in enumerate(self._bound)]
 
     @property
     def output_schema(self) -> Schema:
@@ -444,6 +489,18 @@ class ExpandExec(TpuExec):
 
     def additional_metrics(self):
         return DISPATCH_METRICS
+
+    def _fingerprint_extras(self):
+        if not all(e.deterministic for bp in self._bound for e in bp):
+            return None  # see ProjectExec._fingerprint_extras
+        return tuple(tuple(e.semantic_key() for e in bp)
+                     for bp in self._bound)
+
+    def stage_step(self):
+        """Stage-compiler step (ISSUE 14): all projections emitted from
+        ONE fused program per input batch. NOT a fused_step — the
+        AggregateExec absorption protocol must not swallow expands."""
+        return ("expand", self._bound, self._schema)
 
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         for batch in self.child.execute():
@@ -463,8 +520,8 @@ class SampleExec(TpuExec):
         super().__init__(child)
         self.fraction = float(fraction)
         self.seed = int(seed)
-        self._jit = instrument(self._kernel, label="SampleExec.sample",
-                               owner=self, static_argnums=(2,))
+        self._jit = self._site(self._kernel, label="SampleExec.sample",
+                               static_argnums=(2,))
 
     @property
     def output_schema(self) -> Schema:
@@ -472,6 +529,9 @@ class SampleExec(TpuExec):
 
     def additional_metrics(self):
         return DISPATCH_METRICS
+
+    def _fingerprint_extras(self):
+        return (self.fraction, self.seed)
 
     def _kernel(self, batch: ColumnarBatch, batch_idx, fraction: float):
         import jax as _jax

@@ -81,6 +81,11 @@ class CoalesceBatchesExec(TpuExec):
                 (NUM_INPUT_BATCHES, DEBUG)) + PIPELINE_STAGE_METRICS \
             + DISPATCH_METRICS
 
+    def _fingerprint_extras(self):
+        # its concat program is a module-level site (process-cached
+        # already); the extras exist so PARENT subtrees stay cacheable
+        return (self.target_bytes,)
+
     @property
     def runs_own_pipeline_stage(self) -> bool:
         # wraps its input in a stage of its own — or, when the child

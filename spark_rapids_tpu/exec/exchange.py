@@ -911,6 +911,12 @@ class BroadcastExchangeExec(TpuExec):
     def additional_metrics(self):
         return (BROADCAST_TIME, (PARTITION_SIZE, ESSENTIAL))
 
+    def _fingerprint_extras(self):
+        # stateless pass-through at the program level (materialization
+        # is host-side concat via module sites): extras exist so parent
+        # subtrees over a broadcast build side stay cacheable
+        return ()
+
     def materialize(self) -> ColumnarBatch:
         if self._materialized is None:
             with self.metrics[BROADCAST_TIME].ns_timer():

@@ -36,7 +36,6 @@ from ..ops.join import (
     probe_counts, unmatched_indices, verify_pairs,
 )
 from ..types import BooleanType, Schema, StructField
-from ..obs.dispatch import instrument
 from .base import (BUILD_TIME, DEBUG, DISPATCH_METRICS, GATHER_METRICS,
                    GATHER_TIME,
                    JOIN_TIME, NUM_GATHERS, NUM_INPUT_BATCHES, TpuExec)
@@ -135,18 +134,6 @@ class HashJoinExec(TpuExec):
         # build == non-preserved side; the planner guarantees this.
         if join_type in (LEFT_SEMI, LEFT_ANTI, EXISTENCE):
             assert build_side == "right"
-        # compiled phases: counts (sized by stream bucket) and the probe
-        # body (sized by stream + candidate buckets, static per shape)
-        self._jit_build = instrument(self._build_kernel,
-                                     label="HashJoinExec.build",
-                                     owner=self)
-        self._jit_counts = instrument(self._counts_kernel,
-                                      label="HashJoinExec.counts",
-                                      owner=self)
-        self._jit_probe = instrument(self._probe_kernel,
-                                     label="HashJoinExec.probe",
-                                     owner=self,
-                                     static_argnums=(5, 6, 7, 8))
         # (stream_cap, build_cap) -> (cand_cap, s_caps, b_caps): lets a
         # speculation scope skip the per-batch sizing sync (round 4)
         self._size_cache = {}
@@ -186,6 +173,42 @@ class HashJoinExec(TpuExec):
             if preds:
                 self._build_filter = preds
         self.children = tuple(kids)
+        # compiled phases, built AFTER filter absorption (ISSUE 14):
+        # the plan fingerprint keying the program-site cache must see
+        # the final children + absorbed predicates. counts is sized by
+        # the stream bucket; the probe body by stream + candidate
+        # buckets (static per shape).
+        self._jit_build = self._site(self._build_kernel,
+                                     label="HashJoinExec.build")
+        self._jit_counts = self._site(self._counts_kernel,
+                                      label="HashJoinExec.counts")
+        self._jit_probe = self._site(self._probe_kernel,
+                                     label="HashJoinExec.probe",
+                                     static_argnums=(5, 6, 7, 8))
+
+    def _fingerprint_extras(self):
+        # semantic_key, NOT repr (repr omits non-child expression
+        # parameters — the program-cache soundness contract).
+        # Non-deterministic expressions (a UDF predicate absorbed as a
+        # stream/build filter keys per-INSTANCE by id, recyclable
+        # after GC) opt the subtree out — see ProjectExec.
+        exprs = list(self.left_keys) + list(self.right_keys) \
+            + list(self._stream_filter or ()) \
+            + list(self._build_filter or ())
+        if self.condition is not None:
+            exprs.append(self.condition)
+        if not all(e.deterministic for e in exprs):
+            return None
+
+        def keys(es):
+            return None if es is None else \
+                tuple(e.semantic_key() for e in es)
+        return (self.join_type, self.build_side, keys(self.left_keys),
+                keys(self.right_keys),
+                None if self.condition is None
+                else self.condition.semantic_key(),
+                self.exists_name,
+                keys(self._stream_filter), keys(self._build_filter))
 
     # -- schema ------------------------------------------------------------
     @property

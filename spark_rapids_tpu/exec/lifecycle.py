@@ -586,6 +586,24 @@ def note_engagement(family: str) -> None:
         s.add(domain)
 
 
+def engage_domain(domain: str) -> None:
+    """Engage a breaker DOMAIN directly (ISSUE 14): a CompiledStageExec
+    notes `device_dispatch` at its stage boundary so a classified-
+    transient failure of the fused execution counts against the domain
+    and PR 5 degradation demotes the stage back to per-operator
+    execution. The family-keyed twin (note_engagement) stays the tier
+    selector's surface; this one is for callers that ARE a domain."""
+    if domain not in BREAKER_DOMAINS:
+        return
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        ctx.engaged_domains.add(domain)
+        return
+    s = getattr(_tls, "engaged", None)
+    if s is not None:
+        s.add(domain)
+
+
 def _engaged_set(create: bool = False) -> set:
     ctx = getattr(_tls, "ctx", None)
     if ctx is not None:

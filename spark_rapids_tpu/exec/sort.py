@@ -28,7 +28,6 @@ from ..ops.sort import (
     SortOrder, order_key_lanes, sort_batch_columns, string_words_for,
 )
 from ..types import Schema
-from ..obs.dispatch import instrument
 from .base import (DEBUG, DISPATCH_METRICS, GATHER_METRICS, GATHER_TIME,
                    NUM_GATHERS, NUM_INPUT_BATCHES, SORT_TIME, TpuExec)
 from .coalesce import concat_batches
@@ -74,9 +73,11 @@ class SortExec(TpuExec):
         super().__init__(child)
         self.orders = resolve_sort_orders(orders, child.output_schema)
         self.limit = limit
-        # one compiled sort program per (capacity bucket, string words)
-        self._jit_sort = instrument(self._sort_kernel,
-                                    label="SortExec.sort", owner=self,
+        # one compiled sort program per (capacity bucket, string words);
+        # the site is plan-fingerprint cached (ISSUE 14) so a rebuilt
+        # identical plan reuses it across collects
+        self._jit_sort = self._site(self._sort_kernel,
+                                    label="SortExec.sort",
                                     static_argnums=(1,))
         # round 8: fixed-width columns ride INSIDE lax.sort as packed
         # lanes, so numGathers here counts only the varlen columns'
@@ -93,6 +94,10 @@ class SortExec(TpuExec):
     def additional_metrics(self):
         return (SORT_TIME, (NUM_INPUT_BATCHES, DEBUG)) + GATHER_METRICS \
             + DISPATCH_METRICS
+
+    def _fingerprint_extras(self):
+        return (tuple((o.ordinal, o.ascending, o.nulls_first)
+                      for o in self.orders), self.limit)
 
     def _string_words(self, batch: ColumnarBatch) -> int:
         return string_words_for(batch.columns,

@@ -36,6 +36,20 @@ class AggregateFunction:
     def __init__(self, *inputs: Expression):
         self.inputs = tuple(inputs)
 
+    def _semantic_args(self):
+        """Per-class parameters beyond the input expressions (the
+        Expression._semantic_args contract): everything that changes
+        the aggregate's computation MUST appear here — semantic_key()
+        feeds the plan-fingerprint program cache (ISSUE 14), and a
+        lossy key hands one aggregate another's compiled programs."""
+        return ()
+
+    def semantic_key(self):
+        """Value-complete structural identity (the Expression
+        semantic_key contract, extended to aggregate functions)."""
+        return (type(self).__name__, self._semantic_args(),
+                tuple(e.semantic_key() for e in self.inputs))
+
     def result_type_from_buffer(self, buffer_types):
         """Result type in FINAL mode, where only buffer types are known
         (the default treats them as the input types, which most
@@ -195,6 +209,9 @@ class First(AggregateFunction):
         super().__init__(*inputs)
         self.ignore_nulls = ignore_nulls
 
+    def _semantic_args(self):
+        return (self.ignore_nulls,)
+
     def _op(self):
         return self._OPS[0] if self.ignore_nulls else self._OPS[1]
 
@@ -264,6 +281,10 @@ class Percentile(AggregateFunction):
             percentage = percentage.value
         self.percentage = percentage
 
+    def _semantic_args(self):
+        p = self.percentage
+        return (tuple(p) if isinstance(p, (list, tuple)) else p,)
+
     def update_ops(self):
         return [("collect", 0)]
 
@@ -313,6 +334,9 @@ class ApproxPercentile(Percentile):
             accuracy = accuracy.value
         self.accuracy = int(accuracy) if accuracy else \
             self.DEFAULT_ACCURACY
+
+    def _semantic_args(self):
+        return super()._semantic_args() + (self.accuracy,)
 
     @property
     def _k(self) -> int:

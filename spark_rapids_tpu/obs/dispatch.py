@@ -58,7 +58,8 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = [
     "DispatchLedger", "InstrumentedJit", "instrument", "active_ledger",
     "configure", "reset_dispatch_ledger", "counters", "programs",
-    "health_section", "metric_scope",
+    "health_section", "metric_scope", "site_cache_counters",
+    "reset_site_cache",
 ]
 
 #: canonical per-exec metric names (exec/base.py re-exports them into
@@ -344,10 +345,14 @@ def configure(conf=None) -> Optional[DispatchLedger]:
 
 
 def reset_dispatch_ledger() -> None:
-    """Fresh default-enabled ledger (test isolation)."""
+    """Fresh default-enabled ledger (test isolation). The program-site
+    cache resets with it: the two surfaces are one plane — a test that
+    wants fresh-trace accounting (program_compile events, trace
+    counters) must not inherit another test's already-traced sites."""
     global _ledger
     with _ledger_lock:
         _ledger = DispatchLedger()
+    reset_site_cache()
 
 
 def counters() -> Dict[str, int]:
@@ -390,6 +395,75 @@ def metric_scope(num_metric, time_metric=None):
         yield
     finally:
         _tls.sink = prev
+
+
+# ---------------------------------------------------------------------------
+# plan-fingerprint program-site cache (ISSUE 14): every DataFrame.
+# collect() rebuilds its exec tree, so per-instance jit wrappers used to
+# recompile the WHOLE plan per collect (the PR 13 finding: ~1.9s/collect
+# on the scaled q1 CPU lane). Sites built with a `cache_key` — the
+# owning exec's canonical plan-subtree fingerprint — are process-cached
+# per (label, cache_key): a semantically identical exec instance reuses
+# the SAME InstrumentedJit, so its dispatches ride the existing jax jit
+# cache (the ledger records them as cache hits, zero fresh traces). The
+# fingerprint must capture everything the trace depends on (expression
+# semantics, schemas, trace-affecting conf values, platform) — that
+# contract lives in exec/stage_compiler.plan_fingerprint.
+# ---------------------------------------------------------------------------
+
+_site_cache_lock = threading.Lock()
+#: (label, cache_key) -> InstrumentedJit, LRU-ordered (dict order)
+_site_cache: "Dict[Tuple[str, Any], InstrumentedJit]" = {}
+_site_cache_hits = 0
+_site_cache_misses = 0
+
+
+def _site_cache_max() -> int:
+    try:
+        from ..config import STAGE_PROGRAM_CACHE_ENTRIES, active_conf
+        return max(0, int(active_conf().get(STAGE_PROGRAM_CACHE_ENTRIES)))
+    except Exception:  # noqa: BLE001 — conf unavailable early
+        return 512
+
+
+def _cached_site(fn, label: str, owner, cache_key, jit_kwargs):
+    global _site_cache_hits, _site_cache_misses
+    limit = _site_cache_max()
+    if limit <= 0:
+        return InstrumentedJit(fn, label, owner=owner, **jit_kwargs)
+    key = (label, cache_key)
+    with _site_cache_lock:
+        site = _site_cache.pop(key, None)
+        if site is not None:
+            _site_cache[key] = site  # re-append: most recently used
+            _site_cache_hits += 1
+    if site is not None:
+        site.rebind(owner)
+        return site
+    site = InstrumentedJit(fn, label, owner=owner, **jit_kwargs)
+    with _site_cache_lock:
+        _site_cache_misses += 1
+        _site_cache[key] = site
+        while len(_site_cache) > limit:
+            _site_cache.pop(next(iter(_site_cache)))
+    return site
+
+
+def site_cache_counters() -> Dict[str, int]:
+    """bench `{"stage"}` block + tests: program-site cache activity."""
+    with _site_cache_lock:
+        return {"sites": len(_site_cache), "hits": _site_cache_hits,
+                "misses": _site_cache_misses}
+
+
+def reset_site_cache() -> None:
+    """Drop every cached program site (test isolation; already-built
+    exec trees keep the sites they hold — only NEW lookups re-trace)."""
+    global _site_cache_hits, _site_cache_misses
+    with _site_cache_lock:
+        _site_cache.clear()
+        _site_cache_hits = 0
+        _site_cache_misses = 0
 
 
 def _trace_state_clean() -> bool:
@@ -454,6 +528,22 @@ class InstrumentedJit:
             # plan stages by EXACT label (subclass-safe)
             owner.__dict__.setdefault("_dispatch_sites", []).append(self)
 
+    def rebind(self, owner) -> None:
+        """Re-point metric attribution at a new owning exec — the
+        program-site cache hands one compiled site to every
+        semantically identical exec instance (one per collect), and
+        each execution's numDispatches/compileTimeNs must land on the
+        CURRENTLY executing exec, not the instance that first traced
+        the program. Concurrent identical plans (bench --concurrency)
+        share the site: their per-exec metric split follows the latest
+        rebind — the process ledger stays exact either way."""
+        if owner is None or owner is self._owner:
+            return
+        self._owner = owner
+        sites = owner.__dict__.setdefault("_dispatch_sites", [])
+        if self not in sites:
+            sites.append(self)
+
     def _arg_bytes(self, args, kwargs) -> Tuple[int, int]:
         """Donated vs retained bytes from the trace-time avals (shapes
         are concrete there; no device data is touched)."""
@@ -499,13 +589,27 @@ class InstrumentedJit:
         return led.dispatch(self, args, kwargs)
 
 
-def instrument(fn=None, *, label: str, owner=None, **jit_kwargs):
+def instrument(fn=None, *, label: str, owner=None, cache_key=None,
+               **jit_kwargs):
     """THE jit entry point: `instrument(fn, label=...)` replaces
     `jax.jit(fn)` everywhere the engine compiles a program (the
     dispatch-ledger contract rule holds every `jax.jit`/`pallas_call`
     site in the package to this chokepoint or a justified suppression).
-    Usable as a decorator factory: `@instrument(label=...)`."""
+    Usable as a decorator factory: `@instrument(label=...)`.
+
+    `cache_key` (ISSUE 14): a hashable canonical plan-subtree
+    fingerprint. When given, the site is served from the process-wide
+    program cache — a semantically identical exec built by a later
+    collect() reuses the SAME compiled programs (ledger cache hits,
+    zero fresh traces) with metric attribution rebound to the new
+    owner. The caller owns the soundness contract: equal fingerprints
+    MUST imply byte-identical traces."""
     if fn is None:
+        if cache_key is not None:
+            return lambda f: _cached_site(f, label, owner, cache_key,
+                                          jit_kwargs)
         return lambda f: InstrumentedJit(f, label, owner=owner,
                                          **jit_kwargs)
+    if cache_key is not None:
+        return _cached_site(fn, label, owner, cache_key, jit_kwargs)
     return InstrumentedJit(fn, label, owner=owner, **jit_kwargs)

@@ -114,6 +114,12 @@ EVENT_LEVELS: Dict[str, int] = {
     "program_compile": MODERATE,
     "dispatch_stats": MODERATE,
     "recompile_storm": ESSENTIAL,
+    # whole-stage compilation (ISSUE 14): one record per fused-stage
+    # execution — kind (map | agg | join_agg), the absorbed-op label,
+    # ops absorbed, input batches, program dispatches this execution
+    # issued, and the donated carried-state bytes (the in-place HBM
+    # reuse the donate_argnums contract buys on real hardware)
+    "stage_fused": MODERATE,
     "op_open": DEBUG,
     "op_batch": DEBUG,
     "span": DEBUG,

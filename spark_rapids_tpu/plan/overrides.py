@@ -1118,7 +1118,12 @@ class TpuOverrides:
         meta = self.wrap_and_tag(plan)
         if not self._all_ok(meta):
             raise PlanNotSupported(meta.explain())
-        return meta.convert()
+        # whole-stage compilation (ISSUE 14): after conversion the
+        # stage planner groups whitelisted operator chains into
+        # CompiledStageExec nodes (one jitted program per stage per
+        # batch) — conf-gated, no-op when stage.fusion is off
+        from ..exec.stage_compiler import compile_stages
+        return compile_stages(meta.convert(), self.conf)
 
     def explain(self, plan: L.LogicalPlan) -> str:
         return self.wrap_and_tag(plan).explain()

@@ -117,6 +117,19 @@ def _dispatch_ledger_reset():
 
 
 @pytest.fixture(scope="module", autouse=True)
+def _stage_compiler_reset():
+    """Whole-stage-compilation hygiene (ISSUE 14): the plan-fingerprint
+    program-site cache (cleared with the ledger above, but only at
+    reset points) and the stage counters/size caches are process-wide —
+    a module asserting fresh-trace behavior or per-lane stage deltas
+    must not inherit another module's warm caches."""
+    from spark_rapids_tpu.exec import stage_compiler
+    stage_compiler.reset_stage_counters()
+    yield
+    stage_compiler.reset_stage_counters()
+
+
+@pytest.fixture(scope="module", autouse=True)
 def _no_leaked_lifecycle_state():
     """Lifecycle-governor hygiene (ISSUE 6, same pattern as the leaked
     fault plan): a breaker left open would silently demote a kernel

@@ -1,0 +1,42 @@
+"""Contract-analyzer fixture: the stage-governance rule FIRES here —
+per-batch governance hooks inside traced stage bodies handed to the
+dispatch chokepoint (ISSUE 14: they run once per TRACE, not per batch,
+so cancellation latency / fault coverage / metric totals all lie)."""
+
+from functools import partial
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.obs.dispatch import instrument
+
+
+def make_site(self, qctx):
+    def traced_body(batch):
+        qctx.tick()                      # stage-governance
+        faults.check("device.dispatch")  # stage-governance
+        return batch
+    return instrument(traced_body, label="fx.stage")
+
+
+class Op:
+    def _kernel(self, batch):
+        with self.metrics["opTime"].ns_timer():  # stage-governance
+            return batch
+
+    def build(self):
+        self._jit = self._site(self._kernel, label="Op.kernel")
+
+
+@partial(instrument, label="fx.decorated")
+def decorated_body(batch, bus):
+    bus.emit("op_batch", rows=1)  # stage-governance
+    return batch
+
+
+def helper_hook(tracker, batch):
+    # flagged via the one-hop walk from hooked_site below
+    with tracker.observe((batch,)):
+        return batch
+
+
+def hooked_site():
+    return instrument(lambda b: helper_hook(None, b), label="fx.hop")

@@ -1,0 +1,36 @@
+"""Contract-analyzer fixture twin: stage-governance stays SILENT —
+pure traced bodies are clean, harness-side hooks live outside the
+traced function, and an accepted in-body hook carries a justified
+suppression."""
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.obs.dispatch import instrument
+
+
+def pure_site():
+    # pure dataflow: nothing to flag
+    return instrument(lambda b: b * 2, label="fx.pure")
+
+
+class Op:
+    def _kernel(self, batch):
+        return batch  # pure
+
+    def build(self):
+        self._jit = self._site(self._kernel, label="Op.kernel")
+
+    def drive(self, batch):
+        # harness-side governance (the correct shape): hooks bind
+        # AROUND the program call, never inside the traced body
+        faults.check("device.dispatch", key="stage:1")
+        with self.batch_harness(gather_shape=(batch,)):
+            return self._jit(batch)
+
+
+def accepted_site(qctx):
+    def body(batch):
+        # contract: ok stage-governance — fixture: trace-time consult
+        # deliberately baked per compiled shape, documented
+        qctx.tick()
+        return batch
+    return instrument(body, label="fx.accepted")

@@ -1065,6 +1065,14 @@ def test_e2e_spill_corruption_recovered_by_recompute(spill_q_files,
             "spark.rapids.sql.batchSizeBytes": str(16 * 1024),
             "spark.rapids.sql.broadcastSizeThreshold": "-1",
             "spark.rapids.tpu.pipeline.enabled": "false",
+            # ISSUE 14: the deterministic disk RE-READ depends on the
+            # per-op plan's exact allocation order (the documented
+            # narrow window); the fused stage holds less live memory
+            # and the corrupted file is never unspilled. The recovery
+            # lane UNDER fusion is covered by test_stage_compiler's
+            # forced-spill + chaos tests; this test pins the per-op
+            # choreography that actually re-reads the corrupt file.
+            "spark.rapids.tpu.stage.fusion.enabled": "false",
             "spark.rapids.tpu.test.faults":
                 "spill.disk_write:prob=1,seed=4,kind=corrupt,max=1",
         })

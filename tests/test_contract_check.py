@@ -122,6 +122,25 @@ def test_dispatch_rule_fires():
     assert keys == ["jax.jit", "jax.jit", "pallas_call"], keys
 
 
+def test_stage_governance_rule_fires():
+    """ISSUE 14 satellite: per-batch governance hooks (lifecycle tick,
+    chaos fault points, metric timers, event emits, gather observes)
+    are forbidden inside traced stage bodies handed to the dispatch
+    chokepoint — they run once per TRACE, not per batch. The rule
+    resolves local defs, self._method references, lambdas, partial
+    wrappers and @partial(instrument, ...) decorators, and walks one
+    hop into module-local helpers."""
+    rep = run_fixture("fx_stage.py")
+    assert rules_fired(rep) == ["stage-governance"]
+    keys = sorted(f.key for f in rep.findings)
+    assert keys == ["emit", "faults.check", "ns_timer", "observe",
+                    "tick"], keys
+    scopes = {f.key: f.scope for f in rep.findings}
+    assert scopes["ns_timer"] == "_kernel"      # self._site(self._m)
+    assert scopes["emit"] == "decorated_body"   # @partial(instrument)
+    assert scopes["observe"] == "<lambda>"      # one-hop via helper
+
+
 def test_registry_rules_fire():
     rep = run_fixture("fx_registry.py")
     assert rules_fired(rep) == ["conf-key-registered",
@@ -141,6 +160,7 @@ def test_registry_rules_fire():
     ("fx_accounting_ok.py", 2),
     ("fx_registry_ok.py", 2),
     ("fx_dispatch_ok.py", 2),
+    ("fx_stage_ok.py", 1),
 ])
 def test_suppressions_silence(fname, n_suppressed):
     rep = run_fixture(fname)
@@ -328,7 +348,7 @@ def test_every_rule_family_is_fixture_proven():
     fired = set()
     for fname in ("fx_locks.py", "fx_threads.py", "fx_trace.py",
                   "fx_conf.py", "fx_accounting.py", "fx_registry.py",
-                  "fx_dispatch.py"):
+                  "fx_dispatch.py", "fx_stage.py"):
         for f in run_fixture(fname).findings:
             fired.add(f.rule)
     non_meta = {rid for rid, m in reg_mod.RULES.items()

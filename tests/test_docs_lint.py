@@ -226,6 +226,25 @@ def test_pallas_family_registries_agree():
         f"missing={sorted(fams - rows)} stale={sorted(rows - fams)}")
 
 
+def test_fusion_whitelist_table_matches_registry():
+    """docs/perf.md's fusion-whitelist table lists exactly
+    exec/stage_compiler.FUSABLE_OPS (ISSUE 14) — the tier-table drift
+    lint pattern: an operator added to (or dropped from) the stage
+    compiler without its docs row fails tier-1."""
+    from spark_rapids_tpu.exec.stage_compiler import FUSABLE_OPS
+    docs = (ROOT / "docs" / "perf.md").read_text()
+    m = re.search(r"### Fusion whitelist\n(.*?)(?:\n#|\Z)", docs,
+                  re.DOTALL)
+    assert m, "docs/perf.md lost its fusion-whitelist table"
+    rows = set(re.findall(r"^\|\s*`([A-Za-z0-9_]+Exec)`\s*\|",
+                          m.group(1), re.MULTILINE))
+    expected = set(FUSABLE_OPS)
+    assert rows == expected, (
+        f"docs/perf.md fusion-whitelist table drifted: "
+        f"missing={sorted(expected - rows)} "
+        f"stale={sorted(rows - expected)}")
+
+
 def test_additional_metrics_are_canonical_and_unique():
     classes = _all_exec_classes()
     assert len(classes) >= 20  # the walk actually found the exec tree

@@ -331,6 +331,11 @@ def test_structural_gather_count_per_join_iteration(tmp_path):
     try:
         sess, q = _q3_join_session({
             "spark.rapids.tpu.pallas.fusedTier": "on",
+            # ISSUE 14: this test pins the PER-OP join exec's
+            # structural gather discipline (the fused stage reuses the
+            # same probe kernel; its gather accounting is covered by
+            # test_stage_compiler)
+            "spark.rapids.tpu.stage.fusion.enabled": "false",
             "spark.rapids.tpu.eventLog.enabled": True,
             "spark.rapids.tpu.eventLog.dir": str(tmp_path)})
         rows = q.collect()

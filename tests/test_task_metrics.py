@@ -41,7 +41,10 @@ def test_summary_reachable_from_session_api():
     assert m["total.numOutputBatches"] >= 1
     assert m["total.computeAggTime"] >= 0
     # per-operator breakdown uses the all_metrics addressing
-    assert any(k.startswith("ops.") and "AggregateExec" in k for k in m)
+    # ISSUE 14: the filter+group-by chain executes as a fused stage
+    assert any(k.startswith("ops.") and ("AggregateExec" in k
+                                         or "CompiledStageExec" in k)
+               for k in m)
 
 
 def test_summary_reports_per_query_deltas():

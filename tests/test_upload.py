@@ -236,6 +236,53 @@ def test_pool_grow_reuse_and_lru_trim():
     assert pool.outstanding_bytes() == 0
 
 
+def test_pool_presize_from_batch_size(tmp_path):
+    """ISSUE 14 satellite (the PR 10 recorded TODO): configure()
+    pre-sizes the bucket ladder from batchSizeBytes, so steady-state
+    acquires at or under the target are ALL hits — the miss counter
+    stays at zero."""
+    pool = upload.StagingPool()
+    added = pool.presize(64 * 1024, pool_cap=1 << 20)
+    assert added == sum(256 << i for i in range(9))  # 256B..64KiB
+    # every rung at or under the target acquires as a HIT
+    for nbytes in (100, 600, 5000, 40_000, 65_536):
+        buf = pool.acquire(nbytes)
+        pool.release(buf)
+    assert pool.misses == 0 and pool.hits == 5
+    # past the target still grows on miss (the pre-ISSUE-14 behavior)
+    big = pool.acquire(100_000)
+    assert pool.misses == 1
+    pool.release(big)
+    # idempotent: a second presize with the rungs populated adds nothing
+    assert pool.presize(64 * 1024, pool_cap=1 << 20) == 0
+    # the cap bounds the ladder: a huge target stops at pool_cap
+    capped = upload.StagingPool()
+    capped.presize(1 << 30, pool_cap=4096)
+    assert capped.pooled_bytes() <= 4096
+
+    # the session-configure seam: a steady-state parquet scan hits the
+    # pre-sized ladder with zero grow-on-miss allocations
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.api.session import TpuSession
+    n = 4000
+    pq.write_table(pa.table({
+        "a": np.arange(n, dtype=np.int64),
+        "b": np.arange(n, dtype=np.float64)}),
+        tmp_path / "t.parquet")
+    upload.reset_staging_pool()
+    sess = TpuSession(
+        {"spark.rapids.sql.batchSizeBytes": "1m",
+         "spark.rapids.tpu.transfer.packedUpload.poolBytes": "16m"})
+    proc = upload.staging_pool()
+    assert proc.pooled_bytes() > 0 and proc.misses == 0  # pre-sized
+    rows = sess.read_parquet(str(tmp_path / "t.parquet")).collect()
+    assert len(rows) == n
+    proc.settle()
+    assert proc.misses == 0, proc.stats()  # zero grow-on-miss uploads
+    upload.reset_staging_pool()
+
+
 def test_concurrent_uploads_never_cross_contaminate():
     """Regression (found live via the PR 6 storm): PJRT CPU zero-copy
     is a PER-BUFFER decision — an aliased staging buffer returned to

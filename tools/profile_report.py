@@ -205,6 +205,7 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
                 out[k] = out.get(k, 0) + 1
         return out
 
+    fused = [e for e in events if e.get("kind") == "stage_fused"]
     compiles = [e for e in events if e.get("kind") == "program_compile"]
     storms = [e for e in events if e.get("kind") == "recompile_storm"]
     dstats = [e for e in events if e.get("kind") == "dispatch_stats"]
@@ -270,6 +271,23 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
         # baseline), and any recompile storms. Logs from builds without
         # the dispatch plane simply report zeros/empty lists.
         "dispatch": _dispatch_rollup(compiles, storms, dstats, top),
+        # whole-stage-compilation roll-up (ISSUE 14): fused-stage
+        # executions, operators absorbed, and the dispatches saved vs
+        # the per-op baseline (one program per absorbed op per input
+        # batch is what the fused program replaced). Zero-tolerant:
+        # logs from pre-fusion builds report zeros and print nothing.
+        "fused_stages": {
+            "executions": len(fused),
+            "ops_absorbed": sum(e.get("ops") or 0 for e in fused),
+            "batches": sum(e.get("batches") or 0 for e in fused),
+            "dispatches": sum(e.get("dispatches") or 0 for e in fused),
+            "dispatches_saved": sum(
+                max((e.get("ops") or 0) * (e.get("batches") or 0)
+                    - (e.get("dispatches") or 0), 0) for e in fused),
+            "donated_bytes": max((e.get("donated_bytes") or 0
+                                  for e in fused), default=0),
+            "by_label": sorted({e.get("label") or "?" for e in fused}),
+        },
         "pallas_tier": {"decisions": len(tiers),
                         "engaged": sum(1 for e in tiers
                                        if e.get("engaged"))},
@@ -454,6 +472,17 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
             f"{r['window_ms']}ms)" for r in dp["storms"][:3])
         extras.append(f"RECOMPILE STORMS: {len(dp['storms'])} "
                       f"({detail})")
+    # fused-stage roll-up (ISSUE 14): how much per-operator dispatch
+    # overhead whole-stage compilation collapsed; absent on pre-fusion
+    # logs
+    fs = s["fused_stages"]
+    if fs["executions"]:
+        extras.append(
+            f"fused stages: {fs['executions']} execution(s) "
+            f"({fs['ops_absorbed']} ops absorbed, {fs['dispatches']} "
+            f"dispatches over {fs['batches']} batches — "
+            f"~{fs['dispatches_saved']} saved vs per-op; donated "
+            f"state {_fmt_bytes(fs['donated_bytes'])})")
     pt = s["pallas_tier"]
     if pt["decisions"]:
         extras.append(f"pallas tier decisions: {pt['decisions']} "
