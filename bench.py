@@ -263,6 +263,54 @@ def shuffle_attribution():
     return _delta_since("shuffle", shuffle_mgr.counters())
 
 
+#: `bench.py --shuffle-mode ici|host` (ISSUE 16): pin the ICI
+#: device-resident shuffle lane on or off for the whole run. Default
+#: (None) follows the conf (shuffle.ici.enabled, default off).
+_SHUFFLE_MODE = None
+
+
+def maybe_shuffle_mode(argv=None):
+    """Parse `--shuffle-mode ici|host`. Bad argv emits the usage-error
+    JSON convention and exits 2 — never a traceback."""
+    global _SHUFFLE_MODE
+    argv = sys.argv if argv is None else argv
+    if "--shuffle-mode" not in argv:
+        return None
+    idx = argv.index("--shuffle-mode")
+    try:
+        mode = argv[idx + 1]
+        assert mode in ("ici", "host")
+    except (IndexError, AssertionError):
+        print(json.dumps({"error_kind": "usage",
+                          "error": "--shuffle-mode requires 'ici' or "
+                                   "'host'"}))
+        raise SystemExit(2)
+    _SHUFFLE_MODE = mode
+    from spark_rapids_tpu.config import (RapidsConf, active_conf,
+                                         set_active_conf)
+    settings = dict(active_conf()._settings)
+    settings["spark.rapids.tpu.shuffle.ici.enabled"] = str(
+        mode == "ici").lower()
+    set_active_conf(RapidsConf(settings))
+    return _SHUFFLE_MODE
+
+
+def ici_attribution():
+    """{"ici": ...} block for each BENCH record (ISSUE 16): exchange
+    rounds the ICI device-resident lane ran, map batches and bytes it
+    moved over the collective, collective wall-ns and host-lane
+    fallbacks (shuffle/manager.py ici_counters, as deltas since the
+    previous record). Zeros with --shuffle-mode host (or off-mesh lanes
+    that never shuffle) — the block is present in every record so a pod
+    round can assert the ICI lane actually engaged, and read the
+    serialize frames collapse in the neighboring shuffle block."""
+    from spark_rapids_tpu.shuffle import manager as shuffle_mgr
+    out = _delta_since("ici", shuffle_mgr.ici_counters())
+    if _SHUFFLE_MODE is not None:
+        out["mode"] = _SHUFFLE_MODE
+    return out
+
+
 #: counter snapshot at the previous chaos_attribution() call — the
 #: underlying counters are process-cumulative, each BENCH record must
 #: report only ITS OWN lane's deltas
@@ -713,6 +761,7 @@ def main():
         "workload": workload_attribution(),
         "gather": gather_attribution(),
         "shuffle": shuffle_attribution(),
+        "ici": ici_attribution(),
         "upload": upload_attribution(),
         "dispatch": dispatch_attribution(),
         "stage": stage_attribution(),
@@ -889,6 +938,7 @@ def q3_bench():
         "workload": workload_attribution(),
         "gather": gather_attribution(),
         "shuffle": shuffle_attribution(),
+        "ici": ici_attribution(),
         "upload": upload_attribution(),
         "dispatch": dispatch_attribution(),
         "stage": stage_attribution(),
@@ -908,5 +958,6 @@ if __name__ == "__main__":
     maybe_query_timeout()
     maybe_concurrency()
     maybe_stage_fusion()
+    maybe_shuffle_mode()
     main()
     q3_bench()

@@ -287,6 +287,18 @@ class StructColumn(Column):
     def capacity(self) -> int:
         return int(self.validity.shape[0])
 
+    def with_capacity(self, capacity: int) -> "StructColumn":
+        """Grow (never shrink) the padding bucket, recursing into the
+        children; type(self)(...) keeps Decimal128Column intact."""
+        cap = self.capacity
+        if capacity == cap:
+            return self
+        assert capacity > cap, (capacity, cap)
+        return type(self)(tuple(c.with_capacity(capacity)
+                                for c in self.children),
+                          _pad_tail(self.validity, capacity - cap),
+                          self.dtype)
+
     @staticmethod
     def from_pylist(values: Sequence, dtype: StructType,
                     capacity: Optional[int] = None) -> "StructColumn":

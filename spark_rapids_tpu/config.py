@@ -258,6 +258,25 @@ SHUFFLE_DEVICE_PARTITION = conf_bool(
     "Off restores the host argsort-and-slice partitioner.",
     commonly_used=True)
 
+SHUFFLE_ICI_ENABLED = conf_bool(
+    "spark.rapids.tpu.shuffle.ici.enabled", False,
+    "ICI-native device-resident shuffle lane for the host shuffle "
+    "exchange (exec/exchange.py + parallel/exchange.py, ISSUE 16): when "
+    "an active mesh's axis size equals the exchange's partition count, "
+    "map output is hash-partitioned, packed into a measured "
+    "(partitions, slot_cap) send grid and exchanged device-to-device "
+    "with jax.lax.all_to_all over the mesh axis — zero host "
+    "serialize/deserialize and zero per-batch D2H/H2D on the hot path "
+    "(the reference's UCX/NVLink shuffle transport as an ICI "
+    "collective). Received shards stage as spillable catalog entries, "
+    "so the spill/quota contracts hold. The host serialize/LZ4 lane "
+    "remains the fallback tier: range partitioning, mismatched "
+    "partition counts, single-device runs, an open `ici_exchange` "
+    "breaker, or a failed collective round degrade per exchange to the "
+    "always-works host path. Default off: behavior is byte-identical "
+    "to the host lane either way.",
+    commonly_used=True)
+
 UPLOAD_PACKED = conf_bool(
     "spark.rapids.tpu.transfer.packedUpload.enabled", True,
     "Packed host->device batch upload (columnar/upload.py — the ingest "

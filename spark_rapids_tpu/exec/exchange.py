@@ -29,7 +29,8 @@ from ..columnar.column import StringColumn, bucket_capacity
 from ..expr.core import Expression
 from ..ops.basic import active_mask
 from ..ops.strings import string_lengths
-from ..parallel.exchange import exchange_columns, partition_ids
+from ..parallel.exchange import (exchange_columns, negotiate_slot_cap,
+                                 partition_ids)
 from ..parallel.mesh import DATA_AXIS, active_mesh, mesh_axis_size
 from ..types import Schema
 from ..obs import events as obs_events
@@ -210,7 +211,7 @@ class ShuffleExchangeExec(TpuExec):
         # one host sync per ROUND: size the receive buffer to the
         # measured max partition load, and string lanes to the measured
         # max byte length (truncation structurally impossible)
-        slot_cap = min(bucket_capacity(max(int(max_count), 1)), cap)
+        slot_cap = negotiate_slot_cap(int(max_count), cap)
         width = max(8, (int(max_len) + 7) // 8 * 8)
 
         out = self._get_step(cap, slot_cap, width)(stacked)
@@ -415,6 +416,22 @@ class HostShuffleExchangeExec(TpuExec):
             lambda b, off: _transfer.pack_split(
                 *self._split_kernel(b, off)),
             label="HostShuffleExchangeExec.split_pack", owner=self)
+        # ICI device-resident lane (ISSUE 16): when the active mesh's
+        # axis size equals this exchange's partition count, map output
+        # is exchanged device-to-device (jax.lax.all_to_all) instead of
+        # being serialized through the host shuffle files; the host
+        # lane below stays the fallback tier (range mode, mismatched
+        # partition counts, open breaker, failed collective round)
+        from ..config import SHUFFLE_ICI_ENABLED
+        self._ici_enabled = bool(self._conf.get(SHUFFLE_ICI_ENABLED))
+        self._ici_mesh = None
+        self._ici_measure = None
+        self._ici_steps = {}
+        #: running per-round high-water marks (ISSUE 11 statistics as
+        #: the slot-cap negotiation hint): flooring later rounds by the
+        #: earlier measured load keeps the compiled step shape stable
+        self._ici_cap_hint = 0
+        self._ici_width_hint = 8
         #: host unpack templates per compiled shape key (abstract shapes
         #: via eval_shape — no device work, no gather-recorder side
         #: effects: eval_shape runs OUTSIDE the tracker's observe)
@@ -439,6 +456,17 @@ class HostShuffleExchangeExec(TpuExec):
         # _read_partition prefetches fetch + LZ4 decode through its own
         # pipelined() stage — a consumer must not stack another
         return True
+
+    def _fingerprint_extras(self):
+        # everything this exec's traced programs depend on beyond the
+        # child subtree: the partitioning mode and count, the bound key
+        # expressions, the range ordering and the two lane gates
+        # (ISSUE 16: the ICI exchange step is a _site program — equal
+        # fingerprints let a later identical plan reuse it compiled)
+        return ("host_shuffle", self.partitioning, self.n_partitions,
+                tuple(repr(e) for e in self.partition_exprs),
+                self.range_order, self._device_partition,
+                self._ici_enabled)
 
     def _pid_kernel(self, batch: ColumnarBatch):
         keys = [e.columnar_eval(batch) for e in self._bound]
@@ -640,16 +668,40 @@ class HostShuffleExchangeExec(TpuExec):
         contract concatenated the whole shard at yield). Flat consumers
         get the same pieces via internal_execute; partition-aware ones
         (ShuffledHashJoinExec, PartitionWiseSortExec) take the
-        boundaries from here."""
+        boundaries from here.
+
+        Lane selection (ISSUE 16): the ICI device-resident lane when
+        eligible — conf on, active mesh axis == partition count,
+        device-computable partitioning, breaker closed — else the host
+        serialize/LZ4 lane. The ICI lane itself degrades to the host
+        lane mid-stream on a failed collective round."""
+        if self._ici_eligible():
+            yield from self._execute_partitions_ici()
+            return
+        yield from self._execute_partitions_host()
+
+    def _execute_partitions_host(self, override_source=None
+                                 ) -> "Iterator[Iterator[ColumnarBatch]]":
+        """The host shuffle-manager lane (and the ICI lane's fallback
+        tier). `override_source` replaces the child stream when the ICI
+        lane degrades mid-stream: the leftover batches it already
+        pulled plus the unconsumed remainder. On that path lineage
+        capture is off (a recompute would replay the child from batch
+        zero and rewrite the wrong map output) and the round-robin
+        cursor continues from where the ICI rounds left it."""
         from ..shuffle.manager import HostShuffleReader, shuffle_manager
         mgr = shuffle_manager()
         handle = mgr.register(self.n_partitions, self.output_schema)
         in_batches = self.metrics[NUM_INPUT_BATCHES]
         in_rows = self.metrics[NUM_INPUT_ROWS]
-        self._rr_offset = 0
+        if override_source is None:
+            self._rr_offset = 0
         state = {"done": 0, "outer_done": False, "closed": False}
         try:
-            if self.partitioning == "range":
+            if override_source is not None:
+                source = override_source
+                bounds = None
+            elif self.partitioning == "range":
                 # bounds need a full pass: buffer the input as SPILLABLE
                 # handles (sampling keys host-side as they stream by), so
                 # the buffered data stays under the memory budget — the
@@ -686,6 +738,7 @@ class HostShuffleExchangeExec(TpuExec):
             # could not replay the identical pid assignment
             capture_lineage = (
                 self.partitioning != "range"
+                and override_source is None
                 and bool(self._conf.get(PARTITION_RECOVERY_ENABLED)))
             # runtime statistics (ISSUE 11): per-map-output and
             # per-partition row/byte distributions, recorded from the
@@ -799,6 +852,352 @@ class HostShuffleExchangeExec(TpuExec):
                 state["closed"] = True
                 mgr.unregister(handle)
             raise
+
+    # -- ICI device-resident lane (ISSUE 16) -------------------------------
+    def _ici_eligible(self) -> bool:
+        """May this execution take the device-to-device lane? Conf on,
+        a device-computable partitioning (range bounds are host
+        objects), an active mesh whose axis size IS the partition
+        count (the all-to-all sends one slot grid row per peer), and a
+        closed `ici_exchange` breaker. A no answer is the degradation
+        decision: the host lane is always correct."""
+        if not self._ici_enabled or self.n_partitions <= 1:
+            return False
+        if self.partitioning not in ("hash", "roundrobin", "single"):
+            return False
+        # variable-length nested payloads (array/map) have no packed
+        # slot-grid representation yet — parallel/exchange.py exchanges
+        # fixed-width lanes, strings and struct/decimal limbs only
+        from ..types import ArrayType, MapType, StructType
+
+        def _collective_ok(dt) -> bool:
+            if isinstance(dt, (ArrayType, MapType)):
+                return False
+            if isinstance(dt, StructType):
+                return all(_collective_ok(f.data_type)
+                           for f in dt.fields)
+            return True
+
+        if not all(_collective_ok(f.data_type)
+                   for f in self.output_schema.fields):
+            return False
+        mesh = active_mesh()
+        if mesh is None or mesh_axis_size(mesh) != self.n_partitions:
+            return False
+        from . import lifecycle
+        if not lifecycle.breaker_allows("ici_exchange"):
+            return False
+        self._ici_mesh = mesh
+        return True
+
+    def _ici_pid(self, local: ColumnarBatch, rr_off, n: int):
+        """Per-device partition ids inside the SPMD bodies. rr_off is
+        the device's round-robin cursor at its batch's first row (a
+        traced scalar input — the host tracks it across rounds so the
+        assignment is bit-identical to the host lane's)."""
+        if self.partitioning == "hash":
+            return self._pid_kernel(local)
+        act = active_mask(local.num_rows, local.capacity)
+        if self.partitioning == "roundrobin":
+            iota = jnp.arange(local.capacity, dtype=jnp.int32)
+            pid = (iota + rr_off) % jnp.int32(n)
+            return jnp.where(act, pid, jnp.int32(n))
+        return jnp.where(act, jnp.int32(0), jnp.int32(n))  # single
+
+    def _ici_measure_kernel(self, stacked, rr):
+        """Per-device partition histogram + max string byte length,
+        vmapped over the device axis (pure measurement, no collective):
+        ONE host sync per round sizes the negotiated slot grid."""
+        n = self.n_partitions
+
+        def per_dev(local: ColumnarBatch, off):
+            pid = self._ici_pid(local, off, n)
+            ones = jnp.where(pid < n, jnp.int32(1), jnp.int32(0))
+            counts = jax.ops.segment_sum(ones, pid.astype(jnp.int32),
+                                         num_segments=n + 1)
+            max_len = jnp.int32(0)
+            act = active_mask(local.num_rows, local.capacity)
+            for c in local.columns:
+                if isinstance(c, StringColumn):
+                    lens = string_lengths(c)
+                    max_len = jnp.maximum(
+                        max_len, jnp.max(jnp.where(act, lens, 0)))
+            return jnp.max(counts[:n]), max_len, counts[:n]
+
+        max_count, max_len, totals = jax.vmap(per_dev)(stacked, rr)
+        return jnp.max(max_count), jnp.max(max_len), jnp.sum(totals,
+                                                             axis=0)
+
+    def _get_ici_measure(self):
+        if self._ici_measure is None:
+            self._ici_measure = self._site(
+                self._ici_measure_kernel,
+                "HostShuffleExchangeExec.ici_measure")
+        return self._ici_measure
+
+    def _get_ici_step(self, cap: int, slot_cap: int, width: int):
+        """The exchange program per (capacity, slot_cap, string width)
+        shape: partition-split into the (n, slot_cap) send grid and
+        all-to-all every column lane over the mesh axis — built through
+        _site so an identical later plan reuses the compiled program
+        (exec/stage_compiler.py fingerprint cache)."""
+        key = (cap, slot_cap, width)
+        step = self._ici_steps.get(key)
+        if step is not None:
+            return step
+        n = self.n_partitions
+        schema = self.output_schema
+
+        def spmd(stacked, rr):
+            local = _squeeze0(stacked)
+            pid = self._ici_pid(local, rr[0], n)
+            cols, n_recv = exchange_columns(
+                list(local.columns), (), local.num_rows, local.capacity,
+                DATA_AXIS, n, slot_cap=slot_cap, string_width=width,
+                pid=pid)
+            return _expand0(ColumnarBatch(cols, n_recv, schema))
+
+        from ..parallel.mesh import shard_map_compat
+        step = self._site(
+            shard_map_compat(spmd, mesh=self._ici_mesh,
+                             in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                             out_specs=P(DATA_AXIS)),
+            "HostShuffleExchangeExec.ici_exchange_step", key_salt=key)
+        self._ici_steps[key] = step
+        return step
+
+    def _ici_exchange_round(self, batches, rr_offs, round_idx: int):
+        """One collective round: exactly ONE map batch per device (in
+        map order, padded with empties), so partition p's received rows
+        concatenate across devices in the host lane's map order —
+        byte-identical per-partition row order. Returns the n received
+        shard batches + the exact per-partition row totals."""
+        import time as _time
+
+        import numpy as _np
+        from ..parallel.distributed import stack_batches, unstack_batches
+        from ..shuffle.manager import note_ici_exchange
+        n = self.n_partitions
+        schema = self.output_schema
+        per_dev = list(batches) + [empty_batch(schema)
+                                   for _ in range(n - len(batches))]
+        cap = max(b.capacity for b in per_dev)
+        per_dev = [b.sized_to(cap) for b in per_dev]
+        rr = jnp.asarray(list(rr_offs) + [0] * (n - len(rr_offs)),
+                         dtype=jnp.int32)
+        from . import lifecycle
+        lifecycle.engage_domain("ici_exchange")
+        t0 = _time.perf_counter_ns()
+        # the collective dispatch is the chaos seam: the fault key is
+        # the deterministic round ordinal, and dispatch metrics land on
+        # this exec through the stage-boundary harness
+        with self.batch_harness(fault_point="shuffle.ici_exchange",
+                                fault_key=f"r{round_idx}",
+                                metric_scope=True):
+            stacked = stack_batches(per_dev)
+            max_count, max_len, totals = self._get_ici_measure()(
+                stacked, rr)
+            # one host sync per round; the running high-water hints
+            # keep later (smaller) rounds on the SAME compiled step
+            self._ici_cap_hint = max(self._ici_cap_hint, int(max_count))
+            slot_cap = negotiate_slot_cap(int(max_count), cap,
+                                          hint=self._ici_cap_hint)
+            self._ici_width_hint = max(
+                self._ici_width_hint, (int(max_len) + 7) // 8 * 8)
+            width = self._ici_width_hint
+            out = self._get_ici_step(cap, slot_cap, width)(stacked, rr)
+            shards = unstack_batches(out, n)
+        collective_ns = _time.perf_counter_ns() - t0
+        totals = _np.asarray(totals)
+        moved = sum(s.device_size_bytes() for s in shards)
+        rows = int(totals.sum())
+        fill = rows / float(n * n * slot_cap) if slot_cap else 0.0
+        self.metrics[SHUFFLE_PACK_TIME].add(collective_ns)
+        note_ici_exchange(rounds=1, batches=len(batches), bytes=moved,
+                          collective_ns=collective_ns)
+        obs_events.emit("ici_exchange", exec="HostShuffleExchangeExec",
+                        op_id=self._op_id, round=round_idx,
+                        partitions=n, batches=len(batches), rows=rows,
+                        bytes=moved, slot_cap=slot_cap, width=width,
+                        fill=round(fill, 4),
+                        collective_ns=collective_ns)
+        return shards, totals
+
+    def _execute_partitions_ici(self):
+        """Drive the device-resident lane: child batches group into
+        one-batch-per-device rounds, each round runs the measured
+        all-to-all program, received shards stage as SPILLABLE catalog
+        entries tagged `ici_exchange` (the PR 4-6 spill/quota contracts
+        hold). Zero host serialize frames, zero per-batch D2H/H2D.
+
+        Degradation: a classified-transient round failure (or an
+        injected `shuffle.ici_exchange` fault) records against the
+        `ici_exchange` breaker domain and the rest of the stream —
+        the failed round's batches are still in hand — degrades to the
+        host serialize lane; partitions then drain the staged ICI
+        pieces FIRST and the host partitions after, preserving map
+        order."""
+        from itertools import chain
+
+        from .. import faults
+        from ..memory.spillable import SpillableBatch
+        from ..obs import stats as obs_stats
+        from ..shuffle.manager import note_ici_exchange
+        from . import lifecycle
+        n = self.n_partitions
+        schema = self.output_schema
+        in_batches = self.metrics[NUM_INPUT_BATCHES]
+        in_rows = self.metrics[NUM_INPUT_ROWS]
+        self._rr_offset = 0
+        self._ici_cap_hint = 0
+        self._ici_width_hint = 8
+        staged: List[List[SpillableBatch]] = [[] for _ in range(n)]
+        pending: List[ColumnarBatch] = []
+        rr_offs: List[int] = []
+        part_totals = None
+        round_idx = 0
+        fell_back = False
+        stats_rec = obs_stats.ExchangeRecorder(type(self).__name__,
+                                               self._op_id, n)
+        source = self.child.execute()
+        try:
+            def flush():
+                nonlocal part_totals, round_idx
+                with self.metrics[SHUFFLE_WRITE_TIME].ns_timer():
+                    shards, totals = self._ici_exchange_round(
+                        pending, rr_offs, round_idx)
+                for d, shard in enumerate(shards):
+                    staged[d].append(SpillableBatch.from_batch(
+                        shard, origin="ici_exchange"))
+                part_totals = totals if part_totals is None \
+                    else part_totals + totals
+                stats_rec.record_map(totals.tolist(), None, 0)
+                in_batches.add(len(pending))
+                in_rows.add(sum(b.num_rows_host for b in pending))
+                round_idx += 1
+                del pending[:], rr_offs[:]
+
+            try:
+                for b in source:
+                    rows = b.num_rows_host
+                    rr_offs.append(self._rr_offset)
+                    if self.partitioning == "roundrobin":
+                        self._rr_offset = int((self._rr_offset + rows)
+                                              % n)
+                    pending.append(b)
+                    if len(pending) == n:
+                        flush()
+                if pending:
+                    flush()
+            except Exception as e:  # noqa: BLE001 — degradation seam
+                if not faults.is_task_transient(e):
+                    raise
+                # degradation decision: count the failure against the
+                # breaker domain (enough of them opens the breaker and
+                # later exchanges skip the lane up front) and hand the
+                # batches still in hand + the unconsumed remainder to
+                # the always-works host lane
+                lifecycle.record_domain_failure("ici_exchange")
+                note_ici_exchange(fallbacks=1)
+                obs_events.emit("ici_exchange",
+                                exec="HostShuffleExchangeExec",
+                                op_id=self._op_id, round=round_idx,
+                                fallback=True, error=str(e)[:200])
+                # the failed round's batches replay on the host lane:
+                # rewind the round-robin cursor to the round's first
+                # batch so the host lane assigns the SAME partitions
+                # the collective would have
+                if rr_offs:
+                    self._rr_offset = rr_offs[0]
+                fell_back = True
+        except BaseException:
+            for pieces in staged:
+                for sp in pieces:
+                    sp.close()
+            raise
+        if part_totals is not None:
+            max_part = int(part_totals.max())
+            self.metrics[PARTITION_SIZE].add(max_part)
+            obs_events.emit("exchange", exec="HostShuffleExchangeExec",
+                            op_id=self._op_id, partitions=n,
+                            rounds=round_idx, lane="ici",
+                            max_partition_rows=max_part,
+                            partitioning=self.partitioning)
+        if not fell_back:
+            stats_rec.finish_and_emit()
+            lifecycle.record_domain_success("ici_exchange")
+            for p in range(n):
+                yield self._drain_ici_partition(staged[p], schema)
+            return
+        # hybrid drain: staged ICI rounds carry the EARLIER map
+        # batches, the host lane the rest — chaining per partition
+        # preserves the host lane's per-partition row order exactly
+        host_gens = self._execute_partitions_host(
+            chain(iter(pending), source))
+        stats_rec.finish_and_emit()
+        for p, hg in enumerate(host_gens):
+            yield self._chain_ici_host(staged[p], schema, hg)
+
+    def _drain_ici_partition(self, pieces, schema
+                             ) -> Iterator[ColumnarBatch]:
+        out_rows = self.metrics[NUM_OUTPUT_ROWS]
+        out_batches = self.metrics[NUM_OUTPUT_BATCHES]
+        if not pieces:
+            out_batches.add(1)
+            yield empty_batch(schema)
+            return
+        stage = self.pipeline_stage(self._unspill_ici(pieces),
+                                    "ici-read")
+        try:
+            for b in stage:
+                out_batches.add(1)
+                out_rows.add_device(b.num_rows)
+                yield b
+        finally:
+            stage.close()
+
+    @staticmethod
+    def _unspill_ici(pieces) -> Iterator[ColumnarBatch]:
+        """Unspill staged shard pieces one at a time (pipelined: piece
+        k+1's promotion overlaps the consumer's compute on k); an early
+        close drops the staged remainder's catalog entries."""
+        it = iter(pieces)
+        try:
+            for sp in it:
+                try:
+                    b = sp.get_batch()
+                    sp.release()
+                except BaseException:
+                    sp.close()
+                    raise
+                sp.close()
+                yield b
+        finally:
+            for sp in it:
+                sp.close()
+
+    def _chain_ici_host(self, pieces, schema, host_gen
+                        ) -> Iterator[ColumnarBatch]:
+        """Fallback drain for one partition: the staged ICI pieces
+        (earlier map batches) first, then the host lane's stream. The
+        host generator always yields at least an empty batch, so the
+        ICI side skips its own empty-partition padding."""
+        out_rows = self.metrics[NUM_OUTPUT_ROWS]
+        out_batches = self.metrics[NUM_OUTPUT_BATCHES]
+        try:
+            if pieces:
+                stage = self.pipeline_stage(self._unspill_ici(pieces),
+                                            "ici-read")
+                try:
+                    for b in stage:
+                        out_batches.add(1)
+                        out_rows.add_device(b.num_rows)
+                        yield b
+                finally:
+                    stage.close()
+            yield from host_gen
+        finally:
+            host_gen.close()
 
     def _make_recompute(self, handle, mgr, map_id: int):
         """Partition-granular recovery lineage (ISSUE 6): a zero-arg

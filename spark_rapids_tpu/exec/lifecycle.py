@@ -392,6 +392,9 @@ BREAKER_DOMAINS: Dict[str, str] = {
     "device_dispatch": "guarded device dispatch (memory/retry.py "
                        "oom_guard) -> advisory: already the guarded "
                        "path; open state surfaces in health()/events",
+    "ici_exchange": "ICI device-to-device shuffle lane "
+                    "(exec/exchange.py + parallel/exchange.py) "
+                    "-> host serialize/LZ4 shuffle lane",
 }
 
 #: Pallas kernel family (ops/pallas_tier.PALLAS_FAMILIES) -> breaker
@@ -409,6 +412,9 @@ FAMILY_DOMAINS: Dict[str, str] = {
     # dispatch (it rides the device.dispatch fault point); repeated
     # upload failures implicate the device itself
     "h2d_upload": "device_dispatch",
+    # the ICI lane degrades as a whole (to the host serialize path),
+    # not kernel-by-kernel: its bench family maps onto its own domain
+    "ici_all_to_all": "ici_exchange",
 }
 
 BREAKER_STATES = ("closed", "open", "half_open")

@@ -64,6 +64,8 @@ FAULT_POINTS: Dict[str, str] = {
                        "(memory/retry.py oom_guard)",
     "pipeline.produce": "pipeline producer step "
                         "(exec/pipeline.py PipelinedIterator)",
+    "shuffle.ici_exchange": "ICI collective exchange round dispatch "
+                            "(exec/exchange.py _ici_exchange_round)",
 }
 
 KINDS = ("io", "device", "corrupt")
@@ -384,6 +386,7 @@ def uniform_spec(prob: float, seed: int, points=None) -> str:
         "spill.disk_write": "corrupt",
         "shuffle.decode": "corrupt",
         "shuffle.fetch": "io",
+        "shuffle.ici_exchange": "device",
         "io.multifile_read": "io",
     }
     parts = []

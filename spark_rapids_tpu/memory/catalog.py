@@ -63,9 +63,10 @@ def _leaf_nbytes(tree) -> int:
 class _Entry:
     __slots__ = ("handle_id", "tier", "device_tree", "host_leaves", "treedef",
                  "disk_path", "nbytes", "priority", "in_use", "closed",
-                 "writeback", "pending_device", "owner", "seq")
+                 "writeback", "pending_device", "owner", "seq", "origin")
 
-    def __init__(self, handle_id, tree, priority, owner=None, seq=0):
+    def __init__(self, handle_id, tree, priority, owner=None, seq=0,
+                 origin=None):
         self.handle_id = handle_id
         self.tier = StorageTier.DEVICE
         self.device_tree = tree
@@ -89,6 +90,11 @@ class _Entry:
         #: fault-injection work-item key (ISSUE 7 satellite): handle_id
         #: is a uuid that differs across runs, this does not
         self.seq = seq
+        #: which engine seam registered the buffer (ISSUE 16: the ICI
+        #: exchange tags its staged shards "ici_exchange" so the spill
+        #: plane can attribute device pressure to the shuffle lane);
+        #: None for plain operator state
+        self.origin = origin
 
     @property
     def fault_key(self) -> str:
@@ -182,17 +188,21 @@ class BufferCatalog:
         self._add_seq = itertools.count(1)
 
     # -- registration ------------------------------------------------------
-    def add(self, tree, priority: int = ACTIVE_BATCHING_PRIORITY) -> str:
+    def add(self, tree, priority: int = ACTIVE_BATCHING_PRIORITY,
+            origin: Optional[str] = None) -> str:
         """Register a device pytree; returns a handle id. Accounts its
         footprint against the HBM budget, attributed to the admitting
-        query's workload ticket (ISSUE 7 quota accounting)."""
+        query's workload ticket (ISSUE 7 quota accounting). `origin`
+        labels the registering seam for introspection
+        (bytes_by_origin)."""
         from .budget import memory_budget
         from ..exec import workload
         handle = uuid.uuid4().hex
         owner = workload.current_ticket()
         with self._lock:
             seq = next(self._add_seq)
-        entry = _Entry(handle, tree, priority, owner=owner, seq=seq)
+        entry = _Entry(handle, tree, priority, owner=owner, seq=seq,
+                       origin=origin)
         memory_budget().reserve(entry.nbytes)
         workload.charge(owner, entry.nbytes)
         with self._lock:
@@ -778,6 +788,24 @@ class BufferCatalog:
                     host[owner] = host.get(owner, 0) + e.nbytes
                     host_total += e.nbytes
         return dev, host, dev_total, host_total
+
+    def bytes_by_origin(self):
+        """Per-seam resident-byte attribution (ISSUE 16): {origin:
+        (device bytes, host bytes)} over open entries, untagged entries
+        under "untagged". One lock pass, same writeback tolerance as
+        bytes_by_owner. The ICI shuffle's staged shards show up here
+        under "ici_exchange" — the spill-contract test surface."""
+        out: Dict[str, list] = {}
+        with self._lock:
+            for e in self._entries.values():
+                if e.closed:
+                    continue
+                row = out.setdefault(e.origin or "untagged", [0, 0])
+                if e.tier == StorageTier.DEVICE:
+                    row[0] += e.nbytes
+                elif e.tier == StorageTier.HOST:
+                    row[1] += e.nbytes
+        return {k: tuple(v) for k, v in out.items()}
 
 
 _catalog: Optional[BufferCatalog] = None

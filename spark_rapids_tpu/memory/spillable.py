@@ -20,8 +20,9 @@ class SpillableBatch:
 
     @staticmethod
     def from_batch(batch: ColumnarBatch,
-                   priority: int = ACTIVE_BATCHING_PRIORITY) -> "SpillableBatch":
-        handle = buffer_catalog().add(batch, priority)
+                   priority: int = ACTIVE_BATCHING_PRIORITY,
+                   origin: Optional[str] = None) -> "SpillableBatch":
+        handle = buffer_catalog().add(batch, priority, origin=origin)
         # keep the row count lazy: forcing it here would put one d2h sync
         # on every operator's per-batch path (row counts are device scalars
         # after filters/joins); only split/debug paths need the host value.

@@ -17,7 +17,7 @@ Event kinds and their levels (spark.rapids.tpu.eventLog.level):
              spill_error, spill_writer_dead, task_retry_settle_error,
              partition_recompute, breaker_open, breaker_half_open,
              breaker_close, peer_dead, query_queued, query_admitted,
-             quota_spill
+             quota_spill, ici_exchange
   DEBUG      op_open, op_batch, span
 
 Cost discipline: `active_bus()` returns None when logging is disabled —
@@ -60,6 +60,11 @@ EVENT_LEVELS: Dict[str, int] = {
     # the lane (device|host), frame/byte totals and the write-time
     # split (pack = device partition + packed D2H, serialize, file IO)
     "shuffle_write": MODERATE,
+    # ICI device-resident shuffle lane (ISSUE 16): one record per
+    # collective round with bytes moved over the mesh axis, the
+    # negotiated slot_cap, the send-grid fill ratio and the collective
+    # wall time
+    "ici_exchange": MODERATE,
     "pipeline_wait": MODERATE,
     "pipeline_full": MODERATE,
     # robustness events (ISSUE 4): injected faults, retries at every

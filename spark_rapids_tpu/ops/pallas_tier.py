@@ -36,8 +36,12 @@ from typing import Dict, Optional, Tuple
 #: — the gate is spark.rapids.tpu.transfer.packedUpload.enabled, not a
 #: tier consult), registered here so the kern_bench/docs/breaker-domain
 #: lints cover it like every other measured family.
+#: `ici_all_to_all` follows the same lanes-not-kernels pattern: its two
+#: bench lanes are the host serialize/LZ4 shuffle exchange vs the
+#: device-resident packed all_to_all step (parallel/exchange.py); the
+#: gate is spark.rapids.tpu.shuffle.ici.enabled, not a tier consult.
 PALLAS_FAMILIES = ("murmur3", "join_probe", "scan_agg", "gather",
-                   "partition_split", "h2d_upload")
+                   "partition_split", "h2d_upload", "ici_all_to_all")
 
 #: kern_bench.json layout version. The records file is rewritten by
 #: tools/kern_bench.py with this stamp; a file from an older layout

@@ -9,8 +9,9 @@ the reference's bounce-buffer + RDMA state machines entirely.
 Static-shape contract: every device sends exactly `slot_cap` row slots to
 every peer (invalid slots carry validity False). slot_cap defaults to the
 full local capacity — the true worst case (all local rows hash to one
-partition) — so the exchange can never drop rows; callers with knowledge of
-key distribution can pass a smaller cap and trade memory for speed.
+partition) — so the exchange can never drop rows; production callers
+negotiate a smaller cap from measured per-partition load
+(`negotiate_slot_cap`, ISSUE 16) and trade memory for speed.
 
 Strings ride as (lengths, fixed-width padded byte matrix) pairs
 (ops/strings.py string_to_padded) — the TPU answer to cuDF's varlen
@@ -30,6 +31,22 @@ from ..ops.hashing import murmur3_batch, pmod
 
 #: hash seed for shuffle partitioning (Spark uses 42 for HashPartitioning)
 SHUFFLE_SEED = 42
+
+
+def negotiate_slot_cap(measured_max: int, capacity: int,
+                       hint: int = 0) -> int:
+    """Slot capacity for the (n_parts, slot_cap) send grid, negotiated
+    from the MEASURED max per-partition load instead of the worst-case
+    full-capacity default (ISSUE 16: the review-r1 sizing promoted to a
+    shared primitive). `hint` is the caller's running high-water mark
+    from earlier rounds' per-partition statistics (ISSUE 11) — flooring
+    by it keeps the exchange program shape stable across rounds of one
+    stage, so a later smaller round reuses the compiled step instead of
+    tracing a fresh one. Bucketed (bucket_capacity) and clamped to the
+    local capacity, which is the true worst case."""
+    from ..columnar.column import bucket_capacity
+    return min(bucket_capacity(max(int(measured_max), int(hint), 1)),
+               capacity)
 
 
 def partition_ids(key_cols: Sequence[Column], num_rows, capacity: int,

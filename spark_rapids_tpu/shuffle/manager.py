@@ -57,6 +57,29 @@ def counters() -> Dict[str, int]:
     return out
 
 
+#: process-cumulative ICI-lane counters (ISSUE 16; bench.py embeds
+#: per-record deltas like the write counters above): collective rounds
+#: and batches exchanged device-to-device, bytes moved over the mesh
+#: axis, collective wall time, and rounds that degraded to the host
+#: serialize lane
+_ICI_COUNTERS = {"rounds": 0, "batches": 0, "bytes": 0,
+                 "collective_ns": 0, "fallbacks": 0}
+
+
+def note_ici_exchange(**deltas) -> None:
+    with _COUNTER_LOCK:
+        for k, v in deltas.items():
+            _ICI_COUNTERS[k] += v
+
+
+def ici_counters() -> Dict[str, int]:
+    """Snapshot of the ICI exchange-lane counters. `frames`/`bytes` in
+    counters() stay flat while this lane carries the data — the
+    structural zero-host-serialize assertion tests pin."""
+    with _COUNTER_LOCK:
+        return dict(_ICI_COUNTERS)
+
+
 class HostShuffleHandle:
     """Registration record (Spark's ShuffleHandle analog)."""
 

@@ -1,0 +1,369 @@
+"""ICI-native device-resident shuffle lane (ISSUE 16): on a mesh whose
+axis size equals the partition count, the host shuffle exchange runs
+map-side partition split + packed all-to-all + reduce-side unpack
+entirely on device — zero host serialize frames, zero per-batch
+D2H/H2D. The host serialize/LZ4 path stays as the degradation tier.
+
+Covers: byte-identical results vs the host lane across column families
+(strings, nulls, decimal128, empty partitions), the structural
+zero-host-serialize claim, slot-cap negotiation, spillability of staged
+exchange shards (origin-tagged catalog entries), the roundrobin cursor,
+injected-fault fallback (whole-stream and mid-stream hybrid drain) and
+ICI-lane eligibility gating."""
+
+import decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar import upload
+from spark_rapids_tpu.memory.catalog import buffer_catalog
+from spark_rapids_tpu.parallel.exchange import negotiate_slot_cap
+from spark_rapids_tpu.shuffle import manager as shuffle_mgr
+from spark_rapids_tpu.types import (DOUBLE, LONG, STRING, ArrayType,
+                                    DecimalType, Schema, StructField)
+
+N_DEV = 8  # tests/conftest.py forces 8 virtual CPU devices
+
+
+def _conf(ici: bool, extra=None):
+    conf = {
+        # planExchange=false keeps the mesh for collectives while the
+        # planner still places the HOST shuffle exchange — the exec the
+        # ICI lane lives in
+        "spark.rapids.sql.shuffle.partitions": str(N_DEV),
+        "spark.rapids.tpu.shuffle.planExchange": "false",
+        "spark.rapids.sql.broadcastSizeThreshold": "-1",
+        "spark.rapids.tpu.shuffle.ici.enabled": str(ici).lower(),
+    }
+    if extra:
+        conf.update(extra)
+    return conf
+
+
+def _ici_session(extra=None):
+    return TpuSession(_conf(True, extra), mesh_devices=N_DEV)
+
+
+def _host_session(extra=None):
+    return TpuSession(_conf(False, extra), mesh_devices=N_DEV)
+
+
+def _sorted(rows):
+    return sorted(rows, key=repr)
+
+
+def _find_exchange(plan):
+    from spark_rapids_tpu.exec.exchange import HostShuffleExchangeExec
+    if isinstance(plan, HostShuffleExchangeExec):
+        return plan
+    for attr in ("child", "left", "right"):
+        c = getattr(plan, attr, None)
+        if c is not None:
+            found = _find_exchange(c)
+            if found is not None:
+                return found
+    for c in getattr(plan, "children", ()) or ():
+        found = _find_exchange(c)
+        if found is not None:
+            return found
+    return None
+
+
+# ---------------------------------------------------------------------------
+# slot-cap negotiation (parallel/exchange.py promoted primitive)
+# ---------------------------------------------------------------------------
+
+def test_negotiate_slot_cap():
+    from spark_rapids_tpu.columnar.column import bucket_capacity
+    # measured load rounds up to its capacity bucket...
+    assert negotiate_slot_cap(100, 1024) == bucket_capacity(100)
+    # ...but never past the full-capacity worst case
+    assert negotiate_slot_cap(5000, 1024) == 1024
+    # empty rounds still get a 1-slot grid (all_to_all needs a shape)
+    assert negotiate_slot_cap(0, 1024) >= 1
+    # the running high-water hint floors the cap so later smaller
+    # rounds reuse the SAME compiled step (shape stability)
+    small = negotiate_slot_cap(3, 1024)
+    assert negotiate_slot_cap(3, 1024, hint=100) \
+        == negotiate_slot_cap(100, 1024) >= small
+
+
+# ---------------------------------------------------------------------------
+# equality drive: ICI vs host lane, byte-identical per-partition order
+# ---------------------------------------------------------------------------
+
+def _rich_data(n=300):
+    rng = np.random.default_rng(16)
+    return {
+        "k": [int(x) for x in rng.integers(0, 20, n)],
+        "v": [None if x % 11 == 0 else int(x)
+              for x in rng.integers(-(10 ** 12), 10 ** 12, n)],
+        "s": [None if x % 5 == 0 else ("värde-%d" % x) * (x % 4)
+              for x in range(n)],
+        "d": [None if x % 7 == 0 else float(x) * 0.5 for x in range(n)],
+        "dec": [None if x % 6 == 0
+                else decimal.Decimal(int(x) * 123456789).scaleb(-2)
+                for x in rng.integers(0, 10 ** 6, n)],
+    }
+
+
+def _rich_schema():
+    return Schema((StructField("k", LONG), StructField("v", LONG),
+                   StructField("s", STRING), StructField("d", DOUBLE),
+                   StructField("dec", DecimalType(30, 2))))
+
+
+def test_ici_repartition_matches_host_exactly():
+    """Round-robin repartition of string/null/decimal128 payloads: the
+    ICI lane's output rows EQUAL the host lane's in order, not just as
+    multisets — the one-map-batch-per-device round grouping preserves
+    per-partition row order."""
+    data, sch = _rich_data(), _rich_schema()
+
+    def q(sess):
+        return sess.from_pydict(data, sch, batch_rows=64) \
+            .repartition(N_DEV).collect()
+
+    host = q(_host_session())
+    i0 = shuffle_mgr.ici_counters()
+    ici = q(_ici_session())
+    i1 = shuffle_mgr.ici_counters()
+    assert i1["rounds"] > i0["rounds"], "ICI lane did not engage"
+    assert ici == host
+
+
+@pytest.mark.slow  # ~90s: two fresh sessions compile the 8-way shuffled
+# join pipeline; string/decimal exchange equality stays tier-1 via the
+# repartition drive, and the driver's dryrun third leg keeps a join-path
+# ICI check in every round
+def test_ici_join_agg_matches_host():
+    """Hash-partitioned shuffled join + aggregation over the mesh:
+    ICI and host lanes agree, with string payloads through the join
+    exchange."""
+    rng = np.random.default_rng(7)
+    ldata = {"k": [int(x) for x in rng.integers(0, 20, 300)],
+             "v": [int(x) for x in rng.integers(0, 50, 300)]}
+    rdata = {"k": [int(x) for x in rng.integers(0, 20, 200)],
+             "w": [["a", "bb", None, "dddd"][int(x)]
+                   for x in rng.integers(0, 4, 200)]}
+    lsch = Schema((StructField("k", LONG), StructField("v", LONG)))
+    rsch = Schema((StructField("k", LONG), StructField("w", STRING)))
+
+    def q(sess):
+        l = sess.from_pydict(ldata, lsch, batch_rows=64)
+        r = sess.from_pydict(rdata, rsch, batch_rows=64)
+        return l.join(r, on="k").group_by("k").agg(
+            (F.sum(col("v")), "sv"), (F.count(), "c")).collect()
+
+    host = q(_host_session())
+    i0 = shuffle_mgr.ici_counters()
+    ici = q(_ici_session())
+    i1 = shuffle_mgr.ici_counters()
+    assert i1["rounds"] > i0["rounds"]
+    assert i1["fallbacks"] == i0["fallbacks"]
+    assert _sorted(ici) == _sorted(host)
+
+
+def test_ici_empty_partitions():
+    """Keys confined to two values on an 8-way mesh: most partitions
+    receive nothing, the compaction still yields exact results and the
+    empty partitions drain as empty batches."""
+    data = {"k": [0, 1] * 40, "v": list(range(80))}
+    sch = Schema((StructField("k", LONG), StructField("v", LONG)))
+
+    def q(sess):
+        return sess.from_pydict(data, sch, batch_rows=16) \
+            .group_by("k").agg((F.sum(col("v")), "sv"),
+                               (F.count(), "c")).collect()
+
+    host = q(_host_session())
+    ici = q(_ici_session())
+    assert _sorted(ici) == _sorted(host)
+    assert len(ici) == 2
+
+
+# ---------------------------------------------------------------------------
+# the structural claim: map output never leaves HBM
+# ---------------------------------------------------------------------------
+
+def test_ici_zero_host_serialize_frames():
+    """On the ICI lane the host serializer writes ZERO frames and the
+    upload engine runs ZERO shuffle-read ingests — the exchanged bytes
+    moved device-to-device (shuffle/manager + columnar/upload counter
+    deltas are the structural witnesses)."""
+    data, sch = _rich_data(), _rich_schema()
+    sess = _ici_session()
+    df = sess.from_pydict(data, sch, batch_rows=64).repartition(N_DEV)
+    tree = df._exec().tree_string()
+    assert "HostShuffleExchangeExec" in tree, tree
+
+    c0 = shuffle_mgr.counters()
+    i0 = shuffle_mgr.ici_counters()
+    u0 = upload.counters()
+    rows = df.collect()
+    c1 = shuffle_mgr.counters()
+    i1 = shuffle_mgr.ici_counters()
+    u1 = upload.counters()
+
+    assert len(rows) == len(data["k"])
+    assert c1["frames"] == c0["frames"], \
+        "host serialize frames on the ICI lane"
+    assert c1["bytes"] == c0["bytes"]
+    assert u1["uploads"] == u0["uploads"], \
+        "shuffle-read h2d ingest on the ICI lane"
+    assert i1["rounds"] > i0["rounds"]
+    assert i1["bytes"] > i0["bytes"]
+    assert i1["fallbacks"] == i0["fallbacks"]
+
+
+# ---------------------------------------------------------------------------
+# staged shards are real catalog citizens: origin tag + forced spill
+# ---------------------------------------------------------------------------
+
+def test_ici_staged_shards_spill_and_recover():
+    """Staged exchange shards are origin-tagged spillable catalog
+    entries: mid-drain they show under bytes_by_origin(), a forced
+    full spill pushes them off-device, and the remaining partitions
+    unspill to the exact host-lane rows."""
+    data, sch = _rich_data(), _rich_schema()
+    host = _host_session().from_pydict(data, sch, batch_rows=64) \
+        .repartition(N_DEV).collect()
+
+    sess = _ici_session()
+    plan = sess.from_pydict(data, sch, batch_rows=64) \
+        .repartition(N_DEV)._exec()
+    it = plan.execute()
+    first = next(it)  # all rounds ran; later partitions still staged
+    org = buffer_catalog().bytes_by_origin()
+    assert "ici_exchange" in org, org
+    dev_b, host_b = org["ici_exchange"]
+    assert dev_b + host_b > 0
+    buffer_catalog().synchronous_spill(None)  # steal everything
+    batches = [first] + list(it)
+    rows = [tuple(r) for b in batches for r in b.to_pylist()]
+    assert rows == [tuple(r) for r in host]
+
+
+# ---------------------------------------------------------------------------
+# degradation: injected collective fault -> host serialize lane
+# ---------------------------------------------------------------------------
+
+def _fault_guard():
+    faults.install(None)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def test_ici_fault_falls_back_to_host():
+    """A seeded device fault at the collective dispatch
+    (shuffle.ici_exchange) opens the round's degradation path: the
+    stream finishes on the host serialize lane with exact results and
+    one recorded fallback."""
+    data, sch = _rich_data(), _rich_schema()
+
+    def q(sess):
+        return sess.from_pydict(data, sch, batch_rows=64) \
+            .repartition(N_DEV).collect()
+
+    host = q(_host_session())
+    i0 = shuffle_mgr.ici_counters()
+    faults.install("shuffle.ici_exchange:prob=1,seed=3,kind=device,max=1")
+    try:
+        ici = q(_ici_session())
+    finally:
+        faults.install(None)
+    i1 = shuffle_mgr.ici_counters()
+    assert i1["fallbacks"] - i0["fallbacks"] >= 1
+    assert ici == host
+
+
+def test_ici_midstream_fault_hybrid_drain():
+    """A fault AFTER successful rounds exercises the hybrid drain:
+    staged ICI pieces (earlier map batches) chain before the host
+    lane's partition streams, preserving exact row order. Driven
+    deterministically at the exec seam — a transient raise on round 1
+    of a multi-round stream."""
+    rng = np.random.default_rng(11)
+    data = {"k": [int(x) for x in rng.integers(0, 9, 1200)],
+            "v": [int(x) for x in rng.integers(-40, 40, 1200)]}
+    sch = Schema((StructField("k", LONG), StructField("v", LONG)))
+    # small coalesce target keeps the 64-row scan batches from merging
+    # into one exchange input (19 map batches -> a 3-round stream)
+    extra = {"spark.rapids.sql.batchSizeBytes": "4096"}
+    host = _host_session(extra).from_pydict(data, sch, batch_rows=64) \
+        .repartition(N_DEV).collect()
+
+    sess = _ici_session(extra)
+    plan = sess.from_pydict(data, sch, batch_rows=64) \
+        .repartition(N_DEV)._exec()
+    ex = _find_exchange(plan)
+    assert ex is not None
+    orig = ex._ici_exchange_round
+
+    def flaky(batches, rr_offs, round_idx):
+        if round_idx >= 1:
+            raise faults.InjectedDeviceError("shuffle.ici_exchange")
+        return orig(batches, rr_offs, round_idx)
+
+    ex._ici_exchange_round = flaky
+    i0 = shuffle_mgr.ici_counters()
+    rows = [tuple(r) for b in plan.execute() for r in b.to_pylist()]
+    i1 = shuffle_mgr.ici_counters()
+    assert i1["rounds"] - i0["rounds"] == 1  # round 0 succeeded on ICI
+    assert i1["fallbacks"] - i0["fallbacks"] == 1
+    assert rows == [tuple(r) for r in host]
+
+
+# ---------------------------------------------------------------------------
+# eligibility gating
+# ---------------------------------------------------------------------------
+
+def test_ici_requires_mesh_matching_partitions():
+    """Partition count != mesh axis size -> the exchange silently keeps
+    the host lane (no rounds, frames move)."""
+    data = {"k": [int(x) for x in range(100)],
+            "v": [int(x) for x in range(100)]}
+    sch = Schema((StructField("k", LONG), StructField("v", LONG)))
+    sess = TpuSession(_conf(True, {
+        "spark.rapids.sql.shuffle.partitions": "4"}), mesh_devices=N_DEV)
+    i0 = shuffle_mgr.ici_counters()
+    c0 = shuffle_mgr.counters()
+    got = sess.from_pydict(data, sch, batch_rows=32) \
+        .group_by("k").agg((F.count(), "c")).collect()
+    i1 = shuffle_mgr.ici_counters()
+    c1 = shuffle_mgr.counters()
+    assert len(got) == 100
+    assert i1["rounds"] == i0["rounds"]
+    assert c1["frames"] > c0["frames"], "host lane should have run"
+
+
+def test_ici_skips_nested_payloads():
+    """Array payloads have no packed collective representation — the
+    eligibility gate keeps such schemas on the host lane instead of
+    dispatching a collective that cannot carry them."""
+    data = {"k": [int(x) for x in range(60)],
+            "a": [[int(x), int(x) + 1] for x in range(60)]}
+    sch = Schema((StructField("k", LONG),
+                  StructField("a", ArrayType(LONG))))
+
+    def q(sess):
+        return sess.from_pydict(data, sch, batch_rows=16) \
+            .repartition(N_DEV).collect()
+
+    host = q(_host_session())
+    i0 = shuffle_mgr.ici_counters()
+    ici = q(_ici_session())
+    i1 = shuffle_mgr.ici_counters()
+    assert i1["rounds"] == i0["rounds"], \
+        "nested payload must not take the collective lane"
+    assert ici == host

@@ -52,6 +52,10 @@ DEFAULT_SHAPES = {
     # (rows, n_columns) — packed one-copy host->device batch upload vs
     # the per-buffer jnp.asarray lane (ISSUE 10; lanes, not kernels)
     "h2d_upload": [(1 << 14, 8), (1 << 16, 16)],
+    # (rows per map batch, n_partitions) — device-resident all_to_all
+    # exchange vs the host serialize/LZ4 round trip it replaces
+    # (ISSUE 16; lanes, not kernels)
+    "ici_all_to_all": [(1 << 13, 8), (1 << 15, 8)],
 }
 
 #: smallest per-family shape for --quick CI smoke (compile + one
@@ -63,6 +67,7 @@ QUICK_SHAPES = {
     "gather": [(1 << 11, 1 << 10)],
     "partition_split": [(1 << 11, 4)],
     "h2d_upload": [(1 << 11, 4)],
+    "ici_all_to_all": [(1 << 10, 4)],
 }
 
 
@@ -385,6 +390,83 @@ def bench_h2d_upload(shape, iters, reps, interpret):
             _timed(packed_step, iters, reps))
 
 
+def bench_ici_all_to_all(shape, iters, reps, interpret):
+    """ICI-native device-resident shuffle exchange (ISSUE 16). The
+    record's two slots map lanes, not kernels: xla_ms = the host
+    fallback lane's per-map-batch serialize/LZ4 -> deserialize/upload
+    round trip (shuffle/serializer.py), pallas_ms = the packed device
+    all_to_all exchange step (parallel/exchange.exchange_columns under
+    shard_map). `interpret` is unused — neither lane is a Pallas
+    kernel; the runtime gate is spark.rapids.tpu.shuffle.ici.enabled.
+    Shape is (rows per map batch, n_partitions); the mesh spans
+    min(n_partitions, visible devices) so the family records on a
+    single-device host too (there the collective degenerates to a local
+    permutation — a TPU pod round is what makes the record
+    meaningful)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+    from spark_rapids_tpu.parallel.distributed import stack_batches
+    from spark_rapids_tpu.parallel.exchange import (exchange_columns,
+                                                    negotiate_slot_cap)
+    from spark_rapids_tpu.parallel.mesh import (DATA_AXIS, device_mesh,
+                                                shard_map_compat)
+    from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
+                                                     serialize_batch)
+    from spark_rapids_tpu.types import LONG, Schema, StructField
+
+    rows, n_parts = shape
+    n = min(n_parts, len(jax.devices()))
+    mesh = device_mesh(n)
+    rng = np.random.default_rng(16)
+    cap = bucket_capacity(rows)
+    schema = Schema((StructField("k", LONG), StructField("v", LONG)))
+    batches = []
+    for _ in range(n):
+        k = Column.from_numpy(
+            rng.integers(0, 1 << 20, rows).astype(np.int64), LONG,
+            capacity=cap)
+        v = Column.from_numpy(
+            rng.integers(-(2**40), 2**40, rows).astype(np.int64), LONG,
+            capacity=cap)
+        batches.append(ColumnarBatch([k, v], rows, schema))
+    stacked = stack_batches(batches)
+    # worst-case-safe slot cap (one device could hash every row to one
+    # partition); production rounds negotiate a measured cap instead
+    slot_cap = negotiate_slot_cap(rows, cap)
+
+    def spmd(st):
+        local = jax.tree_util.tree_map(lambda x: x[0], st)
+        cols, n_recv = exchange_columns(
+            list(local.columns), (0,), local.num_rows, local.capacity,
+            DATA_AXIS, n, slot_cap=slot_cap)
+        return jax.tree_util.tree_map(
+            lambda x: x[None], ColumnarBatch(cols, n_recv, schema))
+
+    step = jax.jit(shard_map_compat(
+        spmd, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS)))
+
+    def fold(chk, batch):
+        for c in batch.columns:
+            chk = chk + jnp.sum(jnp.where(c.validity, c.data, 0)) \
+                .astype(jnp.float64)
+        return chk
+
+    def host_step(chk):
+        for b in batches:
+            chk = fold(chk, deserialize_batch(serialize_batch(b), schema))
+        return chk
+
+    def ici_step(chk):
+        return fold(chk, step(stacked))
+
+    return (_timed(host_step, iters, reps),
+            _timed(ici_step, iters, reps))
+
+
 BENCHES = {
     "join_probe": bench_join_probe,
     "scan_agg": bench_scan_agg,
@@ -392,6 +474,7 @@ BENCHES = {
     "gather": bench_gather,
     "partition_split": bench_partition_split,
     "h2d_upload": bench_h2d_upload,
+    "ici_all_to_all": bench_ici_all_to_all,
 }
 
 

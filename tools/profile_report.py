@@ -214,6 +214,8 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
     gstats = [e for e in events if e.get("kind") == "gather_stats"]
     ups = [e for e in events if e.get("kind") == "upload"]
     xstats = [e for e in events if e.get("kind") == "exchange_stats"]
+    ici = [e for e in events if e.get("kind") == "ici_exchange"]
+    ici_ok = [e for e in ici if not e.get("fallback")]
     waits = [e.get("wait_ms") or 0 for e in events
              if e.get("kind") == "query_admitted"]
 
@@ -243,6 +245,23 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
             "pack_ns": total("shuffle_write", "pack_ns"),
             "serialize_ns": total("shuffle_write", "serialize_ns"),
             "io_ns": total("shuffle_write", "io_ns")},
+        # ICI shuffle roll-up (ISSUE 16): device-resident all-to-all
+        # exchange rounds — bytes that never touched the host, the
+        # negotiated slot caps and grid fill (the sizing methodology's
+        # feedback signal), and how many streams degraded to the host
+        # serialize lane. Zero-tolerant: pre-ICI logs report zeros.
+        "ici_shuffle": {
+            "rounds": len(ici_ok),
+            "batches": sum(e.get("batches") or 0 for e in ici_ok),
+            "rows": sum(e.get("rows") or 0 for e in ici_ok),
+            "bytes": sum(e.get("bytes") or 0 for e in ici_ok),
+            "collective_ns": sum(e.get("collective_ns") or 0
+                                 for e in ici_ok),
+            "max_slot_cap": max((e.get("slot_cap") or 0
+                                 for e in ici_ok), default=0),
+            "avg_fill": round(sum(e.get("fill") or 0 for e in ici_ok)
+                              / len(ici_ok), 4) if ici_ok else 0.0,
+            "fallbacks": sum(1 for e in ici if e.get("fallback"))},
         "plan_fallbacks": (count("plan_fallback")
                            + count("plan_not_on_tpu")),
         "robustness": {
@@ -388,6 +407,18 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
             f"{_fmt_ns(sw['pack_ns'])}, serialize "
             f"{_fmt_ns(sw['serialize_ns'])}, io "
             f"{_fmt_ns(sw['io_ns'])})")
+    # ICI shuffle roll-up (ISSUE 16): the host-serialize collapse is
+    # the optimization, so a pod round reads this line right under the
+    # shuffle-write (host lane) one
+    ic = s["ici_shuffle"]
+    if ic["rounds"] or ic["fallbacks"]:
+        extras.append(
+            f"ici shuffle: {ic['rounds']} collective round(s) "
+            f"({ic['batches']} map batches, {ic['rows']} rows, "
+            f"{_fmt_bytes(ic['bytes'])} device-to-device in "
+            f"{_fmt_ns(ic['collective_ns'])}; slot cap "
+            f"{ic['max_slot_cap']}, fill {ic['avg_fill']:.2f}; "
+            f"{ic['fallbacks']} host-lane fallback(s))")
     if s["plan_fallbacks"]:
         extras.append(f"plan fallback/why-not records: "
                       f"{s['plan_fallbacks']}")
