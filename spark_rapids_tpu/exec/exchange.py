@@ -680,15 +680,19 @@ class HostShuffleExchangeExec(TpuExec):
             return
         yield from self._execute_partitions_host()
 
-    def _execute_partitions_host(self, override_source=None
+    def _execute_partitions_host(self, override_source=None,
+                                 stats_rec=None
                                  ) -> "Iterator[Iterator[ColumnarBatch]]":
         """The host shuffle-manager lane (and the ICI lane's fallback
         tier). `override_source` replaces the child stream when the ICI
         lane degrades mid-stream: the leftover batches it already
         pulled plus the unconsumed remainder. On that path lineage
         capture is off (a recompute would replay the child from batch
-        zero and rewrite the wrong map output) and the round-robin
-        cursor continues from where the ICI rounds left it."""
+        zero and rewrite the wrong map output), the round-robin cursor
+        continues from where the ICI rounds left it, and `stats_rec`
+        carries the ICI rounds' map records in — the write phase below
+        appends its own and emits the execution's ONE exchange_stats
+        record."""
         from ..shuffle.manager import HostShuffleReader, shuffle_manager
         mgr = shuffle_manager()
         handle = mgr.register(self.n_partitions, self.output_schema)
@@ -747,8 +751,9 @@ class HostShuffleExchangeExec(TpuExec):
             # this thread) and the process-wide collector
             from ..obs import stats as obs_stats
             from ..obs import telemetry
-            stats_rec = obs_stats.ExchangeRecorder(
-                type(self).__name__, self._op_id, self.n_partitions)
+            if stats_rec is None:
+                stats_rec = obs_stats.ExchangeRecorder(
+                    type(self).__name__, self._op_id, self.n_partitions)
             map_id = 0
             for b in source:
                 in_batches.add(1)
@@ -907,7 +912,10 @@ class HostShuffleExchangeExec(TpuExec):
     def _ici_measure_kernel(self, stacked, rr):
         """Per-device partition histogram + max string byte length,
         vmapped over the device axis (pure measurement, no collective):
-        ONE host sync per round sizes the negotiated slot grid."""
+        ONE host sync per round sizes the negotiated slot grid. The
+        histogram comes back per device — one row per map batch — so
+        the runtime-statistics recorder keeps the host lane's per-map
+        granularity."""
         n = self.n_partitions
 
         def per_dev(local: ColumnarBatch, off):
@@ -924,9 +932,8 @@ class HostShuffleExchangeExec(TpuExec):
                         max_len, jnp.max(jnp.where(act, lens, 0)))
             return jnp.max(counts[:n]), max_len, counts[:n]
 
-        max_count, max_len, totals = jax.vmap(per_dev)(stacked, rr)
-        return jnp.max(max_count), jnp.max(max_len), jnp.sum(totals,
-                                                             axis=0)
+        max_count, max_len, per_map = jax.vmap(per_dev)(stacked, rr)
+        return jnp.max(max_count), jnp.max(max_len), per_map
 
     def _get_ici_measure(self):
         if self._ici_measure is None:
@@ -937,11 +944,18 @@ class HostShuffleExchangeExec(TpuExec):
 
     def _get_ici_step(self, cap: int, slot_cap: int, width: int):
         """The exchange program per (capacity, slot_cap, string width)
-        shape: partition-split into the (n, slot_cap) send grid and
-        all-to-all every column lane over the mesh axis — built through
-        _site so an identical later plan reuses the compiled program
-        (exec/stage_compiler.py fingerprint cache)."""
-        key = (cap, slot_cap, width)
+        shape AND mesh identity: partition-split into the (n, slot_cap)
+        send grid and all-to-all every column lane over the mesh axis —
+        built through _site so an identical later plan reuses the
+        compiled program (exec/stage_compiler.py fingerprint cache).
+        The compiled step closes over the mesh it was built under, so
+        the mesh's axis names + devices are part of the key (and the
+        fingerprint salt): a session that installs a different mesh
+        later — same axis size, different Mesh/device set — gets a
+        fresh step instead of a collective over the stale mesh."""
+        mesh = self._ici_mesh
+        key = (cap, slot_cap, width, mesh.axis_names,
+               tuple(mesh.devices.flat))
         step = self._ici_steps.get(key)
         if step is not None:
             return step
@@ -959,7 +973,7 @@ class HostShuffleExchangeExec(TpuExec):
 
         from ..parallel.mesh import shard_map_compat
         step = self._site(
-            shard_map_compat(spmd, mesh=self._ici_mesh,
+            shard_map_compat(spmd, mesh=mesh,
                              in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
                              out_specs=P(DATA_AXIS)),
             "HostShuffleExchangeExec.ici_exchange_step", key_salt=key)
@@ -971,7 +985,8 @@ class HostShuffleExchangeExec(TpuExec):
         map order, padded with empties), so partition p's received rows
         concatenate across devices in the host lane's map order —
         byte-identical per-partition row order. Returns the n received
-        shard batches + the exact per-partition row totals."""
+        shard batches + the (n_devices, n_partitions) per-map-batch row
+        histogram (sum over axis 0 = the round's partition totals)."""
         import time as _time
 
         import numpy as _np
@@ -995,7 +1010,7 @@ class HostShuffleExchangeExec(TpuExec):
                                 fault_key=f"r{round_idx}",
                                 metric_scope=True):
             stacked = stack_batches(per_dev)
-            max_count, max_len, totals = self._get_ici_measure()(
+            max_count, max_len, per_map = self._get_ici_measure()(
                 stacked, rr)
             # one host sync per round; the running high-water hints
             # keep later (smaller) rounds on the SAME compiled step
@@ -1008,9 +1023,9 @@ class HostShuffleExchangeExec(TpuExec):
             out = self._get_ici_step(cap, slot_cap, width)(stacked, rr)
             shards = unstack_batches(out, n)
         collective_ns = _time.perf_counter_ns() - t0
-        totals = _np.asarray(totals)
+        per_map = _np.asarray(per_map)
         moved = sum(s.device_size_bytes() for s in shards)
-        rows = int(totals.sum())
+        rows = int(per_map.sum())
         fill = rows / float(n * n * slot_cap) if slot_cap else 0.0
         self.metrics[SHUFFLE_PACK_TIME].add(collective_ns)
         note_ici_exchange(rounds=1, batches=len(batches), bytes=moved,
@@ -1021,7 +1036,7 @@ class HostShuffleExchangeExec(TpuExec):
                         bytes=moved, slot_cap=slot_cap, width=width,
                         fill=round(fill, 4),
                         collective_ns=collective_ns)
-        return shards, totals
+        return shards, per_map
 
     def _execute_partitions_ici(self):
         """Drive the device-resident lane: child batches group into
@@ -1030,13 +1045,18 @@ class HostShuffleExchangeExec(TpuExec):
         entries tagged `ici_exchange` (the PR 4-6 spill/quota contracts
         hold). Zero host serialize frames, zero per-batch D2H/H2D.
 
-        Degradation: a classified-transient round failure (or an
-        injected `shuffle.ici_exchange` fault) records against the
-        `ici_exchange` breaker domain and the rest of the stream —
-        the failed round's batches are still in hand — degrades to the
-        host serialize lane; partitions then drain the staged ICI
-        pieces FIRST and the host partitions after, preserving map
-        order."""
+        Degradation: a classified-transient failure of the COLLECTIVE
+        ROUND itself (or an injected `shuffle.ici_exchange` fault)
+        records against the `ici_exchange` breaker domain and the rest
+        of the stream — the failed round's batches are still in hand —
+        degrades to the host serialize lane; partitions then drain the
+        staged ICI pieces FIRST and the host partitions after,
+        preserving map order. The seam is deliberately THAT narrow: a
+        transient raised while pulling from the CHILD stream must
+        propagate to the task-retry layer exactly as the host lane
+        would propagate it — a generator that raised is finalized, so
+        chaining its remainder would silently drop every unconsumed
+        child batch and return partial results."""
         from itertools import chain
 
         from .. import faults
@@ -1053,6 +1073,7 @@ class HostShuffleExchangeExec(TpuExec):
         self._ici_width_hint = 8
         staged: List[List[SpillableBatch]] = [[] for _ in range(n)]
         pending: List[ColumnarBatch] = []
+        pending_rows = 0
         rr_offs: List[int] = []
         part_totals = None
         round_idx = 0
@@ -1061,55 +1082,69 @@ class HostShuffleExchangeExec(TpuExec):
                                                self._op_id, n)
         source = self.child.execute()
         try:
-            def flush():
-                nonlocal part_totals, round_idx
-                with self.metrics[SHUFFLE_WRITE_TIME].ns_timer():
-                    shards, totals = self._ici_exchange_round(
-                        pending, rr_offs, round_idx)
+            def try_flush() -> bool:
+                """Run one collective round over `pending`; True on
+                success. Only the round dispatch is inside the
+                degradation seam — once its shards are in hand they
+                are staged unconditionally (replaying the same batches
+                on the host lane after a partial stage would duplicate
+                rows)."""
+                nonlocal part_totals, pending_rows, round_idx, fell_back
+                try:
+                    with self.metrics[SHUFFLE_WRITE_TIME].ns_timer():
+                        shards, per_map = self._ici_exchange_round(
+                            pending, rr_offs, round_idx)
+                except Exception as e:  # noqa: BLE001 — degradation seam
+                    if not faults.is_task_transient(e):
+                        raise
+                    # degradation decision: count the failure against
+                    # the breaker domain (enough of them opens the
+                    # breaker and later exchanges skip the lane up
+                    # front) and hand the batches still in hand + the
+                    # unconsumed remainder to the always-works host lane
+                    lifecycle.record_domain_failure("ici_exchange")
+                    note_ici_exchange(fallbacks=1)
+                    obs_events.emit("ici_exchange",
+                                    exec="HostShuffleExchangeExec",
+                                    op_id=self._op_id, round=round_idx,
+                                    fallback=True, error=str(e)[:200])
+                    # the failed round's batches replay on the host
+                    # lane: rewind the round-robin cursor to the
+                    # round's first batch so the host lane assigns the
+                    # SAME partitions the collective would have
+                    if rr_offs:
+                        self._rr_offset = rr_offs[0]
+                    fell_back = True
+                    return False
                 for d, shard in enumerate(shards):
                     staged[d].append(SpillableBatch.from_batch(
                         shard, origin="ici_exchange"))
+                totals = per_map.sum(axis=0)
                 part_totals = totals if part_totals is None \
                     else part_totals + totals
-                stats_rec.record_map(totals.tolist(), None, 0)
+                # one stats record per MAP BATCH (the host lane's
+                # granularity): the measure program's per-device
+                # histogram rows, skipping the round's padding devices
+                for d in range(len(pending)):
+                    stats_rec.record_map(per_map[d].tolist(), None, 0)
                 in_batches.add(len(pending))
-                in_rows.add(sum(b.num_rows_host for b in pending))
+                in_rows.add(pending_rows)
                 round_idx += 1
+                pending_rows = 0
                 del pending[:], rr_offs[:]
+                return True
 
-            try:
-                for b in source:
-                    rows = b.num_rows_host
-                    rr_offs.append(self._rr_offset)
-                    if self.partitioning == "roundrobin":
-                        self._rr_offset = int((self._rr_offset + rows)
-                                              % n)
-                    pending.append(b)
-                    if len(pending) == n:
-                        flush()
-                if pending:
-                    flush()
-            except Exception as e:  # noqa: BLE001 — degradation seam
-                if not faults.is_task_transient(e):
-                    raise
-                # degradation decision: count the failure against the
-                # breaker domain (enough of them opens the breaker and
-                # later exchanges skip the lane up front) and hand the
-                # batches still in hand + the unconsumed remainder to
-                # the always-works host lane
-                lifecycle.record_domain_failure("ici_exchange")
-                note_ici_exchange(fallbacks=1)
-                obs_events.emit("ici_exchange",
-                                exec="HostShuffleExchangeExec",
-                                op_id=self._op_id, round=round_idx,
-                                fallback=True, error=str(e)[:200])
-                # the failed round's batches replay on the host lane:
-                # rewind the round-robin cursor to the round's first
-                # batch so the host lane assigns the SAME partitions
-                # the collective would have
-                if rr_offs:
-                    self._rr_offset = rr_offs[0]
-                fell_back = True
+            for b in source:
+                rows = b.num_rows_host
+                rr_offs.append(self._rr_offset)
+                if self.partitioning == "roundrobin":
+                    self._rr_offset = int((self._rr_offset + rows) % n)
+                pending.append(b)
+                pending_rows += rows
+                if len(pending) == n and not try_flush():
+                    break
+            if not fell_back and pending:
+                try_flush()
         except BaseException:
             for pieces in staged:
                 for sp in pieces:
@@ -1126,17 +1161,57 @@ class HostShuffleExchangeExec(TpuExec):
         if not fell_back:
             stats_rec.finish_and_emit()
             lifecycle.record_domain_success("ici_exchange")
-            for p in range(n):
-                yield self._drain_ici_partition(staged[p], schema)
+            yield from self._yield_ici_partitions(staged, schema)
             return
         # hybrid drain: staged ICI rounds carry the EARLIER map
         # batches, the host lane the rest — chaining per partition
-        # preserves the host lane's per-partition row order exactly
+        # preserves the host lane's per-partition row order exactly.
+        # The stats recorder (already holding the ICI rounds' map
+        # records) rides into the host lane, which finish_and_emit()s
+        # it once after its write phase: ONE exchange_stats record per
+        # execution, whichever lanes it crossed.
         host_gens = self._execute_partitions_host(
-            chain(iter(pending), source))
-        stats_rec.finish_and_emit()
-        for p, hg in enumerate(host_gens):
-            yield self._chain_ici_host(staged[p], schema, hg)
+            chain(iter(pending), source), stats_rec=stats_rec)
+        yield from self._yield_ici_partitions(staged, schema,
+                                              host_gens=host_gens)
+
+    def _yield_ici_partitions(self, staged, schema, host_gens=None
+                              ) -> "Iterator[Iterator[ColumnarBatch]]":
+        """Hand out the per-partition drain generators with the host
+        lane's abandonment protection: a NEVER-STARTED generator runs
+        no finally even on close, so a weakref finalizer closes each
+        partition's staged pieces (and their memory-budget
+        reservations) when its generator is dropped undrained;
+        partitions the consumer never reached — the outer generator
+        closed early — close in the finally. SpillableBatch.close is
+        idempotent, so overlapping the inline closes in _unspill_ici
+        is safe. On the hybrid-drain path `host_gens` supplies the host
+        lane's partition streams to chain after the staged pieces; it
+        is closed on the way out so the host side's handle bookkeeping
+        sees outer-done even when the consumer stops early."""
+        import weakref
+
+        def _close_pieces(pieces):
+            for sp in pieces:
+                sp.close()
+
+        hg_it = iter(host_gens) if host_gens is not None else None
+        handed = 0
+        try:
+            for p in range(self.n_partitions):
+                if hg_it is None:
+                    g = self._drain_ici_partition(staged[p], schema)
+                else:
+                    g = self._chain_ici_host(staged[p], schema,
+                                             next(hg_it))
+                weakref.finalize(g, _close_pieces, staged[p])
+                handed += 1
+                yield g
+        finally:
+            for q in range(handed, self.n_partitions):
+                _close_pieces(staged[q])
+            if host_gens is not None:
+                host_gens.close()
 
     def _drain_ici_partition(self, pieces, schema
                              ) -> Iterator[ColumnarBatch]:

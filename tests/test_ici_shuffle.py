@@ -325,6 +325,183 @@ def test_ici_midstream_fault_hybrid_drain():
 
 
 # ---------------------------------------------------------------------------
+# review hardening: seam narrowness, abandonment cleanup, stats unity,
+# mesh-keyed step cache
+# ---------------------------------------------------------------------------
+
+def _midstream_plan(seed=11):
+    """The 3-round shuffle stream the hybrid-drain tests share: 19 map
+    batches through an 8-way repartition."""
+    rng = np.random.default_rng(seed)
+    data = {"k": [int(x) for x in rng.integers(0, 9, 1200)],
+            "v": [int(x) for x in rng.integers(-40, 40, 1200)]}
+    sch = Schema((StructField("k", LONG), StructField("v", LONG)))
+    extra = {"spark.rapids.sql.batchSizeBytes": "4096"}
+    sess = _ici_session(extra)
+    return sess.from_pydict(data, sch, batch_rows=64) \
+        .repartition(N_DEV)._exec()
+
+
+def _ici_origin_bytes():
+    org = buffer_catalog().bytes_by_origin()
+    return sum(org.get("ici_exchange", (0, 0)))
+
+
+def test_ici_child_stream_error_propagates():
+    """A transient error raised by the CHILD stream (not the collective
+    dispatch) must NOT be swallowed into the degradation seam: the
+    raised generator is finalized, so a host-lane fallback would
+    silently drop every unconsumed child batch and return partial
+    results. It propagates to the task-retry layer instead — no
+    fallback recorded, staged shards torn down."""
+    plan = _midstream_plan()
+    ex = _find_exchange(plan)
+    assert ex is not None
+    orig = ex.child.execute
+
+    def flaky_child():
+        for i, b in enumerate(orig()):
+            if i >= N_DEV + 1:  # past round 0: shards already staged
+                raise faults.InjectedDeviceError("upstream.compute")
+            yield b
+
+    ex.child.execute = flaky_child
+    base = _ici_origin_bytes()
+    i0 = shuffle_mgr.ici_counters()
+    with pytest.raises(faults.InjectedDeviceError):
+        list(plan.execute())
+    i1 = shuffle_mgr.ici_counters()
+    assert i1["fallbacks"] == i0["fallbacks"], \
+        "child-stream error misattributed to the ICI collective"
+    assert i1["rounds"] - i0["rounds"] == 1  # round 0 had succeeded
+    assert _ici_origin_bytes() == base, "staged shards leaked on raise"
+
+
+def test_ici_abandoned_partition_generators_release_staged_entries():
+    """A consumer that abandons the outer partition stream — or never
+    starts a yielded partition generator (never-started generators run
+    no finally, even on close) — must not leak the staged shards'
+    catalog entries: the weakref finalizers + the outer finally close
+    every undrained piece."""
+    import gc
+    data, sch = _rich_data(), _rich_schema()
+    sess = _ici_session()
+    plan = sess.from_pydict(data, sch, batch_rows=64) \
+        .repartition(N_DEV)._exec()
+    ex = _find_exchange(plan)
+    assert ex is not None
+    base = _ici_origin_bytes()
+    outer = ex.execute_partitions()
+    g0 = next(outer)  # never started
+    g1 = next(outer)
+    next(g1)          # partially drained, then abandoned
+    assert _ici_origin_bytes() > base, "staged entries live mid-drain"
+    del g0, g1
+    outer.close()     # partitions 2..7 never handed out
+    del outer
+    gc.collect()
+    assert _ici_origin_bytes() == base, \
+        "abandoned partition streams leaked staged catalog entries"
+
+
+def test_ici_hybrid_drain_single_exchange_stats(tmp_path):
+    """One execution emits ONE exchange_stats record even when it
+    crosses both lanes (ICI rounds + host remainder after a mid-stream
+    fault): the recorder rides into the host fallback instead of each
+    lane emitting its own partial roll-up."""
+    import glob
+    import json
+
+    from spark_rapids_tpu.obs import events
+    plan = _midstream_plan()
+    ex = _find_exchange(plan)
+    orig = ex._ici_exchange_round
+
+    def flaky(batches, rr_offs, round_idx):
+        if round_idx >= 1:
+            raise faults.InjectedDeviceError("shuffle.ici_exchange")
+        return orig(batches, rr_offs, round_idx)
+
+    ex._ici_exchange_round = flaky
+    events.enable(str(tmp_path), "MODERATE")
+    try:
+        rows = [r for b in plan.execute() for r in b.to_pylist()]
+    finally:
+        events.reset_event_bus()
+    assert len(rows) == 1200
+    recs = []
+    for f in glob.glob(str(tmp_path / "events-*.jsonl")):
+        with open(f) as fh:
+            recs.extend(json.loads(ln) for ln in fh if ln.strip())
+    stats = [r for r in recs if r["kind"] == "exchange_stats"]
+    assert len(stats) == 1, stats
+    # the single record spans BOTH lanes: every map batch (ICI round 0
+    # replays nothing; its 8 maps + the host lane's 11) and every row
+    assert stats[0]["maps"] == 19
+    assert stats[0]["rows"] == 1200
+
+
+def test_ici_stats_per_map_batch_granularity(tmp_path):
+    """The pure ICI lane records one map per MAP BATCH (the host
+    lane's granularity), not one per collective round — skew roll-ups
+    across lanes stay comparable."""
+    import glob
+    import json
+
+    from spark_rapids_tpu.obs import events
+    plan = _midstream_plan(seed=13)
+    ex = _find_exchange(plan)
+    i0 = shuffle_mgr.ici_counters()
+    events.enable(str(tmp_path), "MODERATE")
+    try:
+        rows = [r for b in plan.execute() for r in b.to_pylist()]
+    finally:
+        events.reset_event_bus()
+    i1 = shuffle_mgr.ici_counters()
+    assert len(rows) == 1200
+    rounds = i1["rounds"] - i0["rounds"]
+    assert rounds >= 2
+    recs = []
+    for f in glob.glob(str(tmp_path / "events-*.jsonl")):
+        with open(f) as fh:
+            recs.extend(json.loads(ln) for ln in fh if ln.strip())
+    stats = [r for r in recs if r["kind"] == "exchange_stats"]
+    assert len(stats) == 1, stats
+    from spark_rapids_tpu.exec.base import NUM_INPUT_BATCHES
+    n_maps = ex.metrics[NUM_INPUT_BATCHES].value
+    assert stats[0]["maps"] == n_maps > rounds
+
+
+def test_ici_step_cache_keys_on_mesh_identity():
+    """The compiled exchange step closes over the mesh it was built
+    under: a session that installs a DIFFERENT mesh later (same axis
+    size, different device order) must miss the step cache and get a
+    fresh step bound to the new mesh, not a collective over the stale
+    one."""
+    import jax
+    from jax.sharding import Mesh
+
+    from spark_rapids_tpu.parallel.mesh import DATA_AXIS
+    data, sch = _rich_data(80), _rich_schema()
+    sess = _ici_session()
+    plan = sess.from_pydict(data, sch, batch_rows=64) \
+        .repartition(N_DEV)._exec()
+    ex = _find_exchange(plan)
+    list(plan.execute())
+    assert ex._ici_steps, "ICI lane did not build a step"
+    cap, slot_cap, width = next(iter(ex._ici_steps))[:3]
+    n0 = len(ex._ici_steps)
+    # same mesh identity -> cache hit
+    ex._get_ici_step(cap, slot_cap, width)
+    assert len(ex._ici_steps) == n0
+    # reversed device order = a different mesh -> cache miss
+    devs = list(jax.devices())[:N_DEV]
+    ex._ici_mesh = Mesh(np.array(devs[::-1]), (DATA_AXIS,))
+    ex._get_ici_step(cap, slot_cap, width)
+    assert len(ex._ici_steps) == n0 + 1
+
+
+# ---------------------------------------------------------------------------
 # eligibility gating
 # ---------------------------------------------------------------------------
 
