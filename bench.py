@@ -430,6 +430,31 @@ def maybe_enable_event_log():
                           "SPARK_RAPIDS_TPU_EVENTLOG_MAX_BYTES", "0")))
 
 
+def maybe_enable_history():
+    """Opt-in query-history capsules for bench runs (ISSUE 17): set
+    SPARK_RAPIDS_TPU_HISTORY_DIR to append one JSONL capsule per
+    governed query (obs/history.py) — two bench runs into separate
+    dirs, then `tools/history_report.py CUR --diff BASE` ranks any
+    regression by the phase that moved.
+    SPARK_RAPIDS_TPU_HISTORY_MAX_BYTES rotates the capsule file.
+    Default: off, one pointer check per collect."""
+    d = os.environ.get("SPARK_RAPIDS_TPU_HISTORY_DIR")
+    if d:
+        from spark_rapids_tpu.obs import history
+        history.enable(d, max_bytes=int(os.environ.get(
+            "SPARK_RAPIDS_TPU_HISTORY_MAX_BYTES", "0")))
+
+
+def phases_attribution():
+    """{"phases": ...} block for each BENCH record (ISSUE 17): the
+    process-cumulative wall-clock phase counters (obs/phase.py) as
+    deltas since the previous record — which phases this lane's wall
+    went to, even for lanes that drive plan.execute() directly with no
+    governed query (where no per-query ledger exists)."""
+    from spark_rapids_tpu.obs import phase
+    return _delta_since("phases", phase.counters())
+
+
 def maybe_enable_telemetry():
     """Opt-in live telemetry for bench runs (ISSUE 11): set
     SPARK_RAPIDS_TPU_TELEMETRY_MS to a sampling interval to start the
@@ -767,6 +792,7 @@ def main():
         "stage": stage_attribution(),
         "telemetry": telemetry_attribution(),
         "statistics": statistics_attribution(),
+        "phases": phases_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:
@@ -944,6 +970,7 @@ def q3_bench():
         "stage": stage_attribution(),
         "telemetry": telemetry_attribution(),
         "statistics": statistics_attribution(),
+        "phases": phases_attribution(),
     }
     chaos = chaos_attribution()
     if chaos is not None:
@@ -954,6 +981,7 @@ def q3_bench():
 if __name__ == "__main__":
     maybe_enable_event_log()
     maybe_enable_telemetry()
+    maybe_enable_history()
     maybe_enable_faults()
     maybe_query_timeout()
     maybe_concurrency()

@@ -328,12 +328,25 @@ DEFAULT_REGISTRY = ContractRegistry(
         LockSpec("dispatch-config", "obs/dispatch.py", None,
                  "_ledger_lock", reentrant=False,
                  note="ledger singleton install/teardown"),
+        LockSpec("phase-global", "obs/phase.py", None, "_global_lock",
+                 reentrant=False,
+                 note="process-cumulative per-phase ns counters"),
+        LockSpec("phase-ledger", "obs/phase.py", "PhaseLedger",
+                 "self._lock", reentrant=False,
+                 note="per-query phase books (direct/folded maps)"),
         LockSpec("event-bus-config", "obs/events.py", None, "_bus_lock",
                  reentrant=False, note="bus singleton install/teardown"),
         LockSpec("event-bus", "obs/events.py", "EventBus", "self._lock",
                  reentrant=False,
                  note="JSONL sink write serialization (leaf lock: nothing "
                  "may be acquired under it)"),
+        LockSpec("history-config", "obs/history.py", None, "_store_lock",
+                 reentrant=False,
+                 note="history store singleton install/teardown"),
+        LockSpec("history", "obs/history.py", "HistoryStore",
+                 "self._lock", reentrant=False,
+                 note="capsule JSONL sink write serialization (leaf "
+                 "lock, the event-bus pattern)"),
     ],
     # outermost-first: a lock may only be acquired while holding locks
     # that sort strictly BEFORE it
@@ -341,7 +354,8 @@ DEFAULT_REGISTRY = ContractRegistry(
         "catalog", "workload-cond", "budget-cond", "semaphore-cond",
         "semaphore", "heartbeat", "breaker", "telemetry-config",
         "telemetry", "stats", "stats-global", "dispatch-config",
-        "dispatch-ledger", "event-bus-config", "event-bus",
+        "dispatch-ledger", "phase-global", "phase-ledger",
+        "event-bus-config", "event-bus", "history-config", "history",
     ],
     cross_query_entries=[
         EntrySpec("memory/catalog.py", "BufferCatalog", "_writer_loop",

@@ -43,6 +43,7 @@ class TpuSession:
         from ..columnar import upload
         from ..obs import dispatch as obs_dispatch
         from ..obs import events as obs_events
+        from ..obs import history as obs_history
         from ..obs import telemetry
         from ..parallel.mesh import device_mesh, set_active_mesh
         self.conf = RapidsConf(conf or {})
@@ -50,6 +51,7 @@ class TpuSession:
         obs_events.configure(self.conf)
         telemetry.configure(self.conf)
         obs_dispatch.configure(self.conf)
+        obs_history.configure(self.conf)
         faults.configure(self.conf)
         # pre-size the upload staging pool's bucket ladder from
         # batchSizeBytes (ISSUE 14 satellite): steady-state scans hit
@@ -96,6 +98,10 @@ class TpuSession:
         out = lifecycle.health()
         out["telemetry"] = telemetry.health_section()
         out["dispatch"] = dispatch.health_section()
+        # per-priority-class wall-clock percentiles over the telemetry
+        # registry's latency ring (ISSUE 17) — {"enabled": False} when
+        # telemetry is off
+        out["slo"] = telemetry.slo_section()
         return out
 
     def active_queries(self) -> List[Dict]:
@@ -400,6 +406,7 @@ class DataFrame:
         from ..columnar import upload
         from ..obs import dispatch as obs_dispatch
         from ..obs import events as obs_events
+        from ..obs import history as obs_history
         from ..obs import telemetry
         from ..parallel.mesh import set_active_mesh
         set_active_conf(self.session.conf)
@@ -407,6 +414,7 @@ class DataFrame:
         obs_events.configure(self.session.conf)
         telemetry.configure(self.session.conf)
         obs_dispatch.configure(self.session.conf)
+        obs_history.configure(self.session.conf)
         faults.configure(self.session.conf)
         upload.configure(self.session.conf)
         return TpuOverrides(self.session.conf).apply(self._plan)
@@ -433,14 +441,96 @@ class DataFrame:
         cancel_query() dequeues a queued query (phase admission-wait).
         A shed arrival (queue full / admission timeout / known-degraded
         device) raises QueryAdmissionError fast."""
+        import time as _time
+
+        from ..config import PHASES_ENABLED
         from ..exec import lifecycle, workload
         from ..exec.task_retry import with_task_retry
+        from ..obs import history as obs_history
+        from ..obs import phase as obs_phase
         with lifecycle.governed(self.session.conf,
                                 owner=self.session._lifecycle_owner) as ctx:
-            with workload.admitted(self.session.conf, ctx):
-                return with_task_retry(
-                    lambda attempt: self._collect_once(),
-                    conf=self.session.conf)
+            # wall-clock phase attribution (ISSUE 17): the ledger spans
+            # the WHOLE governed drive — admission wait, every retry
+            # attempt and its backoff — so sum(phases) == query wall
+            if self.session.conf.get(PHASES_ENABLED):
+                obs_phase.attach(ctx)
+            # history capsule (ISSUE 17): default-off = this one
+            # pointer check; the counter snapshot is read only when a
+            # store is actually installed
+            store = obs_history.active_store()
+            before = obs_history.process_counters() \
+                if store is not None else None
+            if store is not None:
+                # a query failing before its harvest must not write the
+                # PREVIOUS query's plan/metrics into its capsule
+                self.session._last_query_metrics = None
+                self.session._last_query_profile = None
+            t0 = _time.perf_counter_ns()
+            ok = False
+            try:
+                with workload.admitted(self.session.conf, ctx):
+                    out = with_task_retry(
+                        lambda attempt: self._collect_once(),
+                        conf=self.session.conf)
+                    ok = True
+                    return out
+            finally:
+                self._finish_query(ctx, ok, store, before,
+                                   _time.perf_counter_ns() - t0)
+
+    def _finish_query(self, ctx, ok, store, before, fallback_wall_ns):
+        """Query-end observability (ISSUE 17), inside collect's finally
+        chain — close the phase ledger, emit the `query_phases` event,
+        feed the SLO latency ring, append the history capsule. Must
+        never raise (it would mask the query's real exception)."""
+        from ..config import WORKLOAD_PRIORITY
+        from ..exec.workload import PRIORITIES
+        from ..obs import events as obs_events
+        from ..obs import history as obs_history
+        from ..obs import telemetry
+        try:
+            priority = str(self.session.conf.get(
+                WORKLOAD_PRIORITY)).strip().lower()
+            if priority not in PRIORITIES:
+                priority = "interactive"
+            ledger = getattr(ctx, "phase_ledger", None)
+            phases = None
+            wall_ns = fallback_wall_ns
+            if ledger is not None:
+                ledger.finish()
+                wall_ns = ledger.wall_ns
+                phases = ledger.snapshot()
+                # events-plane id (the final attempt's query_scope),
+                # NOT ctx.ctx_id: the two counters drift after any
+                # retry, and the log must join on one id space
+                obs_events.emit(
+                    "query_phases",
+                    query=getattr(ctx, "events_qid", None) or ctx.ctx_id,
+                    ok=ok, wall_ns=wall_ns, attempts=ctx.attempt_no,
+                    priority=priority, phases=phases)
+            if ok:
+                # only completed queries feed the SLO percentiles: a
+                # shed/failed arrival returns in microseconds and would
+                # drag p50 down, under-reporting real latency
+                telemetry.note_query_latency(priority, wall_ns)
+            if store is not None:
+                profile = self.session._last_query_profile
+                deltas = obs_history.counters_delta(
+                    before, obs_history.process_counters())
+                mesh = self.session.mesh
+                store.append(obs_history.build_capsule(
+                    query_id=ctx.ctx_id,
+                    mesh_devices=int(mesh.devices.size)
+                    if mesh is not None else 1,
+                    fingerprint=getattr(profile, "fingerprint", None),
+                    ok=ok, priority=priority, attempts=ctx.attempt_no,
+                    wall_ns=wall_ns, phases=phases,
+                    stats=ctx.runtime_stats,
+                    summary=self.session._last_query_metrics,
+                    deltas=deltas))
+        except Exception:  # noqa: BLE001 — observability never masks
+            pass
 
     def _collect_once(self) -> List[tuple]:
         import time as _time
@@ -450,7 +540,7 @@ class DataFrame:
         from ..obs import events as obs_events
         from ..obs.profile import QueryProfile
         from ..obs.stats import RuntimeStats
-        with obs_events.query_scope():
+        with obs_events.query_scope() as qid:
             # conversion inside the scope: plan_fallback / plan_not_on_tpu
             # events must carry this query's id
             plan = self._exec()
@@ -463,6 +553,10 @@ class DataFrame:
             if ctx is not None:
                 ctx.runtime_stats = stats
                 ctx.root_op_id = plan._op_id
+                # query_phases (emitted after the scope closes) must
+                # carry the same id as this attempt's query_start/
+                # query_end so the event log joins per query
+                ctx.events_qid = qid
             before = query_snapshot()
             obs_events.emit("query_start", root=type(plan).__name__)
             t0 = _time.perf_counter_ns()
@@ -479,7 +573,9 @@ class DataFrame:
                     summary = query_summary(plan, before)
                     self.session._last_query_metrics = summary
                     self.session._last_query_profile = QueryProfile(
-                        plan, summary, statistics=stats)
+                        plan, summary, statistics=stats,
+                        phases=ctx.phase_ledger
+                        if ctx is not None else None)
                 except Exception:  # noqa: BLE001 — must never mask
                     pass
                 obs_events.emit(

@@ -478,6 +478,48 @@ TELEMETRY_HISTORY_SIZE = conf_int(
     "buffer (TpuSession.health()['telemetry'] reads the newest; older "
     "samples survive only in the event log).")
 
+PHASES_ENABLED = conf_bool(
+    "spark.rapids.tpu.phases.enabled", True,
+    "Per-query wall-clock phase attribution (obs/phase.py): every "
+    "governed collect() carries a ledger partitioning its total "
+    "wall-clock into the closed phase set (admission-wait, compile, "
+    "device-compute, host-pack/serialize, shuffle-io, ici-collective, "
+    "spill-wait, semaphore-wait, pipeline-stall, retry-backoff, other) "
+    "with sum(phases) == wall_ns exactly. Surfaced via "
+    "QueryProfile.phases(), the query_phases event (ESSENTIAL) and the "
+    "query-history capsule. Explicitly false = one pointer check per "
+    "accrual site, no ledger; results are byte-identical either way. "
+    "The process-cumulative phase counters bench.py deltas stay on "
+    "regardless (the runtime-statistics discipline).")
+
+HISTORY_ENABLED = conf_bool(
+    "spark.rapids.tpu.history.enabled", False,
+    "Persistent query history (obs/history.py): at the end of every "
+    "collect() append ONE self-describing JSONL capsule — plan "
+    "fingerprint, phase ledger, essential metrics, statistics skew "
+    "summary, dispatch/shuffle/upload deltas, outcome/priority/attempts "
+    "— to history-<pid>-<n>.jsonl under history.dir. Capsules from "
+    "different sessions and processes in one dir never collide and "
+    "survive restarts; aggregate/diff/advise over a dir with "
+    "tools/history_report.py. Off (default) costs one pointer check "
+    "per collect.", commonly_used=True)
+
+HISTORY_DIR = conf_str(
+    "spark.rapids.tpu.history.dir", "",
+    "Directory for query-history capsule files (one "
+    "history-<pid>-<n>.jsonl per configured store); empty = "
+    "/tmp/spark_rapids_tpu_history. Render with "
+    "tools/history_report.py (aggregate per plan fingerprint, "
+    "--diff BASE for phase-ranked regressions, advisor rules).")
+
+HISTORY_MAX_BYTES = conf_bytes(
+    "spark.rapids.tpu.history.maxBytes", 0,
+    "Rotate the history capsule file once it reaches this many bytes: "
+    "the file closes and writing continues in "
+    "history-<pid>-<n>.<rot>.jsonl (the eventLog.maxBytes pattern); "
+    "tools/history_report.py reads a rotated set in order. 0 (default) "
+    "= unbounded, no rotation.")
+
 SORT_OOC_ENABLED = conf_bool(
     "spark.rapids.sql.sort.outOfCore.enabled", True,
     "Bounded-memory streamed run merge for big sorts: runs stay spilled, "

@@ -34,6 +34,7 @@ from ..parallel.exchange import (exchange_columns, negotiate_slot_cap,
 from ..parallel.mesh import DATA_AXIS, active_mesh, mesh_axis_size
 from ..types import Schema
 from ..obs import events as obs_events
+from ..obs import phase as obs_phase
 from ..obs.dispatch import instrument
 from .base import (BROADCAST_TIME, DEBUG, DISPATCH_METRICS, ESSENTIAL,
                    GATHER_METRICS,
@@ -760,10 +761,16 @@ class HostShuffleExchangeExec(TpuExec):
                 n = b.num_rows_host
                 in_rows.add(n)
                 # time only the shuffle work (partition/serialize/write),
-                # not the upstream compute driving child.execute()
-                with self.metrics[SHUFFLE_WRITE_TIME].ns_timer():
+                # not the upstream compute driving child.execute().
+                # Phase attribution (ISSUE 17): the map write's wall is
+                # host-pack/serialize except the writer's file-IO share,
+                # which the nested add() carves out as shuffle-io (and
+                # the span excludes from its own exclusive time)
+                with self.metrics[SHUFFLE_WRITE_TIME].ns_timer(), \
+                        obs_phase.span("host-pack-serialize"):
                     writer, lane, pack_ns, rows_pp = self._write_map(
                         b, n, bounds, handle, mgr, map_id)
+                    obs_phase.add("shuffle-io", writer.io_ns)
                 stats_rec.record_map(rows_pp, writer.partition_bytes,
                                      writer.bytes_written)
                 telemetry.add("exchange.write_bytes",
@@ -1005,10 +1012,14 @@ class HostShuffleExchangeExec(TpuExec):
         t0 = _time.perf_counter_ns()
         # the collective dispatch is the chaos seam: the fault key is
         # the deterministic round ordinal, and dispatch metrics land on
-        # this exec through the stage-boundary harness
-        with self.batch_harness(fault_point="shuffle.ici_exchange",
-                                fault_key=f"r{round_idx}",
-                                metric_scope=True):
+        # this exec through the stage-boundary harness. Phase
+        # attribution (ISSUE 17): the whole measured round — stack,
+        # measure, all-to-all step, unstack — is ici-collective; the
+        # span keeps its cached dispatches out of device-compute
+        with obs_phase.span("ici-collective"), \
+                self.batch_harness(fault_point="shuffle.ici_exchange",
+                                   fault_key=f"r{round_idx}",
+                                   metric_scope=True):
             stacked = stack_batches(per_dev)
             max_count, max_len, per_map = self._get_ici_measure()(
                 stacked, rr)

@@ -130,7 +130,8 @@ class QueryContext:
                  "engaged_domains", "workload_ticket",
                  "phase", "current_op", "root_op_id", "batches_produced",
                  "rows_produced", "attempt_no", "spill_count",
-                 "spill_bytes", "runtime_stats")
+                 "spill_bytes", "runtime_stats", "phase_ledger",
+                 "events_qid")
 
     def __init__(self, timeout_ms: int = 0, check_every: int = 8,
                  owner: Any = None):
@@ -173,6 +174,17 @@ class QueryContext:
         #: per-attempt RuntimeStats (obs/stats.py) — exchanges record
         #: map-output/partition distributions into it mid-flight
         self.runtime_stats = None
+        #: per-query wall-clock phase ledger (obs/phase.py, ISSUE 17):
+        #: attached by DataFrame.collect when phases.enabled; every
+        #: accrual site pays one pointer check when None
+        self.phase_ledger = None
+        #: the events-plane query id of the LATEST attempt's
+        #: query_scope (api/session._collect_once) — the id space
+        #: query_start/query_end records carry. query_phases must join
+        #: them in the log, and the lifecycle ctx_id drifts from it as
+        #: soon as any query retries (one events id per attempt, one
+        #: ctx per governed drive)
+        self.events_qid = None
 
     def note_batch(self, op: str, op_id: int,
                    rows: Optional[int]) -> None:

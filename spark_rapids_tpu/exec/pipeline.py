@@ -46,6 +46,7 @@ from typing import Any, Iterable, Iterator, Optional
 from ..config import (PIPELINE_CLOSE_TIMEOUT_MS, PIPELINE_DEPTH,
                       PIPELINE_ENABLED, active_conf)
 from .. import faults
+from ..obs import phase as obs_phase
 
 _END = object()
 
@@ -317,9 +318,16 @@ class PipelinedIterator:
                     # error, not StopIteration: a materializing consumer
                     # (CachedRelation) must not mistake the cut stream
                     # for a complete one.
-                    self.wait_ns += time.perf_counter_ns() - t0
+                    dt = time.perf_counter_ns() - t0
+                    self.wait_ns += dt
+                    obs_phase.add("pipeline-stall", dt)
                     raise StageCancelled(self._label)
-        self.wait_ns += time.perf_counter_ns() - t0
+        dt = time.perf_counter_ns() - t0
+        self.wait_ns += dt
+        # phase attribution (ISSUE 17): the consumer's blocked-on-
+        # producer time IS the budget producer-thread accruals fold
+        # into (obs/phase.PhaseLedger.snapshot)
+        obs_phase.add("pipeline-stall", dt)
         if item is _END:
             self._finished = True
             self._thread.join()

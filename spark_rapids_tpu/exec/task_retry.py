@@ -160,12 +160,21 @@ def with_task_retry(run: Callable[[int], T],
                 # time.sleep(capped at 5s) would overshoot the
                 # documented wall-clock bound by the whole backoff
                 end = time.monotonic() + backoff
-                while True:
-                    lifecycle.check_current("task-retry")
-                    remaining = end - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    time.sleep(min(0.05, remaining))
+                # phase attribution (ISSUE 17): the settle + backoff
+                # window between attempts, accrued even when the
+                # deadline check raises mid-sleep
+                from ..obs import phase as obs_phase
+                t0b = time.perf_counter_ns()
+                try:
+                    while True:
+                        lifecycle.check_current("task-retry")
+                        remaining = end - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        time.sleep(min(0.05, remaining))
+                finally:
+                    obs_phase.add("retry-backoff",
+                                  time.perf_counter_ns() - t0b)
     finally:
         if prev is None:
             if hasattr(_tls, "attempt"):

@@ -214,6 +214,7 @@ class WorkloadManager:
         from . import lifecycle
         cooldown_ms = lifecycle.breaker_shed_hint_ms("device_dispatch",
                                                      conf)
+        t_adm0 = time.monotonic_ns()
         pending: List[tuple] = []
         try:
             if cooldown_ms is not None:
@@ -298,6 +299,12 @@ class WorkloadManager:
                             self._pump_locked(max_concurrent, pending)
                         self._cond.notify_all()
                         raise
+            # phase attribution (ISSUE 17): the queue residency this
+            # slow path just sat out is the query's admission-wait
+            # share (the fast-path grant above never queues — ~0 wait)
+            from ..obs import phase as obs_phase
+            obs_phase.add("admission-wait",
+                          time.monotonic_ns() - t_adm0)
             return t
         finally:
             self._flush(pending)

@@ -29,6 +29,7 @@ import queue
 import struct
 import tempfile
 import threading
+import time
 import uuid
 import zlib
 from enum import IntEnum
@@ -236,9 +237,17 @@ class BufferCatalog:
             # unwinds with spill-wait phase attribution instead of
             # waiting the hop out
             from ..exec import lifecycle
+            from ..obs import phase as obs_phase
             lifecycle.check_current("spill-wait")
-            if not ev.wait(timeout=1.0):
-                self._writer_ok()
+            t0w = time.perf_counter_ns()
+            try:
+                if not ev.wait(timeout=1.0):
+                    self._writer_ok()
+            finally:
+                # phase attribution (ISSUE 17): blocked-on-writeback
+                # time, accrued even when check_current raises next
+                obs_phase.add("spill-wait",
+                              time.perf_counter_ns() - t0w)
             lifecycle.check_current("spill-wait")
 
     def release(self, handle: str):
@@ -290,6 +299,7 @@ class BufferCatalog:
         from .budget import memory_budget
         from ..exec import workload
         async_write = bool(active_conf().get(SPILL_ASYNC_WRITE))
+        t0s = time.perf_counter_ns()
         freed = 0
         while target_bytes is None or freed < target_bytes:
             evs: List[tuple] = []
@@ -318,6 +328,12 @@ class BufferCatalog:
                 memory_budget().release(victim.nbytes)
                 workload.discharge(victim.owner, victim.nbytes)
         self._enforce_host_limit(async_write, owner=owner)
+        # phase attribution (ISSUE 17): the pass ran on the thread
+        # whose reservation hit pressure — its wall is that query's
+        # spill-wait share (the async lane's queued hops are waited
+        # for, and accrued, at the acquire/budget seams instead)
+        from ..obs import phase as obs_phase
+        obs_phase.add("spill-wait", time.perf_counter_ns() - t0s)
         if freed:
             # per-query spill attribution (ISSUE 11): the reserving
             # thread's governed query experienced this pressure —

@@ -218,7 +218,14 @@ def with_retry(input_item: T, fn: Callable[[T], R],
             return False
         spill_for_retry()
         if backoff_ns:
+            # phase attribution (ISSUE 17): the deliberate let-frees-
+            # land sleep is retry-backoff; the spill pass above accrues
+            # its own wall as spill-wait inside synchronous_spill
+            from ..obs import phase as obs_phase
+            t0b = time.perf_counter_ns()
             time.sleep(backoff_ns / 1e9)
+            obs_phase.add("retry-backoff",
+                          time.perf_counter_ns() - t0b)
         return True
 
     try:

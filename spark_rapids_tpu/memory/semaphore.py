@@ -185,6 +185,8 @@ class TpuSemaphore:
             # the permit is the winner's, but the wait was this
             # thread's — attribute it
             from ..obs import events as obs_events
+            from ..obs import phase as obs_phase
+            obs_phase.add("semaphore-wait", waited)
             obs_events.emit("semaphore_acquire", task_id=task_id,
                             wait_ns=waited)
             return True
@@ -239,6 +241,8 @@ class TpuSemaphore:
             return False
         hold.ready.set()
         from ..obs import events as obs_events
+        from ..obs import phase as obs_phase
+        obs_phase.add("semaphore-wait", waited)
         obs_events.emit("semaphore_acquire", task_id=task_id,
                         wait_ns=waited)
         return True

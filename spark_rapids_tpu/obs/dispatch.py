@@ -248,6 +248,8 @@ class DispatchLedger:
                 self._cache_hits += 1
         # metric attribution outside the lock: TpuMetric.add is a plain
         # int accumulate on the dispatching thread
+        from . import phase as obs_phase
+        obs_phase.note_dispatch(wall_ns, pend.traced)
         metrics = site._owner.metrics if site._owner is not None else None
         if metrics is not None:
             m = metrics.get(NUM_DISPATCHES)

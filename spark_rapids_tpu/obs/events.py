@@ -9,7 +9,7 @@ each line a self-describing record:
 Event kinds and their levels (spark.rapids.tpu.eventLog.level):
 
   ESSENTIAL  query_start, query_end, query_cancelled, query_shed,
-             recompile_storm
+             recompile_storm, query_phases
   MODERATE   op_close, semaphore_acquire, spill, oom_retry,
              pallas_tier, plan_fallback, plan_not_on_tpu, exchange,
              pipeline_wait, pipeline_full, op_error, fault_inject,
@@ -119,6 +119,11 @@ EVENT_LEVELS: Dict[str, int] = {
     "program_compile": MODERATE,
     "dispatch_stats": MODERATE,
     "recompile_storm": ESSENTIAL,
+    # wall-clock phase attribution (ISSUE 17): one record per governed
+    # query at query end with the closed phase ledger (obs/phase.py,
+    # sum(phases) == wall_ns exactly), outcome, priority and attempt
+    # count — headline, like query_end (it IS the query's cost story)
+    "query_phases": ESSENTIAL,
     # whole-stage compilation (ISSUE 14): one record per fused-stage
     # execution — kind (map | agg | join_agg), the absorbed-op label,
     # ops absorbed, input batches, program dispatches this execution

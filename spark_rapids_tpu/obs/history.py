@@ -1,0 +1,259 @@
+"""Query history store (ISSUE 17 tentpole part 2) — the engine's Spark
+history-server analog, one process-local JSONL capsule per finished
+governed query instead of a replayable UI event stream.
+
+Behind `spark.rapids.tpu.history.{enabled,dir,maxBytes}` (default OFF —
+one module pointer check per collect, the PR 2 event-bus discipline),
+`DataFrame.collect`'s governed wrap appends exactly ONE record per
+query:
+
+    {"ts_ms": ..., "query": <id>, "fingerprint": <plan fp or null>,
+     "ok": ..., "priority": ..., "attempts": ...,
+     "wall_ns": ..., "phases": {...},          # closed ledger, sum==wall
+     "rows": ..., "batches": ...,              # essential metrics
+     "skew": {...},                            # worst exchange skew
+     "dispatch": {...}, "shuffle": {...},      # per-query counter deltas
+     "ici": {...}, "upload": {...}, "workload": {...}}
+
+The capsule joins across runs on `fingerprint`
+(exec/base.TpuExec.plan_fingerprint — canonical plan identity,
+ISSUE 14), which is what makes `tools/history_report.py`'s per-plan
+aggregation, `--diff` regression ranking and the profiling advisor
+possible without ever re-reading a plan.
+
+Files follow the event-bus rotated-set pattern: per-process
+`history-<pid>-<seq>.jsonl`, rotating to `<base>.<n>.jsonl` past
+history.maxBytes; creation is lazy, a write failure warns once and
+self-uninstalls the store so a full disk never fails a query.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+DEFAULT_DIR = "/tmp/spark_rapids_tpu_history"
+
+
+class HistoryStore:
+    """Append-only JSONL capsule sink (one line per finished query)."""
+
+    _seq = 0
+    _seq_lock = threading.Lock()
+
+    def __init__(self, directory: str, max_bytes: int = 0):
+        self.directory = directory or DEFAULT_DIR
+        #: rotation threshold (history.maxBytes, the eventLog.maxBytes
+        #: pattern): 0 = unbounded
+        self.max_bytes = max(0, int(max_bytes))
+        with HistoryStore._seq_lock:
+            HistoryStore._seq += 1
+            seq = HistoryStore._seq
+        self._base = os.path.join(self.directory,
+                                  f"history-{os.getpid()}-{seq}")
+        self._rot = 0
+        self._written = 0
+        self.path = f"{self._base}.jsonl"
+        self._lock = threading.Lock()
+        self._file = None
+        self._closed = False
+        #: capsules appended (tests / bench surface)
+        self.records = 0
+
+    def _rotate_locked(self) -> None:
+        """Caller holds self._lock (the event-bus rotation contract)."""
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        self._rot += 1
+        self._written = 0
+        self.path = f"{self._base}.{self._rot}.jsonl"
+
+    def append(self, capsule: Dict[str, Any]) -> None:
+        """Write one capsule. Runs inside collect's finally chain, so it
+        must NEVER raise: a failure warns once and uninstalls the
+        store."""
+        if self._closed:
+            return
+        try:
+            line = json.dumps(capsule, separators=(",", ":"), default=str)
+            with self._lock:
+                if self._closed:
+                    return
+                if self._file is None:
+                    os.makedirs(self.directory, exist_ok=True)
+                    # contract: ok lock-blocking-call — the store lock
+                    # is the declared LEAF lock and exists precisely to
+                    # serialize this lazy open + append; nothing is ever
+                    # acquired under it
+                    self._file = open(self.path, "a")
+                self._file.write(line + "\n")
+                self._file.flush()
+                self._written += len(line) + 1
+                self.records += 1
+                if self.max_bytes and self._written >= self.max_bytes:
+                    self._rotate_locked()
+        except Exception as e:  # noqa: BLE001 — never fail a query
+            import logging
+            logging.getLogger("spark_rapids_tpu.obs").warning(
+                "query history disabled: cannot write %s (%s: %s)",
+                self.path, type(e).__name__, e)
+            self.close()
+            _deactivate(self)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+_store: Optional[HistoryStore] = None
+_store_lock = threading.Lock()
+
+
+def active_store() -> Optional[HistoryStore]:
+    """The configured store, or None — the single pointer check every
+    collect pays in disabled mode."""
+    return _store
+
+
+def _deactivate(store: HistoryStore) -> None:
+    """Uninstall `store` if still active (write-failure self-removal)."""
+    global _store
+    with _store_lock:
+        if _store is store:
+            _store = None
+
+
+def configure(conf=None) -> Optional[HistoryStore]:
+    """(Re)configure from a RapidsConf — process-wide, the event-bus
+    semantics: unset history.enabled keeps another session's store; an
+    EXPLICIT enabled=false tears it down; enabled with unchanged
+    dir+maxBytes keeps the current file open."""
+    global _store
+    from ..config import (HISTORY_DIR, HISTORY_ENABLED, HISTORY_MAX_BYTES,
+                          active_conf)
+    conf = conf if conf is not None else active_conf()
+    enabled = conf.get(HISTORY_ENABLED)
+    with _store_lock:
+        if not enabled:
+            if HISTORY_ENABLED.key in conf._settings \
+                    and _store is not None:
+                _store.close()
+                _store = None
+            return _store
+        directory = conf.get(HISTORY_DIR) or DEFAULT_DIR
+        max_bytes = max(0, conf.get(HISTORY_MAX_BYTES))
+        if _store is not None and _store.directory == directory \
+                and _store.max_bytes == max_bytes:
+            return _store
+        if _store is not None:
+            _store.close()
+        _store = HistoryStore(directory, max_bytes=max_bytes)
+        return _store
+
+
+def enable(directory: str, max_bytes: int = 0) -> HistoryStore:
+    """Conf-free switch-on (bench / tooling entry)."""
+    global _store
+    with _store_lock:
+        if _store is not None:
+            _store.close()
+        _store = HistoryStore(directory, max_bytes=max_bytes)
+        return _store
+
+
+def reset_history() -> None:
+    """Tear down the store (test isolation)."""
+    global _store
+    with _store_lock:
+        if _store is not None:
+            _store.close()
+        _store = None
+
+
+# -- capsule assembly --------------------------------------------------------
+
+#: process-counter families snapshotted before a capsule-bound query and
+#: diffed after — the per-query shares of the engine's cumulative
+#: counters. Keys are capsule field names.
+def process_counters() -> Dict[str, Dict[str, int]]:
+    """One flat snapshot of every counter family the capsule diffs.
+    Read only when a store is active (collect checks active_store()
+    first), so disabled-mode collects never pay these imports."""
+    from ..columnar import upload
+    from ..exec import workload
+    from ..obs import dispatch as obs_dispatch
+    from ..shuffle import manager as shuffle_manager
+    return {
+        "shuffle": shuffle_manager.counters(),
+        "ici": shuffle_manager.ici_counters(),
+        "upload": upload.counters(),
+        "dispatch": obs_dispatch.counters(),
+        "workload": workload.counters(),
+    }
+
+
+def counters_delta(before: Dict[str, Dict[str, int]],
+                   after: Dict[str, Dict[str, int]],
+                   ) -> Dict[str, Dict[str, int]]:
+    """Per-family {key: after-before}, int keys only (nested/derived
+    values in a family snapshot are skipped)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for fam, cur in after.items():
+        base = before.get(fam, {})
+        d = {}
+        for k, v in cur.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                d[k] = v - base.get(k, 0)
+        out[fam] = d
+    return out
+
+
+def worst_skew(stats) -> Optional[Dict[str, Any]]:
+    """The worst (highest-ratio) exchange skew summary of a query's
+    RuntimeStats, tagged with its op — the advisor's partition-skew
+    evidence. None when the query ran no exchange."""
+    worst = None
+    if stats is None:
+        return None
+    for st in stats.exchanges():
+        sk = st.skew()
+        if worst is None or sk["ratio"] > worst["ratio"]:
+            worst = dict(sk)
+            worst["op"] = f"{st.op}#{st.op_id}"
+            worst["partitions"] = st.partitions
+    return worst
+
+
+def build_capsule(*, query_id, fingerprint, ok, priority, attempts,
+                  wall_ns, phases, stats, summary, deltas,
+                  mesh_devices: int = 1) -> Dict[str, Any]:
+    """Assemble the one-line history record. Every field is plain JSON;
+    `phases` is the closed ledger dict (sum == wall_ns) or None when
+    phase attribution was off."""
+    summary = summary or {}
+    capsule: Dict[str, Any] = {
+        "ts_ms": int(time.time() * 1000),
+        "query": query_id,
+        "fingerprint": fingerprint,
+        "ok": bool(ok),
+        "priority": priority,
+        "attempts": attempts,
+        "wall_ns": int(wall_ns),
+        "mesh_devices": int(mesh_devices),
+        "phases": phases,
+        "rows": summary.get("total.numOutputRows", 0),
+        "batches": summary.get("total.numOutputBatches", 0),
+        "sem_wait_ns": summary.get("semWaitTimeNs", 0),
+        "spill_bytes": (summary.get("spilledDeviceBytes", 0)
+                        + summary.get("spilledHostBytes", 0)),
+        "skew": worst_skew(stats),
+    }
+    capsule.update(deltas)
+    return capsule

@@ -80,16 +80,45 @@ class QueryProfile:
     and `.to_json()` renderers."""
 
     def __init__(self, root, summary: Optional[Dict[str, int]] = None,
-                 level: Optional[int] = None, statistics=None):
+                 level: Optional[int] = None, statistics=None,
+                 phases=None):
         level = metrics_level() if level is None else level
         self.tree = _node(root, level)
         self.summary = dict(summary or {})
         #: per-query RuntimeStats (obs/stats.py), captured by
         #: DataFrame._collect_once from the governing QueryContext
         self._runtime_stats = statistics
+        #: wall-clock phase ledger (obs/phase.PhaseLedger) of the
+        #: governed query, or None when phases.enabled is off / the
+        #: collect ran ungoverned
+        self._phase_ledger = phases
+        #: canonical plan fingerprint of the executed root (ISSUE 14 /
+        #: the history capsule join key); None when the plan opted out
+        self.fingerprint = root.plan_fingerprint() \
+            if hasattr(root, "plan_fingerprint") else None
+
+    def phases(self) -> Optional[Dict[str, int]]:
+        """The query's closed wall-clock phase dict (obs/phase.PHASES
+        keys, sum == wall_ns exactly, `other` the derived remainder) —
+        None when no ledger was attached. Pair with `phases_wall_ns()`
+        for the denominator."""
+        if self._phase_ledger is None:
+            return None
+        return self._phase_ledger.snapshot()
+
+    def phases_wall_ns(self) -> Optional[int]:
+        """Wall-clock the phase dict partitions (ns), or None."""
+        if self._phase_ledger is None:
+            return None
+        return self._phase_ledger.wall_ns
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"summary": self.summary, "plan": self.tree}
+        out = {"summary": self.summary, "plan": self.tree}
+        ph = self.phases()
+        if ph is not None:
+            out["phases"] = ph
+            out["phases_wall_ns"] = self.phases_wall_ns()
+        return out
 
     def statistics(self) -> Dict[str, Any]:
         """Runtime statistics of this query (ISSUE 11): per-exchange

@@ -46,7 +46,28 @@ SERIES: Dict[str, str] = {
     "workload.admitted": "queries currently admitted",
     "queries.active": "registered (governed) query contexts",
     "breakers.open": "circuit-breaker domains not closed",
+    "ici.rounds": "cumulative ICI all-to-all exchange rounds",
+    "ici.bytes": "cumulative bytes moved over the ICI shuffle lane",
+    "ici.fallbacks": "ICI exchanges degraded to the host shuffle lane",
 }
+
+#: per-priority-class latency ring depth (queries kept for the SLO
+#: percentile surface). Bounded so a soak's registry stays O(1).
+_SLO_RING = 512
+
+#: percentile points health()["slo"] reports, in order
+_SLO_PCTS = (50, 95, 99)
+
+
+def _percentile(sorted_ns, pct: int) -> int:
+    """Nearest-rank percentile over an already-sorted list (exact for
+    the bounded ring sizes we keep — no interpolation surprises in
+    golden tests)."""
+    n = len(sorted_ns)
+    if n == 0:
+        return 0
+    rank = max(1, -(-pct * n // 100))  # ceil(pct/100 * n), min 1
+    return sorted_ns[min(n, rank) - 1]
 
 
 class TelemetryRegistry:
@@ -60,6 +81,11 @@ class TelemetryRegistry:
         self._counters: Dict[str, int] = {}
         self._series: Dict[str, deque] = {
             name: deque(maxlen=self.history) for name in SERIES}
+        #: per-priority-class query wall-clock ring (ISSUE 17): the
+        #: health()["slo"] percentile surface. Keys are priority-class
+        #: names ("interactive"/"batch"), values bounded deques of ns.
+        self._latency: Dict[str, deque] = {}
+        self._queries_seen: Dict[str, int] = {}
         self.samples_taken = 0
         self.writes = 0
         self._stop = threading.Event()
@@ -74,6 +100,36 @@ class TelemetryRegistry:
     def counter_values(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._counters)
+
+    # -- SLO latency ring (ISSUE 17) ---------------------------------------
+    def note_query_latency(self, priority: str, wall_ns: int) -> None:
+        """Record one finished governed query's wall-clock under its
+        priority class. Bounded ring per class; percentiles are computed
+        lazily on read (slo_snapshot), so the per-query cost is one
+        append under the registry lock."""
+        with self._lock:
+            ring = self._latency.get(priority)
+            if ring is None:
+                ring = self._latency[priority] = deque(maxlen=_SLO_RING)
+            ring.append(int(wall_ns))
+            self._queries_seen[priority] = \
+                self._queries_seen.get(priority, 0) + 1
+            self.writes += 1
+
+    def slo_snapshot(self) -> Dict[str, Dict[str, int]]:
+        """Per-priority-class p50/p95/p99 wall-clock (ns) over the last
+        <= _SLO_RING finished queries, plus the all-time count. Empty
+        dict when no governed query has finished yet."""
+        with self._lock:
+            rings = {p: sorted(r) for p, r in self._latency.items()}
+            seen = dict(self._queries_seen)
+        out: Dict[str, Dict[str, int]] = {}
+        for p, xs in rings.items():
+            row = {f"p{q}_ns": _percentile(xs, q) for q in _SLO_PCTS}
+            row["window"] = len(xs)
+            row["queries"] = seen.get(p, 0)
+            out[p] = row
+        return out
 
     # -- sampling ----------------------------------------------------------
     def sample(self) -> Dict[str, Any]:
@@ -141,12 +197,15 @@ def collect_sample() -> Dict[str, Any]:
     from ..memory.catalog import buffer_catalog
     from ..memory.semaphore import tpu_semaphore
 
+    from ..shuffle import manager as shuffle_manager
+
     cat = buffer_catalog()
     dev_by_owner, host_by_owner, dev_total, host_total = \
         cat.bytes_by_owner()
     up = upload.counters()
     d2h = transfer.counters()
     wl = workload.snapshot()
+    ici = shuffle_manager.ici_counters()
     return {
         "ts_ms": int(time.time() * 1000),
         "hbm.device_bytes": dev_total,
@@ -161,6 +220,9 @@ def collect_sample() -> Dict[str, Any]:
         "workload.admitted": wl["admitted"],
         "queries.active": len(lifecycle.active_query_ids()),
         "breakers.open": len(lifecycle.open_breakers()),
+        "ici.rounds": ici["rounds"],
+        "ici.bytes": ici["bytes"],
+        "ici.fallbacks": ici["fallbacks"],
         "hbm_by_owner": {"device": dev_by_owner, "host": host_by_owner},
     }
 
@@ -185,6 +247,23 @@ def add(name: str, delta: int = 1) -> None:
     r = _registry
     if r is not None:
         r.add(name, delta)
+
+
+def note_query_latency(priority: str, wall_ns: int) -> None:
+    """Per-query SLO accounting entry (api/session.collect). One
+    pointer check when telemetry is off."""
+    r = _registry
+    if r is not None:
+        r.note_query_latency(priority, wall_ns)
+
+
+def slo_section() -> Dict[str, Any]:
+    """The `slo` section of TpuSession.health(): per-priority-class
+    wall-clock percentiles over the latency ring."""
+    r = _registry
+    if r is None:
+        return {"enabled": False}
+    return {"enabled": True, "classes": r.slo_snapshot()}
 
 
 def configure(conf=None) -> Optional[TelemetryRegistry]:

@@ -103,6 +103,23 @@ def _no_leaked_telemetry_state():
 
 
 @pytest.fixture(scope="module", autouse=True)
+def _no_leaked_history_state():
+    """Phase/history-plane hygiene (ISSUE 17, the telemetry pattern): a
+    module that enabled the query-history store must not leave later
+    suites appending capsules to its tmpdir (the file handle would
+    outlive the tmpdir fixture), and the process-global phase counters
+    must not bleed across modules' bench-delta assertions — reset both
+    at module boundaries."""
+    from spark_rapids_tpu.obs import history
+    from spark_rapids_tpu.obs import phase
+    history.reset_history()
+    phase.reset_phase_counters()
+    yield
+    history.reset_history()
+    phase.reset_phase_counters()
+
+
+@pytest.fixture(scope="module", autouse=True)
 def _dispatch_ledger_reset():
     """Dispatch-plane hygiene (ISSUE 13): a module that disabled the
     ledger (dispatch.ledger.enabled=false session) must not leave the

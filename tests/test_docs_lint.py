@@ -13,6 +13,7 @@ table matching a Python registry) and delegates every code walk to
 
 import importlib
 import re
+import sys
 from pathlib import Path
 
 import pytest
@@ -277,3 +278,61 @@ def test_additional_metrics_are_canonical_and_unique():
             problems.append(f"{cls.__name__}: duplicate metric names "
                             f"{names}")
     assert not problems, "\n".join(problems)
+
+
+def test_phase_table_matches_registry():
+    """docs/observability.md's wall-clock phase table lists exactly
+    obs.phase.PHASES (ISSUE 17: the same drift lint the telemetry
+    series / event-kind tables get), scoped to the phase section."""
+    from spark_rapids_tpu.obs import phase
+    docs = (ROOT / "docs" / "observability.md").read_text()
+    m = re.search(r"## Wall-clock phase attribution\n(.*?)(?:\n## |\Z)",
+                  docs, re.DOTALL)
+    assert m, "docs/observability.md lost its phase-attribution section"
+    rows = set(re.findall(r"^\|\s*`([a-z][a-z-]*)`\s*\|", m.group(1),
+                          re.MULTILINE))
+    expected = set(phase.PHASES)
+    assert rows == expected, (
+        f"docs/observability.md phase table drifted: "
+        f"missing={sorted(expected - rows)} "
+        f"stale={sorted(rows - expected)}")
+
+
+def test_advisor_rules_table_matches_registry():
+    """docs/robustness.md's advisor-rules table lists exactly the
+    history_report.ADVISOR_RULES ids (ISSUE 17: the fault-point
+    discipline for the advisor's closed rule registry)."""
+    sys.path.insert(0, str(ROOT / "tools"))
+    try:
+        import history_report
+    finally:
+        sys.path.pop(0)
+    docs = (ROOT / "docs" / "robustness.md").read_text()
+    m = re.search(r"## Advisor rules\n(.*?)(?:\n## |\Z)", docs,
+                  re.DOTALL)
+    assert m, "docs/robustness.md lost its advisor-rules section"
+    rows = set(re.findall(r"^\|\s*`([a-z][a-z-]*)`\s*\|", m.group(1),
+                          re.MULTILINE))
+    expected = {r.id for r in history_report.ADVISOR_RULES}
+    assert rows == expected, (
+        f"docs/robustness.md advisor table drifted: "
+        f"missing={sorted(expected - rows)} "
+        f"stale={sorted(rows - expected)}")
+
+
+def test_canonical_metrics_table_matches_registry():
+    """docs/observability.md's canonical-metrics table has one row per
+    exec.base.CANONICAL_METRICS name (ISSUE 17 satellite: the metric
+    registry gets the same docs lint its consumers always had), scoped
+    to the canonical-metrics section."""
+    docs = (ROOT / "docs" / "observability.md").read_text()
+    m = re.search(r"## Canonical metrics\n(.*?)(?:\n## |\Z)", docs,
+                  re.DOTALL)
+    assert m, "docs/observability.md lost its canonical-metrics section"
+    rows = set(re.findall(r"^\|\s*`([a-zA-Z]+)`\s*\|", m.group(1),
+                          re.MULTILINE))
+    expected = set(exec_base.CANONICAL_METRICS)
+    assert rows == expected, (
+        f"docs/observability.md canonical-metrics table drifted: "
+        f"missing={sorted(expected - rows)} "
+        f"stale={sorted(rows - expected)}")

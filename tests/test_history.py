@@ -1,0 +1,292 @@
+"""Query history store (ISSUE 17 tentpole piece 2): one JSONL capsule
+per governed collect behind spark.rapids.tpu.history.{enabled,dir,
+maxBytes} — default off = one pointer check; capsule schema; rotation;
+configure() lifecycle semantics — plus the event-log
+rotation-under-concurrent-emission regression (satellite)."""
+
+import glob
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.expr.aggexprs import Count, Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.obs import events, history
+from spark_rapids_tpu.obs.phase import PHASES
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, Schema, StructField
+
+
+@pytest.fixture(autouse=True)
+def _history_isolation():
+    yield
+    history.reset_history()
+    events.reset_event_bus()
+    TpuSession()  # restore the default active conf
+
+
+def _q1_query(sess, n=3000):
+    rng = np.random.default_rng(0)
+    schema = Schema((StructField("returnflag", INT),
+                     StructField("quantity", LONG),
+                     StructField("extendedprice", DOUBLE),
+                     StructField("discount", DOUBLE)))
+    df = sess.from_pydict(
+        {"returnflag": rng.integers(0, 4, n).tolist(),
+         "quantity": rng.integers(1, 51, n).tolist(),
+         "extendedprice": (rng.random(n) * 1000).tolist(),
+         "discount": (rng.random(n) * 0.1).tolist()}, schema)
+    return (df.filter(col("quantity") <= lit(45))
+              .select(col("returnflag"), col("quantity"),
+                      (col("extendedprice") * (lit(1.0) - col("discount")))
+                      .alias("disc_price"))
+              .group_by("returnflag")
+              .agg((Sum(col("quantity")), "sum_qty"),
+                   (Sum(col("disc_price")), "sum_disc"), (Count(), "cnt")))
+
+
+def _read_capsules(d):
+    """Rotated-set order: the base file holds the OLDEST records, then
+    .1.jsonl, .2.jsonl, ... ascending (the event-log convention)."""
+    def key(path):
+        stem = path.rsplit(".jsonl", 1)[0]
+        suffix = stem.rsplit(".", 1)[-1]
+        return int(suffix) if suffix.isdigit() else 0
+    out = []
+    for path in sorted(glob.glob(str(d / "history-*.jsonl*")), key=key):
+        with open(path) as f:
+            for ln in f:
+                if ln.strip():
+                    out.append(json.loads(ln))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# disabled mode (the default): one pointer check per collect
+# ---------------------------------------------------------------------------
+
+def test_disabled_default_writes_nothing(tmp_path):
+    sess = TpuSession({"spark.rapids.tpu.history.dir": str(tmp_path)})
+    assert history.active_store() is None   # the one pointer a collect pays
+    rows = _q1_query(sess).collect()
+    assert rows
+    assert glob.glob(str(tmp_path / "*")) == []
+
+
+# ---------------------------------------------------------------------------
+# the capsule
+# ---------------------------------------------------------------------------
+
+def test_collect_appends_one_self_describing_capsule(tmp_path):
+    sess = TpuSession({"spark.rapids.tpu.history.enabled": "true",
+                       "spark.rapids.tpu.history.dir": str(tmp_path)})
+    assert history.active_store() is not None
+    rows = _q1_query(sess).collect()
+    assert len(rows) == 4
+    (cap,) = _read_capsules(tmp_path)
+    assert cap["ok"] is True
+    assert cap["query"] is not None
+    assert cap["attempts"] == 1
+    assert cap["priority"] == "interactive"
+    assert cap["wall_ns"] > 0
+    assert cap["mesh_devices"] >= 1
+    assert isinstance(cap["ts_ms"], int)
+    # the plan fingerprint is the diff join key — stable hex digest
+    assert isinstance(cap["fingerprint"], str) and len(cap["fingerprint"]) == 40
+    # the phase ledger rides the capsule and stays closed
+    assert set(cap["phases"]) == set(PHASES)
+    assert sum(cap["phases"].values()) == cap["wall_ns"]
+    # essential metrics + counter-family deltas (total.* sums span
+    # every operator, so rows >= the 4 result rows)
+    assert cap["rows"] >= 4 and cap["batches"] >= 1
+    assert cap["sem_wait_ns"] >= 0 and cap["spill_bytes"] == 0
+    assert cap["dispatch"]["dispatches"] > 0
+    for fam in ("shuffle", "ici", "upload", "workload"):
+        assert fam in cap
+    # a second collect of the SAME plan shape appends a second capsule
+    # with the SAME fingerprint (the aggregation key)
+    _q1_query(sess).collect()
+    caps = _read_capsules(tmp_path)
+    assert len(caps) == 2
+    assert caps[0]["fingerprint"] == caps[1]["fingerprint"]
+
+
+def test_failed_query_capsule_keeps_its_own_fingerprint(tmp_path):
+    """A query that dies MID-execution still harvested its own plan, so
+    its capsule carries its OWN fingerprint (joining the healthy runs
+    of the same shape in the aggregation) with ok=False and closed
+    phase books."""
+    sess = TpuSession({
+        "spark.rapids.tpu.history.enabled": "true",
+        "spark.rapids.tpu.history.dir": str(tmp_path),
+        "spark.rapids.tpu.task.maxAttempts": "1",
+        "spark.rapids.tpu.task.retryBackoffMs": "1",
+    })
+    _q1_query(sess).collect()  # a healthy run of the same plan shape
+    from spark_rapids_tpu import faults
+    try:
+        faults.install(
+            "device.dispatch:prob=1,seed=11,kind=device,max=99")
+        with pytest.raises(Exception):
+            _q1_query(sess).collect()
+    finally:
+        faults.install(None)
+    caps = _read_capsules(tmp_path)
+    assert len(caps) == 2
+    ok_cap, bad_cap = caps
+    assert ok_cap["ok"] is True and ok_cap["fingerprint"]
+    assert bad_cap["ok"] is False
+    assert bad_cap["fingerprint"] == ok_cap["fingerprint"]
+    assert bad_cap["wall_ns"] > 0
+    assert sum(bad_cap["phases"].values()) == bad_cap["wall_ns"]
+
+
+def test_shed_query_capsule_has_no_stale_plan(tmp_path):
+    """A query that dies BEFORE its plan exists (admission shed) must
+    not write the PREVIOUS query's fingerprint/metrics into its
+    capsule: ok=False, fingerprint None, wall still measured."""
+    from spark_rapids_tpu import QueryAdmissionError
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec import workload
+    settings = {
+        "spark.rapids.tpu.history.enabled": "true",
+        "spark.rapids.tpu.history.dir": str(tmp_path),
+        "spark.rapids.tpu.workload.enabled": "true",
+        "spark.rapids.tpu.workload.maxConcurrentQueries": "1",
+        "spark.rapids.tpu.workload.queueDepth": "0",
+    }
+    sess = TpuSession(settings)
+    _q1_query(sess).collect()  # seeds _last_query_profile
+    m = workload.manager()
+    ticket = m.admit(RapidsConf(settings), None)  # occupy the one slot
+    try:
+        with pytest.raises(QueryAdmissionError):
+            _q1_query(sess).collect()
+    finally:
+        m.release(ticket)
+        workload.reset_workload()
+    caps = _read_capsules(tmp_path)
+    assert len(caps) == 2
+    ok_cap, shed_cap = caps
+    assert ok_cap["ok"] is True and ok_cap["fingerprint"]
+    assert shed_cap["ok"] is False
+    assert shed_cap["fingerprint"] is None   # never the stale plan's
+    assert shed_cap["rows"] == 0 and shed_cap["batches"] == 0
+
+
+# ---------------------------------------------------------------------------
+# rotation + write-never-raises
+# ---------------------------------------------------------------------------
+
+def test_capsule_rotation_past_max_bytes(tmp_path):
+    store = history.enable(str(tmp_path), max_bytes=512)
+    try:
+        for i in range(40):
+            store.append({"i": i, "pad": "x" * 64})
+        assert store.records == 40
+    finally:
+        history.reset_history()
+    files = glob.glob(str(tmp_path / "history-*.jsonl*"))
+    assert len(files) > 1, "512-byte cap never rotated"
+    caps = _read_capsules(tmp_path)
+    assert [c["i"] for c in caps] == list(range(40))  # ordered, lossless
+
+
+def test_write_failure_warns_once_and_self_uninstalls(tmp_path, caplog):
+    store = history.enable(str(tmp_path))
+    store.append({"i": 0})
+    # kill the sink out from under the store: next append must not raise
+    store._file.close()  # noqa: SLF001 — simulating a dead file handle
+    with caplog.at_level("WARNING", logger="spark_rapids_tpu.obs"):
+        store.append({"i": 1})
+    assert history.active_store() is None   # self-uninstalled
+    assert any("history" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# configure() lifecycle (the event-bus conf semantics)
+# ---------------------------------------------------------------------------
+
+def test_configure_unset_keeps_explicit_false_tears_down(tmp_path):
+    TpuSession({"spark.rapids.tpu.history.enabled": "true",
+                "spark.rapids.tpu.history.dir": str(tmp_path)})
+    store = history.active_store()
+    assert store is not None
+    TpuSession()  # history.enabled UNSET: another session's store lives on
+    assert history.active_store() is store
+    TpuSession({"spark.rapids.tpu.history.enabled": "false"})  # explicit
+    assert history.active_store() is None
+
+
+# ---------------------------------------------------------------------------
+# counter deltas + worst-skew summarization (unit)
+# ---------------------------------------------------------------------------
+
+def test_counters_delta_numeric_only():
+    before = {"shuffle": {"bytes": 100, "frames": 2, "flag": True}}
+    after = {"shuffle": {"bytes": 350, "frames": 5, "flag": True},
+             "ici": {"rounds": 3}}
+    d = history.counters_delta(before, after)
+    assert d["shuffle"] == {"bytes": 250, "frames": 3}  # bools skipped
+    assert d["ici"] == {"rounds": 3}
+
+
+def test_build_capsule_tolerates_missing_surfaces():
+    """A capsule from a query with no stats, no summary, no phases
+    still self-describes (every schema field present)."""
+    cap = history.build_capsule(
+        query_id=7, fingerprint=None, ok=False, priority="batch",
+        attempts=3, wall_ns=123, phases=None, stats=None, summary=None,
+        deltas={"dispatch": {"dispatches": 1}})
+    for field in ("ts_ms", "query", "fingerprint", "ok", "priority",
+                  "attempts", "wall_ns", "mesh_devices", "phases",
+                  "rows", "batches", "sem_wait_ns", "spill_bytes",
+                  "skew"):
+        assert field in cap
+    assert cap["query"] == 7 and cap["attempts"] == 3
+    assert cap["phases"] is None and cap["skew"] is None
+    assert cap["dispatch"] == {"dispatches": 1}
+    json.dumps(cap)  # JSONL-serializable as-is
+
+
+# ---------------------------------------------------------------------------
+# satellite: event-log rotation under concurrent emission
+# ---------------------------------------------------------------------------
+
+def test_eventlog_rotation_under_concurrent_emission(tmp_path):
+    """N threads hammering a small-maxBytes bus: rotation must lose no
+    records and tear no lines (every line of every rotated member
+    parses, and the full id set survives)."""
+    bus = events.enable(str(tmp_path), max_bytes=4096)
+    n_threads, per_thread = 8, 200
+    barrier = threading.Barrier(n_threads)
+
+    def hammer(tid):
+        barrier.wait()
+        for i in range(per_thread):
+            # an unregistered kind defaults to MODERATE — kept at the
+            # bus's default level on every thread
+            events.emit("hammer", tid=tid, i=i, pad="y" * 40)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events.reset_event_bus()
+    files = glob.glob(str(tmp_path / "events-*.jsonl*"))
+    assert len(files) > 1, "4KB cap never rotated under the storm"
+    seen = set()
+    for path in files:
+        with open(path) as f:
+            for ln in f:
+                assert ln.endswith("\n"), f"torn line in {path}"
+                rec = json.loads(ln)   # no partial lines
+                if rec["kind"] == "hammer":
+                    seen.add((rec["tid"], rec["i"]))
+    assert seen == {(t, i) for t in range(n_threads)
+                    for i in range(per_thread)}, "lost records"

@@ -1,0 +1,362 @@
+"""Profiling advisor + history report CLI (ISSUE 17 tentpole piece 3)
+and the tier-1 suite-budget tool (satellite): per-fingerprint
+aggregation, phase-ranked --diff regressions, the closed ADVISOR_RULES
+registry on crafted golden scenarios, the profile_report phase
+roll-up, and suite_budget's durations parsing."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from spark_rapids_tpu.obs import history
+from spark_rapids_tpu.obs import phase as obs_phase
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT / "tools"))
+import history_report  # noqa: E402
+import profile_report  # noqa: E402
+import suite_budget  # noqa: E402
+
+
+def test_report_phase_tuple_is_the_registry():
+    """The stdlib-only tool mirrors obs.phase.PHASES by value — drift
+    between the two is a silent misattribution bug."""
+    assert history_report.PHASES == obs_phase.PHASES
+
+
+# ---------------------------------------------------------------------------
+# capsule factory (golden scenarios)
+# ---------------------------------------------------------------------------
+
+def _capsule(fp, wall_ns, ts=0, ok=True, phases=None, mesh=1, **families):
+    ph = {p: 0 for p in history_report.PHASES}
+    ph.update(phases or {})
+    measured = sum(v for k, v in ph.items() if k != "other")
+    ph["other"] = max(0, wall_ns - measured)
+    cap = {"ts_ms": ts, "query": 1, "fingerprint": fp, "ok": ok,
+           "priority": "interactive", "attempts": 1, "wall_ns": wall_ns,
+           "mesh_devices": mesh, "phases": ph, "rows": 100, "batches": 2,
+           "sem_wait_ns": 0, "spill_bytes": 0, "skew": None,
+           "dispatch": {}, "shuffle": {}, "ici": {}, "upload": {},
+           "workload": {}}
+    cap.update(families)
+    return cap
+
+
+def _write_dir(d, capsules):
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / "history-1-1.jsonl", "w") as f:
+        for c in capsules:
+            f.write(json.dumps(c) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+def test_aggregate_per_fingerprint():
+    caps = [
+        _capsule("aaa", 1000, ts=1, phases={"compile": 600}),
+        _capsule("aaa", 3000, ts=2, phases={"compile": 1800}),
+        _capsule("aaa", 2000, ts=3, ok=False, phases={"compile": 1200}),
+        _capsule("bbb", 500, ts=4),
+        {"wall_ns": 42, "ok": True},   # fingerprint-less -> "(none)"
+    ]
+    agg = history_report.aggregate(caps)
+    assert set(agg) == {"aaa", "bbb", "(none)"}
+    a = agg["aaa"]
+    assert a["count"] == 3 and a["ok"] == 2
+    assert a["p50_wall_ns"] == 2000       # nearest-rank of [1000,2000,3000]
+    assert a["p95_wall_ns"] == 3000
+    assert a["phase_mean_ns"]["compile"] == (600 + 1800 + 1200) // 3
+    assert agg["bbb"]["count"] == 1
+    assert agg["(none)"]["count"] == 1
+
+
+def test_read_capsules_skips_bad_lines(tmp_path, capsys):
+    d = tmp_path / "hist"
+    d.mkdir()
+    good = _capsule("aaa", 100, ts=5)
+    (d / "history-9-1.jsonl").write_text(
+        json.dumps(good) + "\n{not json\n\n")
+    caps = history_report.read_capsules(str(d))
+    assert len(caps) == 1 and caps[0]["fingerprint"] == "aaa"
+    assert "skipped 1" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# diff: regressions ranked by the phase that moved
+# ---------------------------------------------------------------------------
+
+def test_diff_ranks_regression_by_moved_phase(tmp_path):
+    base = [_capsule("slowplan", 1_000_000, ts=i,
+                     phases={"device-compute": 800_000})
+            for i in range(3)]
+    base += [_capsule("okplan", 500_000, ts=i + 10,
+                      phases={"device-compute": 400_000})
+             for i in range(3)]
+    # the induced regression: slowplan's wall doubles and the growth is
+    # all compile (a recompile regression)
+    cur = [_capsule("slowplan", 2_000_000, ts=i,
+                    phases={"device-compute": 800_000,
+                            "compile": 1_000_000})
+           for i in range(3)]
+    cur += [_capsule("okplan", 490_000, ts=i + 10,
+                     phases={"device-compute": 390_000})
+            for i in range(3)]
+    cur += [_capsule("newplan", 100, ts=20)]  # no base -> not joined
+    rows = history_report.diff_report(history_report.aggregate(base),
+                                      history_report.aggregate(cur))
+    assert [r["fingerprint"] for r in rows] == ["slowplan", "okplan"]
+    top = rows[0]
+    assert top["delta_ns"] == 1_000_000
+    assert top["pct"] == 100.0
+    assert top["phase"] == "compile"          # the mover, named
+    assert top["phase_delta_ns"] == 1_000_000
+    assert rows[1]["delta_ns"] < 0            # improvement at the bottom
+
+
+# ---------------------------------------------------------------------------
+# advisor goldens
+# ---------------------------------------------------------------------------
+
+def _findings(caps):
+    return history_report.advise(history_report.aggregate(caps))
+
+
+def test_advisor_silent_on_healthy_corpus():
+    # traced once on the first run, program-cache hits thereafter
+    caps = [_capsule("good", 1000, ts=i,
+                     phases={"device-compute": 900},
+                     dispatch={"dispatches": 50,
+                               "traces": 1 if i == 0 else 0, "storms": 0},
+                     upload={"uploads": 10, "per_buffer": 0})
+            for i in range(3)]
+    assert _findings(caps) == []
+
+
+def test_advisor_recompile_storm_golden():
+    # scenario A: an explicit storm event fired
+    caps = [_capsule("stormy", 1000, ts=1,
+                     dispatch={"dispatches": 9, "traces": 9, "storms": 2})]
+    (f,) = _findings(caps)
+    assert f["rule"] == "recompile-storm" and f["fingerprint"] == "stormy"
+    assert f["evidence"]["storms"] == 2
+    assert "advice" in f and f["advice"]
+    # scenario B: no storm, but every repeat of the plan re-traced
+    caps = [_capsule("churny", 1000, ts=i,
+                     dispatch={"dispatches": 4, "traces": 2})
+            for i in range(3)]
+    (f,) = _findings(caps)
+    assert f["rule"] == "recompile-storm"
+    assert f["evidence"]["traces"] == 6 and f["evidence"]["runs"] == 3
+
+
+def test_advisor_ici_eligible_golden():
+    """Multi-device mesh + host shuffle bytes + zero ICI rounds/
+    fallbacks: the lane never even tried — the one-conf fix."""
+    caps = [_capsule("podplan", 1000, ts=1, mesh=8,
+                     shuffle={"bytes": 1 << 20},
+                     ici={"rounds": 0, "fallbacks": 0})]
+    (f,) = _findings(caps)
+    assert f["rule"] == "ici-eligible"
+    assert f["evidence"]["mesh_devices"] == 8
+    assert f["evidence"]["host_shuffle_bytes"] == 1 << 20
+    assert "shuffle.ici.enabled" in f["advice"]
+    # negatives: single device / lane already tried / lane degraded
+    assert _findings([_capsule("x", 1000, mesh=1,
+                               shuffle={"bytes": 1 << 20})]) == []
+    assert _findings([_capsule("x", 1000, mesh=8,
+                               shuffle={"bytes": 1 << 20},
+                               ici={"rounds": 3})]) == []
+    assert _findings([_capsule("x", 1000, mesh=8,
+                               shuffle={"bytes": 1 << 20},
+                               ici={"fallbacks": 1})]) == []
+
+
+def test_advisor_skew_stall_upload_quota():
+    caps = [_capsule("skewed", 1000, ts=1,
+                     skew={"op": "HostShuffleExchangeExec#3",
+                           "ratio": 9.5, "basis": "bytes",
+                           "partitions": 16})]
+    (f,) = _findings(caps)
+    assert f["rule"] == "partition-skew" and f["evidence"]["ratio"] == 9.5
+
+    caps = [_capsule("stally", 1_000_000, ts=1,
+                     phases={"pipeline-stall": 400_000})]
+    (f,) = _findings(caps)
+    assert f["rule"] == "pipeline-stall"
+    assert f["evidence"]["share"] == 0.4
+
+    caps = [_capsule("buffery", 1000, ts=1,
+                     upload={"uploads": 10, "per_buffer": 8})]
+    (f,) = _findings(caps)
+    assert f["rule"] == "per-buffer-upload"
+    assert f["evidence"]["share"] == 0.8
+
+    # quota-spill dominance is CROSS-plan: one plan owns the majority
+    caps = [_capsule("hog", 1000, ts=1,
+                     workload={"quota_spills": 9}),
+            _capsule("meek", 1000, ts=2,
+                     workload={"quota_spills": 1})]
+    (f,) = _findings(caps)
+    assert f["rule"] == "quota-spill-dominance"
+    assert f["fingerprint"] == "hog"
+    assert f["evidence"] == {"quota_spills": 9, "all_plans": 10,
+                             "spill_bytes": 0}
+
+
+# ---------------------------------------------------------------------------
+# the CLI end-to-end: two history dirs, --diff, advisor, both formats
+# ---------------------------------------------------------------------------
+
+def test_cli_diff_end_to_end(tmp_path, capsys):
+    """The acceptance flow: two capsule dirs (base vs current), --diff
+    joins on fingerprint and ranks the induced regression by the phase
+    that moved; the advisor section rides along; text and json agree."""
+    base_d, cur_d = tmp_path / "base", tmp_path / "cur"
+    _write_dir(base_d, [
+        _capsule("deadbeef" * 5, 1_000_000, ts=i,
+                 phases={"device-compute": 900_000}) for i in range(2)])
+    _write_dir(cur_d, [
+        _capsule("deadbeef" * 5, 1_600_000, ts=i, mesh=4,
+                 phases={"device-compute": 900_000,
+                         "host-pack-serialize": 600_000},
+                 shuffle={"bytes": 1 << 22}) for i in range(2)])
+    rc = history_report.main([str(cur_d), "--diff", str(base_d),
+                              "--format", "json"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["capsules"] == 2
+    (row,) = summary["diff"]
+    assert row["fingerprint"] == "deadbeef" * 5
+    assert row["delta_ns"] == 600_000
+    assert row["phase"] == "host-pack-serialize"
+    # the regression also made the plan ici-eligible -> advisor fires
+    assert [f["rule"] for f in summary["advisor"]] == ["ici-eligible"]
+    # text rendering carries the same story
+    assert history_report.main([str(cur_d), "--diff", str(base_d)]) == 0
+    text = capsys.readouterr().out
+    assert "host-pack-serialize" in text
+    assert "[ici-eligible]" in text
+    # an empty dir exits 1 (nothing to report on)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert history_report.main([str(empty)]) == 1
+
+
+def test_real_session_capsules_join_across_dirs(tmp_path):
+    """Fingerprint stability end-to-end: the SAME query shape run into
+    two different history dirs (two 'bench runs') joins on fingerprint
+    in --diff — no crafted capsules, the real store + real plans."""
+    import numpy as np
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.expr.aggexprs import Sum
+    from spark_rapids_tpu.expr.core import col, lit
+    from spark_rapids_tpu.types import LONG, Schema
+
+    def run_into(d):
+        history.enable(str(d))
+        try:
+            sess = TpuSession()
+            rng = np.random.default_rng(1)
+            df = sess.from_pydict(
+                {"k": rng.integers(0, 5, 1000).tolist(),
+                 "v": rng.integers(0, 100, 1000).tolist()},
+                Schema.of(k=LONG, v=LONG))
+            out = (df.filter(col("v") > lit(10)).group_by("k")
+                     .agg((Sum(col("v")), "s")).collect())
+            assert out
+        finally:
+            history.reset_history()
+
+    run_into(tmp_path / "a")
+    run_into(tmp_path / "b")
+    base = history_report.aggregate(
+        history_report.read_capsules(str(tmp_path / "a")))
+    cur = history_report.aggregate(
+        history_report.read_capsules(str(tmp_path / "b")))
+    rows = history_report.diff_report(base, cur)
+    assert len(rows) == 1, "the same plan did not join on fingerprint"
+    assert rows[0]["fingerprint"] != "(none)"
+
+
+# ---------------------------------------------------------------------------
+# profile_report: the phase roll-up block (satellite)
+# ---------------------------------------------------------------------------
+
+def test_profile_report_phase_rollup(tmp_path, capsys):
+    log = tmp_path / "events-1-1.jsonl"
+    recs = [
+        {"ts_ns": 1, "kind": "query_start", "query": 1, "root": "AggregateExec"},
+        {"ts_ns": 2, "kind": "query_phases", "query": 1, "ok": True,
+         "wall_ns": 1000, "attempts": 1, "priority": "interactive",
+         "phases": {"compile": 600, "device-compute": 300, "other": 100}},
+        {"ts_ns": 3, "kind": "query_end", "query": 1, "ok": True,
+         "root": "AggregateExec", "wall_ns": 1000},
+        {"ts_ns": 4, "kind": "query_phases", "query": 2, "ok": True,
+         "wall_ns": 500, "attempts": 1, "priority": "batch",
+         "phases": {"compile": 100, "shuffle-io": 400}},
+    ]
+    log.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    assert profile_report.main([str(log), "--format", "json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    ph = summary["phases"]
+    assert ph["queries"] == 2
+    assert ph["wall_ns"] == 1500
+    assert ph["by_phase"]["compile"] == 700
+    assert ph["by_phase"]["shuffle-io"] == 400
+    assert ph["by_phase"]["other"] == 100
+    text = profile_report.build_report(
+        profile_report.read_event_files(str(log)))
+    assert "wall-clock phases" in text
+    assert "compile" in text
+
+
+# ---------------------------------------------------------------------------
+# suite_budget (satellite): the tier-1 time-budget table
+# ---------------------------------------------------------------------------
+
+SAMPLE_LOG = """\
+============================= slowest durations ==============================
+12.50s call     tests/test_big.py::test_storm
+2.00s setup    tests/test_big.py::test_storm
+1.25s call     tests/test_small.py::TestC::test_y[param-1]
+0.30s teardown tests/test_small.py::TestC::test_y[param-1]
+(12 durations < 0.005s hidden.)
+========================== 3 passed in 16.05s ================================
+"""
+
+
+def test_suite_budget_parse_and_build():
+    rows = suite_budget.parse_durations(SAMPLE_LOG.splitlines())
+    assert len(rows) == 4
+    b = suite_budget.build_budget(rows, budget_s=870.0, top=20)
+    assert b["measured_s"] == pytest.approx(16.05)
+    assert b["headroom_s"] == pytest.approx(870.0 - 16.05)
+    # per-test totals merge call+setup+teardown; worst first
+    assert b["top_tests"][0]["test"] == "tests/test_big.py::test_storm"
+    assert b["top_tests"][0]["seconds"] == pytest.approx(14.5)
+    assert b["top_files"][0]["file"] == "tests/test_big.py"
+    assert b["top_files"][1]["seconds"] == pytest.approx(1.55)
+
+
+def test_suite_budget_cli_and_warn_gate(tmp_path, capsys):
+    log = tmp_path / "run.log"
+    log.write_text(SAMPLE_LOG)
+    assert suite_budget.main([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "tier-1 time budget" in out and "test_storm" in out
+    # the early-warning gate: measured 16.05s > 80% of a 20s budget
+    assert suite_budget.main([str(log), "--budget", "20"]) == 1
+    capsys.readouterr()
+    assert suite_budget.main([str(log), "--budget", "20",
+                              "--format", "json"]) == 1
+    b = json.loads(capsys.readouterr().out)
+    assert b["budget_s"] == 20.0 and b["budget_share"] > 0.8
+    # a log with no durations section is an error, not a silent pass
+    empty = tmp_path / "empty.log"
+    empty.write_text("=== 3 passed in 1.00s ===\n")
+    assert suite_budget.main([str(empty)]) == 1

@@ -124,11 +124,12 @@ def test_jsonl_schema_and_reconciliation(tmp_path):
 
 
 def test_event_level_filters_span_records(tmp_path):
-    """eventLog.level=ESSENTIAL keeps query begin/end only."""
+    """eventLog.level=ESSENTIAL keeps the query begin/end/phase-ledger
+    records only (query_phases joined the essential set in ISSUE 17)."""
     sess = _enabled_session(tmp_path, level="ESSENTIAL")
     _q1_query(sess).collect()
     kinds = {r["kind"] for r in _read_log(tmp_path)}
-    assert kinds == {"query_start", "query_end"}
+    assert kinds == {"query_start", "query_end", "query_phases"}
 
 
 def test_span_nesting_and_attribution(tmp_path):

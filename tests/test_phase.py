@@ -1,0 +1,282 @@
+"""Wall-clock phase attribution (ISSUE 17 tentpole piece 1): the
+closed-set invariant `sum(phases) == wall_ns` exactly — unit-level on
+the ledger's folding/trim rules and end-to-end on a pipelined,
+spilling, task-retried governed query — plus the disabled-mode
+one-pointer-check discipline and the query_phases event surface."""
+
+import glob
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.memory.budget import (memory_budget,
+                                            reset_memory_budget)
+from spark_rapids_tpu.memory.catalog import reset_buffer_catalog
+from spark_rapids_tpu.obs import events, history, phase
+from spark_rapids_tpu.obs.phase import ACCRUABLE, PHASES, PhaseLedger
+
+
+@pytest.fixture(autouse=True)
+def _phase_isolation():
+    yield
+    faults.install(None)
+    phase.reset_phase_counters()
+    history.reset_history()
+    events.reset_event_bus()
+    TpuSession()  # restore the default active conf
+
+
+# ---------------------------------------------------------------------------
+# the closed set
+# ---------------------------------------------------------------------------
+
+def test_phase_set_is_closed_and_other_is_derived():
+    assert PHASES[-1] == "other"
+    assert ACCRUABLE == PHASES[:-1]
+    assert len(set(PHASES)) == len(PHASES)
+    led = PhaseLedger()
+    snap = led.snapshot()
+    assert set(snap) == set(PHASES)
+
+
+# ---------------------------------------------------------------------------
+# global counters (the bench delta surface)
+# ---------------------------------------------------------------------------
+
+def test_global_counters_accrue_and_reset():
+    phase.reset_phase_counters()
+    base = phase.counters()
+    assert set(base) == set(ACCRUABLE) and not any(base.values())
+    phase.add("compile", 1234)
+    phase.add("compile", 1)
+    phase.add("shuffle-io", 7)
+    phase.add("spill-wait", 0)      # zero/negative accruals are no-ops
+    phase.add("spill-wait", -5)
+    cur = phase.counters()
+    assert cur["compile"] == 1235
+    assert cur["shuffle-io"] == 7
+    assert cur["spill-wait"] == 0
+    phase.reset_phase_counters()
+    assert not any(phase.counters().values())
+
+
+def test_span_is_exclusive_of_nested_accruals():
+    """A span's phase gets only its EXCLUSIVE elapsed: time a nested
+    add() (or nested span) reports is subtracted, so arbitrary nesting
+    never double-counts into the global books."""
+    phase.reset_phase_counters()
+    with phase.span("ici-collective"):
+        assert phase.in_span()
+        t0 = time.perf_counter_ns()
+        while time.perf_counter_ns() - t0 < 2_000_000:
+            pass
+        # a nested accrual claiming (more than) the whole block so far
+        phase.add("device-compute", 10_000_000_000)
+    assert not phase.in_span()
+    cur = phase.counters()
+    assert cur["device-compute"] == 10_000_000_000
+    # the child claimed more than the span elapsed -> zero exclusive
+    assert cur["ici-collective"] == 0
+
+
+def test_note_dispatch_routing():
+    """Traced dispatches are compile wherever they happen; cached
+    dispatches are device-compute only OUTSIDE a span (inside one the
+    enclosing phase keeps the time)."""
+    phase.reset_phase_counters()
+    phase.note_dispatch(50, traced=True)
+    phase.note_dispatch(70, traced=False)
+    with phase.span("ici-collective"):
+        phase.note_dispatch(500, traced=True)
+        phase.note_dispatch(900, traced=False)  # swallowed by the span
+    cur = phase.counters()
+    assert cur["compile"] == 550
+    assert cur["device-compute"] == 70
+
+
+# ---------------------------------------------------------------------------
+# ledger folding rules (unit)
+# ---------------------------------------------------------------------------
+
+def _folded_add(led, phase_name, ns):
+    t = threading.Thread(target=led.add, args=(phase_name, ns))
+    t.start()
+    t.join()
+
+
+def test_ledger_folds_producer_time_into_stall_budget():
+    """Folded (producer-thread) time displaces pipeline-stall
+    one-for-one: the consumer stalled exactly while producers worked."""
+    led = PhaseLedger()
+    led.add("pipeline-stall", 1000)
+    _folded_add(led, "device-compute", 400)
+    time.sleep(0.001)  # wall must dominate the synthetic accruals
+    snap = led.snapshot()
+    assert snap["device-compute"] == 400
+    assert snap["pipeline-stall"] == 600
+    assert sum(snap.values()) == led.wall_ns
+    assert min(snap.values()) >= 0
+
+
+def test_ledger_scales_folded_surplus_down():
+    """Producers reporting MORE than the consumer stalled (deep
+    overlap): shares scale down so attribution never exceeds the
+    measured stall budget."""
+    led = PhaseLedger()
+    led.add("pipeline-stall", 1000)
+    _folded_add(led, "device-compute", 3000)
+    _folded_add(led, "host-pack-serialize", 1000)
+    time.sleep(0.001)
+    snap = led.snapshot()
+    assert snap["device-compute"] == 3000 * 1000 // 4000
+    assert snap["host-pack-serialize"] == 1000 * 1000 // 4000
+    assert snap["pipeline-stall"] == 1000 - (750 + 250)
+    assert sum(snap.values()) == led.wall_ns
+
+
+def test_ledger_trims_rather_than_exceeding_wall():
+    """Defensive seam: even a ledger fed absurd direct accruals
+    reports sum == wall with nothing negative."""
+    led = PhaseLedger()
+    led.add("compile", 10**15)
+    led.add("shuffle-io", 500)
+    snap = led.snapshot()
+    assert sum(snap.values()) == led.wall_ns
+    assert min(snap.values()) >= 0
+    assert snap["other"] == 0  # trim leaves no remainder to derive
+
+
+def test_ledger_finish_is_idempotent():
+    led = PhaseLedger()
+    led.add("compile", 10)
+    w1 = led.finish()
+    time.sleep(0.002)
+    assert led.finish() == w1 == led.wall_ns
+    s1, s2 = led.snapshot(), led.snapshot()
+    assert s1 == s2 and sum(s1.values()) == w1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: pipelined + spilling + retried governed query
+# ---------------------------------------------------------------------------
+
+def _storm_parquet(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(5)
+    n_l, n_o = 2000, 500
+    l_key = rng.integers(0, n_o, n_l)
+    l_val = rng.random(n_l) * 100.0
+    l_flag = rng.integers(0, 4, n_l)
+    o_flag = rng.integers(0, 10, n_o)
+    lp, op = str(tmp_path / "lines.parquet"), str(tmp_path / "orders.parquet")
+    pq.write_table(pa.table({
+        "l_key": pa.array(l_key, pa.int64()),
+        "l_val": pa.array(l_val, pa.float64()),
+        "l_flag": pa.array(l_flag, pa.int64())}), lp, row_group_size=512)
+    pq.write_table(pa.table({
+        "o_key": pa.array(np.arange(n_o), pa.int64()),
+        "o_flag": pa.array(o_flag, pa.int64())}), op, row_group_size=128)
+    return lp, op
+
+
+def _storm_query(sess, lp, op):
+    lines = sess.read_parquet(lp).filter(col("l_flag") != lit(0))
+    orders = sess.read_parquet(op).filter(col("o_flag") < lit(5))
+    j = lines.join(orders, left_on=["l_key"], right_on=["o_key"])
+    return (j.group_by("l_key").agg((F.sum("l_val"), "rev"),
+                                    (F.count(), "cnt"))
+             .sort(("rev", False)))
+
+
+STRESS = {
+    "spark.rapids.sql.batchSizeBytes": str(16 * 1024),
+    "spark.rapids.sql.broadcastSizeThreshold": "-1",
+    "spark.rapids.sql.retry.maxAttempts": "50",
+    "spark.rapids.tpu.retry.backoffMs": "1",
+    "spark.rapids.tpu.io.retryBackoffMs": "1",
+    "spark.rapids.tpu.task.retryBackoffMs": "1",
+}
+
+
+def test_phase_invariant_on_pipelined_spilling_retried_query(tmp_path):
+    """THE acceptance criterion: a governed query that pipelines,
+    spills under a forced budget, AND task-retries a mid-flight device
+    fault still closes its phase books exactly — sum(phases) ==
+    wall_ns, nothing negative — and the query_phases ESSENTIAL event
+    carries the same ledger with correct attribution fields."""
+    lp, op = _storm_parquet(tmp_path)
+    settings = dict(STRESS, **{
+        "spark.rapids.tpu.eventLog.enabled": "true",
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path / "ev"),
+        "spark.rapids.tpu.eventLog.level": "ESSENTIAL",
+        "spark.rapids.tpu.test.faults":
+            "device.dispatch:prob=1,seed=3,kind=device,max=1",
+    })
+    reset_buffer_catalog()
+    reset_memory_budget(80 * 1024)  # force spill on a single lane
+    try:
+        sess = TpuSession(settings)
+        rows = _storm_query(sess, lp, op).collect()
+        assert rows
+        assert memory_budget().spill_requests > 0, \
+            "the forced-spill budget lost its teeth"
+        prof = sess.last_query_profile()
+        ph = prof.phases()
+        wall = prof.phases_wall_ns()
+        assert ph is not None and wall > 0
+        assert set(ph) == set(PHASES)
+        assert sum(ph.values()) == wall        # the exact invariant
+        assert min(ph.values()) >= 0
+        assert ph["compile"] > 0               # dispatches traced
+        m = sess.last_query_metrics()
+        assert m["retryCount"] + m["splitAndRetryCount"] >= 0
+        # the ESSENTIAL event carries the same closed books
+        (ev_file,) = glob.glob(str(tmp_path / "ev" / "events-*.jsonl"))
+        with open(ev_file) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        (qp,) = [r for r in recs if r["kind"] == "query_phases"]
+        assert qp["ok"] is True
+        assert qp["attempts"] >= 2, "the injected fault never retried"
+        assert qp["query"] is not None
+        # the ledger joins the FINAL attempt's begin/end records on the
+        # events-plane id — the lifecycle ctx_id drifts from it as soon
+        # as a query retries (one events id per attempt)
+        ends = [r for r in recs if r["kind"] == "query_end"]
+        assert qp["query"] == ends[-1]["query"]
+        assert set(qp["phases"]) == set(PHASES)
+        assert sum(qp["phases"].values()) == qp["wall_ns"]
+        assert qp["phases"]["retry-backoff"] > 0
+    finally:
+        reset_buffer_catalog()
+        reset_memory_budget()
+
+
+def test_phases_disabled_is_one_pointer_check_and_byte_identical(tmp_path):
+    """Explicitly false: no ledger rides the query (profile.phases()
+    is None), the history store stays a single None pointer check, and
+    results are identical to the enabled run."""
+    lp, op = _storm_parquet(tmp_path)
+    on = TpuSession({"spark.rapids.tpu.phases.enabled": "true",
+                     "spark.rapids.sql.broadcastSizeThreshold": "-1"})
+    rows_on = _storm_query(on, lp, op).collect()
+    assert on.last_query_profile().phases() is not None
+
+    off = TpuSession({"spark.rapids.tpu.phases.enabled": "false",
+                      "spark.rapids.sql.broadcastSizeThreshold": "-1"})
+    assert history.active_store() is None  # history off = one pointer
+    rows_off = _storm_query(off, lp, op).collect()
+    assert rows_off == rows_on
+    prof = off.last_query_profile()
+    assert prof.phases() is None
+    assert prof.phases_wall_ns() is None
+    assert "phases" not in prof.to_dict()
+    # the process-cumulative counters stay live either way (bench lane)
+    assert isinstance(phase.counters(), dict)

@@ -708,3 +708,111 @@ def test_storm_hbm_attribution_reconciles(storm_files):
     finally:
         reset_buffer_catalog()
         reset_memory_budget()
+
+
+# ---------------------------------------------------------------------------
+# ICI lane counters + SLO latency ring (ISSUE 17 satellite)
+# ---------------------------------------------------------------------------
+
+def test_ici_counters_sampled_and_exported(capsys):
+    """The ICI shuffle lane's cumulative counters ride every telemetry
+    sample and round-trip through the Prometheus exporter as
+    spark_rapids_tpu_ici_* gauges."""
+    import telemetry_export
+    telemetry.enable(interval_ms=100000)
+    sample = telemetry.collect_sample()
+    for key in ("ici.rounds", "ici.bytes", "ici.fallbacks"):
+        assert key in sample and isinstance(sample[key], int)
+    # every documented series is sampled, and vice versa (no series can
+    # silently fall out of the export again, the way ici.* did)
+    numeric = {k for k, v in sample.items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)
+               and k not in ("ts_ms", "ts_ns")}
+    assert numeric == set(telemetry.SERIES)
+    text = telemetry_export.to_prometheus(
+        dict(sample, kind="telemetry_sample", ts_ms=1700000002000))
+    for key in numeric:   # ...and every one round-trips as a gauge
+        m = telemetry_export._metric(key)
+        assert f"# TYPE {m} gauge" in text
+        assert f"{m} {sample[key]} 1700000002000" in text
+    assert "spark_rapids_tpu_ici_rounds" in text
+
+
+def test_slo_latency_ring_percentiles():
+    """note_query_latency feeds per-priority-class nearest-rank
+    percentiles; health()['slo'] carries them; disabled telemetry is
+    one pointer check ({'enabled': False})."""
+    telemetry.reset_telemetry()
+    assert telemetry.slo_section() == {"enabled": False}
+    telemetry.note_query_latency("interactive", 123)  # no-op when off
+
+    reg = telemetry.enable(interval_ms=100000)
+    assert telemetry.slo_section()["classes"] == {}  # nothing finished
+    for i in range(1, 101):
+        telemetry.note_query_latency("interactive", i * 1000)
+    telemetry.note_query_latency("batch", 7_000_000)
+    snap = reg.slo_snapshot()
+    inter = snap["interactive"]
+    assert inter["p50_ns"] == 50_000     # nearest-rank over 1k..100k
+    assert inter["p95_ns"] == 95_000
+    assert inter["p99_ns"] == 99_000
+    assert inter["window"] == 100 and inter["queries"] == 100
+    assert snap["batch"]["p50_ns"] == 7_000_000
+    assert snap["batch"]["window"] == 1
+
+    sess = TpuSession()
+    slo = sess.health()["slo"]
+    assert slo["enabled"] is True
+    assert slo["classes"]["interactive"]["p95_ns"] == 95_000
+
+
+def test_slo_ring_fed_only_by_completed_queries(tmp_path):
+    """End-to-end: a successful governed collect lands in the ring
+    under its priority class; a failed one does not (it would drag the
+    percentiles toward shed-fast microseconds)."""
+    from spark_rapids_tpu import faults
+    telemetry.enable(interval_ms=100000)
+    sess = TpuSession({"spark.rapids.tpu.task.maxAttempts": "1"})
+    df = sess.from_pydict(
+        {"k": [1, 2, 3, 4], "v": [1.0, 2.0, 3.0, 4.0]},
+        Schema.of(k=LONG, v=DOUBLE))
+    df.filter(col("v") > lit(0.5)).collect()
+    snap = telemetry.active_registry().slo_snapshot()
+    assert snap["interactive"]["queries"] == 1
+    assert snap["interactive"]["p50_ns"] > 0
+    try:
+        faults.install("device.dispatch:prob=1,seed=2,kind=device,max=9")
+        with pytest.raises(Exception):
+            df.filter(col("v") > lit(0.5)).select(col("k")).collect()
+    finally:
+        faults.install(None)
+    snap = telemetry.active_registry().slo_snapshot()
+    assert snap["interactive"]["queries"] == 1, \
+        "a failed query leaked into the SLO ring"
+
+
+def test_bench_phases_block_and_history_env(tmp_path, monkeypatch):
+    """bench records carry process-cumulative phase deltas, and
+    SPARK_RAPIDS_TPU_HISTORY_DIR arms the capsule store for a bench
+    run (the two-dirs --diff workflow)."""
+    import bench
+    from spark_rapids_tpu.obs import history, phase
+    phase.reset_phase_counters()
+    bench._attr_prev.pop("phases", None)
+    base = bench.phases_attribution()
+    assert set(base) == set(phase.ACCRUABLE) and not any(base.values())
+    phase.add("compile", 1000)
+    phase.add("shuffle-io", 250)
+    delta = bench.phases_attribution()
+    assert delta["compile"] == 1000 and delta["shuffle-io"] == 250
+    assert not any(bench.phases_attribution().values())  # consumed
+
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_HISTORY_DIR", str(tmp_path))
+    try:
+        bench.maybe_enable_history()
+        store = history.active_store()
+        assert store is not None
+        store.append({"i": 1})
+        assert store.records == 1
+    finally:
+        history.reset_history()

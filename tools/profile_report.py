@@ -218,9 +218,23 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
     ici_ok = [e for e in ici if not e.get("fallback")]
     waits = [e.get("wait_ms") or 0 for e in events
              if e.get("kind") == "query_admitted"]
+    qphases = [e for e in events if e.get("kind") == "query_phases"]
+    phase_ns: Dict[str, int] = {}
+    for e in qphases:
+        for p, v in (e.get("phases") or {}).items():
+            phase_ns[p] = phase_ns.get(p, 0) + (v or 0)
 
     summary: Dict[str, Any] = {
         "events": len(events),
+        # wall-clock phase attribution roll-up (ISSUE 17): one
+        # query_phases record per governed query, each a closed ledger
+        # (sum(phases) == wall_ns) — summed here so a whole log answers
+        # "where did the wall-clock go" in one table. Zero-tolerant:
+        # pre-phase logs report zeros and print nothing.
+        "phases": {
+            "queries": len(qphases),
+            "wall_ns": sum(e.get("wall_ns") or 0 for e in qphases),
+            "by_phase": phase_ns},
         "queries": sorted({e.get("query") for e in events
                            if e.get("query") is not None}),
         "completed": count("query_end"),
@@ -376,6 +390,21 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
                 f"{_fmt_ns(r['wall_ns']):>10} {r['pct_root']:>5.1f}% "
                 f"{r['rows']:>12} {r['batches']:>8} "
                 f"{_fmt_bytes(r['bytes']):>10}")
+
+    # phase attribution (ISSUE 17): the summed closed ledgers — every
+    # governed query's wall partitioned, shares of the summed wall
+    ph = s["phases"]
+    if ph["queries"]:
+        lines.append("")
+        lines.append(f"wall-clock phases ({ph['queries']} governed "
+                     f"quer{'y' if ph['queries'] == 1 else 'ies'}, "
+                     f"{_fmt_ns(ph['wall_ns'])} total):")
+        wall = ph["wall_ns"] or 1
+        for p, v in sorted(ph["by_phase"].items(),
+                           key=lambda kv: -kv[1]):
+            if v:
+                lines.append(f"    {p:<20} {_fmt_ns(v):>10} "
+                             f"{100.0 * v / wall:>5.1f}%")
 
     extras = []
     if s["spills"]["count"]:

@@ -1,0 +1,133 @@
+"""Render a pytest `--durations` report into the tier-1 time-budget
+table (ISSUE 17 satellite): the suite runs under a hard `timeout 870`
+gate (ROADMAP.md), so every second a test spends is budget another test
+cannot — this tool shows where the seconds go and how much headroom the
+gate has left.
+
+Usage:
+    python -m pytest tests/ -q -m 'not slow' --durations=0 | tee run.log
+    python tools/suite_budget.py run.log [--budget 870] [--top 20]
+                                 [--format text|json]
+
+Parses the `== slowest durations ==` section (call/setup/teardown
+rows), aggregates per test and per file, and prints the top-N table
+with each entry's share of the gate. Exits 1 when the measured total
+exceeds `--warn-fraction` (default 0.8) of the budget — the early
+warning that the next added test pushes tier-1 over the timeout.
+
+Stdlib only; importable (`parse_durations`, `build_budget`) for tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+#: the tier-1 wall-clock gate (ROADMAP.md verify recipe: `timeout 870`)
+DEFAULT_BUDGET_S = 870.0
+
+#: `0.12s call tests/test_x.py::TestC::test_y[param]`
+_ROW = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)\s*$")
+
+
+def parse_durations(lines) -> List[Dict[str, Any]]:
+    """Every duration row in a pytest output: [{seconds, stage, test}].
+    Rows outside the durations section never match the shape, so the
+    whole log can be fed in unfiltered."""
+    out: List[Dict[str, Any]] = []
+    for ln in lines:
+        m = _ROW.match(ln)
+        if m:
+            out.append({"seconds": float(m.group(1)),
+                        "stage": m.group(2), "test": m.group(3)})
+    return out
+
+
+def build_budget(rows: List[Dict[str, Any]], budget_s: float,
+                 top: int = 20) -> Dict[str, Any]:
+    """The budget report as one JSON-able object: per-test totals
+    (call+setup+teardown merged), per-file totals, and the gate
+    arithmetic. NOTE: pytest truncates sub-threshold rows unless
+    `--durations=0`; `measured_s` is a floor, not the suite wall."""
+    by_test: Dict[str, float] = {}
+    by_file: Dict[str, float] = {}
+    for r in rows:
+        by_test[r["test"]] = by_test.get(r["test"], 0.0) + r["seconds"]
+        fname = r["test"].split("::", 1)[0]
+        by_file[fname] = by_file.get(fname, 0.0) + r["seconds"]
+    measured = sum(by_test.values())
+    tests = sorted(by_test.items(), key=lambda kv: -kv[1])
+    files = sorted(by_file.items(), key=lambda kv: -kv[1])
+    return {
+        "budget_s": budget_s,
+        "measured_s": round(measured, 2),
+        "budget_share": round(measured / budget_s, 3) if budget_s else 0,
+        "headroom_s": round(budget_s - measured, 2),
+        "rows": len(rows),
+        "top_tests": [{"test": t, "seconds": round(s, 2),
+                       "share": round(s / budget_s, 4) if budget_s else 0}
+                      for t, s in tests[:top]],
+        "top_files": [{"file": f, "seconds": round(s, 2),
+                       "share": round(s / budget_s, 4) if budget_s else 0}
+                      for f, s in files[:top]],
+    }
+
+
+def render_text(b: Dict[str, Any]) -> str:
+    lines = [
+        f"tier-1 time budget: {b['measured_s']:.1f}s measured of "
+        f"{b['budget_s']:.0f}s gate "
+        f"({b['budget_share']:.0%} used, {b['headroom_s']:.1f}s "
+        f"headroom)",
+        "",
+        "== top tests ==",
+        f"{'seconds':>8} {'share':>6}  test",
+    ]
+    for r in b["top_tests"]:
+        lines.append(f"{r['seconds']:>7.2f}s {r['share']:>6.1%}  "
+                     f"{r['test']}")
+    lines.append("")
+    lines.append("== top files ==")
+    lines.append(f"{'seconds':>8} {'share':>6}  file")
+    for r in b["top_files"]:
+        lines.append(f"{r['seconds']:>7.2f}s {r['share']:>6.1%}  "
+                     f"{r['file']}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("log", help="pytest output containing a "
+                    "--durations section ('-' = stdin)")
+    ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S)
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--warn-fraction", type=float, default=0.8,
+                    help="exit 1 when measured time exceeds this "
+                    "fraction of the budget")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+    f = sys.stdin if args.log == "-" else open(args.log)
+    try:
+        rows = parse_durations(f)
+    finally:
+        if f is not sys.stdin:
+            f.close()
+    if not rows:
+        print("no duration rows found (run pytest with --durations=N)",
+              file=sys.stderr)
+        return 1
+    b = build_budget(rows, args.budget, top=args.top)
+    if args.format == "json":
+        json.dump(b, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_text(b))
+    return 1 if b["measured_s"] > args.warn_fraction * args.budget else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
