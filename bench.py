@@ -490,6 +490,20 @@ def upload_attribution():
     return _delta_since("upload", upload_engine.counters())
 
 
+def encoded_attribution():
+    """{"encoded": ...} block for each BENCH record (ISSUE 18):
+    dictionary-encoded lane activity — columns kept encoded at the
+    scan, code/dictionary byte split, eager-decode bytes avoided,
+    late materializations (and their bytes), code-space predicates
+    and dictionary hash tables served (columnar/encoded.py counters,
+    as deltas since the previous record). All zeros with
+    scan.encoded.enabled=false — a TPU round reads
+    decoded_bytes_avoided next to the upload block to see the H2D
+    shrink the encoded lane bought."""
+    from spark_rapids_tpu.columnar import encoded as encoded_engine
+    return _delta_since("encoded", encoded_engine.counters())
+
+
 def dispatch_attribution():
     """{"dispatch": ...} block for each BENCH record (ISSUE 13):
     compiled programs, program dispatches, fresh traces vs jit cache
@@ -788,6 +802,7 @@ def main():
         "shuffle": shuffle_attribution(),
         "ici": ici_attribution(),
         "upload": upload_attribution(),
+        "encoded": encoded_attribution(),
         "dispatch": dispatch_attribution(),
         "stage": stage_attribution(),
         "telemetry": telemetry_attribution(),
@@ -966,6 +981,7 @@ def q3_bench():
         "shuffle": shuffle_attribution(),
         "ici": ici_attribution(),
         "upload": upload_attribution(),
+        "encoded": encoded_attribution(),
         "dispatch": dispatch_attribution(),
         "stage": stage_attribution(),
         "telemetry": telemetry_attribution(),

@@ -100,6 +100,10 @@ class ColumnarBatch:
                     col = col.with_capacity(cap)
                 cols.append(col)
                 fields.append(StructField(name, col.dtype))
+        # ISSUE 18: account encoded vs decoded scan lanes (encoded_scan
+        # event + advisor evidence) while buffers are still host numpy
+        from .encoded import note_scan_batch
+        note_scan_batch(cols)
         return to_device_batch(cols, n, Schema(tuple(fields)),
                                fault_key=fault_key, seam="scan")
 

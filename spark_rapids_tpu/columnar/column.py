@@ -647,6 +647,20 @@ def column_from_arrow(arr, dtype: Optional[DataType] = None) -> Column:
 
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
+    if pa.types.is_dictionary(arr.type):
+        # ISSUE 18: keep Parquet dictionary columns encoded (a
+        # DictionaryColumn code lane + payload) instead of eagerly
+        # decoding to full width; conf off or unencodable shapes
+        # (non-string values, nulls in the dictionary) decode eagerly.
+        from ..config import SCAN_ENCODED, active_conf
+        if active_conf().get(SCAN_ENCODED):
+            from .encoded import dictionary_from_arrow
+            dt = dtype or from_arrow(arr.type.value_type)
+            if isinstance(dt, (StringType, BinaryType)):
+                enc = dictionary_from_arrow(arr, dt)
+                if enc is not None:
+                    return enc
+        arr = arr.dictionary_decode()
     dt = dtype or from_arrow(arr.type)
     n = len(arr)
     if isinstance(dt, (StringType, BinaryType)):

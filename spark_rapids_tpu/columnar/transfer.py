@@ -227,6 +227,11 @@ def fetch_batch_host(batch) -> Tuple[List[Column], int]:
     Returns (numpy-backed columns, host row count). Already-host batches
     (numpy leaves) pass through untouched.
     """
+    # late-materialization output seam (ISSUE 18): a batch fetched for
+    # host consumption genuinely needs full values — decode encoded
+    # columns through the gather engine before the packed d2h
+    from .encoded import materialize_batch
+    batch = materialize_batch(batch, seam="output")
     leaves = jax.tree_util.tree_leaves(batch.columns)
     if batch._host_rows is not None and all(
             isinstance(x, np.ndarray) for x in leaves):

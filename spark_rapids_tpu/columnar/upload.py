@@ -57,6 +57,7 @@ import numpy as np
 
 from .column import (ArrayColumn, Column, Decimal128Column, MapColumn,
                      StringColumn, StructColumn)
+from .encoded import DictionaryColumn
 from . import transfer as _transfer
 
 __all__ = [
@@ -332,6 +333,9 @@ def _put_aliased(dev, buf: np.ndarray) -> bool:
 # ---------------------------------------------------------------------------
 
 def _col_spec(col: Column):
+    if isinstance(col, DictionaryColumn):
+        return ("dict", col.dtype, col.capacity, col.dict_capacity,
+                col.dict_byte_capacity)
     if isinstance(col, StringColumn):
         return ("str", col.dtype, col.capacity, col.byte_capacity)
     if isinstance(col, Decimal128Column):
@@ -350,6 +354,10 @@ def _col_spec(col: Column):
 
 def _spec_nbytes(spec) -> int:
     kind = spec[0]
+    if kind == "dict":
+        _, _dt, cap, dict_cap, dict_byte_cap = spec
+        # codes + validity + dictionary (offsets, bytes)
+        return cap * 4 + cap + (dict_cap + 1) * 4 + dict_byte_cap
     if kind == "str":
         _, _dt, cap, byte_cap = spec
         return (cap + 1) * 4 + byte_cap + cap
@@ -371,6 +379,10 @@ def _packable_leaf(a) -> bool:
 def _packable_column(col) -> bool:
     """True when the packer knows this column's class and every buffer
     is host-resident — anything else keeps the per-buffer lane."""
+    if isinstance(col, DictionaryColumn):
+        return _packable_leaf(col.codes) and _packable_leaf(col.validity) \
+            and _packable_leaf(col.dict_offsets) \
+            and _packable_leaf(col.dict_data)
     if isinstance(col, StringColumn):
         return _packable_leaf(col.data) and _packable_leaf(col.offsets) \
             and _packable_leaf(col.validity)
@@ -418,6 +430,11 @@ def _put_block(buf: np.ndarray, pos: int, block: np.ndarray) -> int:
 
 def _pack_host_column(col: Column, buf: np.ndarray, pos: int,
                       dd: bool) -> int:
+    if isinstance(col, DictionaryColumn):
+        pos = _put_block(buf, pos, _host_bytes(col.codes, dd))
+        pos = _put_block(buf, pos, _host_bytes(col.dict_offsets, dd))
+        pos = _put_block(buf, pos, _host_bytes(col.dict_data, dd))
+        return _put_block(buf, pos, _host_bytes(col.validity, dd))
     if isinstance(col, StringColumn):
         pos = _put_block(buf, pos, _host_bytes(col.offsets, dd))
         pos = _put_block(buf, pos, _host_bytes(col.data, dd))
@@ -494,6 +511,19 @@ def _dev_cast(raw, np_dtype: np.dtype, count: int, dd: bool):
 
 def _unpack_dev_column(spec, buf, pos: int, dd: bool):
     kind = spec[0]
+    if kind == "dict":
+        _, dt, cap, dict_cap, dict_byte_cap = spec
+        codes = _dev_cast(buf[pos: pos + cap * 4], np.dtype(np.int32),
+                          cap, dd)
+        pos += cap * 4
+        off = _dev_cast(buf[pos: pos + (dict_cap + 1) * 4],
+                        np.dtype(np.int32), dict_cap + 1, dd)
+        pos += (dict_cap + 1) * 4
+        data = buf[pos: pos + dict_byte_cap]
+        pos += dict_byte_cap
+        v = buf[pos: pos + cap].astype(jnp.bool_)
+        pos += cap
+        return DictionaryColumn(codes, data, off, v, dt), pos
     if kind == "str":
         _, dt, cap, byte_cap = spec
         off = _dev_cast(buf[pos: pos + (cap + 1) * 4], np.dtype(np.int32),

@@ -330,6 +330,23 @@ PARQUET_PUSHDOWN_ENABLED = conf_bool(
     "for footer min/max row-group pruning (reference "
     "GpuParquetScan predicate pushdown).")
 
+SCAN_ENCODED = conf_bool(
+    "spark.rapids.tpu.scan.encoded.enabled", True,
+    "Dictionary-encoded execution (columnar/encoded.py, ISSUE 18): the "
+    "parquet scan requests Arrow dictionary arrays for string columns "
+    "and keeps them encoded as a DictionaryColumn — a device-resident "
+    "i32 code lane plus the per-batch dictionary payload — instead of "
+    "eagerly decoding to full-width strings at scan time. Codes + "
+    "dictionary ride the packed H2D upload and spill/unspill as-is "
+    "(typically a >=2x byte shrink on string-heavy scans), equality / "
+    "IN / null predicates compare i32 codes on device, and hash joins "
+    "hash the dictionary once then gather precomputed hashes by code. "
+    "Operators that cannot consume encoded input trigger a "
+    "materialize-at-boundary decode through the gather engine, so "
+    "results are byte-identical with the lane on or off. Off restores "
+    "eager decode at StringColumn.from_arrow.",
+    commonly_used=True)
+
 MULTITHREADED_READ_NUM_THREADS = conf_int(
     "spark.rapids.sql.multiThreadedRead.numThreads", 8,
     "Threads for the cloud multi-file readers (reference "

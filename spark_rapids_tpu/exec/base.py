@@ -178,6 +178,23 @@ DISPATCH_METRICS = ((NUM_DISPATCHES, MODERATE), (COMPILE_TIME, MODERATE))
 class TpuExec:
     """Base columnar operator."""
 
+    #: ISSUE 18 (encoded execution): True when this exec's kernels accept
+    #: DictionaryColumn inputs from its children (code-space predicates,
+    #: encoded-key joins, pass-through projections). Execs override it —
+    #: usually with an eligibility walk over their bound expressions
+    #: (expr/predicates.encoded_safe_predicate) — and the default False
+    #: guarantees an operator never silently misreads the encoded layout:
+    #: its children materialize at the batch boundary instead.
+    consumes_encoded: bool = False
+
+    #: stamped by the PARENT's execute() before this exec's first batch is
+    #: pulled (child iterators start lazily): whether encoded columns may
+    #: cross this exec's output boundary. The root of a plan is never
+    #: stamped, so root output always materializes (the late-
+    #: materialization seam — results are byte-identical with the lane
+    #: off).
+    _encoded_ok_for_parent: bool = False
+
     def __init__(self, *children: "TpuExec"):
         self.children: List[TpuExec] = list(children)
         self._op_id = next(_OP_IDS)
@@ -357,6 +374,13 @@ class TpuExec:
             dump_enabled = bool(active_conf().get(DEBUG_DUMP_PATH))
         except Exception:  # noqa: BLE001 — conf unavailable early
             dump_enabled = False
+        # encoded-execution stamping (ISSUE 18): children learn whether
+        # THIS exec's kernels can consume their encoded columns before
+        # their first batch is pulled (internal_execute below builds the
+        # child iterators lazily); an unstamped/False child materializes
+        # at its own yield boundary in _drive
+        for c in self.children:
+            c._encoded_ok_for_parent = self.consumes_encoded
         it = self.internal_execute()
         bus = obs_events.active_bus()
         # lifecycle governor (ISSUE 6): the ONE batch-boundary
@@ -385,8 +409,15 @@ class TpuExec:
                 close()
 
     def _drive(self, it, bus, qctx, name, rows, batches, dump_enabled):
+        from ..columnar.encoded import materialize_batch
         from ..obs import events as obs_events
         from ..utils.tracing import annotate_op
+        # late materialization (ISSUE 18): when the parent's kernels
+        # cannot consume encoded columns, decode them HERE — once, at the
+        # batch boundary, through the gather engine — instead of letting
+        # them reach code that would misread the layout. Identity (one
+        # isinstance scan) for batches with no encoded columns.
+        decode = not self._encoded_ok_for_parent
         if bus is None:
             # fast path: bit-identical to the pre-obs loop
             while True:
@@ -400,6 +431,8 @@ class TpuExec:
                     except Exception:
                         self._dump_failure_inputs(name)
                         raise
+                    if decode:
+                        batch = materialize_batch(batch, seam="boundary")
                 batches.add(1)
                 if batch._host_rows is not None:
                     rows.add(batch._host_rows)
@@ -446,6 +479,8 @@ class TpuExec:
                         self._dump_failure_inputs(name)
                         bus.emit("op_error", op=name, op_id=self._op_id)
                         raise
+                    if decode:
+                        batch = materialize_batch(batch, seam="boundary")
                 step_ns = time.perf_counter_ns() - t0
                 total_ns += step_ns
                 nbatches += 1
@@ -522,6 +557,12 @@ class TpuExec:
         flag costs one extra host read here, and a trip re-runs the plan
         with every operator on its exact tier."""
         from .speculation import force_exact, speculation_scope
+
+        # late materialization (ISSUE 18): collect consumes root batches
+        # through to_pylist -> fetch_batch_host, which decodes encoded
+        # columns at the "output" seam — let them flow there instead of
+        # double-decoding at the root's own _drive boundary
+        self._encoded_ok_for_parent = True
 
         def run() -> List[tuple]:
             out: List[tuple] = []

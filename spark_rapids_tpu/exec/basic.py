@@ -212,6 +212,14 @@ class ProjectExec(TpuExec):
         return tuple(e.semantic_key() for e in self._bound)
 
     @property
+    def consumes_encoded(self) -> bool:
+        # encoded input is fine when every projection either passes the
+        # column through untouched or never touches a string reference
+        # outside a code-space position (ISSUE 18)
+        from ..expr.predicates import encoded_safe_projection
+        return all(encoded_safe_projection(e) for e in self._bound)
+
+    @property
     def output_grouped_by(self):
         """Projection preserves row order: the child's grouping contract
         carries through for columns projected as bare references."""
@@ -297,6 +305,14 @@ class FilterExec(TpuExec):
             return None  # see ProjectExec._fingerprint_extras
         return (self._bound.semantic_key(),)
 
+    @property
+    def consumes_encoded(self) -> bool:
+        # equality / IN / null predicates evaluate in code space
+        # (expr/predicates.EqualTo code-space lane); the compaction
+        # gather handles DictionaryColumn natively (ops/basic.py)
+        from ..expr.predicates import encoded_safe_predicate
+        return encoded_safe_predicate(self._bound)
+
     def _kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
         pred = self._bound.columnar_eval(batch)
         # Spark: null predicate rows are dropped
@@ -381,6 +397,9 @@ class RangeExec(TpuExec):
 class UnionExec(TpuExec):
     """GpuUnionExec: concatenation of children outputs (schemas align)."""
 
+    #: batches pass through untouched (ISSUE 18)
+    consumes_encoded = True
+
     def __init__(self, *children: TpuExec):
         super().__init__(*children)
 
@@ -401,6 +420,9 @@ class UnionExec(TpuExec):
 
 class LocalLimitExec(TpuExec):
     """GpuLocalLimitExec (limit.scala:168): per-partition row cap."""
+
+    #: row slicing routes through the dict-aware gather (ISSUE 18)
+    consumes_encoded = True
 
     def __init__(self, limit: int, child: TpuExec):
         super().__init__(child)
@@ -515,6 +537,9 @@ class SampleExec(TpuExec):
     `fraction`, decided by the threefry counter RNG on device — fold_in
     of the batch index keeps every batch's draw independent AND the whole
     sample reproducible for a given seed."""
+
+    #: compaction routes through the dict-aware gather (ISSUE 18)
+    consumes_encoded = True
 
     def __init__(self, fraction: float, seed: int, child: TpuExec):
         super().__init__(child)

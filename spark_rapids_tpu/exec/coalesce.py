@@ -72,6 +72,12 @@ class CoalesceBatchesExec(TpuExec):
         super().__init__(child)
         self.target_bytes = target_bytes or active_conf().batch_size_bytes
 
+    #: dictionary-encoded batches flow through untouched on the
+    #: single-batch path; a real multi-batch concat materializes first
+    #: inside flush() — per-batch dictionaries differ, and
+    #: concat_columns requires one shared payload
+    consumes_encoded = True
+
     @property
     def output_schema(self) -> Schema:
         return self.child.output_schema
@@ -115,6 +121,11 @@ class CoalesceBatchesExec(TpuExec):
                 def do(items):
                     batches = [s.get_batch() for s in items]
                     try:
+                        if len(batches) > 1:
+                            from ..columnar.encoded import \
+                                materialize_batch
+                            batches = [materialize_batch(b, seam="concat")
+                                       for b in batches]
                         return concat_batches(batches, self.output_schema)
                     finally:
                         for s in items:

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import Column, StringColumn, bucket_capacity
+from ..columnar.encoded import DictionaryColumn
 from ..expr.core import Expression, resolve
 from ..memory.spillable import SpillableBatch
 from ..ops.basic import active_mask, compaction_order, gather_column
@@ -186,6 +187,36 @@ class HashJoinExec(TpuExec):
                                      label="HashJoinExec.probe",
                                      static_argnums=(5, 6, 7, 8))
 
+    @property
+    def consumes_encoded(self) -> bool:
+        """Encoded inputs are fine when every key is a bare reference
+        (the probe byte-compares through the dictionary spans and the
+        bucket hash precomputes the dictionary's hashes once — ISSUE
+        18) or string-reference-free, and the absorbed filters plus the
+        residual condition pass the code-space walk."""
+        from ..expr.predicates import (encoded_safe_predicate,
+                                       encoded_safe_projection)
+        try:
+            lb = [resolve(e, self.left_schema) for e in self.left_keys]
+            rb = [resolve(e, self.right_schema) for e in self.right_keys]
+        except Exception:  # noqa: BLE001 — unresolvable = conservative
+            return False
+        if not all(encoded_safe_projection(e) for e in lb + rb):
+            return False
+        for preds in (self._stream_filter, self._build_filter):
+            if preds and not all(encoded_safe_predicate(p) for p in preds):
+                return False
+        if self.condition is not None:
+            pair = Schema(tuple(self.left_schema.fields)
+                          + tuple(self.right_schema.fields))
+            try:
+                cond = resolve(self.condition, pair)
+            except Exception:  # noqa: BLE001
+                return False
+            if not encoded_safe_predicate(cond):
+                return False
+        return True
+
     def _fingerprint_extras(self):
         # semantic_key, NOT repr (repr omits non-child expression
         # parameters — the program-cache soundness contract).
@@ -286,7 +317,10 @@ class HashJoinExec(TpuExec):
         out = []
         for c in key_cols:
             v = c.validity & keep
-            if isinstance(c, StringColumn):
+            if isinstance(c, DictionaryColumn):
+                out.append(DictionaryColumn(c.codes, c.dict_data,
+                                            c.dict_offsets, v, c.dtype))
+            elif isinstance(c, StringColumn):
                 out.append(StringColumn(c.data, c.offsets, v, c.dtype))
             elif isinstance(c, StructColumn):
                 out.append(type(c)(c.children, v, c.dtype))
@@ -323,6 +357,14 @@ class HashJoinExec(TpuExec):
             else self.children[0]
         with self.metrics[BUILD_TIME].ns_timer():
             batches = list(build_child.execute())
+            if len(batches) > 1:
+                # distinct per-batch dictionaries cannot concatenate
+                # shape-stably (ops/basic.concat_columns asserts) —
+                # decode first; a single-batch build side (the common
+                # broadcast shape) stays encoded end-to-end
+                from ..columnar.encoded import materialize_batch
+                batches = [materialize_batch(b, seam="concat")
+                           for b in batches]
             if batches:
                 batch = concat_batches(batches, build_child.output_schema)
             else:
@@ -477,6 +519,17 @@ class HashJoinExec(TpuExec):
                     continue
                 bk = build.key_cols[ki]
                 sk = skey_cols[ki]
+                if isinstance(bk, DictionaryColumn) or \
+                        isinstance(sk, DictionaryColumn):
+                    # encoded key (ISSUE 18): byte-compare through
+                    # spans into the ORIGINAL buffers — no decode, and
+                    # no materialized candidate gather (whose byte
+                    # bucket a join fan-out overflows)
+                    from ..columnar.encoded import bytes_equal_at
+                    ok = ok & bytes_equal_at(
+                        bk, b_row, sk,
+                        jnp.where(pair_valid, s_idx, -1))
+                    continue
                 b = gather_column(bk, b_row)
                 s = gather_column(sk, jnp.where(pair_valid, s_idx, -1))
                 if isinstance(bk, StringColumn):

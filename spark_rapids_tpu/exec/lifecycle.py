@@ -427,6 +427,10 @@ FAMILY_DOMAINS: Dict[str, str] = {
     # the ICI lane degrades as a whole (to the host serialize path),
     # not kernel-by-kernel: its bench family maps onto its own domain
     "ici_all_to_all": "ici_exchange",
+    # the encoded lane's code-indexed take (columnar/encoded.dict_take)
+    # is a row gather over the per-dictionary lookup table — it rides
+    # the same Pallas DMA kernel and degrades with the same breaker
+    "dict_gather": "pallas_gather",
 }
 
 BREAKER_STATES = ("closed", "open", "half_open")

@@ -179,7 +179,7 @@ def _digest_entries():
             C.PALLAS_FUSED_TIER, C.PALLAS_FUSED_BENCH_FILE,
             C.IMPROVED_FLOAT_OPS, C.STABLE_SORT, C.SORT_OOC_ENABLED,
             C.DECIMAL_ENABLED, C.SHUFFLE_DEVICE_PARTITION,
-            C.UPLOAD_PACKED, C.BATCH_SIZE_BYTES)
+            C.UPLOAD_PACKED, C.BATCH_SIZE_BYTES, C.SCAN_ENCODED)
 
 
 def trace_conf_digest(conf=None) -> Optional[Tuple]:
@@ -351,6 +351,23 @@ class CompiledStageExec(TpuExec):
         # contract (e.g. the inner join's key-grouped emission feeding
         # a downstream group-by) reads straight through
         return self._terminal.output_grouped_by
+
+    @property
+    def consumes_encoded(self) -> bool:
+        # a map stage can run on dictionary-encoded inputs (ISSUE 18)
+        # exactly when every absorbed operator could individually —
+        # the fused body runs the same columnar_eval/compaction those
+        # operators would. agg/join_agg stages fold values into
+        # aggregate state, so they need materialized inputs. No
+        # encoded-ness entry is folded into the plan fingerprint:
+        # DictionaryColumn and its decoded form are DIFFERENT pytree
+        # structures, so jit keys the compiled program on the actual
+        # input encoding already — the SCAN_ENCODED conf digest entry
+        # only separates plans whose EXECS were built under different
+        # gate values.
+        if self._kind != "map":
+            return False
+        return all(op.consumes_encoded for op in self._absorbed)
 
     def _fingerprint_extras(self):
         term_fp = self._terminal.plan_fingerprint()

@@ -50,6 +50,20 @@ class BinaryComparison(Expression):
     def columnar_eval(self, batch) -> Column:
         l = self.left.columnar_eval(batch)
         r = self.right.columnar_eval(batch)
+        return self._compare_cols(l, r)
+
+    def _compare_cols(self, l: Column, r: Column) -> Column:
+        from ..columnar.encoded import DictionaryColumn
+        if isinstance(l, DictionaryColumn) or isinstance(r, DictionaryColumn):
+            # only EqualTo-vs-literal has a code-space lane (handled in
+            # EqualTo.columnar_eval before evaluation reaches here);
+            # crash loudly instead of misreading the encoded layout —
+            # the exec-layer eligibility walk (encoded_safe_predicate)
+            # materializes upstream so this is unreachable in planned
+            # queries
+            raise TypeError(
+                "dictionary-encoded column reached a non-code-space "
+                "comparison — materialize first (columnar/encoded.py)")
         valid = l.validity & r.validity
         if isinstance(l, StringColumn) or isinstance(r, StringColumn):
             cmp = string_compare_cols(l, r)
@@ -79,6 +93,27 @@ class BinaryComparison(Expression):
 
 class EqualTo(BinaryComparison):
     symbol = "="
+
+    def columnar_eval(self, batch) -> Column:
+        """Code-space lane (ISSUE 18): `encoded_col == literal` compares
+        i32 dictionary codes on device — the literal is matched against
+        the dictionary ONCE (dict_capacity byte compares) and the row
+        answer is a code-indexed gather of the per-entry hit lane, never
+        a row-level decode. Everything else falls to the generic path."""
+        from ..columnar.encoded import DictionaryColumn, encoded_equal_literal
+        lit_l = isinstance(self.left, Literal)
+        lit_r = isinstance(self.right, Literal)
+        if lit_r and not lit_l:
+            l = self.left.columnar_eval(batch)
+            if isinstance(l, DictionaryColumn):
+                return encoded_equal_literal(l, self.right.value)
+            return self._compare_cols(l, self.right.columnar_eval(batch))
+        if lit_l and not lit_r:
+            r = self.right.columnar_eval(batch)
+            if isinstance(r, DictionaryColumn):
+                return encoded_equal_literal(r, self.left.value)
+            return self._compare_cols(self.left.columnar_eval(batch), r)
+        return super().columnar_eval(batch)
 
     def _op(self, l, r):
         return l == r
@@ -300,3 +335,72 @@ class In(Expression):
             hit = jnp.zeros((cap,), jnp.bool_)
         valid = v.validity & (hit | ~jnp.asarray(has_null))
         return Column(hit & valid, valid, BOOLEAN)
+
+
+# -- encoded-execution eligibility walk (ISSUE 18) --------------------------
+# Structural answer to "can this expression evaluate correctly when its
+# string-typed inputs arrive as DictionaryColumns?". The positions with a
+# code-space lane: equality/IN against a literal, null checks, bare
+# pass-through references, and And/Or/Not compositions of those. Everything
+# else must see full values, so the exec layer materializes its input
+# (columnar/encoded.materialize_batch) before evaluating. The walk is
+# intentionally conservative: an unrecognized node is safe only when no
+# string/binary-typed reference occurs anywhere below it.
+
+def _string_free_subtree(e: Expression) -> bool:
+    """True when no string/binary-typed column reference occurs in the
+    subtree — such an expression never receives an encoded column, so it
+    is trivially safe. Unresolved attributes (no type available) count as
+    potentially-string: conservative False."""
+    from ..types import BinaryType, StringType
+    from .core import BoundReference, UnresolvedAttribute
+    if isinstance(e, UnresolvedAttribute):
+        return False
+    if isinstance(e, BoundReference):
+        return not isinstance(e.data_type, (StringType, BinaryType))
+    return all(_string_free_subtree(c) for c in e.children)
+
+
+def _encoded_operand(e: Expression) -> bool:
+    """A position whose evaluation tolerates an encoded column directly
+    (bare reference) or never produces one (string-free subtree)."""
+    from .core import Alias, BoundReference, UnresolvedAttribute
+    if isinstance(e, Alias):
+        return _encoded_operand(e.children[0])
+    if isinstance(e, (BoundReference, UnresolvedAttribute)):
+        return True
+    return _string_free_subtree(e)
+
+
+def encoded_safe_predicate(e: Expression) -> bool:
+    """True when the predicate evaluates correctly over a batch whose
+    string columns are dictionary-encoded (code-space equality/IN/null
+    checks and their boolean compositions)."""
+    if isinstance(e, (And, Or)):
+        return all(encoded_safe_predicate(c) for c in e.children)
+    if isinstance(e, Not):
+        return encoded_safe_predicate(e.children[0])
+    if isinstance(e, (IsNull, IsNotNull)):
+        # validity-lane-only: works on any column class
+        return True
+    if isinstance(e, EqualTo):
+        l, r = e.children
+        if isinstance(r, Literal):
+            return _encoded_operand(l)
+        if isinstance(l, Literal):
+            return _encoded_operand(r)
+        return _string_free_subtree(e)
+    if isinstance(e, In):
+        return _encoded_operand(e.children[0])
+    return _string_free_subtree(e)
+
+
+def encoded_safe_projection(e: Expression) -> bool:
+    """True when a projection expression evaluates correctly over encoded
+    input: bare (aliased) pass-through references carry the encoded
+    column forward untouched; predicates reduce to the walk above;
+    anything else is safe only when string-reference-free."""
+    from .core import Alias
+    if isinstance(e, Alias):
+        return encoded_safe_projection(e.children[0])
+    return _encoded_operand(e) or encoded_safe_predicate(e)

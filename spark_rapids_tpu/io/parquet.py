@@ -123,6 +123,20 @@ class ParquetSource:
         an underestimate like Spark's file-size statistics)."""
         return sum(os.path.getsize(p) for p in self.paths)
 
+    def _read_dictionary(self) -> Optional[List[str]]:
+        """Columns pyarrow should hand back AS dictionary arrays instead
+        of casting the Parquet dictionary pages away (ISSUE 18): the
+        scanned string/binary columns, when the encoded-execution lane
+        is on. None keeps the plain decode."""
+        from ..config import SCAN_ENCODED, active_conf
+        from ..types import BinaryType, StringType
+        conf = self._conf if self._conf is not None else active_conf()
+        if not conf.get(SCAN_ENCODED):
+            return None
+        names = [f.name for f in self.schema.fields
+                 if isinstance(f.data_type, (StringType, BinaryType))]
+        return names or None
+
     def _group_pruned(self, md, rg: int, name_to_idx) -> bool:
         row_group = md.row_group(rg)
         for (name, op, value) in self.filters:
@@ -149,6 +163,7 @@ class ParquetSource:
                          self._conf.get(PARQUET_REBASE_MODE_READ).upper()
                          == "LEGACY")
         may_prune = bool(self.filters) and not legacy_rebase
+        read_dict = self._read_dictionary()
         for p in self.paths:
             pf = pq.ParquetFile(p)
             md = pf.metadata
@@ -162,12 +177,13 @@ class ParquetSource:
 
                 def decode(p=p, rg=rg):
                     # fresh handle per task: ParquetFile is not thread-safe
-                    return pq.ParquetFile(p).read_row_group(
+                    return pq.ParquetFile(
+                        p, read_dictionary=read_dict).read_row_group(
                         rg, columns=self.columns)
                 tasks.append(decode)
             if md.num_row_groups == 0:
-                tasks.append(lambda p=p: pq.read_table(p,
-                                                       columns=self.columns))
+                tasks.append(lambda p=p: pq.read_table(
+                    p, columns=self.columns, read_dictionary=read_dict))
         if self.reader_type == "COALESCING":
             out = self._coalescing_drive(tasks)
         else:

@@ -130,6 +130,13 @@ EVENT_LEVELS: Dict[str, int] = {
     # issued, and the donated carried-state bytes (the in-place HBM
     # reuse the donate_argnums contract buys on real hardware)
     "stage_fused": MODERATE,
+    # dictionary-encoded execution (ISSUE 18): one encoded_scan record
+    # per scan batch that kept columns encoded (code/dict byte split
+    # and the eager-decode bytes avoided), and one encoded_materialize
+    # per late decode through the gather engine with the seam that
+    # forced it (boundary | concat | output | spill)
+    "encoded_scan": MODERATE,
+    "encoded_materialize": MODERATE,
     "op_open": DEBUG,
     "op_batch": DEBUG,
     "span": DEBUG,

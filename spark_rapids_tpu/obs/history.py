@@ -13,7 +13,8 @@ query:
      "rows": ..., "batches": ...,              # essential metrics
      "skew": {...},                            # worst exchange skew
      "dispatch": {...}, "shuffle": {...},      # per-query counter deltas
-     "ici": {...}, "upload": {...}, "workload": {...}}
+     "ici": {...}, "upload": {...}, "workload": {...},
+     "encoded": {...}}
 
 The capsule joins across runs on `fingerprint`
 (exec/base.TpuExec.plan_fingerprint — canonical plan identity,
@@ -186,7 +187,7 @@ def process_counters() -> Dict[str, Dict[str, int]]:
     """One flat snapshot of every counter family the capsule diffs.
     Read only when a store is active (collect checks active_store()
     first), so disabled-mode collects never pay these imports."""
-    from ..columnar import upload
+    from ..columnar import encoded, upload
     from ..exec import workload
     from ..obs import dispatch as obs_dispatch
     from ..shuffle import manager as shuffle_manager
@@ -196,6 +197,7 @@ def process_counters() -> Dict[str, Dict[str, int]]:
         "upload": upload.counters(),
         "dispatch": obs_dispatch.counters(),
         "workload": workload.counters(),
+        "encoded": encoded.counters(),
     }
 
 

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..columnar.column import ArrayColumn, Column, StringColumn, StructColumn
+from ..columnar.encoded import NULL_CODE, DictionaryColumn
 from .strings import gather_string
 
 
@@ -32,6 +33,10 @@ def sanitize(col: Column, num_rows) -> Column:
     """Force the inactive tail to (zero, invalid) so padded slots never leak."""
     act = active_mask(num_rows, col.capacity)
     validity = col.validity & act
+    if isinstance(col, DictionaryColumn):
+        codes = jnp.where(act, col.codes, jnp.int32(NULL_CODE))
+        return DictionaryColumn(codes, col.dict_data, col.dict_offsets,
+                                validity, col.dtype)
     if isinstance(col, StringColumn):
         return StringColumn(col.data, col.offsets, validity, col.dtype)
     if isinstance(col, StructColumn):
@@ -66,6 +71,12 @@ def gather_column(col: Column, indices, out_valid=None,
     valid = col.validity[safe] & in_range
     if out_valid is not None:
         valid = valid & out_valid
+    if isinstance(col, DictionaryColumn):
+        # codes gather fixed-width-style; the dictionary payload rides
+        # along untouched (the whole point of staying encoded)
+        codes = jnp.where(valid, col.codes[safe], jnp.int32(NULL_CODE))
+        return DictionaryColumn(codes, col.dict_data, col.dict_offsets,
+                                valid, col.dtype)
     if isinstance(col, StringColumn):
         return gather_string(col, safe, valid, out_byte_capacity)
     if isinstance(col, StructColumn):
@@ -161,6 +172,22 @@ def concat_columns(a: Column, b: Column, a_rows, b_rows, out_capacity: int
     b_idx = idx - a_rows
     total = a_rows + b_rows
     out_valid = idx < total
+    if isinstance(a, DictionaryColumn):
+        # coalesce inputs are materialized at the operator boundary
+        # (exec/base.py), so this only fires for two views of the SAME
+        # dictionary (e.g. slices of one scan batch) — concat the code
+        # lanes fixed-width-style. Distinct dictionaries cannot be
+        # merged shape-stably here; crash loudly rather than misread.
+        assert isinstance(b, DictionaryColumn) \
+            and a.dict_data is b.dict_data \
+            and a.dict_offsets is b.dict_offsets, \
+            "concat of distinct dictionaries — materialize first"
+        codes = _concat_fixed(a.codes, b.codes, from_b, b_idx, idx)
+        codes = jnp.where(out_valid, codes, jnp.int32(NULL_CODE))
+        valid = _concat_fixed(a.validity, b.validity, from_b, b_idx, idx) \
+            & out_valid
+        return DictionaryColumn(codes, a.dict_data, a.dict_offsets,
+                                valid, a.dtype)
     if isinstance(a, StringColumn):
         from .strings import concat_string
         return concat_string(a, b, a_rows, b_rows, out_capacity)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar.column import Column, StringColumn, StructColumn
+from ..columnar.encoded import DictionaryColumn, row_byte_lanes
 from ..types import (
     BooleanType, ByteType, DateType, DecimalType, DoubleType, FloatType,
     IntegerType, LongType, ShortType, StringType, TimestampType,
@@ -108,14 +109,12 @@ def _normalize_float(data, dtype):
     return jnp.where(data == zero, zero, data)
 
 
-def murmur3_string(col: StringColumn, seed):
-    """Spark Murmur3_x86_32.hashUnsafeBytes: little-endian 4-byte words,
-    then trailing bytes one at a time (sign-extended)."""
-    lengths = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
-    starts = col.offsets[:-1]
-    byte_cap = col.byte_capacity
-    data = col.data
-
+def murmur3_bytes(lengths, starts, data, byte_cap, seed):
+    """Spark Murmur3_x86_32.hashUnsafeBytes over per-row (start, length)
+    byte spans of a flat buffer: little-endian 4-byte words, then
+    trailing bytes one at a time (sign-extended). The span form (ISSUE
+    18) lets dictionary columns hash through code-indirected starts
+    without materializing."""
     def word_at(t):
         # little-endian 4-byte word at starts + 4t per row
         base = starts + 4 * t
@@ -148,11 +147,25 @@ def murmur3_string(col: StringColumn, seed):
     return _fmix(h1, lengths.astype(jnp.uint32))
 
 
+def murmur3_string(col: StringColumn, seed):
+    """Spark Murmur3_x86_32.hashUnsafeBytes over a string column."""
+    lengths = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+    return murmur3_bytes(lengths, col.offsets[:-1], col.data,
+                         col.byte_capacity, seed)
+
+
 def murmur3_column(col: Column, seed) -> jnp.ndarray:
     """Per-row murmur3 update: null rows leave the running hash unchanged
     (Spark semantics). seed is uint32 lanes (running hash)."""
     dt = col.dtype
-    if isinstance(col, StringColumn):
+    if isinstance(col, DictionaryColumn):
+        # non-uniform running hash: hash each row's dictionary bytes
+        # through code-indirected (start, length) spans — no decode.
+        # (murmur3_batch owns the uniform-seed precompute fast path.)
+        lengths, starts, data, byte_cap = row_byte_lanes(col)
+        h = murmur3_bytes(lengths.astype(jnp.int32), starts, data,
+                          byte_cap, seed)
+    elif isinstance(col, StringColumn):
         h = murmur3_string(col, seed)
     elif isinstance(col, StructColumn):
         h = seed
@@ -184,8 +197,18 @@ def murmur3_batch(columns, seed: int = 42) -> jnp.ndarray:
     """Spark Murmur3Hash(cols..., 42) -> int32 lanes."""
     cap = columns[0].capacity
     h = jnp.full((cap,), jnp.uint32(seed))
-    for col in columns:
-        h = murmur3_column(col, h)
+    for i, col in enumerate(columns):
+        if i == 0 and isinstance(col, DictionaryColumn):
+            # ISSUE 18: the running hash is still the uniform scalar
+            # seed, so hash the dictionary ONCE and serve per-row
+            # hashes as a code-indexed gather of the precomputed table
+            # (not a re-hash per row). Later fold positions carry
+            # per-row hashes and take murmur3_column's span path.
+            from ..columnar.encoded import dict_take, dictionary_hashes
+            table = dictionary_hashes(col, seed)
+            h = jnp.where(col.validity, dict_take(table, col.codes), h)
+        else:
+            h = murmur3_column(col, h)
     return h.astype(jnp.int32)
 
 

@@ -335,9 +335,19 @@ def verify_pairs(build: BuildTable, stream_keys: Sequence[Column],
     """Exact key equality per candidate pair (null-safe: nulls never match,
     but null STREAM rows never produce candidates, so only hash collisions
     are filtered here)."""
+    from ..columnar.encoded import DictionaryColumn, bytes_equal_at
     build_row = gather_column_indices(build.perm, build_pos)
     ok = pair_valid
     for bk, sk in zip(build.key_cols, stream_keys):
+        if isinstance(bk, DictionaryColumn) or \
+                isinstance(sk, DictionaryColumn):
+            # encoded key (ISSUE 18): byte-compare through spans into
+            # the ORIGINAL buffers (the sides carry DIFFERENT
+            # dictionaries, so code equality means nothing across them;
+            # a materialized candidate gather would overflow the base
+            # byte bucket under join fan-out)
+            ok = ok & bytes_equal_at(bk, build_row, sk, stream_idx)
+            continue
         b = gather_column(bk, build_row)
         s = gather_column(sk, stream_idx)
         if isinstance(bk, StringColumn):

@@ -40,8 +40,13 @@ from typing import Dict, Optional, Tuple
 #: bench lanes are the host serialize/LZ4 shuffle exchange vs the
 #: device-resident packed all_to_all step (parallel/exchange.py); the
 #: gate is spark.rapids.tpu.shuffle.ici.enabled, not a tier consult.
+#: `dict_gather` (ISSUE 18) is the encoded lane's code-indexed take
+#: (columnar/encoded.dict_take: per-row dictionary lookups for hashes,
+#: literal hit masks and late materialization): its two lanes are the
+#: XLA take and the Pallas DMA row gather over the lookup table.
 PALLAS_FAMILIES = ("murmur3", "join_probe", "scan_agg", "gather",
-                   "partition_split", "h2d_upload", "ici_all_to_all")
+                   "partition_split", "h2d_upload", "ici_all_to_all",
+                   "dict_gather")
 
 #: kern_bench.json layout version. The records file is rewritten by
 #: tools/kern_bench.py with this stamp; a file from an older layout

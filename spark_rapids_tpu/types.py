@@ -296,6 +296,11 @@ def from_arrow(at) -> DataType:
         return MapType(from_arrow(at.key_type), from_arrow(at.item_type))
     if pa.types.is_null(at):
         return NULL
+    if pa.types.is_dictionary(at):
+        # dictionary encoding is a physical layout, not a logical type:
+        # the engine schema carries the VALUE type; the encoded lane
+        # (columnar/encoded.py) keeps the layout at the column level
+        return from_arrow(at.value_type)
     raise TypeError(f"unsupported arrow type {at}")
 
 

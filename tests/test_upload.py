@@ -375,8 +375,10 @@ def test_scan_batch_pins_one_transfer(tmp_path):
     assert d_on["transfers"] == d_on["uploads"]  # ONE per batch
     rows_off, d_off = drive(dict(OFF))
     assert d_off["per_buffer"] == d_off["uploads"] >= 1
-    # 3 columns: fixed(2) + fixed(2) + string(3) buffers + row count
-    assert d_off["transfers"] == 8 * d_off["uploads"]
+    # 3 columns: fixed(2) + fixed(2) + dictionary-coded string(4:
+    # codes + validity + dict offsets/bytes — parquet dictionary-encodes
+    # strings by default, ISSUE 18) buffers + row count
+    assert d_off["transfers"] == 9 * d_off["uploads"]
     assert sorted(rows_on, key=repr) == sorted(rows_off, key=repr)
 
 

@@ -103,7 +103,7 @@ def aggregate(capsules: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
                 "rows": 0, "spill_bytes": 0, "mesh_devices": 1,
                 "skew": None,
                 "dispatch": {}, "shuffle": {}, "ici": {}, "upload": {},
-                "workload": {},
+                "workload": {}, "encoded": {},
             }
         a["count"] += 1
         a["ok"] += 1 if c.get("ok") else 0
@@ -121,7 +121,8 @@ def aggregate(capsules: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
         if sk and (a["skew"] is None
                    or sk.get("ratio", 0) > a["skew"].get("ratio", 0)):
             a["skew"] = sk
-        for fam in ("dispatch", "shuffle", "ici", "upload", "workload"):
+        for fam in ("dispatch", "shuffle", "ici", "upload", "workload",
+                    "encoded"):
             _sum_family(a[fam], c.get(fam))
     for a in by_fp.values():
         walls = sorted(a.pop("walls"))
@@ -238,6 +239,20 @@ def _check_ici_eligible(a: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             "ici_rounds": 0}
 
 
+def _check_encoded_scan(a: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    en = a["encoded"]
+    if en.get("cols_encoded", 0) > 0:
+        return None
+    sbytes = en.get("scan_string_bytes", 0)
+    ubytes = a["upload"].get("bytes", 0)
+    # fire only when the decoded string width is a material share of
+    # what actually crossed the host->device link
+    if sbytes <= 0 or ubytes <= 0 or sbytes * 2 < ubytes:
+        return None
+    return {"scan_string_bytes": sbytes, "upload_bytes": ubytes,
+            "share": round(sbytes / ubytes, 3)}
+
+
 def _check_quota_spills(a: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     spills = a["workload"].get("quota_spills", 0)
     total = a.get("_total_quota_spills", spills)
@@ -298,6 +313,16 @@ ADVISOR_RULES: tuple = (
         "this plan's concurrency share — it is thrashing its own "
         "working set",
         _check_quota_spills),
+    AdvisorRule(
+        "encoded-scan-eligible",
+        "scans shipped decoded string bytes that dominate the "
+        "host->device upload volume while keeping ZERO columns "
+        "dictionary-encoded",
+        "enable spark.rapids.tpu.scan.encoded.enabled — Parquet "
+        "already ships these columns dictionary-encoded; the encoded "
+        "lane uploads the i32 code lane plus the dictionary and "
+        "materializes late through the gather engine",
+        _check_encoded_scan),
 )
 
 

@@ -56,6 +56,10 @@ DEFAULT_SHAPES = {
     # exchange vs the host serialize/LZ4 round trip it replaces
     # (ISSUE 16; lanes, not kernels)
     "ici_all_to_all": [(1 << 13, 8), (1 << 15, 8)],
+    # (rows, dictionary entries) — the encoded lane's code-indexed take
+    # of a per-dictionary table (precomputed hashes / literal hit
+    # masks; ISSUE 18)
+    "dict_gather": [(1 << 16, 1 << 10), (1 << 20, 1 << 12)],
 }
 
 #: smallest per-family shape for --quick CI smoke (compile + one
@@ -68,6 +72,7 @@ QUICK_SHAPES = {
     "partition_split": [(1 << 11, 4)],
     "h2d_upload": [(1 << 11, 4)],
     "ici_all_to_all": [(1 << 10, 4)],
+    "dict_gather": [(1 << 11, 1 << 8)],
 }
 
 
@@ -467,6 +472,39 @@ def bench_ici_all_to_all(shape, iters, reps, interpret):
             _timed(ici_step, iters, reps))
 
 
+def bench_dict_gather(shape, iters, reps, interpret):
+    """Code-indexed take over a per-dictionary lookup table (ISSUE 18):
+    the encoded lane's dict_take (columnar/encoded.py) — precomputed
+    join hashes, literal hit masks and late materialization all index a
+    small table by the i32 code lane. xla_ms = the `table[clip(codes)]`
+    take; pallas_ms = the DMA row gather (ops/pallas_gather.py) over
+    the table as a one-lane matrix, exactly the tier dict_take selects
+    between. Shape is (rows, dictionary entries)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops.pallas_gather import dma_row_gather
+
+    rows, n = shape
+    rng = np.random.default_rng(18)
+    table = jnp.asarray(
+        rng.integers(0, 1 << 32, n, dtype=np.uint64).astype(np.uint32))
+    codes = jnp.asarray(rng.integers(0, n, rows), jnp.int32)
+    mat = table.reshape(n, 1)
+
+    @jax.jit
+    def xla_step(chk):
+        out = table[jnp.clip(codes, 0, n - 1)]
+        return chk + jnp.sum(out, dtype=jnp.float64)
+
+    def pallas_step(chk):
+        out = dma_row_gather(mat, codes, interpret=interpret)[:, 0]
+        return chk + jnp.sum(out, dtype=jnp.float64)
+
+    return (_timed(xla_step, iters, reps),
+            _timed(jax.jit(pallas_step), iters, reps))
+
+
 BENCHES = {
     "join_probe": bench_join_probe,
     "scan_agg": bench_scan_agg,
@@ -475,6 +513,7 @@ BENCHES = {
     "partition_split": bench_partition_split,
     "h2d_upload": bench_h2d_upload,
     "ici_all_to_all": bench_ici_all_to_all,
+    "dict_gather": bench_dict_gather,
 }
 
 

@@ -216,6 +216,8 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
     xstats = [e for e in events if e.get("kind") == "exchange_stats"]
     ici = [e for e in events if e.get("kind") == "ici_exchange"]
     ici_ok = [e for e in ici if not e.get("fallback")]
+    escan = [e for e in events if e.get("kind") == "encoded_scan"]
+    emat = [e for e in events if e.get("kind") == "encoded_materialize"]
     waits = [e.get("wait_ms") or 0 for e in events
              if e.get("kind") == "query_admitted"]
     qphases = [e for e in events if e.get("kind") == "query_phases"]
@@ -276,6 +278,23 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
             "avg_fill": round(sum(e.get("fill") or 0 for e in ici_ok)
                               / len(ici_ok), 4) if ici_ok else 0.0,
             "fallbacks": sum(1 for e in ici if e.get("fallback"))},
+        # encoded-execution roll-up (ISSUE 18): scan batches that kept
+        # columns dictionary-encoded, the code/dictionary byte split,
+        # the eager-decode bytes the lane avoided building, and where
+        # the late materializations happened (a healthy plan decodes
+        # only at output-level seams). Zero-tolerant: pre-encoded logs
+        # report zeros.
+        "encoded": {
+            "scan_batches": len(escan),
+            "cols_encoded": sum(e.get("cols_encoded") or 0
+                                for e in escan),
+            "codes_bytes": sum(e.get("codes_bytes") or 0
+                               for e in escan),
+            "dict_bytes": sum(e.get("dict_bytes") or 0 for e in escan),
+            "decoded_bytes_avoided": sum(
+                e.get("decoded_bytes_avoided") or 0 for e in escan),
+            "materializations": sum(e.get("cols") or 0 for e in emat),
+            "materialize_seams": by("encoded_materialize", "seam")},
         "plan_fallbacks": (count("plan_fallback")
                            + count("plan_not_on_tpu")),
         "robustness": {
@@ -448,6 +467,21 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
             f"{_fmt_ns(ic['collective_ns'])}; slot cap "
             f"{ic['max_slot_cap']}, fill {ic['avg_fill']:.2f}; "
             f"{ic['fallbacks']} host-lane fallback(s))")
+    # encoded-execution roll-up (ISSUE 18): the decode-avoided bytes
+    # are the optimization, so a round reads this line next to the
+    # uploads one
+    en = s["encoded"]
+    if en["scan_batches"] or en["materializations"]:
+        seams = ", ".join(f"{k}:{n}" for k, n in
+                          sorted(en["materialize_seams"].items()))
+        extras.append(
+            f"encoded columns: {en['cols_encoded']} across "
+            f"{en['scan_batches']} scan batch(es) "
+            f"({_fmt_bytes(en['codes_bytes'])} codes + "
+            f"{_fmt_bytes(en['dict_bytes'])} dictionaries, "
+            f"{_fmt_bytes(en['decoded_bytes_avoided'])} eager decode "
+            f"avoided; {en['materializations']} late "
+            f"materialization(s){' at ' + seams if seams else ''})")
     if s["plan_fallbacks"]:
         extras.append(f"plan fallback/why-not records: "
                       f"{s['plan_fallbacks']}")
