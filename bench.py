@@ -504,6 +504,19 @@ def encoded_attribution():
     return _delta_since("encoded", encoded_engine.counters())
 
 
+def adaptive_attribution():
+    """{"adaptive": ...} block for each BENCH record (ISSUE 19):
+    runtime-replanner activity — exchange consults, skew splits,
+    broadcast demotions, single-build conversions, partition
+    coalesces, OOM batch right-sizings, breaker stand-downs and lane
+    errors (exec/adaptive.py counters, as deltas since the previous
+    record). All zeros with adaptive.enabled=false — a round compares
+    the on/off delta next to shuffle/statistics to see what acting on
+    the measured sizes actually bought."""
+    from spark_rapids_tpu.exec import adaptive as adaptive_engine
+    return _delta_since("adaptive", adaptive_engine.counters())
+
+
 def dispatch_attribution():
     """{"dispatch": ...} block for each BENCH record (ISSUE 13):
     compiled programs, program dispatches, fresh traces vs jit cache
@@ -804,6 +817,7 @@ def main():
         "upload": upload_attribution(),
         "encoded": encoded_attribution(),
         "dispatch": dispatch_attribution(),
+        "adaptive": adaptive_attribution(),
         "stage": stage_attribution(),
         "telemetry": telemetry_attribution(),
         "statistics": statistics_attribution(),
@@ -983,6 +997,7 @@ def q3_bench():
         "upload": upload_attribution(),
         "encoded": encoded_attribution(),
         "dispatch": dispatch_attribution(),
+        "adaptive": adaptive_attribution(),
         "stage": stage_attribution(),
         "telemetry": telemetry_attribution(),
         "statistics": statistics_attribution(),

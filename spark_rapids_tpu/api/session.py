@@ -95,6 +95,7 @@ class TpuSession:
         (obs/dispatch.py)."""
         from ..exec import lifecycle
         from ..obs import dispatch, telemetry
+        from ..obs import stats as obs_stats
         out = lifecycle.health()
         out["telemetry"] = telemetry.health_section()
         out["dispatch"] = dispatch.health_section()
@@ -102,6 +103,11 @@ class TpuSession:
         # registry's latency ring (ISSUE 17) — {"enabled": False} when
         # telemetry is off
         out["slo"] = telemetry.slo_section()
+        # skew pressure + adaptive decisions (ISSUE 19): recent
+        # per-exchange max/median ratios and the replanner's decision
+        # counters, so operators see what the measured-statistics
+        # control plane did without reading the event log
+        out["stats"] = obs_stats.health_section()
         return out
 
     def active_queries(self) -> List[Dict]:

@@ -642,6 +642,57 @@ PARTITION_RECOVERY_ENABLED = conf_bool(
     "provenance (spill files, missing lineage, repeated corruption of "
     "one map output) still falls back to whole-plan re-execution.")
 
+ADAPTIVE_ENABLED = conf_bool(
+    "spark.rapids.tpu.adaptive.enabled", True,
+    "Adaptive runtime replanning (exec/adaptive.py): consult the "
+    "MEASURED per-partition map-output sizes the exchange recorder "
+    "already captures and replan at exchange-read boundaries — split a "
+    "skewed reducer partition into map-granular sub-reads "
+    "(adaptive.skewedPartitionFactor), demote a measured-oversized "
+    "broadcast/single-build join to the sub-partitioned strategy "
+    "before its first OOM retry (adaptive.autoBroadcastMaxBytes and "
+    "the workload governor's quota share), convert a shuffle join "
+    "whose build side measured small to single-build, coalesce "
+    "adjacent tiny reducer partitions (adaptive.coalesceTargetBytes), "
+    "and shrink the query's batch target after an OOM split. CPU "
+    "results are unchanged: integer paths stay byte-exact; float "
+    "deltas are limited to the documented OOM-split reduction-order "
+    "class. A misfiring replan lane demotes itself to the static plan "
+    "through the `adaptive` circuit-breaker domain.",
+    commonly_used=True)
+
+ADAPTIVE_SKEW_FACTOR = conf_float(
+    "spark.rapids.tpu.adaptive.skewedPartitionFactor", 4.0,
+    "A reducer partition whose measured bytes exceed this factor times "
+    "the median partition size (and adaptive.skewedPartitionMinBytes) "
+    "is read as map-output-granular sub-reads, each a separate probe "
+    "stream against the replicated build side, so no single hash-join "
+    "window holds the whole hot key. <= 0 disables skew splitting.")
+
+ADAPTIVE_SKEW_MIN_BYTES = conf_bytes(
+    "spark.rapids.tpu.adaptive.skewedPartitionMinBytes", 16 * 1024 * 1024,
+    "Floor below which a reducer partition is never treated as skewed "
+    "regardless of its ratio to the median — small exchanges are "
+    "cheaper to read whole than to split.")
+
+ADAPTIVE_AUTO_BROADCAST_MAX_BYTES = conf_bytes(
+    "spark.rapids.tpu.adaptive.autoBroadcastMaxBytes", 64 * 1024 * 1024,
+    "Measured build-side cap for adaptive join strategy changes: a "
+    "planned broadcast/single-build join whose build side MEASURES "
+    "larger than this (or the admitting ticket's quota share) demotes "
+    "to the sub-partitioned strategy before the first OOM retry, and a "
+    "shuffle join whose build side measures at most this converts to "
+    "single-build. -1 disables both conversions.")
+
+ADAPTIVE_COALESCE_TARGET_BYTES = conf_bytes(
+    "spark.rapids.tpu.adaptive.coalesceTargetBytes", 1024 * 1024,
+    "Adjacent reducer partitions whose measured bytes sum to no more "
+    "than this merge into one read on flat (partition-oblivious) "
+    "consumers, killing per-partition dispatch overhead on thousand-"
+    "partition plans. Partition-aware consumers (shuffled joins, "
+    "partition-wise sort) always see the static boundaries. "
+    "0 disables coalescing.")
+
 BREAKER_ENABLED = conf_bool(
     "spark.rapids.tpu.breaker.enabled", False,
     "Degradation circuit breakers (exec/lifecycle.py): track classified-"

@@ -1,0 +1,299 @@
+"""Adaptive runtime replanner (ISSUE 19) — act on the MEASURED exchange
+statistics the obs subsystem already records, so adversarial data shapes
+degrade gracefully instead of OOMing or livelocking.
+
+The engine has recorded exact per-partition map-output rows/bytes at
+every host exchange since ISSUE 11 (`obs/stats.ExchangeRecorder`), and
+the advisor can *diagnose* partition skew (ISSUE 17) — but nothing
+*acted*. This module is the control plane: consulted at exchange-read
+boundaries (after the write phase, before any reader stream exists), it
+makes four decisions, every one from measured bytes, never estimates:
+
+``skew_split``
+    A reducer partition over ``skewedPartitionFactor x median`` (and the
+    min-bytes floor) is read as K map-output-granular sub-reads
+    (`shuffle/manager.HostShuffleReader.plan_map_groups`), each its own
+    probe stream against the replicated build side — no single
+    hash-join window ever holds the whole hot key. Per-map lineage
+    recovery (ISSUE 6) still works under a split read, and the ICI
+    all-to-all lane stands down for the exchange (uneven splits don't
+    fit the static device collective).
+``broadcast_demote``
+    A planned broadcast/single-build join whose build side MEASURES
+    larger than ``autoBroadcastMaxBytes`` — or the admitting ticket's
+    workload-governor quota share — demotes to the sub-partitioned
+    strategy BEFORE the first OOM retry fires.
+``single_build_convert``
+    The converse: a shuffled hash join whose build side measured small
+    at exchange-write time collapses back to one single-build probe
+    pass, skipping the probe side's exchange entirely.
+``partition_coalesce``
+    Adjacent reducer partitions under ``coalesceTargetBytes`` merge
+    into one read on flat (partition-oblivious) consumers only —
+    partition-aware consumers (shuffled joins, partition-wise sort)
+    always see the static boundaries.
+``batch_right_size``
+    After `with_retry` resorts to an OOM split, the query's
+    QueryContext carries a halved batch target consumed by
+    CoalesceBatchesExec, so later batches of the same query stop
+    re-triggering the retry lane.
+
+Every applied decision emits an ``adaptive_replan`` event carrying its
+evidence (measured bytes, threshold, chosen action); refusals and
+strategy demotions emit ``adaptive_demote``. The lane registers the
+``adaptive`` breaker domain: decisions engage it for the attempt, and a
+consult-path error records a domain failure, so a misfiring replanner
+demotes itself to the static plan instead of flapping.
+
+Results are unchanged on CPU: integer paths stay byte-exact (splits and
+coalesces regroup the same decoded blocks in the same order); float
+deltas are limited to the documented OOM-split reduction-order class.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: decision slug -> what it does / its evidence. The docs/robustness.md
+#: "Adaptive execution" table is lint-checked against this registry
+#: (tests/test_docs_lint.py), like the breaker-domain table.
+DECISIONS: Dict[str, str] = {
+    "skew_split": "reducer partition over factor x median bytes read "
+                  "as map-granular sub-reads, one probe stream each",
+    "broadcast_demote": "measured-oversized build side (conf cap or "
+                        "quota share) demoted to sub-partitioned "
+                        "strategy before any OOM retry",
+    "single_build_convert": "shuffle join whose build side measured "
+                            "small converted to a single-build probe "
+                            "pass (probe-side exchange skipped)",
+    "partition_coalesce": "adjacent reducer partitions under the "
+                          "target merged into one read (flat "
+                          "consumers only)",
+    "batch_right_size": "query batch target halved after an OOM "
+                        "split, consumed by CoalesceBatchesExec",
+}
+
+#: decision slug -> counter key (the "adaptive" counter family bench /
+#: history / profile_report roll up)
+_DECISION_COUNTER = {
+    "skew_split": "skew_splits",
+    "broadcast_demote": "broadcast_demotes",
+    "single_build_convert": "single_build_converts",
+    "partition_coalesce": "partition_coalesces",
+    "batch_right_size": "batch_right_sizes",
+}
+
+_COUNTER_LOCK = threading.Lock()
+_COUNTERS: Dict[str, int] = {
+    "consults": 0,
+    "skew_splits": 0,
+    "broadcast_demotes": 0,
+    "single_build_converts": 0,
+    "partition_coalesces": 0,
+    "batch_right_sizes": 0,
+    "breaker_demotions": 0,
+    "errors": 0,
+}
+
+
+def _note(**deltas: int) -> None:
+    with _COUNTER_LOCK:
+        for k, v in deltas.items():
+            _COUNTERS[k] = _COUNTERS.get(k, 0) + v
+
+
+def counters() -> Dict[str, int]:
+    """Cumulative process-wide decision counters (the chaos-counters
+    snapshot pattern: bench and history diff these per record)."""
+    with _COUNTER_LOCK:
+        return dict(_COUNTERS)
+
+
+def reset_adaptive() -> None:
+    """Zero the counters (test isolation)."""
+    with _COUNTER_LOCK:
+        for k in _COUNTERS:
+            _COUNTERS[k] = 0
+
+
+# -- gate --------------------------------------------------------------------
+
+def consult(conf, op: str = "", op_id: int = -1) -> bool:
+    """May adaptive decisions apply here? Conf on AND the `adaptive`
+    breaker closed. A breaker refusal is itself a demotion decision:
+    counted and emitted (ESSENTIAL) so operators see the lane stand
+    down, exactly the ICI degradation-seam discipline."""
+    from ..config import ADAPTIVE_ENABLED
+    if not conf.get(ADAPTIVE_ENABLED):
+        return False
+    from . import lifecycle
+    if not lifecycle.breaker_allows("adaptive"):
+        _note(breaker_demotions=1)
+        from ..obs import events as obs_events
+        obs_events.emit("adaptive_demote", exec=op, op_id=op_id,
+                        decision="lane", reason="breaker_open")
+        return False
+    _note(consults=1)
+    return True
+
+
+def note_error(op: str = "", op_id: int = -1, error: str = "") -> None:
+    """A consult-path failure: the replanner must never take a query
+    down, so callers catch, fall back to the static plan, and record
+    the failure against the `adaptive` breaker domain here — repeated
+    misfires open the breaker and the lane stands down."""
+    _note(errors=1)
+    from . import lifecycle
+    lifecycle.record_domain_failure("adaptive")
+    from ..obs import events as obs_events
+    obs_events.emit("adaptive_demote", exec=op, op_id=op_id,
+                    decision="lane", reason="error",
+                    error=str(error)[:200])
+
+
+def note_decision(decision: str, op: str = "", op_id: int = -1,
+                  **evidence) -> None:
+    """One applied decision: count it, emit the evidence-carrying
+    `adaptive_replan` event, and engage the breaker domain for the
+    attempt so a downstream transient failure is attributed here."""
+    _note(**{_DECISION_COUNTER[decision]: 1})
+    from ..obs import events as obs_events
+    obs_events.emit("adaptive_replan", exec=op, op_id=op_id,
+                    decision=decision, **evidence)
+    from . import lifecycle
+    lifecycle.engage_domain("adaptive")
+
+
+def note_demote(decision: str, op: str = "", op_id: int = -1,
+                **evidence) -> None:
+    """A strategy demotion (ESSENTIAL visibility): a planned cheap
+    strategy measured unaffordable and the safe one was chosen."""
+    _note(**{_DECISION_COUNTER[decision]: 1})
+    from ..obs import events as obs_events
+    obs_events.emit("adaptive_demote", exec=op, op_id=op_id,
+                    decision=decision, **evidence)
+    from . import lifecycle
+    lifecycle.engage_domain("adaptive")
+
+
+# -- decision 1: skewed-reducer splitting ------------------------------------
+
+def skew_threshold(per_part_bytes: Sequence[int],
+                   conf) -> Optional[Tuple[int, int]]:
+    """(threshold_bytes, median_bytes) above which a partition is
+    skewed, or None when splitting is off / undecidable. Median over
+    the NONZERO partitions (the ExchangeStats.skew basis: empty
+    partitions of a sparse key space would drag the median to zero and
+    flag everything)."""
+    from ..config import ADAPTIVE_SKEW_FACTOR, ADAPTIVE_SKEW_MIN_BYTES
+    factor = conf.get(ADAPTIVE_SKEW_FACTOR)
+    if factor <= 0:
+        return None
+    nz = sorted(b for b in per_part_bytes if b > 0)
+    if len(nz) < 2:
+        return None
+    median = nz[len(nz) // 2]
+    floor = max(0, conf.get(ADAPTIVE_SKEW_MIN_BYTES))
+    return max(int(factor * median), floor), median
+
+
+# -- decision 2: measured build-side caps ------------------------------------
+
+def auto_broadcast_max(conf) -> int:
+    """The conf cap for measured single-build/broadcast decisions
+    (-1 = conversions off)."""
+    from ..config import ADAPTIVE_AUTO_BROADCAST_MAX_BYTES
+    return conf.get(ADAPTIVE_AUTO_BROADCAST_MAX_BYTES)
+
+
+def demote_cap(conf) -> Optional[Tuple[int, str]]:
+    """(cap_bytes, basis) a measured build side must stay under to keep
+    a single-build plan: the tighter of adaptive.autoBroadcastMaxBytes
+    and the admitting ticket's workload quota share (basis "conf" /
+    "quota"). None when neither bound applies."""
+    cap = auto_broadcast_max(conf)
+    bound = (cap, "conf") if cap >= 0 else None
+    try:
+        from ..memory.budget import memory_budget
+        from . import workload
+        share = workload.quota_bytes(memory_budget().limit)
+    except Exception:  # noqa: BLE001 — governor off / no budget
+        share = None
+    if share is not None and (bound is None or share < bound[0]):
+        bound = (share, "quota")
+    return bound
+
+
+# -- decision 3: tiny-partition coalescing -----------------------------------
+
+def coalesce_groups(per_part_bytes: Sequence[int], target: int,
+                    exclude: Optional[Set[int]] = None,
+                    ) -> Optional[List[List[int]]]:
+    """Greedy adjacent grouping of reducer partitions whose measured
+    bytes fit `target` together; `exclude`d partitions (e.g. ones being
+    skew-split) always stand alone. Returns the full partition cover in
+    order, or None when no group would merge anything."""
+    exclude = exclude or set()
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_b = 0
+    for p, b in enumerate(per_part_bytes):
+        if p in exclude or b > target:
+            if cur:
+                groups.append(cur)
+                cur, cur_b = [], 0
+            groups.append([p])
+            continue
+        if cur and cur_b + b > target:
+            groups.append(cur)
+            cur, cur_b = [], 0
+        cur.append(p)
+        cur_b += b
+    if cur:
+        groups.append(cur)
+    if all(len(g) == 1 for g in groups):
+        return None
+    return groups
+
+
+# -- decision 4: OOM-feedback batch right-sizing -----------------------------
+
+#: never shrink the batch target below this — a 4 KiB floor keeps a
+#: pathological split cascade from degenerating to row-at-a-time
+MIN_BATCH_TARGET = 4 * 1024
+
+
+def note_oom_split() -> None:
+    """Called from with_retry's SPLIT branch: halve the governed
+    query's effective batch target (floored) so CoalesceBatchesExec
+    stops assembling batches the device just proved it cannot hold.
+    Outside a governed query, or with adaptive off, this is a no-op."""
+    from . import lifecycle
+    ctx = lifecycle.current_context()
+    if ctx is None:
+        return
+    from ..config import ADAPTIVE_ENABLED, BATCH_SIZE_BYTES, active_conf
+    conf = active_conf()
+    if not conf.get(ADAPTIVE_ENABLED):
+        return
+    cur = ctx.adaptive_batch_target
+    if cur is None:
+        cur = conf.get(BATCH_SIZE_BYTES)
+    new = max(MIN_BATCH_TARGET, cur // 2)
+    if new >= cur:
+        return
+    ctx.adaptive_batch_target = new
+    note_decision("batch_right_size", op="with_retry",
+                  prev_target=cur, new_target=new)
+
+
+def batch_target_override() -> Optional[int]:
+    """The governed query's shrunken batch target, or None — ONE
+    context-pointer read plus one attribute read on the hot path, no
+    conf access (CoalesceBatchesExec consults this per flush check)."""
+    from . import lifecycle
+    ctx = lifecycle.current_context()
+    if ctx is None:
+        return None
+    return ctx.adaptive_batch_target

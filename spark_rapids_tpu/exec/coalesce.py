@@ -19,6 +19,7 @@ from ..ops.basic import concat_columns, sanitize
 from ..types import Schema
 from ..obs import dispatch as obs_dispatch
 from ..obs.dispatch import instrument
+from . import adaptive
 from .base import (COMPILE_TIME, CONCAT_TIME, DEBUG, DISPATCH_METRICS,
                    NUM_DISPATCHES, NUM_INPUT_BATCHES, NUM_INPUT_ROWS,
                    PIPELINE_STAGE_METRICS, TpuExec)
@@ -156,11 +157,20 @@ class CoalesceBatchesExec(TpuExec):
                 else:
                     in_rows.add_device(batch.num_rows)
                 size = batch.device_size_bytes()
-                if pending and pending_bytes + size > self.target_bytes:
+                # OOM-feedback right-sizing (ISSUE 19): a with_retry
+                # SPLIT earlier in this query shrank the governed batch
+                # target — honor it here so later batches stop
+                # re-triggering the retry lane. One context-pointer
+                # read per batch, no conf access.
+                target = self.target_bytes
+                override = adaptive.batch_target_override()
+                if override is not None and override < target:
+                    target = override
+                if pending and pending_bytes + size > target:
                     yield flush()
                 pending.append(SpillableBatch.from_batch(batch))
                 pending_bytes += size
-                if pending_bytes >= self.target_bytes:
+                if pending_bytes >= target:
                     yield flush()
             tail = flush()
             if tail is not None:

@@ -426,6 +426,15 @@ class HostShuffleExchangeExec(TpuExec):
         from ..config import SHUFFLE_ICI_ENABLED
         self._ici_enabled = bool(self._conf.get(SHUFFLE_ICI_ENABLED))
         self._ici_mesh = None
+        # adaptive skew shield (ISSUE 19): set by a downstream
+        # partition-aware probe consumer (ShuffledHashJoinExec) on its
+        # STREAM-side exchange — a skew split needs map-output-granular
+        # host files, so an armed splitter keeps this execution off the
+        # ICI all-to-all (uneven splits don't fit the static device
+        # collective); measured write bytes surface for the
+        # single-build conversion consult
+        self._adaptive_probe_split = False
+        self._adaptive_write_bytes: Optional[int] = None
         self._ici_measure = None
         self._ici_steps = {}
         #: running per-round high-water marks (ISSUE 11 statistics as
@@ -659,10 +668,11 @@ class HostShuffleExchangeExec(TpuExec):
     def internal_execute(self) -> Iterator[ColumnarBatch]:
         import numpy as np  # noqa: F401 — used by _pid_for
 
-        for gen in self.execute_partitions():
+        for gen in self.execute_partitions(flat=True):
             yield from gen
 
-    def execute_partitions(self) -> "Iterator[Iterator[ColumnarBatch]]":
+    def execute_partitions(self, flat: bool = False,
+                           ) -> "Iterator[Iterator[ColumnarBatch]]":
         """One lazy batch-generator per partition, in partition order:
         decoded blocks stream WITHOUT concatenation (ADVICE r3 #2 — a
         skewed partition's device peak is one decoded block; the old
@@ -675,14 +685,21 @@ class HostShuffleExchangeExec(TpuExec):
         eligible — conf on, active mesh axis == partition count,
         device-computable partitioning, breaker closed — else the host
         serialize/LZ4 lane. The ICI lane itself degrades to the host
-        lane mid-stream on a failed collective round."""
+        lane mid-stream on a failed collective round.
+
+        `flat` marks a partition-oblivious consumer (internal_execute):
+        only then may the adaptive replanner coalesce adjacent tiny
+        partitions into one read — partition-AWARE consumers (shuffled
+        joins, partition-wise sort) must see the static boundaries or
+        a zipped pair of exchanges would desync."""
+        self._adaptive_write_bytes = None
         if self._ici_eligible():
             yield from self._execute_partitions_ici()
             return
-        yield from self._execute_partitions_host()
+        yield from self._execute_partitions_host(flat=flat)
 
     def _execute_partitions_host(self, override_source=None,
-                                 stats_rec=None
+                                 stats_rec=None, flat: bool = False
                                  ) -> "Iterator[Iterator[ColumnarBatch]]":
         """The host shuffle-manager lane (and the ICI lane's fallback
         tier). `override_source` replaces the child stream when the ICI
@@ -804,8 +821,20 @@ class HostShuffleExchangeExec(TpuExec):
             # distribution summary profile_report rolls up and the AQE
             # loop (ROADMAP 4) will consult
             stats_rec.finish_and_emit()
+            #: measured write total for the single-build conversion
+            #: consult (ISSUE 19) — host lane only (ICI rounds record
+            #: rows, not bytes)
+            self._adaptive_write_bytes = stats_rec.total_bytes() or None
             reader = HostShuffleReader(handle, mgr, self._conf)
             n = self.n_partitions
+            # adaptive replanning (ISSUE 19): the consult point — the
+            # write phase measured every partition exactly, no reader
+            # stream exists yet. The ICI fallback drain is excluded
+            # (its stats carry rows only, and lineage is off).
+            split_plan, flat_groups = {}, None
+            if override_source is None:
+                split_plan, flat_groups = self._adaptive_read_plan(
+                    stats_rec, reader, handle, flat)
 
             def cleanup_if_finished():
                 if state["outer_done"] and state["done"] >= n \
@@ -821,7 +850,11 @@ class HostShuffleExchangeExec(TpuExec):
                 # may list() the outer generator before reading any
                 # partition (exhausting the outer must not tear down the
                 # shuffle files under the readers)
-                inner = self._read_partition(reader, p)
+                groups = split_plan.get(p)
+                inner = self._read_partition(reader, p) \
+                    if groups is None \
+                    else self._read_partition_split(reader, p, groups,
+                                                    handle)
                 try:
                     for b in inner:
                         out_batches.add(1)
@@ -843,16 +876,43 @@ class HostShuffleExchangeExec(TpuExec):
                     state["done"] += 1
                     cleanup_if_finished()
 
+            def _mark_done_all(cells):
+                for cell in cells:
+                    _mark_done(cell)
+
+            def group_stream(ps, cells):
+                # a coalesced read (ISSUE 19 decision 3): chain the
+                # member partitions' UNCHANGED streams — same stages,
+                # same batches, same order — so the merge is pure read
+                # grouping; the finally settles every member's cell
+                try:
+                    for p, cell in zip(ps, cells):
+                        yield from part_stream(p, cell)
+                finally:
+                    _mark_done_all(cells)
+
             import weakref
             try:
-                for p in range(n):
-                    cell = [False]
-                    g = part_stream(p, cell)
-                    # a NEVER-STARTED generator runs no finally even on
-                    # close: the weakref finalizer keeps an abandoned
-                    # partition stream from leaking the shuffle handle
-                    weakref.finalize(g, _mark_done, cell)
-                    yield g
+                if flat_groups is None:
+                    for p in range(n):
+                        cell = [False]
+                        g = part_stream(p, cell)
+                        # a NEVER-STARTED generator runs no finally even
+                        # on close: the weakref finalizer keeps an
+                        # abandoned partition stream from leaking the
+                        # shuffle handle
+                        weakref.finalize(g, _mark_done, cell)
+                        yield g
+                else:
+                    for ps in flat_groups:
+                        cells = [[False] for _ in ps]
+                        if len(ps) == 1:
+                            g = part_stream(ps[0], cells[0])
+                            weakref.finalize(g, _mark_done, cells[0])
+                        else:
+                            g = group_stream(ps, cells)
+                            weakref.finalize(g, _mark_done_all, cells)
+                        yield g
             finally:
                 state["outer_done"] = True
                 cleanup_if_finished()
@@ -899,6 +959,22 @@ class HostShuffleExchangeExec(TpuExec):
         from . import lifecycle
         if not lifecycle.breaker_allows("ici_exchange"):
             return False
+        # adaptive skew shield (ISSUE 19): an armed skew splitter needs
+        # the host lane's map-output-granular files — uneven sub-reads
+        # don't fit the static device collective. The stand-down is a
+        # degradation decision, reported through the ISSUE 16 seam
+        # (fallback event + counter) so the lane change is visible.
+        if self._adaptive_probe_split:
+            from ..config import ADAPTIVE_ENABLED, ADAPTIVE_SKEW_FACTOR
+            if self._conf.get(ADAPTIVE_ENABLED) \
+                    and self._conf.get(ADAPTIVE_SKEW_FACTOR) > 0:
+                from ..shuffle.manager import note_ici_exchange
+                note_ici_exchange(fallbacks=1)
+                obs_events.emit("ici_exchange",
+                                exec=type(self).__name__,
+                                op_id=self._op_id, fallback=True,
+                                reason="adaptive_skew_split")
+                return False
         self._ici_mesh = mesh
         return True
 
@@ -1369,6 +1445,92 @@ class HostShuffleExchangeExec(TpuExec):
         if not saw:
             yield empty_batch(self.output_schema)
 
+    # -- adaptive replanning (ISSUE 19) -------------------------------------
+    def _adaptive_read_plan(self, stats_rec, reader, handle, flat):
+        """The exchange-read consult point: decide skew splits (any
+        consumer) and tiny-partition coalescing (flat consumers only)
+        from the write phase's MEASURED per-partition bytes. Never
+        raises — a consult failure records against the `adaptive`
+        breaker domain and the static plan runs."""
+        from . import adaptive
+        op = type(self).__name__
+        try:
+            per_part = stats_rec.partition_bytes()
+            if self.n_partitions <= 1 or per_part is None:
+                return {}, None
+            if not adaptive.consult(self._conf, op=op,
+                                    op_id=self._op_id):
+                return {}, None
+            split_plan = {}
+            thr = adaptive.skew_threshold(per_part, self._conf)
+            if thr is not None and len(handle.map_outputs) > 1:
+                threshold, median = thr
+                for p, b in enumerate(per_part):
+                    if b <= threshold:
+                        continue
+                    groups = reader.plan_map_groups(p, threshold)
+                    if len(groups) <= 1:
+                        continue
+                    split_plan[p] = groups
+                    adaptive.note_decision(
+                        "skew_split", op=op, op_id=self._op_id,
+                        partition=p, bytes=b, threshold=threshold,
+                        median_bytes=median, subs=len(groups),
+                        max_sub_bytes=max(g[1] for g in groups))
+            flat_groups = None
+            if flat:
+                from ..config import ADAPTIVE_COALESCE_TARGET_BYTES
+                target = self._conf.get(ADAPTIVE_COALESCE_TARGET_BYTES)
+                if target > 0:
+                    flat_groups = adaptive.coalesce_groups(
+                        per_part, target, exclude=set(split_plan))
+                    if flat_groups is not None:
+                        adaptive.note_decision(
+                            "partition_coalesce", op=op,
+                            op_id=self._op_id,
+                            partitions=self.n_partitions,
+                            reads=len(flat_groups),
+                            target_bytes=target)
+            return split_plan, flat_groups
+        except Exception as e:  # noqa: BLE001 — replan must not kill
+            adaptive.note_error(op=op, op_id=self._op_id, error=e)
+            return {}, None
+
+    def _read_partition_split(self, reader, p: int, groups, handle,
+                              ) -> Iterator[ColumnarBatch]:
+        """A skew-split partition read (ISSUE 19 decision 1): K
+        map-granular sub-reads in map order, each its own pipelined
+        fetch/decode/promote stage, so the in-flight decode window is
+        one sub-read (≤ the skew threshold) instead of the whole hot
+        partition. Downstream, each promoted batch is one probe window
+        against the replicated build side — concatenated output is
+        byte-identical to the unsplit read."""
+        from ..columnar.upload import promote_stream
+        read_time = self.metrics[SHUFFLE_READ_TIME]
+        ordinal = [0]
+        saw = False
+        for sub, (paths, _sub_bytes) in enumerate(groups):
+            stage = self.pipeline_stage(
+                promote_stream(
+                    reader.read_partition_maps(p, paths, sub, ordinal),
+                    key_prefix=f"upload:p{p}", seam="shuffle",
+                    num_metric=self.metrics[NUM_UPLOADS],
+                    time_metric=self.metrics[UPLOAD_PACK_TIME]),
+                "shuffle-read")
+            try:
+                while True:
+                    with read_time.ns_timer():
+                        try:
+                            b = next(stage)
+                        except StopIteration:
+                            break
+                    saw = True
+                    yield b
+            finally:
+                stage.close()
+        if not saw:
+            yield empty_batch(self.output_schema)
+
     def node_description(self):
         return (f"HostShuffleExchangeExec[n={self.n_partitions}, "
                 f"keys={self.partition_exprs!r}]")
@@ -1462,9 +1624,61 @@ class ShuffledHashJoinExec(TpuExec):
         # flow through the inner join one batch at a time (round 5 —
         # a skewed shard is no longer concatenated whole; the build side
         # still materializes its partition, as any hash build must)
-        lit_ = self.children[0].execute_partitions()
-        rit = self.children[1].execute_partitions()
         build_right = self._join.build_side == "right"
+        # adaptive skew shield (ISSUE 19): arm the STREAM-side host
+        # exchange — its skewed partitions split into sub-read probe
+        # streams against this join's replicated per-partition build,
+        # and an armed splitter keeps that exchange off the ICI lane
+        stream_child = self.children[0] if build_right \
+            else self.children[1]
+        build_child = self.children[1] if build_right \
+            else self.children[0]
+        if isinstance(stream_child, HostShuffleExchangeExec):
+            stream_child._adaptive_probe_split = True
+        # single-build conversion (ISSUE 19 decision 2, converse): when
+        # the build side's exchange MEASURES small at write time, the
+        # per-partition zip collapses to one single-build probe pass —
+        # the build replays whole (it fits by measurement) and the
+        # probe side's exchange is skipped entirely (its subtree
+        # streams straight into the probe). Correct because the
+        # partitioned join's union is the whole join; only row order
+        # changes.
+        build_gens = None
+        if isinstance(stream_child, HostShuffleExchangeExec) \
+                and isinstance(build_child, HostShuffleExchangeExec):
+            from . import adaptive
+            from ..config import ADAPTIVE_ENABLED
+            conf = build_child._conf
+            cap = adaptive.auto_broadcast_max(conf) \
+                if conf.get(ADAPTIVE_ENABLED) else -1
+            if cap >= 0 and adaptive.consult(
+                    conf, op=type(self).__name__, op_id=self._op_id):
+                build_gens = list(build_child.execute_partitions())
+                measured = build_child._adaptive_write_bytes
+                if measured is not None and measured <= cap:
+                    adaptive.note_decision(
+                        "single_build_convert", op=type(self).__name__,
+                        op_id=self._op_id, measured_bytes=measured,
+                        threshold=cap)
+                    batches = [b for g in build_gens for b in g]
+                    probe = stream_child.child.execute()
+                    if build_right:
+                        self._rscan._batches = batches
+                        self._lscan.set_stream(probe)
+                    else:
+                        self._lscan._batches = batches
+                        self._rscan.set_stream(probe)
+                    yield from self._join.execute()
+                    return
+        if build_gens is None:
+            lit_ = self.children[0].execute_partitions()
+            rit = self.children[1].execute_partitions()
+        elif build_right:
+            lit_ = self.children[0].execute_partitions()
+            rit = iter(build_gens)
+        else:
+            lit_ = iter(build_gens)
+            rit = self.children[1].execute_partitions()
         while True:
             lp = next(lit_, None)
             rp = next(rit, None)

@@ -1002,19 +1002,40 @@ class AdaptiveJoinExec(TpuExec):
         multithreaded = self._conf.get(SHUFFLE_MODE).upper() \
             == "MULTITHREADED"
         left, right = self.children
+        # quota-aware broadcast demotion (ISSUE 19 decision 2): the
+        # measured build side must also fit the adaptive cap — the
+        # tighter of adaptive.autoBroadcastMaxBytes and the admitting
+        # ticket's workload quota share. A single-build plan whose
+        # build MEASURES over the cap demotes to the sub-partitioned
+        # strategy BEFORE the first OOM retry fires.
+        from . import adaptive
+        from ..config import ADAPTIVE_ENABLED
+        cap_basis = None
+        if self._conf.get(ADAPTIVE_ENABLED) and adaptive.consult(
+                self._conf, op=type(self).__name__, op_id=self._op_id):
+            cap_basis = adaptive.demote_cap(self._conf)
         r_sps, size_r = self._materialize(right)
         r_scan = _SpillableScanExec(r_sps, right.output_schema)
         swappable = self.join_type == "inner" and not self.condition
+        demoted = False
         if thr_b >= 0 and size_r <= thr_b:
-            # small build: stream the left side straight through
-            self._measured = (None, size_r)
-            self._choice = "build_right"
-            join: TpuExec = HashJoinExec(
-                left, r_scan, self.left_keys, self.right_keys,
-                self.join_type, build_side="right",
-                condition=self.condition)
-            yield from join.execute()
-            return
+            if cap_basis is not None and size_r > cap_basis[0]:
+                demoted = True
+                adaptive.note_demote(
+                    "broadcast_demote", op=type(self).__name__,
+                    op_id=self._op_id, measured_bytes=size_r,
+                    threshold=cap_basis[0], basis=cap_basis[1],
+                    planned="build_right")
+            else:
+                # small build: stream the left side straight through
+                self._measured = (None, size_r)
+                self._choice = "build_right"
+                join: TpuExec = HashJoinExec(
+                    left, r_scan, self.left_keys, self.right_keys,
+                    self.join_type, build_side="right",
+                    condition=self.condition)
+                yield from join.execute()
+                return
         # symmetric: hold BOTH sides spillable, measure, decide
         l_sps, size_l = self._materialize(left)
         l_scan = _SpillableScanExec(l_sps, left.output_schema)
@@ -1022,13 +1043,23 @@ class AdaptiveJoinExec(TpuExec):
         # the side that would actually be BUILT must fit: only inner
         # joins without a condition may swap build sides
         build_size = min(size_l, size_r) if swappable else size_r
-        if thr_sub >= 0 and build_size > thr_sub and multithreaded:
+        # a demoted join sub-partitions when the to-be-built side still
+        # exceeds the cap; the effective threshold is the tighter of
+        # the static conf and the measured cap
+        over_cap = (demoted and cap_basis is not None
+                    and build_size > cap_basis[0])
+        eff_sub = thr_sub
+        if over_cap:
+            eff_sub = cap_basis[0] if thr_sub < 0 \
+                else min(thr_sub, cap_basis[0])
+        if multithreaded and ((thr_sub >= 0 and build_size > thr_sub)
+                              or over_cap):
             from .exchange import (HostShuffleExchangeExec,
                                    ShuffledHashJoinExec)
             # size k from the side that will actually be BUILT (build is
             # forced right for non-swappable joins — ADVICE r3 #4)
             k = min(256, max(self._conf.get(SHUFFLE_PARTITIONS),
-                             -(-build_size // max(thr_sub, 1))))
+                             -(-build_size // max(eff_sub, 1))))
             lex = HostShuffleExchangeExec(self.left_keys, l_scan,
                                           int(k), self._conf)
             rex = HostShuffleExchangeExec(self.right_keys, r_scan, int(k),

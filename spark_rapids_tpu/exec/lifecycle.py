@@ -131,7 +131,7 @@ class QueryContext:
                  "phase", "current_op", "root_op_id", "batches_produced",
                  "rows_produced", "attempt_no", "spill_count",
                  "spill_bytes", "runtime_stats", "phase_ledger",
-                 "events_qid")
+                 "events_qid", "adaptive_batch_target")
 
     def __init__(self, timeout_ms: int = 0, check_every: int = 8,
                  owner: Any = None):
@@ -185,6 +185,13 @@ class QueryContext:
         #: soon as any query retries (one events id per attempt, one
         #: ctx per governed drive)
         self.events_qid = None
+        #: OOM-feedback batch right-sizing (exec/adaptive.py): set by
+        #: the first with_retry SPLIT of the query, consumed by
+        #: CoalesceBatchesExec as a shrunken target so later batches of
+        #: the same query stop re-triggering the retry lane. Persists
+        #: across attempts (unlike runtime_stats) — the signal is about
+        #: the query's data shape, not one attempt's luck
+        self.adaptive_batch_target: Optional[int] = None
 
     def note_batch(self, op: str, op_id: int,
                    rows: Optional[int]) -> None:
@@ -407,6 +414,10 @@ BREAKER_DOMAINS: Dict[str, str] = {
     "ici_exchange": "ICI device-to-device shuffle lane "
                     "(exec/exchange.py + parallel/exchange.py) "
                     "-> host serialize/LZ4 shuffle lane",
+    "adaptive": "runtime replanner (exec/adaptive.py) "
+                "-> static plan: measured-statistics decisions (skew "
+                "split, broadcast demotion, coalescing, batch "
+                "right-sizing) are skipped while open",
 }
 
 #: Pallas kernel family (ops/pallas_tier.PALLAS_FAMILIES) -> breaker

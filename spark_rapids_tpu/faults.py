@@ -66,6 +66,8 @@ FAULT_POINTS: Dict[str, str] = {
                         "(exec/pipeline.py PipelinedIterator)",
     "shuffle.ici_exchange": "ICI collective exchange round dispatch "
                             "(exec/exchange.py _ici_exchange_round)",
+    "shuffle.skew_split": "adaptive skew-split sub-read frame "
+                          "(shuffle/manager.py read_partition_maps)",
 }
 
 KINDS = ("io", "device", "corrupt")
@@ -387,6 +389,7 @@ def uniform_spec(prob: float, seed: int, points=None) -> str:
         "shuffle.decode": "corrupt",
         "shuffle.fetch": "io",
         "shuffle.ici_exchange": "device",
+        "shuffle.skew_split": "corrupt",
         "io.multifile_read": "io",
     }
     parts = []

@@ -254,6 +254,13 @@ def with_retry(input_item: T, fn: Callable[[T], R],
                                         task_id=_state.task_id)
                         if split_policy is None:
                             raise
+                        # OOM-feedback batch right-sizing (ISSUE 19):
+                        # the device just proved this batch size wrong —
+                        # shrink the governed query's batch target so
+                        # CoalesceBatchesExec stops rebuilding batches
+                        # that re-trigger this lane
+                        from ..exec import adaptive
+                        adaptive.note_oom_split()
                         halves = split_policy(item)
                         owned.discard(id(item))
                         owned.update(id(h) for h in halves)

@@ -9,7 +9,7 @@ each line a self-describing record:
 Event kinds and their levels (spark.rapids.tpu.eventLog.level):
 
   ESSENTIAL  query_start, query_end, query_cancelled, query_shed,
-             recompile_storm, query_phases
+             recompile_storm, query_phases, adaptive_demote
   MODERATE   op_close, semaphore_acquire, spill, oom_retry,
              pallas_tier, plan_fallback, plan_not_on_tpu, exchange,
              pipeline_wait, pipeline_full, op_error, fault_inject,
@@ -17,7 +17,7 @@ Event kinds and their levels (spark.rapids.tpu.eventLog.level):
              spill_error, spill_writer_dead, task_retry_settle_error,
              partition_recompute, breaker_open, breaker_half_open,
              breaker_close, peer_dead, query_queued, query_admitted,
-             quota_spill, ici_exchange
+             quota_spill, ici_exchange, adaptive_replan
   DEBUG      op_open, op_batch, span
 
 Cost discipline: `active_bus()` returns None when logging is disabled —
@@ -137,6 +137,14 @@ EVENT_LEVELS: Dict[str, int] = {
     # forced it (boundary | concat | output | spill)
     "encoded_scan": MODERATE,
     "encoded_materialize": MODERATE,
+    # adaptive runtime replanning (ISSUE 19): one adaptive_replan
+    # record per applied decision (skew_split / single_build_convert /
+    # partition_coalesce / batch_right_size) with its measured-bytes
+    # evidence and chosen action; adaptive_demote is headline — a
+    # planned strategy measured unaffordable (broadcast_demote) or the
+    # replan lane itself stood down (breaker_open / error)
+    "adaptive_replan": MODERATE,
+    "adaptive_demote": ESSENTIAL,
     "op_open": DEBUG,
     "op_batch": DEBUG,
     "span": DEBUG,

@@ -188,7 +188,7 @@ def process_counters() -> Dict[str, Dict[str, int]]:
     Read only when a store is active (collect checks active_store()
     first), so disabled-mode collects never pay these imports."""
     from ..columnar import encoded, upload
-    from ..exec import workload
+    from ..exec import adaptive, workload
     from ..obs import dispatch as obs_dispatch
     from ..shuffle import manager as shuffle_manager
     return {
@@ -198,6 +198,7 @@ def process_counters() -> Dict[str, Dict[str, int]]:
         "dispatch": obs_dispatch.counters(),
         "workload": workload.counters(),
         "encoded": encoded.counters(),
+        "adaptive": adaptive.counters(),
     }
 
 

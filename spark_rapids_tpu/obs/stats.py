@@ -230,6 +230,11 @@ _global_lock = threading.Lock()
 _global_map_bytes = Log2Hist()
 _global_maps = 0
 _global_last_skew = 0.0
+#: ring of the most recent exchanges' skew summaries — the
+#: TpuSession.health() "stats" surface (ISSUE 19): operators see skew
+#: pressure per exchange without reading the event log
+_RECENT_MAX = 8
+_global_recent: List[Dict[str, Any]] = []
 
 
 class ExchangeRecorder:
@@ -259,6 +264,22 @@ class ExchangeRecorder:
             if total_bytes:
                 _global_map_bytes.add(int(total_bytes))
 
+    def partition_bytes(self) -> Optional[List[int]]:
+        """EXACT per-partition byte totals measured so far (the
+        serializer's own offset sums), or None when no map recorded
+        bytes — the adaptive replanner's evidence (ISSUE 19). A copy:
+        safe to consult while a hybrid drain still records."""
+        st = self._local
+        with st._lock:
+            if st.maps == 0 or not any(st.per_partition_bytes):
+                return None
+            return list(st.per_partition_bytes)
+
+    def total_bytes(self) -> int:
+        """Total serialized bytes measured so far."""
+        with self._local._lock:
+            return self._local.bytes
+
     def finish(self) -> Optional[Dict[str, Any]]:
         global _global_last_skew
         if self._local.maps == 0:
@@ -266,6 +287,14 @@ class ExchangeRecorder:
         out = self._local.summary()
         with _global_lock:
             _global_last_skew = out["skew"]["ratio"]
+            sk = out["skew"]
+            _global_recent.append({
+                "op": f"{out['op']}#{out['op_id']}",
+                "partitions": out["partitions"], "maps": out["maps"],
+                "bytes": out["bytes"], "basis": sk["basis"],
+                "max": sk["max"], "median": sk["median"],
+                "ratio": sk["ratio"]})
+            del _global_recent[:-_RECENT_MAX]
         return out
 
     def finish_and_emit(self) -> Optional[Dict[str, Any]]:
@@ -301,6 +330,20 @@ def counters() -> Dict[str, int]:
         }
 
 
+def health_section() -> Dict[str, Any]:
+    """The TpuSession.health() "stats" block (ISSUE 19 satellite):
+    recent per-exchange max/median skew plus the adaptive decisions
+    taken — the skew-pressure surface operators read instead of the
+    event log."""
+    from ..exec import adaptive
+    with _global_lock:
+        recent = [dict(r) for r in _global_recent]
+        last = _global_last_skew
+    return {"recent_exchanges": recent,
+            "last_skew_ratio": last,
+            "adaptive": adaptive.counters()}
+
+
 def reset_stats() -> None:
     """Test isolation for the process-wide collector."""
     global _global_map_bytes, _global_maps, _global_last_skew
@@ -308,3 +351,4 @@ def reset_stats() -> None:
         _global_map_bytes = Log2Hist()
         _global_maps = 0
         _global_last_skew = 0.0
+        del _global_recent[:]

@@ -878,9 +878,18 @@ class PlanMeta(BaseMeta):
         stream side at all), else shuffled hash join over the mesh, else
         the single-partition hash join. Keyless joins go to the
         (broadcast) nested-loop join."""
-        from ..config import BROADCAST_SIZE_THRESHOLD
+        from ..config import ADAPTIVE_ENABLED, BROADCAST_SIZE_THRESHOLD
         from ..exec.exchange import BroadcastExchangeExec
         thr = self.conf.get(BROADCAST_SIZE_THRESHOLD)
+        # adaptive cap (ISSUE 19): when the runtime replanner is on,
+        # its measured-bytes broadcast cap also bounds the ESTIMATE-
+        # based decision — an estimate past adaptive.autoBroadcastMax
+        # Bytes must not plan a broadcast the replanner would demote
+        if thr >= 0 and self.conf.get(ADAPTIVE_ENABLED):
+            from ..exec import adaptive
+            cap = adaptive.auto_broadcast_max(self.conf)
+            if cap >= 0:
+                thr = min(thr, cap)
         jt = p.join_type
         size_l = estimate_plan_size(p.children[0])
         size_r = estimate_plan_size(p.children[1])

@@ -17,7 +17,7 @@ import struct
 import tempfile
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -342,6 +342,66 @@ class HostShuffleReader:
                 # exchange captured at write time can rewrite just this
                 # map output — consult it before surrendering the whole
                 # attempt to the task-retry lane
+                yield self._recover_block(path, partition, frame_idx, e)
+
+    # -- adaptive skew-split sub-reads (ISSUE 19) ---------------------------
+    def plan_map_groups(self, partition: int, target_bytes: int,
+                        ) -> List[Tuple[List[str], int]]:
+        """Greedy map-output-granular grouping of one partition's
+        segments so each group stays under `target_bytes` (a single
+        oversized map output still gets its own group — maps are the
+        split granularity, ISSUE 6 lineage follows them). Map order is
+        preserved, so the concatenation of the groups' frames IS the
+        unsplit read: integer results stay byte-exact. Uses the cached
+        index tables — no data IO."""
+        groups: List[Tuple[List[str], int]] = []
+        cur: List[str] = []
+        cur_b = 0
+        for path in list(self.handle.map_outputs):
+            offsets = self._index(path)
+            b = offsets[partition + 1] - offsets[partition]
+            if cur and cur_b + b > target_bytes:
+                groups.append((cur, cur_b))
+                cur, cur_b = [], 0
+            cur.append(path)
+            cur_b += b
+        if cur:
+            groups.append((cur, cur_b))
+        return groups
+
+    def read_partition_maps(self, partition: int, paths: Sequence[str],
+                            sub: int, ordinal: List[int],
+                            ) -> Iterator[ColumnarBatch]:
+        """One skew-split sub-read: `partition` restricted to the map
+        outputs in `paths`. Mirrors read_partition's fetch/decode
+        pipelining but bounds the decode window to one sub-read — the
+        memory effect the split exists for. `ordinal` is a shared
+        mutable counter threaded across a partition's sub-reads so the
+        per-frame decode keys stay GLOBALLY numbered in map-output
+        order: seeded `shuffle.decode` chaos draws replay identically
+        with adaptive on or off. The sub-read seam carries its own
+        keyed fault point (`shuffle.skew_split`, work-item key
+        shuffle_id:partition:sub); an injected corrupt frame recovers
+        through the same per-map lineage lane as an unsplit read."""
+        from ..obs import events as obs_events
+        qid = obs_events.current_query_id()
+        key = f"{self.handle.shuffle_id}:{partition}:{sub}"
+        paths = list(paths)
+        segs = list(self._pool.map(
+            lambda path: obs_events.with_query_id(
+                qid, self._fetch_segment, path, partition), paths))
+        jobs = []
+        for path, frames in zip(paths, segs):
+            for i, fr in enumerate(frames):
+                fr = faults.apply("shuffle.skew_split", fr, key=key)
+                jobs.append((path, i, self._pool.submit(
+                    obs_events.with_query_id, qid,
+                    self._decode, fr, f"p{partition}:{ordinal[0]}")))
+                ordinal[0] += 1
+        for path, frame_idx, fut in jobs:
+            try:
+                yield fut.result()
+            except faults.IntegrityError as e:
                 yield self._recover_block(path, partition, frame_idx, e)
 
     def _recover_block(self, path: str, partition: int, frame_idx: int,

@@ -147,6 +147,19 @@ def _stage_compiler_reset():
 
 
 @pytest.fixture(scope="module", autouse=True)
+def _adaptive_counters_reset():
+    """Adaptive-replanner hygiene (ISSUE 19, the dispatch pattern): the
+    decision counters are process-wide and several suites assert exact
+    deltas (skew splits taken, demotions observed) — zero them at
+    module boundaries so one module's replans don't bleed into
+    another's assertions."""
+    from spark_rapids_tpu.exec import adaptive
+    adaptive.reset_adaptive()
+    yield
+    adaptive.reset_adaptive()
+
+
+@pytest.fixture(scope="module", autouse=True)
 def _no_leaked_lifecycle_state():
     """Lifecycle-governor hygiene (ISSUE 6, same pattern as the leaked
     fault plan): a breaker left open would silently demote a kernel

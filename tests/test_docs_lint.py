@@ -115,6 +115,24 @@ def test_breaker_tables_match_registry():
         f"stale={sorted(rows - expected)}")
 
 
+def test_adaptive_decisions_table_matches_registry():
+    """docs/robustness.md's adaptive-execution decision table lists
+    exactly exec.adaptive.DECISIONS (ISSUE 19: the same drift lint the
+    breaker-domain table gets), scoped to the adaptive section."""
+    from spark_rapids_tpu.exec import adaptive
+    docs = (ROOT / "docs" / "robustness.md").read_text()
+    m = re.search(r"## Adaptive execution\n(.*?)(?:\n## |\Z)",
+                  docs, re.DOTALL)
+    assert m, "docs/robustness.md lost its adaptive-execution section"
+    rows = set(re.findall(r"^\|\s*`([a-z_]+)`\s*\|", m.group(1),
+                          re.MULTILINE))
+    expected = set(adaptive.DECISIONS)
+    assert rows == expected, (
+        f"docs/robustness.md adaptive decision table drifted: "
+        f"missing={sorted(expected - rows)} "
+        f"stale={sorted(rows - expected)}")
+
+
 def test_workload_tables_match_registry():
     """docs/robustness.md's workload-governor admission-state and
     priority tables list exactly workload.ADMISSION_STATES /

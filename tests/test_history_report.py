@@ -208,6 +208,40 @@ def test_advisor_skew_stall_upload_quota():
                              "spill_bytes": 0}
 
 
+def test_advisor_partition_skew_sharpens_to_adaptive_one_conf():
+    """Closed loop (ISSUE 19): a skewed capsule whose adaptive family
+    shows ZERO consults (the lane was off) gets the one-conf remedy —
+    enable adaptive.enabled — instead of the manual repartition advice;
+    a capsule where the lane DID consult keeps the static advice."""
+    skew = {"op": "HostShuffleExchangeExec#3", "ratio": 9.5,
+            "basis": "bytes", "partitions": 16}
+    caps = [_capsule("skewed", 1000, ts=1, skew=skew)]
+    (f,) = _findings(caps)
+    assert f["rule"] == "partition-skew"
+    assert f["evidence"]["adaptive_consults"] == 0
+    assert "spark.rapids.tpu.adaptive.enabled" in f["advice"]
+    assert "_advice" not in f["evidence"]
+    caps = [_capsule("skewed", 1000, ts=1, skew=skew,
+                     adaptive={"consults": 4, "skew_splits": 2})]
+    (f,) = _findings(caps)
+    assert f["rule"] == "partition-skew"
+    assert f["evidence"]["skew_splits"] == 2
+    assert "spark.rapids.tpu.adaptive.enabled" not in f["advice"]
+
+
+def test_advisor_adaptive_demotion_storm_golden():
+    """The replan lane repeatedly stood down behind an open `adaptive`
+    breaker: the advisor names the misfiring lane."""
+    caps = [_capsule("flappy", 1000, ts=1,
+                     adaptive={"breaker_demotions": 5, "errors": 3,
+                               "consults": 2, "skew_splits": 1})]
+    (f,) = _findings(caps)
+    assert f["rule"] == "adaptive-demotion-storm"
+    assert f["evidence"] == {"breaker_demotions": 5, "errors": 3,
+                             "skew_splits": 1, "consults": 2}
+    assert "skewedPartitionFactor" in f["advice"]
+
+
 # ---------------------------------------------------------------------------
 # the CLI end-to-end: two history dirs, --diff, advisor, both formats
 # ---------------------------------------------------------------------------

@@ -145,8 +145,12 @@ def test_exchange_stats_skew_and_exact_sums():
 # ---------------------------------------------------------------------------
 
 def _golden_join_session(extra=None):
+    # adaptive OFF: these goldens pin the STATIC plan's telemetry shape
+    # (3 exchanges); the replanner's single-build conversion would
+    # delete the tiny build side's partitioned read from under them
     settings = {"spark.rapids.sql.shuffle.partitions": "3",
-                "spark.rapids.sql.broadcastSizeThreshold": "-1"}
+                "spark.rapids.sql.broadcastSizeThreshold": "-1",
+                "spark.rapids.tpu.adaptive.enabled": "false"}
     settings.update(extra or {})
     sess = TpuSession(settings)
     n_l, n_o = 240, 16

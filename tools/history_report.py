@@ -103,7 +103,7 @@ def aggregate(capsules: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
                 "rows": 0, "spill_bytes": 0, "mesh_devices": 1,
                 "skew": None,
                 "dispatch": {}, "shuffle": {}, "ici": {}, "upload": {},
-                "workload": {}, "encoded": {},
+                "workload": {}, "encoded": {}, "adaptive": {},
             }
         a["count"] += 1
         a["ok"] += 1 if c.get("ok") else 0
@@ -122,7 +122,7 @@ def aggregate(capsules: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
                    or sk.get("ratio", 0) > a["skew"].get("ratio", 0)):
             a["skew"] = sk
         for fam in ("dispatch", "shuffle", "ici", "upload", "workload",
-                    "encoded"):
+                    "encoded", "adaptive"):
             _sum_family(a[fam], c.get(fam))
     for a in by_fp.values():
         walls = sorted(a.pop("walls"))
@@ -213,8 +213,32 @@ def _check_partition_skew(a: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     sk = a.get("skew")
     if not sk or sk.get("ratio", 0) < 4.0:
         return None
-    return {"op": sk.get("op"), "ratio": sk.get("ratio"),
-            "basis": sk.get("basis"), "partitions": sk.get("partitions")}
+    ev = {"op": sk.get("op"), "ratio": sk.get("ratio"),
+          "basis": sk.get("basis"), "partitions": sk.get("partitions"),
+          "adaptive_consults": a["adaptive"].get("consults", 0),
+          "skew_splits": a["adaptive"].get("skew_splits", 0)}
+    # closed loop (ISSUE 19): when the capsule shows the adaptive
+    # replanner never consulted, the remedy is the ONE-CONF fix — the
+    # engine can split this partition itself from the same measured
+    # statistics this rule fired on
+    if ev["adaptive_consults"] == 0:
+        ev["_advice"] = (
+            "enable spark.rapids.tpu.adaptive.enabled — the runtime "
+            "replanner splits the skewed partition into map-granular "
+            "sub-reads from these same measured statistics")
+    return ev
+
+
+def _check_adaptive_demotion_storm(a: Dict[str, Any],
+                                   ) -> Optional[Dict[str, Any]]:
+    ad = a["adaptive"]
+    demotions = ad.get("breaker_demotions", 0)
+    if demotions <= 0:
+        return None
+    return {"breaker_demotions": demotions,
+            "errors": ad.get("errors", 0),
+            "skew_splits": ad.get("skew_splits", 0),
+            "consults": ad.get("consults", 0)}
 
 
 def _check_pipeline_stall(a: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -292,6 +316,16 @@ ADVISOR_RULES: tuple = (
         "the exchange",
         _check_partition_skew),
     AdvisorRule(
+        "adaptive-demotion-storm",
+        "the adaptive replan lane repeatedly stood down (open "
+        "`adaptive` breaker) while serving this plan — its decisions "
+        "are misfiring, not helping",
+        "raise spark.rapids.tpu.adaptive.skewedPartitionFactor so "
+        "only extreme skew triggers replanning, or pin "
+        "spark.rapids.tpu.adaptive.enabled off for this workload; the "
+        "adaptive_demote events carry the failing decision",
+        _check_adaptive_demotion_storm),
+    AdvisorRule(
         "pipeline-stall",
         "the query spends >= 30% of wall-clock blocked on pipeline "
         "producers (consumer starvation)",
@@ -337,9 +371,13 @@ def advise(agg: Dict[str, Dict[str, Any]]) -> List[Dict[str, Any]]:
         for rule in ADVISOR_RULES:
             ev = rule.check(a)
             if ev is not None:
+                # a check may override the static remedy with a
+                # sharper, evidence-specific one (the partition-skew
+                # one-conf adaptive fix)
+                advice = ev.pop("_advice", rule.advice)
                 findings.append({"rule": rule.id, "fingerprint": fp,
                                  "summary": rule.summary,
-                                 "advice": rule.advice, "evidence": ev})
+                                 "advice": advice, "evidence": ev})
         del a["_total_quota_spills"]
     return findings
 

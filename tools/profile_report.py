@@ -218,6 +218,8 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
     ici_ok = [e for e in ici if not e.get("fallback")]
     escan = [e for e in events if e.get("kind") == "encoded_scan"]
     emat = [e for e in events if e.get("kind") == "encoded_materialize"]
+    replans = [e for e in events if e.get("kind") == "adaptive_replan"]
+    demotes = [e for e in events if e.get("kind") == "adaptive_demote"]
     waits = [e.get("wait_ms") or 0 for e in events
              if e.get("kind") == "query_admitted"]
     qphases = [e for e in events if e.get("kind") == "query_phases"]
@@ -380,6 +382,36 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
                  "p95_partition_bytes": e.get("p95_partition_bytes"),
                  "p95_map_output_bytes": e.get("p95_map_output_bytes")}
                 for e in xstats]},
+        # adaptive-execution roll-up (ISSUE 19): what the runtime
+        # replanner DID with the measured statistics above — decision
+        # counts by kind plus each decision's evidence record
+        "adaptive": {
+            "replans": len(replans),
+            "demotes": len(demotes),
+            "skew_splits": sum(1 for e in replans
+                               if e.get("decision") == "skew_split"),
+            "broadcast_demotes": sum(
+                1 for e in demotes
+                if e.get("decision") == "broadcast_demote"),
+            "single_build_converts": sum(
+                1 for e in replans
+                if e.get("decision") == "single_build_convert"),
+            "partition_coalesces": sum(
+                1 for e in replans
+                if e.get("decision") == "partition_coalesce"),
+            "batch_right_sizes": sum(
+                1 for e in replans
+                if e.get("decision") == "batch_right_size"),
+            "lane_demotions": sum(1 for e in demotes
+                                  if e.get("decision") == "lane"),
+            "decisions": [
+                {k: e.get(k) for k in
+                 ("kind", "exec", "op_id", "decision", "reason",
+                  "partition", "bytes", "measured_bytes", "threshold",
+                  "median_bytes", "subs", "max_sub_bytes", "basis",
+                  "reads", "target_bytes", "prev_target", "new_target")
+                 if e.get(k) is not None}
+                for e in replans + demotes]},
     }
     return summary
 
@@ -612,6 +644,19 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
             f"{_fmt_bytes(st['p95_map_output_bytes'])}")
     if st["telemetry_samples"]:
         extras.append(f"telemetry samples: {st['telemetry_samples']}")
+    # adaptive-execution roll-up (ISSUE 19): what the runtime replanner
+    # did with those measured statistics — reads right under the skew
+    # line it acted on
+    ad = s["adaptive"]
+    if ad["replans"] or ad["demotes"]:
+        extras.append(
+            f"adaptive decisions: {ad['skew_splits']} skew split(s), "
+            f"{ad['broadcast_demotes']} broadcast demotion(s), "
+            f"{ad['single_build_converts']} single-build conversion(s), "
+            f"{ad['partition_coalesces']} coalesce(s), "
+            f"{ad['batch_right_sizes']} batch right-sizing(s)"
+            + (f", {ad['lane_demotions']} lane stand-down(s)"
+               if ad["lane_demotions"] else ""))
     if extras:
         lines.append("")
         lines.extend(extras)
