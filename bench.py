@@ -517,6 +517,19 @@ def adaptive_attribution():
     return _delta_since("adaptive", adaptive_engine.counters())
 
 
+def speculation_attribution():
+    """{"speculation": ...} block for each BENCH record (ISSUE 20):
+    straggler-shield activity — stall episodes and their actions,
+    speculative sub-reads launched/won/denied, post-bound wait ns,
+    dispatch-timeout trips, dead-peer invalidations
+    (exec/speculation_shield.py counters, as deltas since the previous
+    record). All zeros with the shield's confs at defaults — a chaos
+    round with delay injection reads spec_wins next to shuffle to see
+    what racing the tail bought."""
+    from spark_rapids_tpu.exec import speculation_shield
+    return _delta_since("speculation", speculation_shield.counters())
+
+
 def dispatch_attribution():
     """{"dispatch": ...} block for each BENCH record (ISSUE 13):
     compiled programs, program dispatches, fresh traces vs jit cache
@@ -818,6 +831,7 @@ def main():
         "encoded": encoded_attribution(),
         "dispatch": dispatch_attribution(),
         "adaptive": adaptive_attribution(),
+        "speculation": speculation_attribution(),
         "stage": stage_attribution(),
         "telemetry": telemetry_attribution(),
         "statistics": statistics_attribution(),
@@ -998,6 +1012,7 @@ def q3_bench():
         "encoded": encoded_attribution(),
         "dispatch": dispatch_attribution(),
         "adaptive": adaptive_attribution(),
+        "speculation": speculation_attribution(),
         "stage": stage_attribution(),
         "telemetry": telemetry_attribution(),
         "statistics": statistics_attribution(),

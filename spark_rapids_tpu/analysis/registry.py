@@ -155,9 +155,9 @@ class RuleMeta:
 
 
 def _build_rules() -> Dict[str, RuleMeta]:
-    from . import (rules_accounting, rules_conf, rules_dispatch,
-                   rules_locks, rules_registry, rules_stage,
-                   rules_threads, rules_trace)
+    from . import (rules_accounting, rules_bounded, rules_conf,
+                   rules_dispatch, rules_locks, rules_registry,
+                   rules_stage, rules_threads, rules_trace)
     rules = [
         RuleMeta(
             "lock-blocking-call", "lock-discipline",
@@ -182,6 +182,16 @@ def _build_rules() -> Dict[str, RuleMeta]:
             "deadlock analysis",
             "taking the catalog lock while holding the event-bus lock",
             rules_locks.check_order),
+        RuleMeta(
+            "bounded-wait", "lock-discipline",
+            "unbounded blocking rendezvous — wait/get/result/sleep "
+            "with no positional args and no timeout= keyword parks "
+            "its thread beyond every watchdog, deadline and "
+            "cancellation poll",
+            "ISSUE 20 (straggler & stall shield: stalls the shield "
+            "cannot observe cannot be mitigated)",
+            "self._done.wait() / fut.result() with no timeout",
+            rules_bounded.check),
         RuleMeta(
             "thread-adopt", "thread-propagation",
             "threading.Thread / pool submit whose target never routes "

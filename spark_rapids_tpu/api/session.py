@@ -96,9 +96,14 @@ class TpuSession:
         from ..exec import lifecycle
         from ..obs import dispatch, telemetry
         from ..obs import stats as obs_stats
+        from ..parallel import heartbeat
         out = lifecycle.health()
         out["telemetry"] = telemetry.health_section()
         out["dispatch"] = dispatch.health_section()
+        # peer liveness registry (ISSUE 20): live/dead peers, lifetime
+        # purges and blacklisted slots — {"enabled": False} in the
+        # default single-process session (no installed manager)
+        out["peers"] = heartbeat.health_section()
         # per-priority-class wall-clock percentiles over the telemetry
         # registry's latency ring (ISSUE 17) — {"enabled": False} when
         # telemetry is off
@@ -461,6 +466,13 @@ class DataFrame:
             # attempt and its backoff — so sum(phases) == query wall
             if self.session.conf.get(PHASES_ENABLED):
                 obs_phase.attach(ctx)
+            # progress watchdog (ISSUE 20): armed only when
+            # stall.timeoutMs > 0, after the ledger (its query_stalled
+            # event reads the dominant phase mid-flight); stopped in
+            # the same finally chain that closes the query books
+            from ..exec import speculation_shield
+            watchdog = speculation_shield.watchdog_for(
+                ctx, self.session.conf)
             # history capsule (ISSUE 17): default-off = this one
             # pointer check; the counter snapshot is read only when a
             # store is actually installed
@@ -482,6 +494,8 @@ class DataFrame:
                     ok = True
                     return out
             finally:
+                if watchdog is not None:
+                    watchdog.stop()
                 self._finish_query(ctx, ok, store, before,
                                    _time.perf_counter_ns() - t0)
 

@@ -564,7 +564,8 @@ TEST_RETRY_OOM_INJECTION_MODE = conf_str(
 TEST_FAULTS = conf_str(
     "spark.rapids.tpu.test.faults", "",
     "Seeded chaos injection at the registered fault points (faults.py): "
-    "'<point>:prob=P,seed=S,kind=io|device|corrupt[,max=N][;...]'. "
+    "'<point>:prob=P,seed=S,kind=io|device|corrupt|delay[,max=N]"
+    "[,ms=N][;...]'. "
     "Decisions are a pure hash of (seed, point, task_id, call_index), "
     "so any chaos failure replays exactly. Empty (default) = injection "
     "off, one pointer check per site.", internal=True)
@@ -641,6 +642,83 @@ PARTITION_RECOVERY_ENABLED = conf_bool(
     "re-running the whole query through the task-retry lane. Ambiguous "
     "provenance (spill files, missing lineage, repeated corruption of "
     "one map output) still falls back to whole-plan re-execution.")
+
+STALL_TIMEOUT_MS = conf_int(
+    "spark.rapids.tpu.stall.timeoutMs", 0,
+    "Progress watchdog for governed queries (exec/speculation_shield.py "
+    "— distinct from the total-wall query.timeoutMs deadline): a query "
+    "whose driving seam advances no root-output batches or rows for "
+    "this many ms emits one query_stalled event (ESSENTIAL, with the "
+    "ledger phase the time went into and the stalled operator) and "
+    "takes stall.action. 0 (default) disables the watchdog — no "
+    "monitor thread, one conf read per collect.")
+
+STALL_ACTION = conf_str(
+    "spark.rapids.tpu.stall.action", "report",
+    "What the progress watchdog does when a governed query stalls past "
+    "stall.timeoutMs: 'report' only emits the query_stalled event; "
+    "'retry-seam' additionally fails the stalled attempt with a "
+    "transient TpuTaskRetryError at its next cancellation checkpoint, "
+    "routing it onto the bounded task-retry lane; 'cancel' cancels the "
+    "query cooperatively (QueryCancelledError, reason 'stalled').")
+
+SHUFFLE_SPECULATION_ENABLED = conf_bool(
+    "spark.rapids.tpu.shuffle.speculation.enabled", False,
+    "Speculative shuffle sub-reads (exec/speculation_shield.py + "
+    "shuffle/manager.py): when one per-(map,frame) fetch or decode "
+    "future exceeds a latency bound derived from the reader's own "
+    "measured distribution (Log2Hist p95 x speculation.multiplier, "
+    "floored at speculation.minMs), launch ONE duplicate attempt under "
+    "a 'spec:' work-item key — first result wins, the loser is "
+    "cancelled or discarded. Bounded by speculation.maxInFlight per "
+    "query; each resolution emits a speculative_fetch event. Off "
+    "(default) keeps the plain unbounded-wait read path, one conf read "
+    "per reader.")
+
+SHUFFLE_SPECULATION_MULTIPLIER = conf_float(
+    "spark.rapids.tpu.shuffle.speculation.multiplier", 3.0,
+    "Latency-bound factor for speculative shuffle sub-reads: a fetch/"
+    "decode is considered straggling once it exceeds multiplier x the "
+    "reader's measured p95 for that stage (Spark's "
+    "spark.speculation.multiplier analog, against measured quantiles "
+    "instead of task medians).")
+
+SHUFFLE_SPECULATION_MIN_MS = conf_int(
+    "spark.rapids.tpu.shuffle.speculation.minMs", 100,
+    "Floor on the speculative-read latency bound: a fetch/decode is "
+    "never speculated before this many ms regardless of how fast the "
+    "measured p95 says the stage usually is — cold histograms and "
+    "microsecond-fast local reads must not trigger duplicate work.")
+
+SHUFFLE_SPECULATION_MAX_INFLIGHT = conf_int(
+    "spark.rapids.tpu.shuffle.speculation.maxInFlight", 2,
+    "Speculative duplicate attempts one query may have in flight at "
+    "once. A straggling future past the bound with no free slot keeps "
+    "waiting on its primary (counted speculative_denied) — duplicates "
+    "ride the existing bounded reader pool and are never free "
+    "admission-path work.")
+
+DISPATCH_TIMEOUT_MS = conf_int(
+    "spark.rapids.tpu.dispatch.timeoutMs", 0,
+    "Hang bound on guarded device dispatch (obs/dispatch.py chokepoint "
+    "and the ICI collective seam): a dispatched program not ready "
+    "after this many ms emits dispatch_timeout, records a "
+    "device_dispatch (or ici_exchange) breaker failure, and raises a "
+    "transient task-lane error — the wedged call is abandoned on its "
+    "watchdog thread instead of hanging the process. 0 (default) "
+    "disables the bound: dispatch runs inline with no helper thread.")
+
+DEAD_PEER_INVALIDATION_ENABLED = conf_bool(
+    "spark.rapids.tpu.shuffle.deadPeerInvalidation.enabled", True,
+    "Dead-peer map-output invalidation (parallel/heartbeat.py + "
+    "shuffle/manager.py): a peer_dead transition invalidates the map "
+    "outputs registered to that peer, so the next read of one routes "
+    "through the partition-granular recompute lane (lineage re-executes "
+    "only the producing sub-plan) instead of trusting a dead "
+    "executor's shards — Spark's fetch-failure map-output invalidation, "
+    "single-process edition. The peer's slot stays blacklisted until "
+    "it re-registers. Requires an installed heartbeat manager; without "
+    "one (the default single-process session) nothing changes.")
 
 ADAPTIVE_ENABLED = conf_bool(
     "spark.rapids.tpu.adaptive.enabled", True,

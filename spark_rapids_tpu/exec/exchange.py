@@ -1092,7 +1092,13 @@ class HostShuffleExchangeExec(TpuExec):
         # attribution (ISSUE 17): the whole measured round — stack,
         # measure, all-to-all step, unstack — is ici-collective; the
         # span keeps its cached dispatches out of device-compute
+        # a round's collective programs hang-bound (when
+        # dispatch.timeoutMs > 0) against the ici_exchange breaker, so
+        # a wedged all-to-all degrades to the host lane like any other
+        # classified-transient round failure (ISSUE 20)
+        from . import speculation_shield
         with obs_phase.span("ici-collective"), \
+                speculation_shield.dispatch_domain("ici_exchange"), \
                 self.batch_harness(fault_point="shuffle.ici_exchange",
                                    fault_key=f"r{round_idx}",
                                    metric_scope=True):

@@ -131,7 +131,7 @@ class QueryContext:
                  "phase", "current_op", "root_op_id", "batches_produced",
                  "rows_produced", "attempt_no", "spill_count",
                  "spill_bytes", "runtime_stats", "phase_ledger",
-                 "events_qid", "adaptive_batch_target")
+                 "events_qid", "adaptive_batch_target", "stall_retry")
 
     def __init__(self, timeout_ms: int = 0, check_every: int = 8,
                  owner: Any = None):
@@ -192,6 +192,12 @@ class QueryContext:
         #: across attempts (unlike runtime_stats) — the signal is about
         #: the query's data shape, not one attempt's luck
         self.adaptive_batch_target: Optional[int] = None
+        #: progress-watchdog verdict under stall.action=retry-seam
+        #: (exec/speculation_shield.py): set by the watchdog thread,
+        #: consumed ONCE by check() at the stalled attempt's next
+        #: cancellation checkpoint — the seam raises a transient
+        #: QueryStalledError onto the bounded task-retry lane
+        self.stall_retry = False
 
     def note_batch(self, op: str, op_id: int,
                    rows: Optional[int]) -> None:
@@ -256,6 +262,15 @@ class QueryContext:
         its deadline. The FIRST checker (any thread) emits the single
         `query_cancelled` event with its phase attribution — that is the
         wait the query actually died in."""
+        if self.stall_retry:
+            # watchdog retry-seam verdict: consume the flag (a retried
+            # attempt starts clean) and fail THIS attempt transiently —
+            # it routes onto the task-retry lane, not the fatal unwind
+            self.stall_retry = False
+            from ..faults import QueryStalledError
+            raise QueryStalledError(
+                f"query stalled at seam {self.current_op!r}; retrying "
+                f"the attempt (noticed in phase {phase})")
         if not self.cancelled():
             return
         reason = self.reason or "user"

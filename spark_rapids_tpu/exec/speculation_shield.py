@@ -1,0 +1,495 @@
+"""Straggler & stall shield (ISSUE 20 tentpole): the tail-latency
+control loop that turns the heartbeat, stats and phase planes from
+passive reporting into active mitigation.
+
+The reference stack's answers to the tail are Spark's speculative
+execution (a task running past `spark.speculation.multiplier` x the
+median gets a duplicate attempt, first result wins) and fetch-failure
+handling (a dead executor's map outputs are invalidated and recomputed
+from lineage, not re-fetched forever). Theseus (PAPERS.md) shows
+distributed accelerator pipelines gate on their slowest data-movement
+participant; this module rebuilds the mitigation loop for the
+single-process multi-thread engine, in four conf-gated pieces:
+
+* **Progress watchdog** (`ProgressWatchdog`) — distinct from the
+  total-wall `query.timeoutMs` deadline: a governed query whose driving
+  seam advances no root batches/rows for
+  `spark.rapids.tpu.stall.timeoutMs` emits ONE `query_stalled` event
+  (ESSENTIAL — with the stalled operator and the dominant phase from
+  the PR 17 ledger, read mid-flight without closing its books) and
+  takes `stall.action`: `report` | `retry-seam` (fail the attempt with
+  a transient error at its next cancellation checkpoint, onto the
+  bounded task-retry lane) | `cancel`. Re-arms after each episode.
+
+* **Speculative shuffle sub-reads** (`ReadSpeculation`) — a
+  fetch/decode future that exceeds a latency bound derived from the
+  reader's OWN measured distribution (Log2Hist p95 x
+  `speculation.multiplier`, floored at `speculation.minMs`) gets ONE
+  duplicate attempt under a `spec:` work-item key; first result wins,
+  the loser is cancelled or discarded. In-flight speculations are
+  bounded per query (`speculation.maxInFlight`) — a denied straggler
+  keeps waiting on its primary. Duplicates ride the bounded reader
+  pool: never free admission-path work.
+
+* **Dispatch hang bound** (`timed_call`) — a watchdog-timed
+  block-until-ready wrapper at the dispatch-ledger chokepoint and the
+  ICI collective seam: a wedged device program classifies as a
+  transient task error after `dispatch.timeoutMs` (breaker domain
+  `device_dispatch` / `ici_exchange`), instead of hanging the process.
+
+* **Dead-peer invalidation glue** (`on_peer_dead`) — the
+  HeartbeatManager's `peer_dead` transition invalidates that peer's
+  registered map outputs in the shuffle registry
+  (shuffle/manager.HostShuffleManager.invalidate_peer_outputs), so the
+  next read routes through the PR 5 partition-granular recompute lane;
+  the peer's slot stays blacklisted until it re-registers.
+
+Cost discipline: every capability defaults off (the dead-peer lane
+defaults on but requires an installed heartbeat manager, absent in the
+default single-process session) and costs one conf/pointer check when
+off. Counters are process-cumulative (`counters()`), deltaed per bench
+record and rolled into the history capsule `speculation` family.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED
+from concurrent.futures import TimeoutError as FuturesTimeout
+from concurrent.futures import wait as futures_wait
+from typing import Callable, Dict, Optional
+
+#: what the progress watchdog may do on a stall —
+#: docs/robustness.md's STALL_ACTIONS table is lint-checked against
+#: this tuple (tests/test_docs_lint.py), like BREAKER_DOMAINS
+STALL_ACTIONS = ("report", "retry-seam", "cancel")
+
+
+# ---------------------------------------------------------------------------
+# counters (bench.py {"speculation": ...} deltas + profile_report roll-up)
+# ---------------------------------------------------------------------------
+
+_counter_lock = threading.Lock()
+_counters: Dict[str, int] = {
+    "stalls": 0,
+    "stall_retries": 0,
+    "stall_cancels": 0,
+    "spec_launched": 0,
+    "spec_wins": 0,
+    "spec_primary_wins": 0,
+    "spec_denied": 0,
+    "spec_wait_ns": 0,
+    "dispatch_timeouts": 0,
+    "peer_invalidations": 0,
+    "outputs_invalidated": 0,
+}
+
+
+def _count(key: str, n: int = 1) -> None:
+    with _counter_lock:
+        _counters[key] += n
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of the process-cumulative shield counters — one dict so
+    bench.py can delta it per record (chaos-delta pattern)."""
+    with _counter_lock:
+        return dict(_counters)
+
+
+def reset_shield() -> None:
+    """Test isolation: zero the counters and drop per-query speculation
+    slots (the conftest reset companion)."""
+    with _counter_lock:
+        for k in _counters:
+            _counters[k] = 0
+    with _slot_lock:
+        _slots.clear()
+
+
+# ---------------------------------------------------------------------------
+# progress watchdog
+# ---------------------------------------------------------------------------
+
+class ProgressWatchdog:
+    """One daemon monitor per governed query (armed by
+    `TpuSession.collect` when `stall.timeoutMs` > 0). Polls the
+    QueryContext's root-output progress counters — the note_batch
+    attribute writes the governor already pays for — and fires when
+    they freeze for the configured window. Always stop()ed by the
+    collect finally; a leaked thread still dies with the process
+    (daemon) and goes quiet as soon as the poll sees the stop flag."""
+
+    def __init__(self, ctx, timeout_ms: int, action: str):
+        self.ctx = ctx
+        self.timeout_s = max(1, int(timeout_ms)) / 1000.0
+        self.action = action if action in STALL_ACTIONS else "report"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        # poll a few times per window so a stall is noticed within
+        # ~1.25x the timeout, capped at 1s so short windows stay sharp
+        interval = min(max(self.timeout_s / 4.0, 0.005), 1.0)
+        # contract: ok thread-adopt — the watchdog observes ONE query's
+        # context (held directly, not via thread-locals) and attributes
+        # its event through with_query_id at emit time
+        self._thread = threading.Thread(
+            target=self._loop, args=(interval,), daemon=True,
+            name=f"stall-watchdog-{self.ctx.ctx_id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+
+    def _progress(self) -> tuple:
+        c = self.ctx
+        # attempt_no participates: a task retry resets batch counts to
+        # zero, which must read as progress (the retry lane is moving),
+        # not as a frozen seam
+        return (c.attempt_no, c.batches_produced, c.rows_produced)
+
+    def _loop(self, interval: float) -> None:
+        last = self._progress()
+        last_t = time.monotonic()
+        while not self._stop.wait(interval):
+            cur = self._progress()
+            now = time.monotonic()
+            if cur != last:
+                last, last_t = cur, now
+                continue
+            if now - last_t < self.timeout_s:
+                continue
+            self._fire(now - last_t)
+            # re-arm: a query still frozen fires again only after
+            # another FULL window (one event per stall episode)
+            last_t = now
+
+    def _fire(self, stalled_s: float) -> None:
+        ctx = self.ctx
+        _count("stalls")
+        led = ctx.phase_ledger
+        phase = led.dominant_phase() if led is not None else None
+        from ..obs import events as obs_events
+        obs_events.with_query_id(
+            ctx.events_qid, obs_events.emit, "query_stalled",
+            stalled_ms=int(stalled_s * 1000),
+            timeout_ms=int(self.timeout_s * 1000),
+            action=self.action, seam=ctx.current_op, phase=phase,
+            attempt=ctx.attempt_no, batches=ctx.batches_produced,
+            rows=ctx.rows_produced)
+        if self.action == "cancel":
+            _count("stall_cancels")
+            ctx.cancel("stalled")
+        elif self.action == "retry-seam":
+            _count("stall_retries")
+            # consumed (and cleared) by QueryContext.check at the
+            # stalled attempt's next cancellation checkpoint: the seam
+            # raises a transient error onto the task-retry lane
+            ctx.stall_retry = True
+
+
+def watchdog_for(ctx, conf) -> Optional[ProgressWatchdog]:
+    """The collect()-seam constructor: a started watchdog when
+    `stall.timeoutMs` > 0, else None (one conf read — the entire
+    disabled-mode cost)."""
+    from ..config import STALL_ACTION, STALL_TIMEOUT_MS
+    timeout_ms = conf.get(STALL_TIMEOUT_MS)
+    if not timeout_ms or timeout_ms <= 0:
+        return None
+    dog = ProgressWatchdog(ctx, timeout_ms, conf.get(STALL_ACTION))
+    dog.start()
+    return dog
+
+
+# ---------------------------------------------------------------------------
+# speculative shuffle sub-reads
+# ---------------------------------------------------------------------------
+
+#: per-query in-flight speculation slots (key: governed ctx_id, or None
+#: for ungoverned readers — still bounded, process-wide)
+_slot_lock = threading.Lock()
+_slots: Dict[Optional[int], int] = {}
+
+
+def _slot_key() -> Optional[int]:
+    from . import lifecycle
+    ctx = lifecycle.current_context()
+    return ctx.ctx_id if ctx is not None else None
+
+
+def _take_slot(max_inflight: int) -> bool:
+    key = _slot_key()
+    with _slot_lock:
+        n = _slots.get(key, 0)
+        if n >= max_inflight:
+            return False
+        _slots[key] = n + 1
+        return True
+
+
+def _release_slot() -> None:
+    key = _slot_key()
+    with _slot_lock:
+        n = _slots.get(key, 0) - 1
+        if n <= 0:
+            _slots.pop(key, None)
+        else:
+            _slots[key] = n
+
+
+class ReadSpeculation:
+    """Per-reader speculative sub-read policy: measured fetch/decode
+    latency histograms (ms), the derived straggler bound, and the
+    first-result-wins race. One instance per HostShuffleReader when
+    `shuffle.speculation.enabled`; the reader keeps its plain
+    unbounded-wait path untouched when off."""
+
+    __slots__ = ("multiplier", "min_ms", "max_inflight", "_hists",
+                 "_lock")
+
+    def __init__(self, multiplier: float, min_ms: int,
+                 max_inflight: int):
+        from ..obs.stats import Log2Hist
+        self.multiplier = max(1.0, float(multiplier))
+        self.min_ms = max(1, int(min_ms))
+        self.max_inflight = max(1, int(max_inflight))
+        self._hists = {"fetch": Log2Hist(), "decode": Log2Hist()}
+        self._lock = threading.Lock()
+
+    def timed(self, stage: str, fn, *args):
+        """Pool-side wrapper: run the fetch/decode and record its
+        latency into the stage's histogram — the distribution the
+        straggler bound derives from."""
+        t0 = time.perf_counter_ns()
+        out = fn(*args)
+        ms = (time.perf_counter_ns() - t0) // 1_000_000
+        with self._lock:
+            self._hists[stage].add(int(ms))
+        return out
+
+    def bound_ms(self, stage: str) -> int:
+        """The straggler bound for `stage`: measured p95 x multiplier,
+        floored at min_ms (a cold histogram or microsecond-fast local
+        reads must not trigger duplicate work)."""
+        with self._lock:
+            p95 = self._hists[stage].percentile(95)
+        return max(int(p95 * self.multiplier), self.min_ms)
+
+    def resolve(self, stage: str, primary, launch: Callable[[], object],
+                key: str):
+        """Wait on `primary` up to the stage's straggler bound; past it,
+        take an in-flight slot and launch ONE duplicate via `launch()`
+        (a zero-arg returning a Future keyed `spec:<key>`). First
+        successful result wins; the loser is cancelled (a running loser
+        is discarded when its pool slot drains). A denied straggler —
+        no free slot — keeps waiting on its primary. Failure semantics:
+        a failed loser is ignored while the other attempt is pending;
+        both failing surfaces the primary's error (it carries the real
+        fault identity)."""
+        bound_s = self.bound_ms(stage) / 1000.0
+        try:
+            return primary.result(timeout=bound_s)
+        except FuturesTimeout:
+            pass
+        t0 = time.perf_counter_ns()
+        if not _take_slot(self.max_inflight):
+            _count("spec_denied")
+            try:
+                return self._await(primary)
+            finally:
+                self._note_wait(t0)
+        _count("spec_launched")
+        spec = None
+        try:
+            spec = launch()
+            winner, out, err = self._race(primary, spec)
+        except BaseException:
+            # cancelled mid-race (deadline / user): drop both attempts
+            primary.cancel()
+            if spec is not None:
+                spec.cancel()
+            raise
+        finally:
+            _release_slot()
+        wait_ns = self._note_wait(t0)
+        if winner == "spec":
+            _count("spec_wins")
+        elif winner == "primary":
+            _count("spec_primary_wins")
+        from ..obs import events as obs_events
+        obs_events.emit("speculative_fetch", stage=stage, key=key,
+                        winner=winner, bound_ms=int(bound_s * 1000),
+                        wait_ms=wait_ns // 1_000_000)
+        if err is not None:
+            raise err
+        return out
+
+    def _note_wait(self, t0: int) -> int:
+        """Accrue the post-bound wait (straggler exposure the shield
+        raced against) into the shield counters and the PR 17 phase
+        ledger's `spec-wait` phase. This runs on a pipeline
+        producer/consumer thread: a producer-side accrual lands in the
+        ledger's folded map and re-attributes pipeline-stall budget, so
+        `sum(phases) == wall_ns` holds unchanged."""
+        ns = time.perf_counter_ns() - t0
+        _count("spec_wait_ns", int(ns))
+        from ..obs import phase as obs_phase
+        obs_phase.add("spec-wait", int(ns))
+        return int(ns)
+
+    def _await(self, fut):
+        """Bounded-poll wait on one future, honoring cooperative
+        cancellation between polls (the denied-slot path)."""
+        from . import lifecycle
+        while True:
+            try:
+                return fut.result(timeout=0.05)
+            except FuturesTimeout:
+                lifecycle.check_current("pipeline-wait")
+
+    def _race(self, primary, spec):
+        """First successful result of the two attempts. Returns
+        (winner, result, error): error is set only when BOTH failed."""
+        pending = {primary: "primary", spec: "spec"}
+        errs: Dict[str, BaseException] = {}
+        from . import lifecycle
+        while pending:
+            done, _ = futures_wait(list(pending), timeout=0.05,
+                                   return_when=FIRST_COMPLETED)
+            if not done:
+                lifecycle.check_current("pipeline-wait")
+                continue
+            for fut in done:
+                who = pending.pop(fut)
+                err = fut.exception()
+                if err is None:
+                    for loser in pending:
+                        loser.cancel()
+                    # contract: ok bounded-wait — fut came from the
+                    # FIRST_COMPLETED done set: already resolved,
+                    # result() returns without blocking
+                    return who, fut.result(), None
+                errs[who] = err
+        return "none", None, errs.get("primary") or errs.get("spec")
+
+
+def reader_speculation(conf) -> Optional[ReadSpeculation]:
+    """The HostShuffleReader constructor hook: a ReadSpeculation when
+    `shuffle.speculation.enabled`, else None (one conf read — the
+    entire disabled-mode cost; the reader's plain path is untouched)."""
+    from ..config import (SHUFFLE_SPECULATION_ENABLED,
+                          SHUFFLE_SPECULATION_MAX_INFLIGHT,
+                          SHUFFLE_SPECULATION_MIN_MS,
+                          SHUFFLE_SPECULATION_MULTIPLIER)
+    if not conf.get(SHUFFLE_SPECULATION_ENABLED):
+        return None
+    return ReadSpeculation(conf.get(SHUFFLE_SPECULATION_MULTIPLIER),
+                           conf.get(SHUFFLE_SPECULATION_MIN_MS),
+                           conf.get(SHUFFLE_SPECULATION_MAX_INFLIGHT))
+
+
+# ---------------------------------------------------------------------------
+# dispatch hang bound
+# ---------------------------------------------------------------------------
+
+def timed_call(fn: Callable[[], object], timeout_ms: int, domain: str,
+               what: str):
+    """Run the zero-arg `fn` (a device dispatch + block-until-ready)
+    under a hang bound: past `timeout_ms` the call is abandoned on its
+    daemon helper thread, a `dispatch_timeout` event fires, the breaker
+    domain records a failure, and a transient DispatchTimeoutError
+    routes the attempt onto the task-retry lane — the process never
+    wedges behind a hung device program. One thread spawn per call: the
+    bound is an opt-in diagnostic (`dispatch.timeoutMs`, default 0 =
+    this function is never reached)."""
+    box: Dict[str, object] = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["out"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed below
+            box["err"] = e
+        finally:
+            done.set()
+
+    # contract: ok thread-adopt — the caller's closure carries every
+    # thread-local it needs (the dispatch ledger adopts its pending
+    # frame inside fn); nothing else on this helper emits or reads
+    # query state
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"dispatch-shield-{domain}")
+    t.start()
+    if not done.wait(max(1, int(timeout_ms)) / 1000.0):
+        _count("dispatch_timeouts")
+        from ..obs import events as obs_events
+        obs_events.emit("dispatch_timeout", domain=domain, what=what,
+                        timeout_ms=int(timeout_ms))
+        from . import lifecycle
+        lifecycle.record_domain_failure(domain)
+        from ..faults import DispatchTimeoutError
+        raise DispatchTimeoutError(
+            f"{what}: device program not ready after {timeout_ms}ms "
+            f"(domain {domain}); abandoning the dispatch to the "
+            f"task-retry lane")
+    err = box.get("err")
+    if err is not None:
+        raise err
+    return box.get("out")
+
+
+def dispatch_timeout_ms(conf=None) -> int:
+    """The configured hang bound (0 = off) — read by the dispatch
+    ledger's configure() and the ICI seam, never per dispatch."""
+    from ..config import DISPATCH_TIMEOUT_MS, active_conf
+    conf = conf if conf is not None else active_conf()
+    return max(0, int(conf.get(DISPATCH_TIMEOUT_MS)))
+
+
+#: breaker-domain override for hang-bounded dispatches: the ICI
+#: exchange round sets "ici_exchange" so a wedged collective records
+#: against the breaker that already owns host-lane degradation, not the
+#: generic device_dispatch domain
+_domain_tls = threading.local()
+
+
+@contextlib.contextmanager
+def dispatch_domain(domain: str):
+    """Dispatches hang-bounded inside this block attribute their
+    timeout to `domain` (see `_domain_tls`). Nests; restores on exit."""
+    prev = getattr(_domain_tls, "domain", None)
+    _domain_tls.domain = domain
+    try:
+        yield
+    finally:
+        _domain_tls.domain = prev
+
+
+def current_dispatch_domain() -> str:
+    return getattr(_domain_tls, "domain", None) or "device_dispatch"
+
+
+# ---------------------------------------------------------------------------
+# dead-peer map-output invalidation glue
+# ---------------------------------------------------------------------------
+
+def on_peer_dead(executor_id: str) -> None:
+    """The HeartbeatManager.on_peer_dead callback (wired by
+    parallel.heartbeat.install): invalidate the dead peer's registered
+    map outputs so the next read recovers through the
+    partition-granular lane. Conf-gated; runs outside the heartbeat
+    lock, on whatever thread noticed the transition."""
+    from ..config import DEAD_PEER_INVALIDATION_ENABLED, active_conf
+    if not active_conf().get(DEAD_PEER_INVALIDATION_ENABLED):
+        return
+    from ..shuffle.manager import shuffle_manager
+    n = shuffle_manager().invalidate_peer_outputs(executor_id)
+    if n:
+        _count("peer_invalidations")
+        _count("outputs_invalidated", n)

@@ -14,8 +14,8 @@ commit protocol. Rebuilt here for a single-process multi-thread engine:
   module-global pointer check (`_PLAN is None`).
 
 * **Injection** is driven by one conf
-  (`spark.rapids.tpu.test.faults = "<point>:prob=P,seed=S,kind=K[,max=N][;...]"`,
-  kind in io|device|corrupt) and keyed on (task_id, work-item key,
+  (`spark.rapids.tpu.test.faults = "<point>:prob=P,seed=S,kind=K[,max=N][,ms=N][;...]"`,
+  kind in io|device|corrupt|delay) and keyed on (task_id, work-item key,
   per-sequence call index): the decision is a pure hash of
   (seed, point, task, key, index) — no wall clock, no RNG state. Sites
   evaluated on pool/producer threads pass their work-item identity as
@@ -70,7 +70,7 @@ FAULT_POINTS: Dict[str, str] = {
                           "(shuffle/manager.py read_partition_maps)",
 }
 
-KINDS = ("io", "device", "corrupt")
+KINDS = ("io", "device", "corrupt", "delay")
 
 
 # ---------------------------------------------------------------------------
@@ -94,6 +94,21 @@ class TpuTaskRetryError(RuntimeError):
 class IntegrityError(TpuTaskRetryError):
     """Checksum mismatch on a spill file or shuffle block: the bytes are
     quarantined, the only recovery is recomputation (task retry)."""
+
+
+class QueryStalledError(TpuTaskRetryError):
+    """The progress watchdog (exec/speculation_shield.py) declared this
+    attempt's driving seam stalled under `stall.action=retry-seam`:
+    the attempt is abandoned at its next cancellation checkpoint and
+    re-executed on the bounded task-retry lane."""
+
+
+class DispatchTimeoutError(TpuTaskRetryError):
+    """A dispatched device program was not ready inside
+    `dispatch.timeoutMs` (exec/speculation_shield.timed_call): the
+    wedged call is abandoned on its watchdog thread and the attempt
+    re-executes — the engine analog of a task killed on a hung
+    device."""
 
 
 class InjectedIOError(OSError):
@@ -155,15 +170,18 @@ def classify(exc: BaseException) -> str:
 # ---------------------------------------------------------------------------
 
 class _PointSpec:
-    __slots__ = ("point", "prob", "seed", "kind", "max_injections")
+    __slots__ = ("point", "prob", "seed", "kind", "max_injections",
+                 "delay_ms")
 
     def __init__(self, point: str, prob: float, seed: int, kind: str,
-                 max_injections: Optional[int]):
+                 max_injections: Optional[int], delay_ms: int = 0):
         self.point = point
         self.prob = prob
         self.seed = seed
         self.kind = kind
         self.max_injections = max_injections
+        #: kind=delay only: injected latency per firing (ms)
+        self.delay_ms = delay_ms
 
 
 class FaultPlan:
@@ -242,6 +260,15 @@ class FaultPlan:
             raise InjectedIOError(point)
         if kind == "device":
             raise InjectedDeviceError(point)
+        if kind == "delay":
+            # a deterministic straggler, not a failure: the call blocks
+            # for the armed ms and proceeds with its data untouched —
+            # the reproducible slow participant every watchdog /
+            # speculation test needs (budget, stats and the
+            # fault_inject event were accounted by decide() above)
+            import time
+            time.sleep(self.specs[point].delay_ms / 1000.0)
+            return data
         pos = zlib.crc32(f"pos:{point}:{len(data)}".encode()) % len(data)
         out = bytearray(data)
         out[pos] ^= 0xFF
@@ -254,9 +281,9 @@ class FaultPlan:
 
 def parse_faults(spec: str) -> Optional[FaultPlan]:
     """Parse the conf grammar:
-    `<point>:prob=P,seed=S,kind=io|device|corrupt[,max=N][;<point>:...]`.
-    Unknown points or kinds fail loudly — a typo'd chaos spec silently
-    injecting nothing is worse than an error."""
+    `<point>:prob=P,seed=S,kind=io|device|corrupt|delay[,max=N][,ms=N]
+    [;<point>:...]`. Unknown points or kinds fail loudly — a typo'd
+    chaos spec silently injecting nothing is worse than an error."""
     spec = (spec or "").strip()
     if not spec:
         return None
@@ -270,7 +297,7 @@ def parse_faults(spec: str) -> Optional[FaultPlan]:
         if point not in FAULT_POINTS:
             raise ValueError(f"unknown fault point {point!r}; known: "
                              f"{sorted(FAULT_POINTS)}")
-        prob, seed, kind, max_inj = 1.0, 0, "io", None
+        prob, seed, kind, max_inj, delay_ms = 1.0, 0, "io", None, 0
         for kv in kvs.split(","):
             kv = kv.strip()
             if not kv:
@@ -288,9 +315,14 @@ def parse_faults(spec: str) -> Optional[FaultPlan]:
                 kind = v
             elif k == "max":
                 max_inj = int(v)
+            elif k == "ms":
+                delay_ms = int(v)
             else:
                 raise ValueError(f"unknown fault option {k!r} for {point}")
-        specs[point] = _PointSpec(point, prob, seed, kind, max_inj)
+        if kind == "delay" and delay_ms <= 0:
+            raise ValueError(f"kind=delay for {point} requires ms=N > 0")
+        specs[point] = _PointSpec(point, prob, seed, kind, max_inj,
+                                  delay_ms=delay_ms)
     return FaultPlan(specs, spec) if specs else None
 
 

@@ -174,9 +174,15 @@ class DispatchLedger:
     lock-blocking-call contract)."""
 
     def __init__(self, storm_traces: int = 8,
-                 storm_window_ms: int = 10_000):
+                 storm_window_ms: int = 10_000, timeout_ms: int = 0):
         self.storm_traces = max(1, int(storm_traces))
         self.storm_window_ms = max(1, int(storm_window_ms))
+        #: dispatch hang bound (ISSUE 20): > 0 routes every dispatch
+        #: through a watchdog-timed helper thread that also blocks
+        #: until the program's outputs are ready — a wedged device
+        #: program becomes a transient DispatchTimeoutError instead of
+        #: hanging the process. 0 (the default) = the plain inline path.
+        self.timeout_ms = max(0, int(timeout_ms))
         self._lock = threading.Lock()
         self._programs: Dict[Tuple, ProgramStats] = {}
         self._dispatches = 0
@@ -199,12 +205,17 @@ class DispatchLedger:
         # discriminate on
         site_first = bucket not in site._seen_buckets
         pend = _Pending()
-        _tls.pending = pend
         t0 = time.perf_counter_ns()
         try:
-            return site._jit(*args, **kwargs)
+            if self.timeout_ms > 0:
+                return _timed_dispatch(site, args, kwargs, pend,
+                                       self.timeout_ms)
+            _tls.pending = pend
+            try:
+                return site._jit(*args, **kwargs)
+            finally:
+                _tls.pending = None
         finally:
-            _tls.pending = None
             if pend.traced and site_first:
                 site._seen_buckets.add(bucket)
             self._account(site, key, pend, site_first,
@@ -311,6 +322,32 @@ class DispatchLedger:
             return [p.to_dict() for p in self._programs.values()]
 
 
+def _timed_dispatch(site: "InstrumentedJit", args, kwargs,
+                    pend: _Pending, timeout_ms: int):
+    """Hang-bounded dispatch (ISSUE 20): the program runs — and is
+    blocked until ready, so a wedged device execution cannot hide
+    behind async dispatch — on a watchdog-timed helper thread. The
+    helper adopts the caller's pending frame (jax traces on the calling
+    thread, which is the helper here); the breaker domain comes from
+    the thread-local override so the ICI collective seam books its
+    timeouts against `ici_exchange` (exec/speculation_shield)."""
+    from ..exec import speculation_shield
+    domain = speculation_shield.current_dispatch_domain()
+
+    def run():
+        _tls.pending = pend
+        try:
+            out = site._jit(*args, **kwargs)
+            import jax
+            jax.block_until_ready(out)
+            return out
+        finally:
+            _tls.pending = None
+
+    return speculation_shield.timed_call(run, timeout_ms, domain,
+                                         site.label)
+
+
 _ledger: Optional[DispatchLedger] = DispatchLedger()
 _ledger_lock = threading.Lock()
 
@@ -329,20 +366,23 @@ def configure(conf=None) -> Optional[DispatchLedger]:
     Storm thresholds are re-read here — never per dispatch."""
     global _ledger
     from ..config import (DISPATCH_LEDGER_ENABLED, DISPATCH_STORM_TRACES,
-                          DISPATCH_STORM_WINDOW_MS, active_conf)
+                          DISPATCH_STORM_WINDOW_MS, DISPATCH_TIMEOUT_MS,
+                          active_conf)
     conf = conf if conf is not None else active_conf()
     enabled = conf.get(DISPATCH_LEDGER_ENABLED)
     traces = conf.get(DISPATCH_STORM_TRACES)
     window = conf.get(DISPATCH_STORM_WINDOW_MS)
+    timeout = conf.get(DISPATCH_TIMEOUT_MS)
     with _ledger_lock:
         if not enabled:
             _ledger = None
             return None
         if _ledger is None:
-            _ledger = DispatchLedger(traces, window)
+            _ledger = DispatchLedger(traces, window, timeout)
         else:
             _ledger.storm_traces = max(1, int(traces))
             _ledger.storm_window_ms = max(1, int(window))
+            _ledger.timeout_ms = max(0, int(timeout))
         return _ledger
 
 

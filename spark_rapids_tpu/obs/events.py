@@ -9,7 +9,8 @@ each line a self-describing record:
 Event kinds and their levels (spark.rapids.tpu.eventLog.level):
 
   ESSENTIAL  query_start, query_end, query_cancelled, query_shed,
-             recompile_storm, query_phases, adaptive_demote
+             recompile_storm, query_phases, adaptive_demote,
+             query_stalled
   MODERATE   op_close, semaphore_acquire, spill, oom_retry,
              pallas_tier, plan_fallback, plan_not_on_tpu, exchange,
              pipeline_wait, pipeline_full, op_error, fault_inject,
@@ -145,6 +146,15 @@ EVENT_LEVELS: Dict[str, int] = {
     # replan lane itself stood down (breaker_open / error)
     "adaptive_replan": MODERATE,
     "adaptive_demote": ESSENTIAL,
+    # straggler & stall shield (ISSUE 20): a stalled governed query is
+    # headline (its SLO is already lost — the event names the frozen
+    # seam and the phase the time went into); speculative sub-read
+    # resolutions, dispatch hang-bound trips and dead-peer map-output
+    # invalidations are MODERATE, like the other recovery-lane records
+    "query_stalled": ESSENTIAL,
+    "speculative_fetch": MODERATE,
+    "dispatch_timeout": MODERATE,
+    "map_output_invalidated": MODERATE,
     "op_open": DEBUG,
     "op_batch": DEBUG,
     "span": DEBUG,

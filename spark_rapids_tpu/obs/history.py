@@ -14,7 +14,7 @@ query:
      "skew": {...},                            # worst exchange skew
      "dispatch": {...}, "shuffle": {...},      # per-query counter deltas
      "ici": {...}, "upload": {...}, "workload": {...},
-     "encoded": {...}}
+     "encoded": {...}, "speculation": {...}}
 
 The capsule joins across runs on `fingerprint`
 (exec/base.TpuExec.plan_fingerprint — canonical plan identity,
@@ -188,7 +188,7 @@ def process_counters() -> Dict[str, Dict[str, int]]:
     Read only when a store is active (collect checks active_store()
     first), so disabled-mode collects never pay these imports."""
     from ..columnar import encoded, upload
-    from ..exec import adaptive, workload
+    from ..exec import adaptive, speculation_shield, workload
     from ..obs import dispatch as obs_dispatch
     from ..shuffle import manager as shuffle_manager
     return {
@@ -199,6 +199,7 @@ def process_counters() -> Dict[str, Dict[str, int]]:
         "workload": workload.counters(),
         "encoded": encoded.counters(),
         "adaptive": adaptive.counters(),
+        "speculation": speculation_shield.counters(),
     }
 
 

@@ -62,6 +62,8 @@ PHASES = (
     "semaphore-wait",      # device admission (memory/semaphore.py)
     "pipeline-stall",      # consumer blocked on producer (exec/pipeline.py)
     "retry-backoff",       # task-retry + OOM-retry backoff sleeps
+    "spec-wait",           # post-bound straggler wait the speculation
+                           # shield raced against (exec/speculation_shield)
     "other",               # derived remainder — never negative
 )
 
@@ -207,6 +209,19 @@ class PhaseLedger:
         if self._wall is None:
             self._wall = time.perf_counter_ns() - self._t0
         return self._wall
+
+    def dominant_phase(self) -> Optional[str]:
+        """The largest phase accrued SO FAR, read mid-flight without
+        closing the measurement window (the stall watchdog's `query
+        stuck in <phase>` attribution — snapshot() would freeze wall).
+        None when nothing has accrued yet."""
+        with self._lock:
+            merged = dict(self._direct)
+            for p, v in self._folded.items():
+                merged[p] = merged.get(p, 0) + v
+        if not merged:
+            return None
+        return max(merged, key=merged.get)
 
     @property
     def wall_ns(self) -> int:

@@ -56,6 +56,20 @@ class HeartbeatManager:
         #: peers already reported dead (one peer_dead event per
         #: live->dead transition; a returning beat re-arms it)
         self._reported_dead: set = set()
+        #: reported-dead peers' slots (ISSUE 20): withheld from the
+        #: exchange/planner surfaces until the peer re-registers (the
+        #: returning beat clears the entry) or is purged (the peer is
+        #: forgotten entirely and its slot recycles, the ISSUE 7
+        #: bounded-registry contract)
+        self._blacklist: Dict[str, int] = {}
+        #: peers purged over this manager's lifetime (health surface)
+        self._purged = 0
+        #: dead-peer transition hook (ISSUE 20): called OUTSIDE the
+        #: lock, once per live->dead transition, with the executor id —
+        #: parallel.heartbeat.install wires it to the speculation
+        #: shield's map-output invalidation. None = no glue (the
+        #: default for a bare test manager).
+        self.on_peer_dead: Optional[Callable[[str], None]] = None
 
     def _purge_locked(self, now: float,
                       keep: Optional[str] = None) -> List[tuple]:
@@ -78,16 +92,33 @@ class HeartbeatManager:
             if executor_id not in self._reported_dead:
                 unreported.append((executor_id, now - peer.last_beat))
             self._reported_dead.discard(executor_id)
+            # the purge forgets the peer entirely: its blacklist entry
+            # goes with it (the recycled slot belongs to nobody)
+            self._blacklist.pop(executor_id, None)
+            self._purged += 1
         return unreported
 
     def _emit_dead(self, fresh) -> None:
         """One peer_dead event per live->dead transition — emitted
-        outside the lock."""
+        outside the lock, then the on_peer_dead hook (ISSUE 20: the
+        speculation shield invalidates the dead peer's map outputs
+        here). A hook failure must not kill the poller that happened
+        to notice the transition."""
         for executor_id, silent_s in fresh:
             from ..obs import events as obs_events
             obs_events.emit("peer_dead", executor_id=executor_id,
                             silent_ms=int(silent_s * 1000),
                             timeout_ms=int(self.timeout_s * 1000))
+            hook = self.on_peer_dead
+            if hook is not None:
+                try:
+                    hook(executor_id)
+                except Exception:  # noqa: BLE001 — see docstring
+                    import logging
+                    logging.getLogger(
+                        "spark_rapids_tpu.parallel").warning(
+                        "on_peer_dead hook failed for %s", executor_id,
+                        exc_info=True)
 
     def _register_locked(self, executor_id: str,
                          host: str = "local") -> List[PeerInfo]:
@@ -108,6 +139,9 @@ class HeartbeatManager:
         else:
             self._peers[executor_id].last_beat = now
         self._reported_dead.discard(executor_id)
+        # the returning peer re-registers: its slot comes off the
+        # blacklist (ISSUE 20 — the dead-peer quarantine ends here)
+        self._blacklist.pop(executor_id, None)
         return [p for p in self._peers.values()
                 if p.executor_id != executor_id]
 
@@ -136,6 +170,7 @@ class HeartbeatManager:
                 prev = me.last_beat
                 me.last_beat = now
                 self._reported_dead.discard(executor_id)
+                self._blacklist.pop(executor_id, None)
                 peers = [p for p in self._peers.values()
                          if p.executor_id != executor_id
                          and p.registered_at > prev]
@@ -163,10 +198,79 @@ class HeartbeatManager:
             fresh = [(e, now - self._peers[e].last_beat) for e in dead
                      if e not in self._reported_dead]
             self._reported_dead.update(e for e, _ in fresh)
+            # a freshly dead peer's slot is blacklisted: withheld from
+            # every planning surface until it re-registers (or the
+            # purge forgets the peer and recycles the slot)
+            for e, _ in fresh:
+                self._blacklist[e] = self._peers[e].slot
         # liveness is observable (ISSUE 6 satellite): one peer_dead
         # event per live->dead transition — emitted outside the lock
         self._emit_dead(purged + fresh)
         return dead
+
+    def blacklisted_slots(self) -> Dict[str, int]:
+        """executor_id -> slot for peers currently dead-and-quarantined
+        (ISSUE 20): withheld from planning until re-registration."""
+        with self._lock:
+            return dict(self._blacklist)
+
+    def health_section(self) -> Dict[str, object]:
+        """The TpuSession.health()["peers"] payload: live/dead peer
+        ids, lifetime purge count and the blacklisted slots. Polls the
+        registry (so stale transitions report), like dead_peers()."""
+        now = time.monotonic()
+        with self._lock:
+            purged = self._purge_locked(now)
+            live, dead = [], []
+            for p in self._peers.values():
+                (dead if now - p.last_beat > self.timeout_s
+                 else live).append(p.executor_id)
+            out = {"enabled": True, "live": sorted(live),
+                   "dead": sorted(dead), "purged": self._purged,
+                   "blacklisted_slots": dict(self._blacklist)}
+        self._emit_dead(purged)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# process-wide manager registry (ISSUE 20): the session health surface
+# and the dead-peer -> map-output-invalidation glue need ONE nominated
+# manager; a bare test manager stays un-wired unless installed.
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[HeartbeatManager] = None
+_active_lock = threading.Lock()
+
+
+def install(manager: Optional[HeartbeatManager]) -> None:
+    """Nominate `manager` as the process's heartbeat registry (None =
+    clear, test isolation). Wires its on_peer_dead hook to the
+    speculation shield's map-output invalidation — the conf gate
+    (`shuffle.deadPeerInvalidation.enabled`) is consulted inside the
+    hook at transition time, so installing is unconditional."""
+    global _ACTIVE
+    with _active_lock:
+        prev, _ACTIVE = _ACTIVE, manager
+    if prev is not None and prev is not manager:
+        prev.on_peer_dead = None
+    if manager is not None:
+        from ..exec import speculation_shield
+        manager.on_peer_dead = speculation_shield.on_peer_dead
+
+
+def active_manager() -> Optional[HeartbeatManager]:
+    return _ACTIVE
+
+
+def health_section() -> Dict[str, object]:
+    """`TpuSession.health()["peers"]`: the installed manager's liveness
+    surface, or the explicit disabled shape when no manager runs (the
+    default single-process session)."""
+    mgr = _ACTIVE
+    if mgr is None:
+        return {"enabled": False, "live": [], "dead": [], "purged": 0,
+                "blacklisted_slots": {}}
+    return mgr.health_section()
 
 
 class HeartbeatEndpoint:

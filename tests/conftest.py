@@ -160,6 +160,22 @@ def _adaptive_counters_reset():
 
 
 @pytest.fixture(scope="module", autouse=True)
+def _speculation_shield_reset():
+    """Straggler-shield hygiene (ISSUE 20, the adaptive pattern): the
+    shield counters (stalls, spec wins/denials, dispatch timeouts,
+    peer invalidations) are process-wide and asserted as deltas, and a
+    heartbeat manager left installed would keep routing peer_dead
+    transitions into later suites — zero both at module boundaries."""
+    from spark_rapids_tpu.exec import speculation_shield
+    from spark_rapids_tpu.parallel import heartbeat
+    speculation_shield.reset_shield()
+    heartbeat.install(None)
+    yield
+    speculation_shield.reset_shield()
+    heartbeat.install(None)
+
+
+@pytest.fixture(scope="module", autouse=True)
 def _no_leaked_lifecycle_state():
     """Lifecycle-governor hygiene (ISSUE 6, same pattern as the leaked
     fault plan): a breaker left open would silently demote a kernel

@@ -1,0 +1,31 @@
+"""Contract-analyzer fixture (never imported): the `bounded-wait` rule
+FIRES on each provably unbounded rendezvous here, and stays quiet on
+every bounded / non-blocking form. Waitables arrive as arguments so no
+other rule (thread-adopt, lock-discipline) has anything to say."""
+
+import time
+
+
+def parked_on_event(ev):
+    ev.wait()  # bounded-wait: no timeout
+
+
+def parked_on_queue(q):
+    return q.get()  # bounded-wait: queue get with no timeout
+
+
+def parked_on_future(fut):
+    return fut.result()  # bounded-wait: result with no timeout
+
+
+def bounded_forms(ev, fut, d, q):
+    ev.wait(5)                  # positional bound — clean
+    fut.result(timeout=2)       # keyword bound — clean
+    d.get("key")                # dict lookup, positional args — clean
+    q.get(timeout=0.1)          # bounded queue get — clean
+    time.sleep(0.01)            # duration IS the positional — clean
+
+
+def splat_forms(ev, args, kwargs):
+    ev.wait(*args)      # bound may ride the splat — unprovable, clean
+    ev.wait(**kwargs)   # same for keyword splat

@@ -1,0 +1,21 @@
+"""Contract-analyzer fixture (never imported): the same unbounded
+rendezvous sites as fx_bounded_wait.py, each silenced by a justified
+bounded-wait suppression in the standard grammar."""
+
+
+def worker_loop(q):
+    while True:
+        # contract: ok bounded-wait — fixture: daemon feed queue,
+        # parked-on-empty is its idle state; a sentinel unparks it
+        job = q.get()
+        if job is None:
+            return
+
+
+def drain(fut):
+    # contract: ok bounded-wait — fixture: producer owns the deadline
+    return fut.result()
+
+
+def rendezvous(ev):
+    ev.wait()  # contract: ok bounded-wait — fixture: signaled in finally

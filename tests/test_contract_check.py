@@ -76,6 +76,18 @@ def test_lock_rules_fire():
     assert by_rule["lock-order"][0].key == "fx-lock->fx-outer"
 
 
+def test_bounded_wait_rule_fires():
+    """ISSUE 20 satellite: provably unbounded waits (zero positional
+    args, no timeout= kwarg) on wait/get/result/sleep fire; bounded,
+    positional-arg (dict.get) and splat forms stay clean."""
+    rep = run_fixture("fx_bounded_wait.py")
+    assert rules_fired(rep) == ["bounded-wait"]
+    got = {(f.scope, f.key) for f in rep.findings}
+    assert got == {("parked_on_event", "ev.wait"),
+                   ("parked_on_queue", "q.get"),
+                   ("parked_on_future", "fut.result")}, got
+
+
 def test_thread_rule_fires_and_resolves_adoption():
     rep = run_fixture("fx_threads.py")
     assert rules_fired(rep) == ["thread-adopt"]
@@ -154,6 +166,7 @@ def test_registry_rules_fire():
 
 @pytest.mark.parametrize("fname,n_suppressed", [
     ("fx_locks_ok.py", 4),
+    ("fx_bounded_wait_ok.py", 3),
     ("fx_threads_ok.py", 2),
     ("fx_trace_ok.py", 4),
     ("fx_conf_ok.py", 1),
@@ -346,9 +359,9 @@ def test_every_rule_family_is_fixture_proven():
     fixture where it fires (the per-rule tests above pin the details —
     this keeps a NEW rule from landing without a fixture)."""
     fired = set()
-    for fname in ("fx_locks.py", "fx_threads.py", "fx_trace.py",
-                  "fx_conf.py", "fx_accounting.py", "fx_registry.py",
-                  "fx_dispatch.py", "fx_stage.py"):
+    for fname in ("fx_locks.py", "fx_bounded_wait.py", "fx_threads.py",
+                  "fx_trace.py", "fx_conf.py", "fx_accounting.py",
+                  "fx_registry.py", "fx_dispatch.py", "fx_stage.py"):
         for f in run_fixture(fname).findings:
             fired.add(f.rule)
     non_meta = {rid for rid, m in reg_mod.RULES.items()

@@ -153,6 +153,27 @@ def test_workload_tables_match_registry():
         f"stale={sorted(rows - expected)}")
 
 
+def test_stall_actions_table_matches_registry():
+    """docs/robustness.md's stall-action table lists exactly
+    speculation_shield.STALL_ACTIONS (ISSUE 20: the breaker-table drift
+    discipline for the progress watchdog's closed action set), scoped
+    to the shield section."""
+    from spark_rapids_tpu.exec import speculation_shield
+    docs = (ROOT / "docs" / "robustness.md").read_text()
+    m = re.search(r"## Straggler & stall shield\n(.*?)(?:\n## |\Z)",
+                  docs, re.DOTALL)
+    assert m, "docs/robustness.md lost its straggler-shield section"
+    # the action table nests inside the watchdog bullet, so rows carry
+    # the bullet's indent
+    rows = set(re.findall(r"^\s*\|\s*`([a-z][a-z-]*)`\s*\|", m.group(1),
+                          re.MULTILINE))
+    expected = set(speculation_shield.STALL_ACTIONS)
+    assert rows == expected, (
+        f"docs/robustness.md stall-action table drifted: "
+        f"missing={sorted(expected - rows)} "
+        f"stale={sorted(rows - expected)}")
+
+
 def test_robustness_event_kinds_are_registered():
     """Every event kind the robustness layer emits is in
     obs.events.EVENT_LEVELS (an unregistered kind silently defaults to

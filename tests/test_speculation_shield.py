@@ -1,0 +1,502 @@
+"""Straggler & stall shield (ISSUE 20): progress-watchdog units
+(fire/re-arm, the retry-seam verdict consumed at the next cancellation
+checkpoint, cancel), the deterministic `delay` fault kind, speculative
+shuffle sub-reads (bound floor, first-result-wins race, slot denial,
+both-fail error identity, and the e2e speculation-win drive under
+injected delay with ZERO whole-plan retries), the dispatch hang bound
+(timed_call + breaker domain override + the ledger chokepoint), and
+dead-peer map-output invalidation through the partition-granular
+recompute lane.
+
+Deterministic on single-core CPU: stalls are real frozen contexts with
+generous multiples of tiny windows; the injected straggler is the
+seeded `kind=delay` plan (max=1 — the `spec:`-salted duplicate draws
+from an exhausted budget, so the duplicate is provably fast)."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import QueryCancelledError
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.exec import lifecycle, speculation_shield
+from spark_rapids_tpu.exec.speculation_shield import (ProgressWatchdog,
+                                                      ReadSpeculation,
+                                                      dispatch_domain,
+                                                      current_dispatch_domain,
+                                                      timed_call,
+                                                      watchdog_for)
+from spark_rapids_tpu.faults import (DispatchTimeoutError,
+                                     QueryStalledError,
+                                     TpuTaskRetryError)
+from spark_rapids_tpu.obs import events
+from spark_rapids_tpu.parallel import heartbeat
+from spark_rapids_tpu.parallel.heartbeat import HeartbeatManager
+from spark_rapids_tpu.shuffle.manager import (HostShuffleReader,
+                                              HostShuffleWriter,
+                                              partition_batch_host,
+                                              shuffle_manager)
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.types import LONG, Schema
+
+FAST = {
+    "spark.rapids.tpu.io.retryBackoffMs": "1",
+    "spark.rapids.tpu.task.retryBackoffMs": "1",
+    "spark.rapids.tpu.retry.backoffMs": "1",
+}
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    """Every test starts with zeroed shield counters, no heartbeat
+    manager, no injection, no governed contexts, the conf restored."""
+    prev = C.active_conf()
+    faults.install(None)
+    lifecycle.reset_lifecycle()
+    speculation_shield.reset_shield()
+    heartbeat.install(None)
+    yield
+    faults.install(None)
+    lifecycle.reset_lifecycle()
+    speculation_shield.reset_shield()
+    heartbeat.install(None)
+    C.set_active_conf(prev)
+
+
+@pytest.fixture
+def spy(monkeypatch):
+    rows = []
+    real = events.emit
+
+    def spy_emit(kind, **fields):
+        rows.append({"kind": kind, **fields})
+        real(kind, **fields)
+
+    monkeypatch.setattr(events, "emit", spy_emit)
+    return rows
+
+
+def _kinds(rows, kind):
+    return [r for r in rows if r["kind"] == kind]
+
+
+def _wait_for(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# progress watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_disabled_by_default():
+    ctx = lifecycle.QueryContext()
+    assert watchdog_for(ctx, C.active_conf()) is None
+    conf = C.RapidsConf({"spark.rapids.tpu.stall.timeoutMs": "0"})
+    assert watchdog_for(ctx, conf) is None
+
+
+def test_watchdog_fires_and_rearms_on_a_frozen_seam(spy):
+    """A context advancing no batches/rows for the window fires ONE
+    query_stalled per episode and re-arms — a query frozen for several
+    windows reports several episodes, not a storm per poll."""
+    ctx = lifecycle.QueryContext()
+    ctx.current_op = "HashAggregateExec"
+    dog = ProgressWatchdog(ctx, 50, "report")
+    dog.start()
+    try:
+        assert _wait_for(lambda: len(_kinds(spy, "query_stalled")) >= 2)
+    finally:
+        dog.stop()
+    evs = _kinds(spy, "query_stalled")
+    assert len(evs) >= 2
+    first = evs[0]
+    assert first["action"] == "report"
+    assert first["seam"] == "HashAggregateExec"
+    assert first["attempt"] == 1
+    assert first["stalled_ms"] >= 50 and first["timeout_ms"] == 50
+    # the poll cadence is ~4x the window: episodes must be ~one per
+    # window, not one per poll tick
+    assert speculation_shield.counters()["stalls"] == len(evs)
+    # report action never touches the query
+    assert not ctx.cancelled() and not ctx.stall_retry
+
+
+def test_watchdog_stays_quiet_while_progress_flows(spy):
+    ctx = lifecycle.QueryContext()
+    ctx.root_op_id = 7
+    dog = ProgressWatchdog(ctx, 60, "report")
+    dog.start()
+    try:
+        end = time.monotonic() + 0.3
+        while time.monotonic() < end:
+            ctx.note_batch("ScanExec", 7, 10)  # root output: progress
+            time.sleep(0.01)
+    finally:
+        dog.stop()
+    assert _kinds(spy, "query_stalled") == []
+    assert speculation_shield.counters()["stalls"] == 0
+
+
+def test_watchdog_retry_seam_fails_the_attempt_transiently(spy):
+    """stall.action=retry-seam: the watchdog flags the attempt; the
+    NEXT cancellation checkpoint raises QueryStalledError — a
+    task-lane (transient) error consumed once, so the retried attempt
+    starts clean."""
+    ctx = lifecycle.QueryContext()
+    dog = ProgressWatchdog(ctx, 50, "retry-seam")
+    dog.start()
+    try:
+        assert _wait_for(lambda: ctx.stall_retry)
+    finally:
+        dog.stop()
+    with pytest.raises(QueryStalledError) as ei:
+        ctx.check("compute")
+    assert isinstance(ei.value, TpuTaskRetryError)  # task-retry lane
+    ctx.check("compute")  # the flag was consumed: attempt runs clean
+    assert speculation_shield.counters()["stall_retries"] >= 1
+    assert _kinds(spy, "query_stalled")[0]["action"] == "retry-seam"
+
+
+def test_watchdog_cancel_action_cancels_through_the_token(spy):
+    ctx = lifecycle.QueryContext()
+    dog = ProgressWatchdog(ctx, 50, "cancel")
+    dog.start()
+    try:
+        assert _wait_for(ctx.cancelled)
+    finally:
+        dog.stop()
+    assert ctx.reason == "stalled"
+    with pytest.raises(QueryCancelledError):
+        ctx.check("compute")
+    assert speculation_shield.counters()["stall_cancels"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# the deterministic `delay` fault kind (the injected straggler)
+# ---------------------------------------------------------------------------
+
+def test_delay_kind_sleeps_without_failing():
+    faults.install("shuffle.fetch:prob=1,seed=3,kind=delay,ms=60,max=1")
+    t0 = time.monotonic()
+    assert faults.apply("shuffle.fetch", b"abc", key="k") == b"abc"
+    assert time.monotonic() - t0 >= 0.055  # slept, data untouched
+    t1 = time.monotonic()
+    faults.apply("shuffle.fetch", b"abc", key="k2")  # budget exhausted
+    assert time.monotonic() - t1 < 0.05
+    assert faults.active_plan().stats()["shuffle.fetch"] == 1
+
+
+def test_delay_kind_requires_positive_ms():
+    with pytest.raises(ValueError):
+        faults.parse_faults("shuffle.fetch:prob=1,seed=1,kind=delay")
+
+
+# ---------------------------------------------------------------------------
+# speculative sub-reads: policy units
+# ---------------------------------------------------------------------------
+
+def test_bound_floor_and_measured_growth():
+    spec = ReadSpeculation(3.0, 100, 2)
+    assert spec.bound_ms("fetch") == 100  # cold histogram: the floor
+    for _ in range(64):
+        with spec._lock:
+            spec._hists["fetch"].add(400)
+    assert spec.bound_ms("fetch") > 100  # p95 x multiplier took over
+    assert spec.bound_ms("decode") == 100  # stages measure separately
+
+
+def test_fast_primary_never_speculates(spy):
+    spec = ReadSpeculation(3.0, 50, 2)
+    with ThreadPoolExecutor(1) as pool:
+        out = spec.resolve("fetch", pool.submit(lambda: "ok"),
+                           launch=lambda: pytest.fail("speculated"),
+                           key="m0:0")
+    assert out == "ok"
+    assert speculation_shield.counters()["spec_launched"] == 0
+    assert _kinds(spy, "speculative_fetch") == []
+
+
+def test_straggling_primary_races_one_duplicate_spec_wins(spy):
+    release = threading.Event()
+    spec = ReadSpeculation(3.0, 20, 2)
+    with ThreadPoolExecutor(2) as pool:
+        primary = pool.submit(release.wait, 10)
+        try:
+            out = spec.resolve(
+                "fetch", primary,
+                launch=lambda: pool.submit(lambda: "dup"), key="m0:0")
+        finally:
+            release.set()
+    assert out == "dup"
+    c = speculation_shield.counters()
+    assert c["spec_launched"] == 1 and c["spec_wins"] == 1
+    assert c["spec_wait_ns"] > 0  # post-bound exposure accrued
+    (ev,) = _kinds(spy, "speculative_fetch")
+    assert ev["winner"] == "spec" and ev["stage"] == "fetch"
+    assert ev["key"] == "m0:0" and ev["bound_ms"] >= 20
+
+
+def test_denied_straggler_waits_out_its_primary(spy):
+    assert speculation_shield._take_slot(1)  # occupy the only slot
+    try:
+        spec = ReadSpeculation(3.0, 10, 1)
+        with ThreadPoolExecutor(1) as pool:
+            primary = pool.submit(lambda: (time.sleep(0.1), "slow")[1])
+            out = spec.resolve("fetch", primary,
+                               launch=lambda: pytest.fail("no slot"),
+                               key="m0:0")
+    finally:
+        speculation_shield._release_slot()
+    assert out == "slow"
+    c = speculation_shield.counters()
+    assert c["spec_denied"] == 1 and c["spec_launched"] == 0
+    assert c["spec_wait_ns"] > 0  # denial still measures the exposure
+    assert _kinds(spy, "speculative_fetch") == []
+
+
+def test_both_attempts_failing_surfaces_the_primary_error(spy):
+    def slow_boom():
+        time.sleep(0.05)
+        raise ValueError("primary fault identity")
+
+    def fast_boom():
+        raise RuntimeError("duplicate fault")
+
+    spec = ReadSpeculation(3.0, 10, 2)
+    with ThreadPoolExecutor(2) as pool:
+        with pytest.raises(ValueError, match="primary fault identity"):
+            spec.resolve("fetch", pool.submit(slow_boom),
+                         launch=lambda: pool.submit(fast_boom),
+                         key="m0:0")
+    (ev,) = _kinds(spy, "speculative_fetch")
+    assert ev["winner"] == "none"
+    c = speculation_shield.counters()
+    assert c["spec_wins"] == 0 and c["spec_primary_wins"] == 0
+
+
+# ---------------------------------------------------------------------------
+# e2e: the speculation-win drive (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def _shuffle_query_data():
+    rng = np.random.default_rng(7)
+    data = {"k": [int(x) for x in rng.integers(0, 50, 2000)],
+            "v": [int(x) for x in rng.integers(0, 1000, 2000)]}
+    oracle = {}
+    for k, v in zip(data["k"], data["v"]):
+        oracle[k] = oracle.get(k, 0) + v
+    return data, sorted(oracle.items())
+
+
+def test_injected_straggler_loses_the_race_zero_plan_retries(spy):
+    """ISSUE 20 acceptance: a seeded `delay` straggler on ONE shuffle
+    fetch is raced by a speculative duplicate (the `spec:` salt draws
+    from the exhausted max=1 budget, so the duplicate is provably
+    fast), results equal the numpy oracle, and the whole-plan retry
+    lane never fires."""
+    data, oracle = _shuffle_query_data()
+    settings = dict(FAST, **{
+        "spark.rapids.sql.shuffle.partitions": "3",
+        "spark.rapids.sql.broadcastSizeThreshold": "-1",
+        "spark.rapids.tpu.test.faults":
+            "shuffle.fetch:prob=1,seed=1,kind=delay,ms=400,max=1",
+        "spark.rapids.tpu.shuffle.speculation.enabled": "true",
+        "spark.rapids.tpu.shuffle.speculation.minMs": "50",
+    })
+    sess = TpuSession(settings)
+    df = sess.from_pydict(data, Schema.of(k=LONG, v=LONG),
+                          batch_rows=500)
+    got = sorted(df.group_by("k").agg((F.sum("v"), "s")).collect())
+    assert got == oracle
+    c = speculation_shield.counters()
+    assert c["spec_wins"] >= 1, "the duplicate never won the race"
+    wins = [e for e in _kinds(spy, "speculative_fetch")
+            if e["winner"] == "spec"]
+    assert wins and wins[0]["stage"] == "fetch"
+    assert _kinds(spy, "task_retry") == [], \
+        "a straggler must not burn a whole-plan attempt"
+    assert _kinds(spy, "query_stalled") == []
+
+
+# ---------------------------------------------------------------------------
+# dispatch hang bound
+# ---------------------------------------------------------------------------
+
+def test_timed_call_passthrough_and_error_relay():
+    assert timed_call(lambda: 7, 1000, "device_dispatch", "x") == 7
+    with pytest.raises(KeyError):
+        timed_call(lambda: {}["missing"], 1000, "device_dispatch", "x")
+    assert speculation_shield.counters()["dispatch_timeouts"] == 0
+
+
+def test_timed_call_timeout_classifies_transient_and_trips_breaker(spy):
+    """A wedged call past the bound raises DispatchTimeoutError (task
+    lane), emits dispatch_timeout with its domain, and records a
+    breaker-domain failure — with breaker.threshold=1 the domain
+    opens."""
+    C.set_active_conf(C.RapidsConf({
+        "spark.rapids.tpu.breaker.enabled": "true",
+        "spark.rapids.tpu.breaker.threshold": "1",
+        "spark.rapids.tpu.breaker.windowMs": "60000",
+        "spark.rapids.tpu.breaker.cooldownMs": "60000",
+    }))
+    wedged = threading.Event()
+    with pytest.raises(DispatchTimeoutError) as ei:
+        timed_call(lambda: wedged.wait(10), 50, "ici_exchange", "a2a")
+    wedged.set()  # unpark the abandoned helper
+    assert isinstance(ei.value, TpuTaskRetryError)
+    (ev,) = _kinds(spy, "dispatch_timeout")
+    assert ev["domain"] == "ici_exchange" and ev["timeout_ms"] == 50
+    assert speculation_shield.counters()["dispatch_timeouts"] == 1
+    assert "ici_exchange" in lifecycle.open_breakers()
+
+
+def test_dispatch_domain_override_nests_and_restores():
+    assert current_dispatch_domain() == "device_dispatch"
+    with dispatch_domain("ici_exchange"):
+        assert current_dispatch_domain() == "ici_exchange"
+        with dispatch_domain("device_dispatch"):
+            assert current_dispatch_domain() == "device_dispatch"
+        assert current_dispatch_domain() == "ici_exchange"
+    assert current_dispatch_domain() == "device_dispatch"
+
+
+def test_hang_bounded_dispatch_lane_runs_queries_correctly():
+    """dispatch.timeoutMs > 0 reroutes every ledger-chokepoint dispatch
+    through the watchdog helper (dispatch + block_until_ready on the
+    helper thread): results are unchanged and no bound trips."""
+    from spark_rapids_tpu.obs import dispatch as obs_dispatch
+    before = obs_dispatch.counters()["dispatches"]
+    sess = TpuSession({"spark.rapids.tpu.dispatch.timeoutMs": "30000"})
+    df = sess.from_pydict({"a": list(range(100))}, Schema.of(a=LONG))
+    (row,) = df.agg((F.sum("a"), "s")).collect()
+    assert row == (sum(range(100)),)
+    assert obs_dispatch.counters()["dispatches"] > before, \
+        "the timed lane never dispatched — the bound was not exercised"
+    assert speculation_shield.counters()["dispatch_timeouts"] == 0
+
+
+# ---------------------------------------------------------------------------
+# dead-peer map-output invalidation
+# ---------------------------------------------------------------------------
+
+SCH = Schema.of(k=LONG, v=LONG)
+
+
+def _write_two_maps(mgr, n_rows=64):
+    handle = mgr.register(2, SCH)
+    rows = []
+    for map_id in range(2):
+        b = ColumnarBatch.from_pydict(
+            {"k": [i % 2 for i in range(n_rows)],
+             "v": [map_id * 1000 + i for i in range(n_rows)]}, SCH)
+        parts = partition_batch_host(
+            b, np.array([i % 2 for i in range(n_rows)]), 2)
+        HostShuffleWriter(handle, map_id, mgr).write([[p] for p in parts])
+        rows += b.to_pylist()
+    return handle, rows
+
+
+def test_dead_peer_invalidates_outputs_and_recomputes_once(spy):
+    """The peer_dead transition invalidates the peer's bound map
+    outputs (exactly once), the next read re-executes lineage through
+    the partition-granular lane (trigger=dead_peer), the lineage-less
+    output falls back to its committed bytes, and the slot stays
+    blacklisted until the peer re-registers."""
+    mgr = shuffle_manager()
+    handle, rows = _write_two_maps(mgr)
+    with_lineage, without_lineage = handle.map_outputs
+    saved = {p: (open(p, "rb").read(), open(p + ".index", "rb").read())
+             for p in (with_lineage,)}
+    recomputes = []
+
+    def recompute():
+        recomputes.append(1)
+        data, idx = saved[with_lineage]
+        with open(with_lineage, "wb") as f:
+            f.write(data)
+        with open(with_lineage + ".index", "wb") as f:
+            f.write(idx)
+
+    handle.lineage[with_lineage] = recompute
+    mgr.bind_peer_output("exec-1", handle, with_lineage)
+    mgr.bind_peer_output("exec-1", handle, without_lineage)
+    try:
+        m = HeartbeatManager(timeout_s=0.05)
+        heartbeat.install(m)  # wires on_peer_dead to the shield
+        m.register("exec-1")
+        time.sleep(0.08)
+        assert m.dead_peers() == ["exec-1"]
+        # the transition hook ran: both outputs marked, exactly once
+        evs = _kinds(spy, "map_output_invalidated")
+        assert {e["map_path"] for e in evs} == {
+            p.rsplit("/", 1)[-1] for p in handle.map_outputs}
+        assert {e["has_lineage"] for e in evs} == {True, False}
+        assert handle.invalidated == set(handle.map_outputs)
+        c = speculation_shield.counters()
+        assert c["peer_invalidations"] == 1
+        assert c["outputs_invalidated"] == 2
+        assert m.blacklisted_slots() == {"exec-1": 0}
+        assert heartbeat.health_section()["dead"] == ["exec-1"]
+        # a second poll is not a second transition
+        m.dead_peers()
+        assert speculation_shield.counters()["outputs_invalidated"] == 2
+        # the read consumes the markers: lineage recomputes in place,
+        # the lineage-less output reads its committed bytes as-is
+        r = HostShuffleReader(handle, mgr)
+        got = [row for p in range(2) for b in r.read_partition(p)
+               for row in b.to_pylist()]
+        assert sorted(got, key=repr) == sorted(rows, key=repr)
+        assert recomputes == [1]
+        recs = _kinds(spy, "partition_recompute")
+        assert len(recs) == 1 and recs[0]["trigger"] == "dead_peer"
+        assert not handle.invalidated  # all markers consumed
+        assert _kinds(spy, "task_retry") == []
+        # re-registration clears the blacklist (the peer is back)
+        m.register("exec-1")
+        assert m.blacklisted_slots() == {}
+    finally:
+        mgr.unregister(handle)
+
+
+def test_invalidation_conf_gate_off_leaves_outputs_trusted(spy):
+    C.set_active_conf(C.RapidsConf({
+        "spark.rapids.tpu.shuffle.deadPeerInvalidation.enabled":
+            "false"}))
+    mgr = shuffle_manager()
+    handle, rows = _write_two_maps(mgr)
+    mgr.bind_peer_output("exec-9", handle, handle.map_outputs[0])
+    try:
+        m = HeartbeatManager(timeout_s=0.05)
+        heartbeat.install(m)
+        m.register("exec-9")
+        time.sleep(0.08)
+        assert m.dead_peers() == ["exec-9"]
+        assert handle.invalidated == set()
+        assert _kinds(spy, "map_output_invalidated") == []
+        assert speculation_shield.counters()["peer_invalidations"] == 0
+    finally:
+        mgr.unregister(handle)
+
+
+def test_session_health_reports_peer_section():
+    out = TpuSession({}).health()["peers"]
+    assert out == {"enabled": False, "live": [], "dead": [],
+                   "purged": 0, "blacklisted_slots": {}}
+    m = HeartbeatManager()
+    heartbeat.install(m)
+    m.register("e1")
+    out = TpuSession({}).health()["peers"]
+    assert out["enabled"] is True and out["live"] == ["e1"]
+    assert out["dead"] == [] and out["blacklisted_slots"] == {}

@@ -42,7 +42,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional
 PHASES = (
     "admission-wait", "compile", "device-compute", "host-pack-serialize",
     "shuffle-io", "ici-collective", "spill-wait", "semaphore-wait",
-    "pipeline-stall", "retry-backoff", "other",
+    "pipeline-stall", "retry-backoff", "spec-wait", "other",
 )
 
 
@@ -104,6 +104,7 @@ def aggregate(capsules: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
                 "skew": None,
                 "dispatch": {}, "shuffle": {}, "ici": {}, "upload": {},
                 "workload": {}, "encoded": {}, "adaptive": {},
+                "speculation": {},
             }
         a["count"] += 1
         a["ok"] += 1 if c.get("ok") else 0
@@ -122,7 +123,7 @@ def aggregate(capsules: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
                    or sk.get("ratio", 0) > a["skew"].get("ratio", 0)):
             a["skew"] = sk
         for fam in ("dispatch", "shuffle", "ici", "upload", "workload",
-                    "encoded", "adaptive"):
+                    "encoded", "adaptive", "speculation"):
             _sum_family(a[fam], c.get(fam))
     for a in by_fp.values():
         walls = sorted(a.pop("walls"))
@@ -277,6 +278,24 @@ def _check_encoded_scan(a: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             "share": round(sbytes / ubytes, 3)}
 
 
+def _check_straggler_prone(a: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    sp = a["speculation"]
+    wall = a["p50_wall_ns"]
+    wait = a["phase_mean_ns"].get("spec-wait", 0)
+    denied, wins = sp.get("spec_denied", 0), sp.get("spec_wins", 0)
+    # fire on either face of straggler exposure: wall-clock spent past
+    # the measured p95 bound, or the in-flight budget repeatedly
+    # refusing to race a straggler it detected
+    slow = wall > 0 and wait * 100 >= wall * 10
+    starved = denied > 0 and denied > wins
+    if not (slow or starved):
+        return None
+    return {"spec_wait_mean_ns": wait, "p50_wall_ns": wall,
+            "share": round(wait / wall, 3) if wall else 0.0,
+            "spec_launched": sp.get("spec_launched", 0),
+            "spec_wins": wins, "spec_denied": denied}
+
+
 def _check_quota_spills(a: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     spills = a["workload"].get("quota_spills", 0)
     total = a.get("_total_quota_spills", spills)
@@ -347,6 +366,17 @@ ADVISOR_RULES: tuple = (
         "this plan's concurrency share — it is thrashing its own "
         "working set",
         _check_quota_spills),
+    AdvisorRule(
+        "straggler-prone",
+        "the plan's shuffle reads repeatedly outlive their measured "
+        "p95 straggler bound (spec-wait >= 10% of wall, or speculation "
+        "denials outnumber wins)",
+        "raise spark.rapids.tpu.shuffle.speculation.maxInFlight so "
+        "denied stragglers get a duplicate raced instead of being "
+        "waited out, and check the storage path feeding the shuffle "
+        "dirs; if wins dominate, the duplicates are already saving "
+        "the tail",
+        _check_straggler_prone),
     AdvisorRule(
         "encoded-scan-eligible",
         "scans shipped decoded string bytes that dominate the "

@@ -312,6 +312,16 @@ def build_summary(events: List[Dict[str, Any]], top: int = 10,
                         "half_open": count("breaker_half_open"),
                         "close": count("breaker_close")},
             "partition_recomputes": count("partition_recompute")},
+        # straggler-shield roll-up (ISSUE 20): stall episodes by the
+        # configured action, speculative sub-read races by winner, hang
+        # bounds tripped by breaker domain, and dead-peer map-output
+        # invalidations. Zero-tolerant: pre-shield logs print nothing.
+        "speculation": {
+            "stalls": by("query_stalled", "action"),
+            "spec_fetches": count("speculative_fetch"),
+            "spec_winners": by("speculative_fetch", "winner"),
+            "dispatch_timeouts": by("dispatch_timeout", "domain"),
+            "outputs_invalidated": count("map_output_invalidated")},
         "workload": {
             "admissions": count("query_admitted"),
             "queued": count("query_queued"),
@@ -550,6 +560,29 @@ def build_report(events: List[Dict[str, Any]], top: int = 10,
                       f"partition-granular recompute(s), "
                       f"{rob['task_retries']} whole-plan "
                       "re-execution(s)")
+    # straggler-shield roll-up (ISSUE 20): reads right under the
+    # recovery lanes it feeds — a stalled/straggling run shows WHERE
+    # the shield intervened next to what the retry lanes then paid
+    sp = s["speculation"]
+    if sp["stalls"]:
+        n_stall = sum(sp["stalls"].values())
+        detail = ", ".join(f"{a}:{n}" for a, n
+                           in sorted(sp["stalls"].items()))
+        extras.append(f"query stalls: {n_stall} ({detail})")
+    if sp["spec_fetches"]:
+        w = sp["spec_winners"]
+        extras.append(
+            f"speculative sub-reads: {sp['spec_fetches']} "
+            f"({w.get('spec', 0)} spec won, "
+            f"{w.get('primary', 0)} primary won)")
+    if sp["dispatch_timeouts"]:
+        n_to = sum(sp["dispatch_timeouts"].values())
+        detail = ", ".join(f"{d}:{n}" for d, n
+                           in sorted(sp["dispatch_timeouts"].items()))
+        extras.append(f"dispatch hang bounds tripped: {n_to} ({detail})")
+    if sp["outputs_invalidated"]:
+        extras.append(f"dead-peer map outputs invalidated: "
+                      f"{sp['outputs_invalidated']}")
     # workload-governor roll-up (ISSUE 7): admission flow, sheds by
     # reason, and quota-triggered self-spills
     wl = s["workload"]
